@@ -1,0 +1,101 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on jax/XLA/Pallas.
+
+The public namespace mirrors ``paddle.*`` (SURVEY.md §2.2) so existing
+Paddle training scripts can switch imports (or alias ``paddle =
+paddle_tpu``) and run on TPU: tensors live in HBM as ``jax.Array``s,
+ops lower through XLA, parallelism is sharding over a
+``jax.sharding.Mesh`` instead of NCCL process groups.
+"""
+
+__version__ = "0.1.0"
+
+import os as _os
+
+# jax must see consistent platform config before first use; respect
+# user-set JAX_PLATFORMS (tests force cpu with a virtual 8-device mesh).
+import jax  # noqa: E402
+
+# Paddle's default integer dtype is int64 and float64 arrays round-trip;
+# jax truncates both unless x64 is on.  Compute dtypes stay f32/bf16
+# (weak typing keeps python scalars from promoting arrays).
+jax.config.update("jax_enable_x64", True)
+
+from . import flags as _flags_mod
+from .flags import set_flags, get_flags  # noqa
+
+from .framework import dtype as _dtype_mod
+from .framework.dtype import (  # noqa
+    DType, set_default_dtype, get_default_dtype)
+from .framework.dtype import (  # noqa
+    bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2)
+from .framework.random import (  # noqa
+    seed, get_rng_state, set_rng_state, get_cuda_rng_state,
+    set_cuda_rng_state)
+
+from .places import (  # noqa
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace, CustomPlace,
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
+    is_compiled_with_xpu, is_compiled_with_tpu, device_count)
+
+from .tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa
+
+# op surface: everything in ops is also a paddle.* function
+from .ops import *  # noqa
+from .ops import OP_TABLE  # noqa
+from .ops.manipulation import concat, stack, split, where  # noqa
+
+from .autograd import no_grad, enable_grad, grad  # noqa
+from .autograd import tape as _tape_mod
+from .autograd.py_layer import PyLayer  # noqa
+
+from . import autograd  # noqa
+from . import nn  # noqa
+from . import optimizer  # noqa
+from . import io  # noqa
+from . import metric  # noqa
+from . import vision  # noqa
+from . import amp  # noqa
+from . import jit  # noqa
+from . import static  # noqa
+from . import distributed  # noqa
+from . import framework  # noqa
+from . import profiler  # noqa
+from . import incubate  # noqa
+from . import device  # noqa
+from . import linalg as _linalg_ns  # noqa
+
+from .framework.io import save, load  # noqa
+from .hapi.model import Model  # noqa
+from .hapi import callbacks  # noqa
+from .jit import to_static  # noqa
+from .distributed.parallel import DataParallel  # noqa
+
+
+def disable_static(place=None):
+    """Dygraph is the default and only-eager mode; kept for parity."""
+    return None
+
+
+def enable_static():
+    from .static import _enable_static_mode
+    _enable_static_mode()
+
+
+def in_dynamic_mode():
+    from .static import _static_mode_enabled
+    return not _static_mode_enabled()
+
+
+def is_grad_enabled():
+    return _tape_mod.is_grad_enabled()
+
+
+def set_grad_enabled(mode):
+    return _tape_mod.set_grad_enabled(mode)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size=input_size, dtypes=dtypes, input=input)

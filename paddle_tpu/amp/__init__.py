@@ -1,0 +1,13 @@
+"""paddle.amp parity (python/paddle/amp/ — SURVEY.md §2.2).
+
+On TPU the native mixed-precision dtype is bf16: no loss scaling is
+numerically required (bf16 has fp32's exponent range), so ``GradScaler``
+keeps its API but defaults to a no-op unless fp16 is requested.
+``auto_cast`` installs a per-op cast hook into the op dispatch path —
+the same point upstream's eager ad_funcs consult the AMP state.
+"""
+
+from .auto_cast import (  # noqa
+    auto_cast, autocast, amp_guard, white_list, black_list)
+from .grad_scaler import GradScaler, AmpScaler  # noqa
+from .decorate import decorate, amp_decorate  # noqa

@@ -1,0 +1,97 @@
+"""auto_cast: O1 (per-op white/black list) and O2 (pure low precision).
+
+Parity: python/paddle/amp/auto_cast.py.  The op white/black lists follow
+upstream's defaults (matmul/conv-class ops cast down; softmax/norm/loss
+stay fp32).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..ops import _primitive
+
+# ops that benefit from low precision (MXU ops)
+WHITE_LIST: Set[str] = {
+    "matmul", "bmm", "mm", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "linear", "einsum",
+    "scaled_dot_product_attention", "flash_attention",
+}
+# numerically sensitive: keep fp32
+BLACK_LIST: Set[str] = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum",
+    "cos_sim", "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "cross_entropy", "layer_norm", "rms_norm", "batch_norm_train",
+    "batch_norm_eval", "group_norm", "instance_norm", "norm",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "mse_loss", "l1_loss", "nll_loss", "kl_div", "logsumexp", "erf",
+    "erfinv", "pow", "cumsum", "cumprod",
+}
+
+white_list = WHITE_LIST
+black_list = BLACK_LIST
+
+_amp_state = {"enabled": False, "dtype": None, "level": "O1",
+              "custom_white": set(), "custom_black": set()}
+
+
+def amp_state():
+    return dict(_amp_state)
+
+
+def _make_hook():
+    target = _amp_state["dtype"]
+    level = _amp_state["level"]
+    cw = _amp_state["custom_white"]
+    cb = _amp_state["custom_black"]
+
+    def hook(opname, vals):
+        def cast_all(dt):
+            return [v.astype(dt)
+                    if hasattr(v, "dtype") and hasattr(v, "astype")
+                    and jnp.issubdtype(v.dtype, jnp.floating)
+                    and v.dtype != dt else v
+                    for v in vals]
+
+        if level == "O2":
+            if opname in BLACK_LIST.union(cb) - cw:
+                return cast_all(jnp.float32)
+            return cast_all(target)
+        # O1
+        if opname in (WHITE_LIST | cw) - cb:
+            return cast_all(target)
+        if opname in (BLACK_LIST | cb):
+            return cast_all(jnp.float32)
+        return vals
+
+    return hook
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "float16", use_promote: bool = True):
+    """``paddle.amp.auto_cast`` — on TPU prefer dtype='bfloat16'."""
+    prev = dict(_amp_state)
+    prev_hook = _primitive._amp_hook
+    if enable:
+        _amp_state.update(
+            enabled=True,
+            dtype=dtypes.to_jax_dtype(dtype),
+            level=level,
+            custom_white=set(custom_white_list or ()),
+            custom_black=set(custom_black_list or ()))
+        _primitive.set_amp_hook(_make_hook())
+    try:
+        yield
+    finally:
+        _amp_state.update(prev)
+        _primitive.set_amp_hook(prev_hook)
+
+
+autocast = auto_cast
+amp_guard = auto_cast

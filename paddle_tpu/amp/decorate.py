@@ -1,0 +1,51 @@
+"""amp.decorate (parity: python/paddle/amp/auto_cast.py::decorate) —
+O2: cast model params to fp16/bf16, optimizer keeps fp32 master weights
+(our optimizers do this via multi_precision)."""
+
+from __future__ import annotations
+
+from ..framework import dtype as dtypes
+
+
+def decorate(models, optimizers=None, level="O1", dtype="float16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        target = dtypes.convert_dtype(dtype)
+        excluded = set()
+        if excluded_layers:
+            exc = excluded_layers if isinstance(excluded_layers,
+                                                (list, tuple)) \
+                else [excluded_layers]
+            for e in exc:
+                if isinstance(e, type):
+                    for m in model_list:
+                        for l in m.sublayers(include_self=True):
+                            if isinstance(l, e):
+                                excluded.add(id(l))
+                else:
+                    excluded.add(id(e))
+        from ..nn.norm import _BatchNormBase, LayerNorm
+        for m in model_list:
+            for l in m.sublayers(include_self=True):
+                # norms stay fp32 (upstream keeps them fp32 in O2)
+                if isinstance(l, (_BatchNormBase, LayerNorm)) or \
+                        id(l) in excluded:
+                    continue
+                for p in l._parameters.values():
+                    if p is not None and dtypes.is_floating(p._value.dtype):
+                        p._value = p._value.astype(target.np_dtype)
+        if optimizers is not None:
+            opt_list = [optimizers] if not isinstance(
+                optimizers, (list, tuple)) else list(optimizers)
+            for o in opt_list:
+                o._multi_precision = True if master_weight is None \
+                    else bool(master_weight)
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+amp_decorate = decorate

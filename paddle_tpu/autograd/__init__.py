@@ -1,0 +1,6 @@
+"""User-facing autograd (parity: python/paddle/autograd/)."""
+
+from .tape import (  # noqa
+    backward, grad, no_grad, enable_grad, is_grad_enabled,
+    set_grad_enabled, reset_tape)
+from .py_layer import PyLayer, PyLayerContext  # noqa

@@ -1,0 +1,110 @@
+"""PyLayer: user-defined autograd ops in Python.
+
+Parity: python/paddle/autograd/py_layer.py — users subclass ``PyLayer``
+with static ``forward``/``backward``; backward receives upstream grads
+and returns grads for forward's tensor inputs.  Implemented by recording
+a single closure tape node whose "jax function" is a ``jax.custom_vjp``
+wrapping the user's two staticmethods, so it composes with the rest of
+the tape exactly like a built-in op (the analog of upstream's
+``PyLayerGradNode``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax
+import jax.numpy as jnp
+
+from . import tape as _tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.non_differentiable = []
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable.extend(tensors)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..tensor import Tensor
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+
+        # Run forward with grad disabled — the op is atomic on the tape.
+        with _tape.no_grad_ctx():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        any_grad = any(not t.stop_gradient for t in tensor_args) \
+            and _tape.is_grad_enabled()
+        if any_grad:
+            diff_idx = [i for i, t in enumerate(tensor_args)
+                        if not t.stop_gradient]
+
+            def _fn(*vals):
+                # Forward value already computed; this function exists so
+                # the tape can call jax.vjp on it.  We wrap the user's
+                # backward as a custom VJP to avoid re-differentiating the
+                # (possibly non-traceable) forward.
+                raise RuntimeError("PyLayer forward should not be re-run")
+
+            # Record a special node; backward dispatch is custom.
+            node = _tape.TapeNode(_fn, tuple(tensor_args),
+                                  tuple(t._value for t in tensor_args),
+                                  {}, tuple(diff_idx), tuple(out_list),
+                                  cls.__name__)
+            node.fn = None  # flag: custom node
+            node.kwargs = {"__pylayer__": (cls, ctx, len(tensor_args))}
+            _tape._tape.append(node)
+            for o in out_list:
+                if o not in ctx.non_differentiable and jnp.issubdtype(
+                        o._value.dtype, jnp.inexact):
+                    o.stop_gradient = False
+        return outs
+
+
+def _pylayer_vjp(node, out_cts_full):
+    """Dispatch a PyLayer node's backward: call the user's backward with
+    upstream grads as Tensors; returns cotangent arrays per diff input."""
+    from ..tensor import Tensor
+    cls, ctx, n_in = node.kwargs["__pylayer__"]
+    grads_in = [Tensor(c) if c is not None else None for c in out_cts_full]
+    with _tape.no_grad_ctx():
+        res = cls.backward(ctx, *grads_in)
+    if not isinstance(res, (tuple, list)):
+        res = (res,)
+    out = []
+    for i in node.diff_idx:
+        r = res[i] if i < len(res) else None
+        out.append(None if r is None else
+                   (r._value if isinstance(r, Tensor) else jnp.asarray(r)))
+    return out

@@ -1,0 +1,269 @@
+"""Eager autograd: a lightweight op tape replayed under ``jax.vjp``.
+
+Paddle's dygraph autograd builds a GradNode graph in C++ as ops execute
+(upstream: paddle/fluid/eager/ — ``egr::GradNodeBase``, ``AutogradMeta``,
+``egr::Backward()``; see SURVEY.md §2.1 "Eager autograd engine").  The
+TPU-native equivalent records, per differentiable op call, the pure jax
+function plus its inputs/outputs; ``backward()`` walks the tape in
+reverse, computing each op's VJP with ``jax.vjp`` and accumulating
+cotangents (the analog of ``GradTensorHolder``).
+
+Design notes
+------------
+* The tape is global and append-only within a "graph generation".  Any op
+  whose inputs include a ``stop_gradient=False`` tensor records a node.
+* ``jax.vjp`` re-runs the op's forward to get the linearisation — eager
+  backward therefore costs ~2× forward, like any tape with recompute.
+  The jitted training path (``Model.fit`` fast path, ``@to_static``)
+  bypasses the tape entirely with ``jax.value_and_grad`` over a
+  functional call, where XLA dedupes the forward.
+* Cotangent accumulation is keyed by tensor identity; leaf tensors get
+  ``.grad`` populated (Paddle semantics: grads *accumulate* across
+  backward calls until ``clear_grad``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_grad_enabled: bool = True
+_tape: List["TapeNode"] = []
+
+
+class TapeNode:
+    __slots__ = ("fn", "args", "arg_vals", "kwargs", "diff_idx", "outputs",
+                 "name")
+
+    def __init__(self, fn, args, arg_vals, kwargs, diff_idx, outputs, name):
+        self.fn = fn              # pure fn over arrays
+        self.args = args          # mixed Tensor / const positional args
+        self.arg_vals = arg_vals  # values snapshotted at call time (jax
+                                  # arrays are immutable, so this guards
+                                  # against later in-place buffer swaps)
+        self.kwargs = kwargs      # static (non-diff) kwargs
+        self.diff_idx = diff_idx  # positions of tracked Tensor args
+        self.outputs = outputs    # tuple of output Tensors
+        self.name = name
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+@contextlib.contextmanager
+def no_grad_ctx():
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+class no_grad:
+    """``paddle.no_grad`` — usable as context manager or decorator."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            with no_grad_ctx():
+                return fn(*a, **kw)
+        return wrapper
+
+
+class enable_grad(no_grad):
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        set_grad_enabled(True)
+        return self
+
+    def __call__(self, fn):
+        def wrapper(*a, **kw):
+            prev = is_grad_enabled()
+            set_grad_enabled(True)
+            try:
+                return fn(*a, **kw)
+            finally:
+                set_grad_enabled(prev)
+        return wrapper
+
+
+def record(fn: Callable, args: Sequence[Any], arg_vals: Sequence[Any],
+           kwargs: Dict[str, Any], diff_idx: Sequence[int],
+           outputs: Sequence[Any], name: str = "") -> None:
+    _tape.append(TapeNode(fn, tuple(args), tuple(arg_vals), dict(kwargs),
+                          tuple(diff_idx), tuple(outputs),
+                          name or getattr(fn, "__name__", "op")))
+
+
+def reset_tape() -> None:
+    _tape.clear()
+
+
+def tape_size() -> int:
+    return len(_tape)
+
+
+def _ones_like(val):
+    return jnp.ones_like(val)
+
+
+def _node_vjp(node, cts):
+    """VJP one tape node given the cotangent accumulator.
+
+    Only inexact-dtype outputs participate (jax requires float0
+    cotangents for integer primals — integer outputs like argmax indices
+    simply don't carry gradient).  Returns cotangents aligned with
+    ``node.diff_idx`` or None if nothing flows through this node.
+    """
+    if "__pylayer__" in node.kwargs:
+        from .py_layer import _pylayer_vjp
+        full = [cts.get(id(o)) for o in node.outputs]
+        if all(c is None for c in full):
+            return None
+        full = [jnp.zeros_like(o._value) if c is None else c
+                for o, c in zip(node.outputs, full)]
+        return _pylayer_vjp(node, full)
+    out_idx = [j for j, o in enumerate(node.outputs)
+               if jnp.issubdtype(o._value.dtype, jnp.inexact)]
+    if not out_idx:
+        return None
+    out_cts = [cts.get(id(node.outputs[j])) for j in out_idx]
+    if all(c is None for c in out_cts):
+        return None
+    out_cts = [jnp.zeros_like(node.outputs[j]._value) if c is None else c
+               for j, c in zip(out_idx, out_cts)]
+    diff_vals = [node.arg_vals[i] for i in node.diff_idx]
+
+    def _f(*dvals, _node=node, _out_idx=tuple(out_idx)):
+        vals = list(_node.arg_vals)
+        for i, v in zip(_node.diff_idx, dvals):
+            vals[i] = v
+        out = _node.fn(*vals, **_node.kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        return tuple(outs[j] for j in _out_idx)
+
+    _, vjp_fn = jax.vjp(_f, *diff_vals)
+    return vjp_fn(tuple(out_cts))
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """Reverse-walk the tape from ``tensors`` (usually one scalar loss).
+
+    Populates ``.grad`` on every reachable leaf with
+    ``stop_gradient=False`` and on non-leaves that called
+    ``retain_grads()``.  Matches ``paddle.autograd.backward`` semantics.
+    """
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # cotangent accumulator keyed by tensor identity
+    cts: Dict[int, Any] = {}
+    for t, g in zip(tensors, grad_tensors):
+        seed = _ones_like(t._value) if g is None else (
+            g._value if hasattr(g, "_value") else jnp.asarray(g))
+        _accum(cts, id(t), seed)
+
+    produced = {id(o): n for n in _tape for o in n.outputs}
+
+    for node in reversed(_tape):
+        in_cts = _node_vjp(node, cts)
+        if in_cts is None:
+            continue
+        for i, ct in zip(node.diff_idx, in_cts):
+            t = node.args[i]
+            if ct is None or t.stop_gradient:
+                continue
+            if id(t) in produced and not getattr(t, "_retain_grads", False):
+                _accum(cts, id(t), ct)   # interior: keep flowing
+            else:
+                _accum(cts, id(t), ct)
+                _add_grad(t, ct)
+
+    # leaves fed directly as roots (e.g. x.backward() on a leaf): nothing to do.
+    if not retain_graph:
+        reset_tape()
+
+
+def _accum(cts: Dict[int, Any], key: int, val) -> None:
+    cur = cts.get(key)
+    cts[key] = val if cur is None else cur + val
+
+
+def _add_grad(t, ct) -> None:
+    from ..tensor import Tensor
+    ct = jnp.asarray(ct, dtype=t._value.dtype)
+    if t.grad is None:
+        t.grad = Tensor(ct, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._value + ct, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None):
+    """``paddle.grad`` — returns grads of ``outputs`` w.r.t. ``inputs``
+    without touching ``.grad`` slots.  Implemented by running the normal
+    tape walk into a private accumulator."""
+    from ..tensor import Tensor
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    cts: Dict[int, Any] = {}
+    for t, g in zip(outputs, grad_outputs):
+        seed = _ones_like(t._value) if g is None else (
+            g._value if hasattr(g, "_value") else jnp.asarray(g))
+        _accum(cts, id(t), seed)
+
+    for node in reversed(_tape):
+        in_cts = _node_vjp(node, cts)
+        if in_cts is None:
+            continue
+        for i, ct in zip(node.diff_idx, in_cts):
+            if ct is not None and not node.args[i].stop_gradient:
+                _accum(cts, id(node.args[i]), ct)
+
+    results = []
+    for t in inputs:
+        c = cts.get(id(t))
+        if c is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it.")
+            results.append(None)
+        else:
+            results.append(Tensor(c, stop_gradient=not create_graph))
+    if retain_graph is False or retain_graph is None and not create_graph:
+        pass  # keep tape: paddle.grad defaults to retaining for repeat calls
+    return results

@@ -1,0 +1,53 @@
+"""paddle.device parity (python/paddle/device/)."""
+
+from ..places import (  # noqa
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_xpu)
+
+
+def get_all_device_type():
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class cuda:
+    """paddle.device.cuda namespace mapped onto the accelerator."""
+
+    @staticmethod
+    def device_count():
+        from ..places import device_count as dc
+        return dc()
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        import jax
+        try:
+            dev = jax.devices()[0]
+            stats = dev.memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        import jax
+        try:
+            dev = jax.devices()[0]
+            stats = dev.memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def empty_cache():
+        return None
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()

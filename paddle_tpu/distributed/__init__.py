@@ -1,0 +1,32 @@
+"""paddle.distributed parity surface (python/paddle/distributed/).
+
+Architecture (SURVEY.md §5.8): there is no runtime comm library —
+collectives are XLA HLO ops compiled onto ICI/DCN.  This package is
+(a) the mesh/axis manager (fleet.topology → jax.sharding.Mesh),
+(b) functional collectives (shard_map-wrapped psum/all_gather/... for
+dygraph parity, free fusion under jit),
+(c) the host control plane (jax.distributed ≈ TCPStore rendezvous),
+(d) the launch CLI with the PADDLE_TRAINER_* env contract.
+"""
+
+from .parallel import (  # noqa
+    ParallelEnv, init_parallel_env, get_rank, get_world_size,
+    is_initialized, DataParallel)
+from .communication import (  # noqa
+    all_reduce, all_gather, broadcast, reduce, reduce_scatter, alltoall,
+    all_to_all, send, recv, isend, irecv, scatter, barrier, new_group,
+    wait, ReduceOp, get_group)
+from . import fleet  # noqa
+from . import sharding  # noqa
+from .collective import split, get_mesh, set_mesh  # noqa
+from .fleet.recompute import recompute  # noqa
+from . import checkpoint  # noqa
+
+# auto-parallel style API
+from .auto_parallel.api import (  # noqa
+    ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard)
+
+
+def launch():
+    from .launch.main import main
+    main()

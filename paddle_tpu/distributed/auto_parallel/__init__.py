@@ -1,0 +1,2 @@
+from .api import (  # noqa
+    ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard)

@@ -1,0 +1,111 @@
+"""Semi-auto parallel API (parity: python/paddle/distributed/
+auto_parallel/ — ProcessMesh, shard_tensor; SURVEY.md §2.2 "Auto-parallel
+(semi-auto)": Paddle's own GSPMD analog).
+
+On TPU this is nearly definitional: ProcessMesh IS jax.sharding.Mesh,
+shard_tensor IS device_put with a NamedSharding, and "SPMD rule
+inference + reshard" IS the XLA SPMD partitioner.  The API therefore
+maps 1:1 with no pass pipeline to port.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ...tensor import Tensor
+
+
+class Placement:
+    pass
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+
+class Partial(Placement):
+    def __repr__(self):
+        return "Partial()"
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Union[List, np.ndarray],
+                 dim_names: Optional[List[str]] = None):
+        self._arr = np.asarray(mesh)
+        self.dim_names = dim_names or [f"d{i}"
+                                       for i in range(self._arr.ndim)]
+        self.shape = list(self._arr.shape)
+        self.process_ids = self._arr.reshape(-1).tolist()
+        self._jax_mesh = None
+
+    def get_jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            picked = np.asarray([devices[i % len(devices)]
+                                 for i in self.process_ids]).reshape(
+                self._arr.shape)
+            self._jax_mesh = Mesh(picked, tuple(self.dim_names))
+        return self._jax_mesh
+
+    @property
+    def mesh(self):
+        return self._arr
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessMesh) and \
+            np.array_equal(self._arr, other._arr) and \
+            self.dim_names == other.dim_names
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dims={self.dim_names})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
+                        ndim: int) -> PartitionSpec:
+    spec: List = [None] * ndim
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            spec[p.dim] = mesh.dim_names[mesh_dim]
+    return PartitionSpec(*spec)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
+    jmesh = mesh.get_jax_mesh()
+    spec = _placements_to_spec(placements, mesh, t.ndim)
+    sharded = jax.device_put(t._value, NamedSharding(jmesh, spec))
+    out = Tensor(sharded, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out.dist_spec = tuple(spec)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_op(op, mesh: ProcessMesh = None, in_placements=None,
+             out_placements=None):
+    def wrapper(*args, **kwargs):
+        return op(*args, **kwargs)
+    return wrapper

@@ -1,0 +1,84 @@
+"""Global mesh registry + tensor-parallel split helper (the analog of
+upstream's communicator bookkeeping in paddle.distributed.collective).
+
+The Mesh is THE central object of the TPU build (SURVEY.md §5.8): axes
+('dp','sharding','pp','sep','mp') ordered DCN-outer → ICI-inner so
+model-parallel collectives ride ICI.  Built by fleet.init from
+DistributedStrategy.hybrid_configs; consumed by every jit'ed step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+# pp outermost: pipeline stages tolerate DCN latency; mp innermost:
+# per-layer allreduce needs ICI bandwidth (scaling-book recipe).
+
+
+def build_mesh(degrees: Dict[str, int],
+               devices: Optional[Sequence] = None) -> Mesh:
+    """degrees: axis name → size. Missing axes get size 1 (kept in the
+    mesh so shardings can always name them)."""
+    if devices is None:
+        devices = jax.devices()
+    sizes = [int(degrees.get(a, 1)) for a in AXIS_ORDER]
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(
+            f"mesh needs {total} devices, have {len(devices)}")
+    devices = list(devices)[:total]
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(sizes, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def set_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH
+
+
+def ensure_mesh() -> Mesh:
+    global _GLOBAL_MESH
+    if _GLOBAL_MESH is None:
+        _GLOBAL_MESH = build_mesh({})
+    return _GLOBAL_MESH
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(ensure_mesh(), PartitionSpec(*spec))
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split — megatron-style parallel embedding/fc
+    helper.  Provided for API parity; prefer fleet.meta_parallel layers."""
+    from .fleet.meta_parallel import (ColumnParallelLinear,
+                                      RowParallelLinear,
+                                      VocabParallelEmbedding)
+    if operation == "linear":
+        if axis == 0:
+            return RowParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False)(x)
+        return ColumnParallelLinear(size[0], size[1],
+                                    weight_attr=weight_attr,
+                                    gather_output=gather_out,
+                                    has_bias=bias_attr is not False)(x)
+    if operation == "embedding":
+        return VocabParallelEmbedding(size[0], size[1],
+                                      weight_attr=weight_attr)(x)
+    raise ValueError(f"unknown operation {operation}")

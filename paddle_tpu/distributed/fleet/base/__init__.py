@@ -1,0 +1,2 @@
+from .distributed_strategy import DistributedStrategy  # noqa
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa

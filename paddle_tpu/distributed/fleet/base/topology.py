@@ -1,0 +1,211 @@
+"""Hybrid-parallel topology (parity: python/paddle/distributed/fleet/
+base/topology.py — CommunicateTopology + HybridCommunicateGroup,
+SURVEY.md §2.2 "HybridCommunicateGroup / topology" row).
+
+Upstream builds an N-D process grid and one NCCL communicator per axis
+slice.  Here the grid IS a ``jax.sharding.Mesh``: creating the topology
+builds the mesh (axes pp,dp,sharding,sep,mp — DCN-outer→ICI-inner) and
+registers per-axis ``Group``s whose ``axis_name`` routes collectives to
+``lax.psum``-family ops on that mesh axis.  "Communicator creation"
+costs nothing (SURVEY.md §3.3 TPU mapping).
+"""
+
+from __future__ import annotations
+
+from functools import reduce
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...communication import Group
+from ... import collective as coll
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._parallel_names = list(hybrid_group_names or
+                                    ["data", "pipe", "sharding", "sep",
+                                     "model"])
+        self._dims = list(dims or [1] * len(self._parallel_names))
+        self.coordinate = None
+        self._world_size = int(np.prod(self._dims))
+        arr = np.arange(self._world_size).reshape(self._dims)
+        self._rank_array = arr
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world_size
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        return int(self._rank_array[tuple(coords)])
+
+    def get_coord(self, rank):
+        coords = np.unravel_index(rank, self._dims)
+        return dict(zip(self._parallel_names, (int(c) for c in coords)))
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coordinate on axis_name == index."""
+        ax = self._parallel_names.index(axis_name)
+        taken = np.take(self._rank_array, index, axis=ax)
+        return sorted(int(r) for r in taken.reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        """List of rank-groups along axis_name (one per slice)."""
+        ax = self._parallel_names.index(axis_name)
+        moved = np.moveaxis(self._rank_array, ax, -1)
+        flat = moved.reshape(-1, self._dims[ax])
+        return [list(map(int, row)) for row in flat]
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: CommunicateTopology, rank: int = 0):
+        self._topo = topology
+        self.global_rank = rank
+        coord = topology.get_coord(rank)
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") \
+            if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("model")
+        self._dp_rank = coord["data"]
+        self._pp_rank = coord["pipe"]
+        self._sharding_rank = coord["sharding"]
+        self._sep_rank = coord.get("sep", 0)
+        self._mp_rank = coord["model"]
+
+        # per-axis groups bound to mesh axis names
+        self._dp_group = self._make_group("data", "dp")
+        self._pp_group = self._make_group("pipe", "pp")
+        self._sharding_group = self._make_group("sharding", "sharding")
+        self._sep_group = self._make_group("sep", "sep") \
+            if self._sep_degree > 1 or "sep" in \
+            topology.get_hybrid_group_names() else None
+        self._mp_group = self._make_group("model", "mp")
+        # "check" group: mp×pp fused group for global-norm clip parity
+        self._check_group = Group(
+            sorted(set(self._mp_group.ranks) | set(self._pp_group.ranks)),
+            axis_name=("pp", "mp"))
+
+        # build/register the jax mesh matching this topology
+        degrees = {"dp": self._dp_degree, "pp": self._pp_degree,
+                   "sharding": self._sharding_degree,
+                   "sep": self._sep_degree, "mp": self._mp_degree}
+        try:
+            coll.set_mesh(coll.build_mesh(degrees))
+        except ValueError:
+            # fewer local devices than the logical topology (multi-host
+            # deferred bring-up): mesh is built at first jit by the
+            # runner with global devices
+            pass
+
+    def _make_group(self, topo_axis: str, mesh_axis: str) -> Group:
+        coord = self._topo.get_coord(self.global_rank)
+        fixed = {k: v for k, v in coord.items() if k != topo_axis}
+        ranks = [self._topo.get_rank(**{**fixed, topo_axis: i})
+                 for i in range(self._topo.get_dim(topo_axis))]
+        return Group(ranks, axis_name=mesh_axis)
+
+    # -- parity accessors ---------------------------------------------------
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return self.global_rank
+
+    # data parallel
+    def get_data_parallel_rank(self):
+        return self._dp_rank
+
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_data_parallel_group_src_rank(self):
+        return self._dp_group.ranks[0]
+
+    # model (tensor) parallel
+    def get_model_parallel_rank(self):
+        return self._mp_rank
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_model_parallel_group_src_rank(self):
+        return self._mp_group.ranks[0]
+
+    # pipeline
+    def get_stage_id(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_rank(self):
+        return self._pp_rank
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def is_first_stage(self):
+        return self._pp_rank == 0
+
+    def is_last_stage(self):
+        return self._pp_rank == self._pp_degree - 1
+
+    # sharding
+    def get_sharding_parallel_rank(self):
+        return self._sharding_rank
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_sharding_parallel_group_src_rank(self):
+        return self._sharding_group.ranks[0]
+
+    # sep (sequence/context parallel)
+    def get_sep_parallel_rank(self):
+        return self._sep_rank
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    def get_sep_parallel_group(self):
+        return self._sep_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return self._check_group
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        coord = self._topo.get_coord(self.global_rank)
+        coord["pipe"] = stage_id
+        coord.update(kwargs)
+        return self._topo.get_rank(**coord)
+
+
+_HYBRID_PARALLEL_GROUP: Optional[HybridCommunicateGroup] = None
+
+
+def _set_hybrid_parallel_group(hcg: HybridCommunicateGroup):
+    global _HYBRID_PARALLEL_GROUP
+    _HYBRID_PARALLEL_GROUP = hcg
+
+
+def _get_hybrid_parallel_group() -> Optional[HybridCommunicateGroup]:
+    return _HYBRID_PARALLEL_GROUP

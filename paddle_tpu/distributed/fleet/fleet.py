@@ -1,0 +1,113 @@
+"""Fleet facade (parity: python/paddle/distributed/fleet/fleet.py —
+fleet.init / distributed_model / distributed_optimizer)."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            _set_hybrid_parallel_group,
+                            _get_hybrid_parallel_group)
+from ..parallel import ParallelEnv, init_parallel_env
+
+
+class Fleet:
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=False, strategy=None,
+             log_level="INFO"):
+        self._strategy = strategy or DistributedStrategy()
+        env = init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        dims = [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+                hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+                hc.get("mp_degree", 1)]
+        names = ["data", "pipe", "sharding", "sep", "model"]
+        topo = CommunicateTopology(names, dims)
+        self._hcg = HybridCommunicateGroup(topo, env.rank)
+        _set_hybrid_parallel_group(self._hcg)
+        # MP rng tracker: shared global seed, distinct local seed per mp
+        # rank (paddle's tensor_init_seed semantics)
+        from ....framework import random as _random
+        seed = self._strategy.tensor_parallel_configs.get(
+            "tensor_init_seed", -1)
+        if seed is None or seed < 0:
+            seed = 42
+        _random.model_parallel_random_seed(
+            seed, self._hcg.get_model_parallel_rank())
+        self._is_initialized = True
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        return self._hcg or _get_hybrid_parallel_group()
+
+    def worker_index(self):
+        return ParallelEnv().rank
+
+    def worker_num(self):
+        return ParallelEnv().world_size
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_endpoints(self, to_string=False):
+        eps = ParallelEnv().trainer_endpoints
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        from ..communication import barrier
+        barrier()
+
+    def distributed_model(self, model):
+        """Wrap per topology (SURVEY.md §3.3: DataParallel |
+        TensorParallel | PipelineParallel | GroupSharded per axes)."""
+        hcg = self.get_hybrid_communicate_group()
+        from .meta_parallel.parallel_wrappers import (
+            TensorParallel, PipelineParallelWrapper)
+        from ..parallel import DataParallel
+        from .meta_parallel.pp_layers import PipelineLayer
+        if hcg.get_pipe_parallel_world_size() > 1 or isinstance(
+                model, PipelineLayer):
+            return PipelineParallelWrapper(model, hcg, self._strategy)
+        if hcg.get_model_parallel_world_size() > 1:
+            return TensorParallel(model, hcg, self._strategy)
+        if hcg.get_data_parallel_world_size() > 1 or \
+                hcg.get_sharding_parallel_world_size() > 1:
+            return DataParallel(model)
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        from .meta_optimizers.dygraph_optimizer import \
+            HybridParallelOptimizer
+        hcg = self.get_hybrid_communicate_group()
+        if hcg is None:
+            return optimizer
+        return HybridParallelOptimizer(optimizer, hcg, self._strategy)
+
+    # PS-mode API kept for signature parity; PS is a documented non-goal
+    # (SURVEY.md §2.1 Parameter Server row).
+    def is_server(self):
+        return False
+
+    def is_worker(self):
+        return True
+
+    def init_worker(self):
+        pass
+
+    def stop_worker(self):
+        pass
+
+
+fleet_instance = Fleet()

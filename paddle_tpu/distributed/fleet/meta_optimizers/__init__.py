@@ -1,0 +1,3 @@
+from .dygraph_optimizer import (  # noqa
+    HybridParallelOptimizer, HybridParallelGradScaler,
+    DygraphShardingOptimizer)

@@ -1,0 +1,102 @@
+"""HybridParallelOptimizer (parity: python/paddle/distributed/fleet/
+meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py —
+SURVEY.md §3.4 step ③: global-norm clip across mp/pp/sharding groups,
+grad sync, sharded state).
+
+The psum for the global-norm square-sum across the check group is wired
+through ClipGradByGlobalNorm._comm_hook so it fires inside the traced
+step (an mp×pp psum on ICI); outside a trace on one chip it's identity.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ....nn.clip_grad import ClipGradByGlobalNorm
+from ....optimizer.optimizer import Optimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg, strategy):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+        self._opt_state_tree = None
+        clip = optimizer._grad_clip
+        if isinstance(clip, ClipGradByGlobalNorm):
+            clip._comm_hook = self._sq_sum_comm
+
+    def _sq_sum_comm(self, sq):
+        """Sum grad-norm square-sums across mp+pp(+sharding) axes when
+        traced; the hybrid global norm contract of upstream's
+        _dygraph_clip."""
+        if isinstance(sq, jax.core.Tracer):
+            try:
+                for ax in ("mp", "pp", "sharding"):
+                    sq = lax.psum(sq, ax)
+            except NameError:
+                pass
+        return sq
+
+    # passthrough surface
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        return self._inner_opt.minimize(loss, **kwargs)
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        return self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelGradScaler:
+    def __init__(self, scaler, hcg):
+        self._scaler = scaler
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_scaler"], item)
+
+    def scale(self, loss):
+        return self._scaler.scale(loss)
+
+    def step(self, optimizer):
+        return self._scaler.step(
+            optimizer._inner_opt if isinstance(
+                optimizer, HybridParallelOptimizer) else optimizer)
+
+    def minimize(self, optimizer, scaled_loss):
+        return self._scaler.minimize(
+            optimizer._inner_opt if isinstance(
+                optimizer, HybridParallelOptimizer) else optimizer,
+            scaled_loss)
+
+
+class DygraphShardingOptimizer:
+    """Stage-1 sharding optimizer (v2.6 refactor parity): state is
+    placed sharded by the runner; eager path delegates."""
+
+    def __init__(self, optimizer, hcg=None):
+        self._inner_opt = optimizer
+        self._inner_opt._sharded_state = True
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+    def step(self):
+        self._inner_opt.step()

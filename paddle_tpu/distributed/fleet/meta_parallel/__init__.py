@@ -1,0 +1,9 @@
+from .mp_layers import (  # noqa
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, mark_as_sequence_parallel_parameter)
+from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa
+from .pipeline_parallel import PipelineParallel, pipeline_spmd  # noqa
+from .parallel_wrappers import (  # noqa
+    TensorParallel, PipelineParallelWrapper)
+from .sharding_parallel import (  # noqa
+    GroupShardedStage2, GroupShardedStage3, GroupShardedOptimizerStage2)

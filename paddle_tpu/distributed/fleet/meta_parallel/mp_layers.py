@@ -1,0 +1,152 @@
+"""Tensor/model-parallel layers (parity: python/paddle/distributed/
+fleet/layers/mpu/mp_layers.py — ColumnParallelLinear, RowParallelLinear,
+VocabParallelEmbedding; mp_ops.py ParallelCrossEntropy).
+
+TPU-native design (SURVEY.md §7.0 "TP"): these are *annotation-carrying*
+layers.  Parameters are full-logical-shape arrays tagged with a
+``dist_spec`` PartitionSpec over the 'mp' mesh axis; under jit the XLA
+SPMD partitioner shards them and inserts the Megatron collectives
+(column fwd: none; row fwd: all-reduce; embedding: all-reduce) — the
+exact comms upstream codes by hand with c_allreduce ops, but fused and
+scheduled by the compiler.  Eagerly (single chip) they behave as the
+dense layers, so loss-parity tests vs the serial model hold trivially.
+
+``sequence_parallel=True`` switches the activation layout to
+seq-sharded between blocks (Megatron-SP): outputs get a
+``with_sharding_constraint`` on ('mp' over seq), turning the row
+all-reduce into reduce-scatter + later all-gather — SURVEY.md §5.7.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ....tensor import Tensor
+from .... import ops
+from ....nn.layer import Layer
+from ....nn import initializer as I
+from ... import collective as coll
+
+
+def _constraint(x_value, spec):
+    """with_sharding_constraint when a mesh is active and we're tracing."""
+    mesh = coll.get_mesh()
+    if mesh is None or not isinstance(x_value, jax.core.Tracer):
+        return x_value
+    try:
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.lax.with_sharding_constraint(
+            x_value, NamedSharding(mesh, PartitionSpec(*spec)))
+    except Exception:
+        return x_value
+
+
+@ops.primitive(name="mp_constraint")
+def _constrain_op(x, spec=()):
+    return _constraint(x, spec)
+
+
+class ColumnParallelLinear(Layer):
+    """Weight [in, out] sharded on out ('mp'): y_local = x @ W_shard."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.gather_output = gather_output
+        self.is_mp = True
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.dist_spec = (None, "mp")
+        self.weight.is_distributed = True
+        if has_bias is None or has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            self.bias.dist_spec = ("mp",)
+            self.bias.is_distributed = True
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = ops.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            # keep output sharded on the feature dim
+            out = _constrain_op(out, spec=(None,) * (out.ndim - 1) + ("mp",))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Weight [in, out] sharded on in ('mp'): partial sums all-reduced by
+    SPMD propagation."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False,
+                 fuse_matmul_bias=False, mp_group=None, name=None):
+        super().__init__()
+        self._in_features = in_features
+        self._out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.is_mp = True
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.dist_spec = ("mp", None)
+        self.weight.is_distributed = True
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        if self.input_is_parallel:
+            x = _constrain_op(x, spec=(None,) * (x.ndim - 1) + ("mp",))
+        out = ops.linear(x, self.weight, None)
+        out = _constrain_op(out, spec=(None,) * out.ndim)  # replicated
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding table sharded on vocab ('mp')."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self.weight = self.create_parameter(
+            shape=[num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 0.02))
+        self.weight.dist_spec = ("mp", None)
+        self.weight.is_distributed = True
+
+    def forward(self, x):
+        out = ops.embedding(x, self.weight)
+        return _constrain_op(out, spec=(None,) * out.ndim)
+
+
+class ParallelCrossEntropy(Layer):
+    """Vocab-parallel loss (parity: c_softmax_with_cross_entropy —
+    SURVEY.md §2.1 "Collective c_ops").  With logits sharded on the class
+    dim, XLA lowers the log-sum-exp reduction to the same mp all-reduce
+    pattern the CUDA op implements by hand."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return ops.cross_entropy(input, label, reduction="none",
+                                 ignore_index=self.ignore_index)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True

@@ -1,0 +1,61 @@
+"""Model wrappers chosen by fleet.distributed_model (parity:
+python/paddle/distributed/fleet/meta_parallel/tensor_parallel.py /
+pipeline_parallel.py wrappers)."""
+
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+
+class MetaParallelBase(Layer):
+    def __init__(self, layers, hcg, strategy):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+
+class TensorParallel(MetaParallelBase):
+    """mp wrapper: nothing to do at runtime — the mp layers carry their
+    own sharding specs; grads on replicated params are averaged by the
+    same jit psum as dp."""
+
+
+class PipelineParallelWrapper(MetaParallelBase):
+    """pp wrapper: exposes train_batch (upstream PipelineParallel API)."""
+
+    def __init__(self, layers, hcg, strategy):
+        super().__init__(layers, hcg, strategy)
+        from .pipeline_parallel import PipelineParallel
+        self._engine = PipelineParallel(layers, hcg, strategy)
+        self.accumulate_steps = self._engine.accumulate_steps
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        return self._engine.train_batch(data, optimizer, lr_scheduler,
+                                        scaler)
+
+    def eval_batch(self, data, compute_loss=True):
+        return self._engine.eval_batch(data, compute_loss)

@@ -1,0 +1,127 @@
+"""Pipeline layer description (parity: python/paddle/distributed/fleet/
+meta_parallel/parallel_layers/pp_layers.py — PipelineLayer, LayerDesc,
+SharedLayerDesc).
+
+Upstream segments a LayerDesc list across pp ranks and each rank
+instantiates only its stages.  On TPU (single process, SPMD) the
+PipelineLayer instantiates ALL layers and records the stage partition;
+the compiled pipeline schedule (``pipeline_parallel.py``) either
+(a) shard_maps uniform stages over the 'pp' mesh axis with ppermute
+activations, or (b) runs stages inline when pp_degree == 1 — so the same
+model code works at any pp degree.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ....nn.container import LayerList
+
+
+class LayerDesc:
+    def __init__(self, layer_func: Callable, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', '?')})"
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._topology = topology
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pipe")
+        self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+
+        descs = list(layers)
+        built: List[Layer] = []
+        self._shared: dict = {}
+        self._funcs: List[Optional[Callable]] = []
+        for d in descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append(layer)
+                self._funcs.append(d.forward_func)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+                self._funcs.append(None)
+            elif isinstance(d, Layer):
+                built.append(d)
+                self._funcs.append(None)
+            else:  # plain callable (e.g. lambda reshape)
+                built.append(None)
+                self._funcs.append(d)
+        self.run_function = built
+        self._layers_list = LayerList([l for l in built if l is not None])
+        self._segment()
+
+    def _segment(self):
+        n = len(self.run_function)
+        P = self._num_stages
+        if self._seg_method.startswith("layer:"):
+            pat = self._seg_method.split("layer:", 1)[1]
+            idx = [i for i, l in enumerate(self.run_function)
+                   if l is not None and pat in type(l).__name__]
+            # uniform split of matched layers across stages
+            per = max(len(idx) // P, 1)
+            bounds = [0]
+            for s in range(1, P):
+                k = min(s * per, len(idx) - 1)
+                bounds.append(idx[k] if k < len(idx) else n)
+            bounds.append(n)
+        else:
+            per = (n + P - 1) // P
+            bounds = [min(i * per, n) for i in range(P)] + [n]
+        self.segment_parts = bounds
+
+    def get_stage_layers(self, stage_id: int):
+        lo, hi = self.segment_parts[stage_id], self.segment_parts[stage_id
+                                                                  + 1]
+        return list(zip(self.run_function[lo:hi], self._funcs[lo:hi]))
+
+    def forward(self, x):
+        """Inline (pp=1 or trace-through) execution of all stages."""
+        for i, (layer, fn) in enumerate(zip(self.run_function,
+                                            self._funcs)):
+            item = layer if layer is not None else fn
+            if self._recompute_interval > 0 and layer is not None \
+                    and i % self._recompute_interval == 0:
+                from ..recompute import recompute
+                x = recompute(item, x) if not isinstance(x, tuple) \
+                    else recompute(item, *x)
+            else:
+                if fn is not None and layer is not None:
+                    x = fn(layer, x)
+                elif layer is not None:
+                    x = layer(x) if not isinstance(x, tuple) else layer(*x)
+                else:
+                    x = fn(x) if not isinstance(x, tuple) else fn(*x)
+        return x

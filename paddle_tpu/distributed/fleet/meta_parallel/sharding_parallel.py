@@ -1,0 +1,116 @@
+"""ZeRO / GroupSharded stages (parity: python/paddle/distributed/fleet/
+meta_parallel/sharding/ — GroupShardedStage2/3,
+GroupShardedOptimizerStage2; SURVEY.md §2.2 "Sharding (ZeRO)").
+
+TPU-native design: sharding is a *placement property*, not a runtime
+(SURVEY.md §7.0).  The stages differ only in which tensors carry a
+'sharding'-axis spec:
+
+* stage 1: optimizer state sharded (moments carry the spec; grads/params
+  replicated).  Weight-update sharding per PAPERS.md entry 4
+  ("Automatic Cross-Replica Sharding of Weight Update"): XLA's SPMD
+  partitioner does the reduce-scatter → local update → all-gather
+  rewrite when the state is sharded and params replicated.
+* stage 2: + gradients sharded (the jit emits reduce-scatter instead of
+  all-reduce for the grad psum).
+* stage 3: + parameters sharded (FSDP: all-gather per layer emerges from
+  propagation; XLA schedules prefetch).
+
+``shard_spec_for(value, stage)`` picks the largest divisible dim to
+shard on the 'sharding' axis; the runner applies the specs at
+device_put/jit boundaries.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ....nn.layer import Layer
+from ....tensor import Tensor
+
+
+def shard_spec_for(shape, axis_size: int, stage_axis: str = "sharding"):
+    """Pick the first dim divisible by the sharding degree; None spec
+    (replicated) if nothing divides."""
+    for i, s in enumerate(shape):
+        if s % axis_size == 0 and s >= axis_size:
+            spec = [None] * len(shape)
+            spec[i] = stage_axis
+            return tuple(spec)
+    return (None,) * len(shape)
+
+
+def apply_sharding_stage(model: Layer, stage: int, axis_size: int):
+    """Tag parameters (stage 3) so the jit runner shards them; stages
+    1/2 are consumed by the optimizer/grad sharding logic in the
+    runner."""
+    for _, p in model.named_parameters():
+        if stage >= 3 and p.dist_spec is None:
+            p.dist_spec = shard_spec_for(p.shape, axis_size)
+        p.sharding_stage = stage
+    return model
+
+
+class GroupShardedOptimizerStage2:
+    """Wraps an optimizer: its state tree is placed sharded (the runner
+    reads ._sharded_state=True and applies 'sharding' specs to state
+    leaves)."""
+
+    def __init__(self, params, optim, group=None, offload=False,
+                 device="tpu", **kwargs):
+        self._optim = optim
+        self._optim._sharded_state = True
+        self._params = params
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_optim"], item)
+
+    def step(self):
+        self._optim.step()
+
+    def clear_grad(self):
+        self._optim.clear_grad()
+
+
+class GroupShardedStage2(Layer):
+    def __init__(self, layer, sharding_optimizer, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23, **kwargs):
+        super().__init__()
+        self._layers = layer
+        self._sharding_optimizer = sharding_optimizer
+        from ..base.topology import _get_hybrid_parallel_group
+        hcg = _get_hybrid_parallel_group()
+        size = hcg.get_sharding_parallel_world_size() if hcg else 1
+        apply_sharding_stage(layer, 2, max(size, 1))
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, sd, *a, **kw):
+        return self._layers.set_state_dict(sd, *a, **kw)
+
+
+class GroupShardedStage3(GroupShardedStage2):
+    def __init__(self, layer, optimizer=None, group=None,
+                 sync_buffers=False, segment_size=2 ** 20, **kwargs):
+        Layer.__init__(self)
+        self._layers = layer
+        self._sharding_optimizer = optimizer
+        from ..base.topology import _get_hybrid_parallel_group
+        hcg = _get_hybrid_parallel_group()
+        size = hcg.get_sharding_parallel_world_size() if hcg else 1
+        apply_sharding_stage(layer, 3, max(size, 1))
+
+    def get_all_parameters(self, convert2cpu=False):
+        return self._layers.parameters()

@@ -1,0 +1,84 @@
+"""Recompute / activation checkpointing (parity: python/paddle/
+distributed/fleet/recompute/recompute.py — SURVEY.md §2.2 "Recompute").
+
+Upstream re-runs forward inside backward with RNG-state replay via a
+PyLayer.  On TPU both paths reduce to ``jax.checkpoint`` (remat):
+
+* traced (jit step): ``jax.checkpoint`` around the block — XLA inserts
+  the rematerialisation, RNG determinism is free because keys are
+  explicit inputs.
+* eager tape: record one atomic closure node whose VJP is
+  ``jax.vjp(jax.checkpoint(fn))`` — the forward values are NOT saved
+  (only inputs), matching upstream's memory behaviour.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+
+from ....tensor import Tensor
+from ....autograd import tape as _tape
+from ....framework import random as _random
+
+
+def recompute(function: Callable, *args, **kwargs):
+    preserve = kwargs.pop("preserve_rng_state", True)
+    use_reentrant = kwargs.pop("use_reentrant", True)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    # Snapshot RNG so eager replay is deterministic (paddle semantics)
+    rng_state = _random.get_rng_state() if preserve else None
+
+    def pure_fn(*vals):
+        wrapped = []
+        it = iter(vals)
+        for a in args:
+            wrapped.append(Tensor(next(it)) if isinstance(a, Tensor)
+                           else a)
+        if rng_state is not None:
+            saved = _random.get_rng_state()
+            _random.set_rng_state(rng_state)
+        try:
+            out = function(*wrapped, **kwargs)
+        finally:
+            if rng_state is not None:
+                _random.set_rng_state(saved)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt_fn = jax.checkpoint(pure_fn)
+
+    from ....ops._primitive import apply_closure
+    return apply_closure(lambda *vals: ckpt_fn(*vals), tensor_args,
+                         name="recompute")
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """recompute over a Sequential's sublayers in segments."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions) if not hasattr(functions, "_sub_layers") \
+        else list(functions._sub_layers.values())
+    seg_size = max(len(layers) // max(segments, 1), 1)
+
+    def run_segment(start, end):
+        def fn(x):
+            for l in layers[start:end]:
+                x = l(x)
+            return x
+        return fn
+
+    x = args[0]
+    i = 0
+    while i < len(layers):
+        end = min(i + seg_size, len(layers))
+        x = recompute(run_segment(i, end), x)
+        i = end
+    return x
+
+
+def recompute_hybrid(ctx, function, *args, **kwargs):
+    return recompute(function, *args, **kwargs)

@@ -1,0 +1,3 @@
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa
+from . import sequence_parallel_utils  # noqa
+from ..recompute import recompute  # noqa

@@ -1,0 +1,37 @@
+"""Hybrid-parallel grad utilities (parity: python/paddle/distributed/
+fleet/utils/hybrid_parallel_util.py — fused_allreduce_gradients)."""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from ....tensor import Tensor
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Average grads over the dp group.  Inside a traced step this emits
+    one fused psum per dtype bucket (XLA fuses adjacent collectives —
+    the analog of upstream's 25MB bucketing); eagerly on one process
+    it's a no-op (dp sync happens in the compiled step)."""
+    group = hcg.get_data_parallel_group() if hcg else None
+    if group is None or group.nranks <= 1:
+        return
+    for p in parameter_list:
+        if p.grad is None:
+            continue
+        g = p.grad._value
+        if isinstance(g, jax.core.Tracer) and group.axis_name:
+            p.grad = Tensor(lax.psum(g, group.axis_name) / group.nranks)
+
+
+def broadcast_mp_parameters(model, hcg):
+    return None  # replicated-by-construction under SPMD
+
+
+def broadcast_dp_parameters(model, hcg):
+    return None
+
+
+def broadcast_sharding_parameters(model, hcg):
+    return None

@@ -1,0 +1,68 @@
+"""Megatron-SP utilities (parity: python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py — SURVEY.md §5.7 mechanism 1).
+
+On TPU the scatter/gather pair is a sharding-constraint pair: marking
+activations seq-sharded on 'mp' between blocks makes XLA replace the mp
+all-reduce with reduce-scatter (fwd) + all-gather (bwd) automatically —
+the transformation upstream implements with explicit autograd ops.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ....tensor import Tensor
+from .... import ops
+from ....nn.layer import Layer
+from ..meta_parallel.mp_layers import (_constrain_op,
+                                       ColumnParallelLinear,
+                                       RowParallelLinear)
+
+
+def scatter(x):
+    """Mark seq dim (axis 1 of [b, s, h]) sharded on 'mp'."""
+    return _constrain_op(x, spec=(None, "mp") + (None,) * (x.ndim - 2))
+
+
+def all_gather(x):
+    """Back to replicated seq."""
+    return _constrain_op(x, spec=(None,) * x.ndim)
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x):
+        return scatter(x)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x):
+        return all_gather(x)
+
+
+class AllGatherOp(GatherOp):
+    pass
+
+
+class ReduceScatterOp(ScatterOp):
+    pass
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    def forward(self, x):
+        x = all_gather(x)  # gather seq before the column matmul
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    def forward(self, x):
+        out = ops.linear(x, self.weight, None)
+        out = scatter(out)  # reduce-scatter onto seq shards
+        if self.bias is not None:
+            out = out + self.bias
+        return out

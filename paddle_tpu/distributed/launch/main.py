@@ -1,0 +1,121 @@
+"""python -m paddle_tpu.distributed.launch (parity: python/paddle/
+distributed/launch/main.py — SURVEY.md §3.3).
+
+Keeps the env contract (PADDLE_TRAINER_ID, PADDLE_TRAINERS_NUM,
+PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT, PADDLE_MASTER) and
+the per-rank ``log/workerlog.N`` files (§5.5 — load-bearing operational
+detail).
+
+TPU twist: one process drives all local chips (jax SPMD), so the
+default is ONE worker per host, not one per device; ``--nproc_per_node``
+is honoured for CPU-mesh simulation.  Watchdog: non-elastic mode kills
+the pod on any rank death and restarts up to --max_restart times with
+checkpoint-resume (elastic semantics of SURVEY.md §5.3).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--nnodes", type=str, default="1")
+    p.add_argument("--nproc_per_node", type=int, default=None)
+    p.add_argument("--master", type=str, default=None)
+    p.add_argument("--rank", type=int, default=-1)
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("--job_id", type=str, default="default")
+    p.add_argument("--devices", "--gpus", "--tpus", type=str, default=None,
+                   dest="devices")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_server", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    nnodes = int(str(args.nnodes).split(":")[0])
+    nproc = args.nproc_per_node or 1
+    os.makedirs(args.log_dir, exist_ok=True)
+
+    master = args.master
+    if master is None:
+        master = f"127.0.0.1:{_free_port()}"
+
+    world = nnodes * nproc
+    endpoints = []
+    base_port = _free_port()
+    for i in range(world):
+        endpoints.append(f"127.0.0.1:{base_port + i}")
+
+    procs: List[subprocess.Popen] = []
+    restarts = 0
+    while True:
+        procs.clear()
+        for local_rank in range(nproc):
+            rank = (max(args.rank, 0)) * nproc + local_rank
+            env = dict(os.environ)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+                "PADDLE_MASTER": master,
+                "PADDLE_JOB_ID": args.job_id,
+                "FLAGS_selected_tpus": str(local_rank),
+            })
+            log_path = os.path.join(args.log_dir,
+                                    f"workerlog.{local_rank}")
+            log_f = open(log_path, "a")
+            cmd = [sys.executable, args.training_script] + \
+                args.training_script_args
+            procs.append(subprocess.Popen(cmd, env=env, stdout=log_f,
+                                          stderr=subprocess.STDOUT))
+        # watchdog
+        failed = False
+        while True:
+            alive = [p.poll() is None for p in procs]
+            codes = [p.poll() for p in procs]
+            if not any(alive):
+                failed = any(c not in (0, None) for c in codes)
+                break
+            if any(c not in (0, None) for c in codes):
+                # a rank died: kill the pod (upstream non-elastic policy)
+                for p in procs:
+                    if p.poll() is None:
+                        p.send_signal(signal.SIGTERM)
+                failed = True
+                time.sleep(2)
+                break
+            time.sleep(1)
+        if not failed:
+            print(f"launch: job {args.job_id} finished OK")
+            return 0
+        restarts += 1
+        if restarts > args.max_restart:
+            print(f"launch: job failed after {restarts - 1} restarts",
+                  file=sys.stderr)
+            return 1
+        print(f"launch: restarting ({restarts}/{args.max_restart}) — "
+              "trainers resume from their last checkpoint")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

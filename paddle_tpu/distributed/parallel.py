@@ -1,0 +1,173 @@
+"""Process-level parallel environment (parity: python/paddle/distributed/
+parallel.py — ParallelEnv, init_parallel_env, DataParallel).
+
+Control plane: upstream rendezvouses through TCPStore and creates NCCL
+communicators per group (SURVEY.md §3.3).  Here ``init_parallel_env``
+maps the same env-var contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM
+/ PADDLE_MASTER) onto ``jax.distributed.initialize`` — the coordination
+service IS the TCPStore analog; mesh axes replace communicators.
+
+``DataParallel`` needs no Reducer on TPU: gradients are averaged by a
+``psum`` that XLA fuses into the backward (SURVEY.md §2.1 "DataParallel
+Reducer" row).  The wrapper installs a dp sharding annotation and
+averages grads across the dp axis eagerly when running multi-process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from ..nn.layer import Layer
+
+
+class ParallelEnv:
+    """Reads the paddle launch env contract."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._device_id = int(os.environ.get(
+            "FLAGS_selected_tpus",
+            os.environ.get("FLAGS_selected_gpus", "0")).split(",")[0])
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                                "")
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+
+_parallel_env_initialized = [False]
+
+
+def init_parallel_env():
+    """Multi-host bring-up: jax.distributed.initialize with the paddle
+    env contract.  Single-process (the common test path) is a no-op."""
+    env = ParallelEnv()
+    if _parallel_env_initialized[0]:
+        return env
+    if env.world_size > 1:
+        master = os.environ.get("PADDLE_MASTER")
+        if not master and env.trainer_endpoints:
+            master = env.trainer_endpoints[0]
+        jax.distributed.initialize(
+            coordinator_address=master,
+            num_processes=env.world_size,
+            process_id=env.rank)
+    _parallel_env_initialized[0] = True
+    return env
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.get_group_rank(ParallelEnv().rank)
+    return ParallelEnv().rank
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return ParallelEnv().world_size
+
+
+def is_initialized() -> bool:
+    return _parallel_env_initialized[0]
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel wrapper.
+
+    On TPU the gradient sync is not a wrapper concern: under jit+mesh the
+    dp ``psum`` is emitted by sharding propagation; in eager multi-process
+    mode ``fused_allreduce_gradients`` (fleet utils) is called by the
+    optimizer hook.  The wrapper therefore only (a) marks parameters with
+    a replicated dist spec, (b) forwards attribute access, keeping
+    upstream API semantics (including ``no_sync``)."""
+
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grad_sync_enabled = True
+        for p in layers.parameters():
+            p.is_distributed = False  # replicated under dp
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            prev = self._grad_sync_enabled
+            self._grad_sync_enabled = False
+            try:
+                yield
+            finally:
+                self._grad_sync_enabled = prev
+
+        return ctx()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def train(self):
+        self._layers.train()
+        return self
+
+    def eval(self):
+        self._layers.eval()
+        return self
+
+    @property
+    def training(self):
+        return self._layers.training
+
+    @training.setter
+    def training(self, v):
+        pass

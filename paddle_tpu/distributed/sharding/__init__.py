@@ -1,0 +1,48 @@
+"""paddle.distributed.sharding (parity: python/paddle/distributed/
+sharding/group_sharded.py — group_sharded_parallel / save_group_sharded_model)."""
+
+from __future__ import annotations
+
+from ..fleet.meta_parallel.sharding_parallel import (  # noqa
+    GroupShardedStage2, GroupShardedStage3, GroupShardedOptimizerStage2,
+    apply_sharding_stage)
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None,
+                           group=None, offload=False, sync_buffers=False,
+                           buffer_max_size=2 ** 23, segment_size=2 ** 20,
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
+    """level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3)."""
+    from ..fleet.base.topology import _get_hybrid_parallel_group
+    hcg = _get_hybrid_parallel_group()
+    size = hcg.get_sharding_parallel_world_size() if hcg else 1
+    if level == "os":
+        apply_sharding_stage(model, 1, max(size, 1))
+        optimizer._sharded_state = True
+        return model, optimizer, scaler
+    if level == "os_g":
+        opt = GroupShardedOptimizerStage2(model.parameters(), optimizer,
+                                          group=group, offload=offload)
+        wrapped = GroupShardedStage2(model, opt, group=group,
+                                     sync_buffers=sync_buffers,
+                                     buffer_max_size=buffer_max_size)
+        return wrapped, opt, scaler
+    if level == "p_g_os":
+        wrapped = GroupShardedStage3(model, optimizer=optimizer,
+                                     group=group,
+                                     sync_buffers=sync_buffers,
+                                     segment_size=segment_size)
+        optimizer._sharded_state = True
+        return wrapped, optimizer, scaler
+    raise ValueError(f"unknown level {level!r}; use os | os_g | p_g_os")
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    import os
+    from ...framework.io import save
+    os.makedirs(output, exist_ok=True)
+    target = model._layers if hasattr(model, "_layers") else model
+    save(target.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

@@ -1,0 +1,75 @@
+"""Runtime flag registry.
+
+TPU-native analog of Paddle's gflags-backed flag system
+(upstream: paddle/phi/core/flags.h, paddle/utils/flags.cc — see SURVEY.md
+§5.6).  Paddle exports C++ ``PHI_DEFINE_EXPORTED_*`` flags to Python via
+``paddle.set_flags``/``get_flags`` and seeds them from ``FLAGS_*``
+environment variables at import.  Here the registry is pure Python: flags
+are declared with a type + default, values are read from the environment
+once at import, and ``set_flags``/``get_flags`` keep the same call shape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+_REGISTRY: Dict[str, Any] = {}
+_TYPES: Dict[str, type] = {}
+
+
+def _coerce(typ: type, raw: Union[str, Any]):
+    if isinstance(raw, typ):
+        return raw
+    if typ is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return typ(raw)
+
+
+def define_flag(name: str, default: Any, help: str = "") -> None:
+    """Declare a flag. Environment variable of the same name wins over the
+    default, matching Paddle's import-time env scan."""
+    typ = type(default)
+    _TYPES[name] = typ
+    env = os.environ.get(name)
+    _REGISTRY[name] = _coerce(typ, env) if env is not None else default
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """``paddle.set_flags({'FLAGS_...': value})`` parity."""
+    for name, value in flags.items():
+        if name not in _REGISTRY:
+            raise ValueError(f"Unknown flag {name!r}")
+        _REGISTRY[name] = _coerce(_TYPES[name], value)
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    """``paddle.get_flags([...])`` parity."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for name in flags:
+        if name not in _REGISTRY:
+            raise ValueError(f"Unknown flag {name!r}")
+        out[name] = _REGISTRY[name]
+    return out
+
+
+def flag(name: str) -> Any:
+    return _REGISTRY[name]
+
+
+# ---------------------------------------------------------------------------
+# Core flags honoured by the framework (names follow upstream FLAGS_*).
+# ---------------------------------------------------------------------------
+define_flag("FLAGS_check_nan_inf", False,
+            "Scan op outputs for NaN/Inf (maps to jax debug_nans behaviour).")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "Determinism request; XLA:TPU is deterministic by default.")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "Accepted for compatibility; PJRT/XLA owns HBM allocation.")
+define_flag("FLAGS_use_stride_kernel", False, "Compat no-op.")
+define_flag("FLAGS_embedding_deterministic", 1, "Compat; TPU is deterministic.")
+define_flag("FLAGS_allocator_strategy", "auto_growth", "Compat no-op.")

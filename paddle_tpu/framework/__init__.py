@@ -1,0 +1,5 @@
+from . import dtype
+from . import random
+from .dtype import (  # noqa
+    DType, convert_dtype, set_default_dtype, get_default_dtype)
+from .random import seed, get_rng_state, set_rng_state  # noqa

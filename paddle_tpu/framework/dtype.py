@@ -1,0 +1,110 @@
+"""Dtype system.
+
+Paddle exposes dtypes as ``paddle.float32`` etc. (upstream:
+paddle/phi/common/data_type.h + python/paddle/framework/dtype.py).  Here a
+dtype is a thin alias object over a numpy/jax dtype so that
+``paddle.float32``, string names (``'float32'``), numpy dtypes and jax
+dtypes all interoperate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+
+class DType:
+    """A Paddle-style dtype handle wrapping a numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        if isinstance(other, str):
+            try:
+                return self == convert_dtype(other)
+            except (ValueError, TypeError):
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", ml_dtypes.float8_e5m2)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NAME["float"] = float32
+_BY_NAME["double"] = float64
+_BY_NAME["half"] = float16
+_BY_NAME["int"] = int32
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def set_default_dtype(d) -> None:
+    _DEFAULT_DTYPE[0] = convert_dtype(d)
+
+
+def get_default_dtype() -> str:
+    return _DEFAULT_DTYPE[0].name
+
+
+def default_float_dtype() -> DType:
+    return _DEFAULT_DTYPE[0]
+
+
+def convert_dtype(d) -> DType:
+    """Normalise str / numpy / jax / DType to a DType."""
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in _BY_NAME:
+            return _BY_NAME[d]
+        raise ValueError(f"Unknown dtype name {d!r}")
+    npd = np.dtype(d)
+    if npd in _BY_NP:
+        return _BY_NP[npd]
+    raise ValueError(f"Unsupported dtype {d!r}")
+
+
+def to_jax_dtype(d):
+    """DType/str/np → the dtype object jnp understands."""
+    return convert_dtype(d).np_dtype
+
+
+def is_floating(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.floating)
+
+
+def is_integer(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.integer)

@@ -1,0 +1,183 @@
+"""Random state management.
+
+Paddle has per-device Philox generators mutated in place
+(``paddle.seed``, ``paddle.get_rng_state``/``set_rng_state``) plus the
+model-parallel ``get_rng_state_tracker`` that gives TP ranks a *shared*
+seed for non-sharded tensors and *distinct* seeds for sharded dropout
+(upstream: python/paddle/distributed/fleet/layers/mpu/random.py — see
+SURVEY.md §2.2 "TP/MP" row).
+
+JAX wants explicit, splittable keys.  The bridge is a stateful generator
+holding a key that is split on every draw.  For jit-traced code the draw
+happens at *trace* time with a concrete fold-in counter, so a traced step
+function must thread keys explicitly — ``Generator.draw_key()`` returns a
+fresh concrete key that can be passed into a jitted function.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+_DEFAULT_SEED = 0
+
+
+class Generator:
+    """Stateful splittable PRNG — the analog of one Philox stream."""
+
+    def __init__(self, seed: int = 0):
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int) -> "Generator":
+        self._seed = int(seed)
+        self._counter = 0
+        return self
+
+    def seed(self) -> int:
+        return self._seed
+
+    def draw_key(self) -> jax.Array:
+        """Fresh key; advances state.  Concrete (never a tracer)."""
+        k = jax.random.fold_in(jax.random.PRNGKey(self._seed), self._counter)
+        self._counter += 1
+        return k
+
+    def get_state(self):
+        return {"seed": self._seed, "counter": self._counter}
+
+    def set_state(self, state):
+        self._seed = int(state["seed"])
+        self._counter = int(state["counter"])
+
+
+_default_generator = Generator(_DEFAULT_SEED)
+
+# When a functional/jit runner is active it installs a key provider so
+# random ops consume *traced* keys threaded through the step function
+# instead of trace-time constants from the stateful generator.
+_key_provider = None
+
+
+@contextlib.contextmanager
+def key_provider(provider):
+    """Install a zero-arg callable returning a fresh (possibly traced)
+    PRNG key; used by the jitted train-step runner."""
+    global _key_provider
+    prev = _key_provider
+    _key_provider = provider
+    try:
+        yield
+    finally:
+        _key_provider = prev
+
+
+def make_split_provider(key: jax.Array):
+    """Provider that derives key_i = fold_in(key, i) for i = 0,1,2,..."""
+    counter = [0]
+
+    def provider():
+        k = jax.random.fold_in(key, counter[0])
+        counter[0] += 1
+        return k
+
+    return provider
+
+
+def next_key() -> jax.Array:
+    """The one entry point random ops use to obtain a key."""
+    if _key_provider is not None:
+        return _key_provider()
+    return _default_generator.draw_key()
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int) -> Generator:
+    """``paddle.seed`` parity: reseeds the global generator (and the MP
+    tracker's base states are derived from it on registration)."""
+    _default_generator.manual_seed(s)
+    np.random.seed(s % (2 ** 32))
+    return _default_generator
+
+
+def get_rng_state():
+    return [_default_generator.get_state()]
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state[0] if isinstance(state, list) else state)
+
+
+def get_cuda_rng_state():  # compat alias used by recompute
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    set_rng_state(state)
+
+
+class RNGStatesTracker:
+    """Model-parallel RNG tracker (``get_rng_state_tracker`` parity).
+
+    Named states: ``global_seed`` shared across TP ranks,
+    ``local_seed`` distinct per TP rank — so dropout inside a
+    column/row-parallel pair is decorrelated while replicated tensors stay
+    identical.  ``rng_state(name)`` swaps the default generator for the
+    named one inside the context, exactly like upstream's tracker swaps
+    the CUDA RNG state.
+    """
+
+    def __init__(self):
+        self.states_: Dict[str, Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self.states_.setdefault(n, Generator(0)).set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        global _default_generator
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        orig = _default_generator
+        _default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            _default_generator = orig
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed_: int, mp_rank: int = 0):
+    """Initialise tracker the way fleet does: shared global seed, per-rank
+    local seed offset by the mp rank."""
+    _tracker.reset()
+    _tracker.add("global_seed", seed_ + 100003)
+    _tracker.add("local_seed", seed_ + 2048 + mp_rank * 1024)

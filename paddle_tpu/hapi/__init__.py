@@ -1,0 +1,3 @@
+from .model import Model  # noqa
+from . import callbacks  # noqa
+from .summary import summary  # noqa

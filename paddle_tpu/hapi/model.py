@@ -1,0 +1,390 @@
+"""paddle.Model (parity: python/paddle/hapi/model.py — SURVEY.md §3.1).
+
+Upstream's ``DynamicGraphAdapter.train_batch`` runs per-op eager kernels
+with a C++ backward queue; the TPU adapter compiles the WHOLE train step
+(forward + loss + grads + optimizer update) into one XLA program via
+``jax.value_and_grad`` over the functional form of the network — the
+conclusion of SURVEY.md §3.1: "on TPU the entire train_batch becomes ONE
+traced+compiled function".  Eager mode (`Model.prepare(jit=False)`) uses
+the tape for parity/debugging.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import functional_call as F
+from ..metric import Metric
+from ..framework import random as _random
+from ..framework.io import save as _save, load as _load
+from ..optimizer.lr import LRScheduler
+from . import callbacks as cbk_mod
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._use_jit = True
+        self._jit_train_step = None
+        self._jit_eval_step = None
+        self._opt_state = None
+        self.stop_training = False
+
+    # -- preparation --------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit: bool = True):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            assert isinstance(m, Metric), \
+                "metrics must be paddle_tpu.metric.Metric instances"
+        self._use_jit = jit
+        self._amp_level = None
+        self._amp_dtype = "bfloat16"
+        if amp_configs:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs
+            elif isinstance(amp_configs, dict):
+                self._amp_level = amp_configs.get("level", "O1")
+                self._amp_dtype = amp_configs.get("dtype", "bfloat16")
+        self._jit_train_step = None
+        self._jit_eval_step = None
+
+    # -- single-batch APIs --------------------------------------------------
+    def _prepare_data(self, data):
+        out = []
+        for d in _to_list(data):
+            if isinstance(d, Tensor):
+                out.append(d._value)
+            else:
+                out.append(jnp.asarray(np.asarray(d)))
+        return out
+
+    def _forward_with_loss(self, inputs, labels):
+        """Runs in both eager and traced contexts."""
+        from ..amp import auto_cast
+        import contextlib
+        ctx = (auto_cast(level=self._amp_level, dtype=self._amp_dtype)
+               if self._amp_level else contextlib.nullcontext())
+        with ctx:
+            outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        if self._loss is not None:
+            loss = self._loss(*(outs + labels))
+        else:
+            loss = outs[0]
+        return loss, outs
+
+    def _build_jit_train_step(self):
+        opt = self._optimizer
+        net = self.network
+
+        def step(params, frozen, buffers, opt_state, lr, key, *data):
+            n_in = self._n_inputs
+            inputs = [Tensor(v) for v in data[:n_in]]
+            labels = [Tensor(v) for v in data[n_in:]]
+
+            def loss_fn(p):
+                with F.bind(net, p, buffers, frozen) as holder:
+                    from ..autograd import tape as _tape
+                    with _tape.no_grad_ctx():
+                        with _random.key_provider(
+                                _random.make_split_provider(key)):
+                            loss, outs = self._forward_with_loss(inputs,
+                                                                 labels)
+                new_buf = holder.get("buffers", {})
+                return loss._value.astype(jnp.float32), (
+                    [o._value for o in outs], new_buf)
+
+            (loss_val, (out_vals, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt_state = opt.apply_gradients_tree(
+                params, grads, opt_state, lr)
+            return loss_val, out_vals, new_params, new_opt_state, new_buf
+
+        return jax.jit(step)
+
+    def _build_jit_eval_step(self):
+        net = self.network
+
+        def step(params, frozen, buffers, *data):
+            n_in = self._n_inputs
+            inputs = [Tensor(v) for v in data[:n_in]]
+            labels = [Tensor(v) for v in data[n_in:]]
+            with F.bind(net, params, buffers, frozen):
+                from ..autograd import tape as _tape
+                with _tape.no_grad_ctx():
+                    loss, outs = self._forward_with_loss(inputs, labels)
+            return loss._value, [o._value for o in outs]
+
+        return jax.jit(step)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs_v = self._prepare_data(inputs)
+        labels_v = self._prepare_data(labels)
+        self._n_inputs = len(inputs_v)
+        if self._use_jit:
+            return self._train_batch_jit(inputs_v, labels_v, update)
+        return self._train_batch_eager(inputs_v, labels_v, update)
+
+    def _train_batch_jit(self, inputs_v, labels_v, update=True):
+        if self._jit_train_step is None:
+            self._jit_train_step = self._build_jit_train_step()
+        net = self.network
+        params = F.param_dict(net)
+        frozen = F.frozen_dict(net)
+        buffers = F.buffer_dict(net)
+        if self._opt_state is None:
+            self._opt_state = self._optimizer.init_state_tree(params)
+        lr = jnp.asarray(self._optimizer.get_lr(), dtype=jnp.float32)
+        key = _random.default_generator().draw_key()
+        loss_val, out_vals, new_params, new_opt_state, new_buf = \
+            self._jit_train_step(params, frozen, buffers, self._opt_state,
+                                 lr, key, *inputs_v, *labels_v)
+        if update:
+            name_to_param = dict(net.named_parameters())
+            for n, v in new_params.items():
+                name_to_param[n]._value = v
+            self._opt_state = new_opt_state
+            name_to_buf = dict(net.named_buffers())
+            for n, v in new_buf.items():
+                if n in name_to_buf and name_to_buf[n] is not None:
+                    name_to_buf[n]._value = v
+            self._optimizer._global_step += 1
+        metrics = self._update_metrics(out_vals, labels_v)
+        return self._format_loss(loss_val), metrics
+
+    def _train_batch_eager(self, inputs_v, labels_v, update=True):
+        inputs = [Tensor(v) for v in inputs_v]
+        labels = [Tensor(v) for v in labels_v]
+        loss, outs = self._forward_with_loss(inputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics([o._value for o in outs], labels_v)
+        return self._format_loss(loss._value), metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs_v = self._prepare_data(inputs)
+        labels_v = self._prepare_data(labels)
+        self._n_inputs = len(inputs_v)
+        if self._jit_eval_step is None:
+            self._jit_eval_step = self._build_jit_eval_step()
+        net = self.network
+        loss_val, out_vals = self._jit_eval_step(
+            F.param_dict(net), F.frozen_dict(net), F.buffer_dict(net),
+            *inputs_v, *labels_v)
+        metrics = self._update_metrics(out_vals, labels_v)
+        return self._format_loss(loss_val), metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs_v = self._prepare_data(inputs)
+        from ..autograd import tape as _tape
+        with _tape.no_grad_ctx():
+            outs = self.network(*[Tensor(v) for v in inputs_v])
+        return [o.numpy() for o in _to_list(outs)]
+
+    def _update_metrics(self, out_vals, labels_v):
+        results = []
+        for m in self._metrics:
+            pred = Tensor(out_vals[0])
+            lbl = Tensor(labels_v[0]) if labels_v else None
+            corr = m.compute(pred, lbl)
+            r = m.update(corr)
+            results.append(r)
+        return results
+
+    def _format_loss(self, loss_val):
+        return [np.asarray(jax.device_get(loss_val))]
+
+    # -- loops --------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size,
+                                     num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        do_eval = eval_loader is not None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            steps = None
+        cbks = cbk_mod.config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=self._metrics_name())
+
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if hasattr(train_loader, "batch_sampler") and hasattr(
+                    train_loader.batch_sampler, "set_epoch"):
+                train_loader.batch_sampler.set_epoch(epoch)
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(train_loader, cbks, "train",
+                                       num_iters=num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if do_eval and epoch % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbks)
+                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
+            if self.stop_training:
+                break
+        cbks.on_end("train")
+
+    def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
+        self._reset_metrics()
+        logs: Dict[str, Any] = {}
+        for step, data in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            data = _to_list(data)
+            # split into inputs/labels: heuristic — loss present means the
+            # last item(s) are labels (paddle uses _inputs/_labels specs
+            # when provided)
+            n_label = len(_to_list(self._labels)) if self._labels else 1
+            if self._loss is None:
+                n_label = 0
+            inputs = data[:len(data) - n_label] if n_label else data
+            labels = data[len(data) - n_label:] if n_label else []
+            cbks.on_batch_begin(mode, step, logs)
+            if mode == "train":
+                loss, metrics = self.train_batch(inputs, labels)
+            else:
+                loss, metrics = self.eval_batch(inputs, labels)
+            logs["loss"] = loss
+            for name, val in zip(self._metrics_name()[1:], metrics):
+                logs[name] = val
+            logs["batch_size"] = (inputs[0].shape[0] if inputs else 0)
+            logs["step"] = step
+            cbks.on_batch_end(mode, step, logs)
+        self._merge_metric_logs(logs)
+        return logs
+
+    def _merge_metric_logs(self, logs):
+        for m in self._metrics:
+            res = m.accumulate()
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = res if isinstance(res, list) else [res]
+            for n, v in zip(names, vals):
+                logs[n] = v
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None,
+                 _callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = eval_data
+        cbks = _callbacks or cbk_mod.config_callbacks(
+            callbacks, model=self, log_freq=log_freq, verbose=verbose,
+            metrics=self._metrics_name())
+        cbks.on_begin("eval")
+        logs = self._run_one_epoch(loader, cbks, "eval",
+                                   num_iters=num_iters)
+        cbks.on_end("eval", logs)
+        out = {"loss": logs.get("loss")}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            for n in names:
+                if n in logs:
+                    out[n] = logs[n]
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        else:
+            loader = test_data
+        outputs = []
+        for data in loader:
+            data = _to_list(data)
+            n_label = 1 if self._loss is not None else 0
+            inputs = data[:len(data) - n_label] if n_label else data
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+        # transpose: list-of-batches → per-output list
+        if not outputs:
+            return []
+        n_out = len(outputs[0])
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r) for r in result]
+        return result
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        if training:
+            _save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                _save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from ..jit.save_load import save as jit_save
+            jit_save(self.network, path)
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = _load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_load(opt_path))
+        self._opt_state = None  # re-derive from optimizer state lazily
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size=input_size)
+
+    # -- helpers ------------------------------------------------------------
+    def _metrics_name(self):
+        names = ["loss"]
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _reset_metrics(self):
+        for m in self._metrics:
+            m.reset()

@@ -1,0 +1,5 @@
+"""paddle.incubate parity (staging ground — python/paddle/incubate/).
+Grown as features land; nn.functional fused ops alias the main ops
+(XLA fuses them anyway, which is the whole point on TPU)."""
+
+from . import nn  # noqa

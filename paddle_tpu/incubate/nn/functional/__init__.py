@@ -1,0 +1,70 @@
+"""incubate fused-op APIs (upstream: paddle/incubate/nn/functional/ —
+fused_multi_head_attention etc., backed by hand-fused CUDA in
+paddle/fluid/operators/fused/).  On TPU these alias the composable ops:
+XLA fusion produces the same fused kernels the CUDA versions hand-code
+(SURVEY.md §2.1 "Fused transformer ops": "XLA fusion does most")."""
+
+from ....ops.nn_ops import scaled_dot_product_attention  # noqa
+from ....ops.nn_ops import linear as fused_linear  # noqa
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, *args,
+                               **kwargs):
+    raise NotImplementedError(
+        "fused_multi_head_attention: use nn.MultiHeadAttention — XLA "
+        "fuses the composed form on TPU")
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
+    raise NotImplementedError(
+        "fused_feedforward: use Linear+activation — XLA fuses on TPU")
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, *args,
+                     **kwargs):
+    from ....ops.nn_ops import layer_norm
+    return layer_norm(x, x.shape[-1:], norm_weight, norm_bias, epsilon)
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5,
+                                           ln_epsilon=1e-5, training=True):
+    from ....ops.nn_ops import layer_norm, dropout
+    out = x if bias is None else x + bias
+    out = dropout(out, p=dropout_rate, training=training)
+    out = out + residual
+    return layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1):
+    from ....ops.nn_ops import rms_norm
+    return rms_norm(x, norm_weight, norm_bias, epsilon, begin_norm_axis)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """RoPE (upstream fused_rope CUDA kernel) — composed form, fused by
+    XLA."""
+    import jax.numpy as jnp
+    from ....ops._primitive import primitive
+
+    @primitive(name="rope_apply")
+    def _rope(t, sin_, cos_):
+        # t: [b, s, h, d]
+        if use_neox_rotary_style:
+            d = t.shape[-1]
+            t1, t2 = t[..., : d // 2], t[..., d // 2:]
+            rot = jnp.concatenate([-t2, t1], axis=-1)
+        else:
+            t1 = t[..., ::2]
+            t2 = t[..., 1::2]
+            rot = jnp.stack([-t2, t1], axis=-1).reshape(t.shape)
+        return t * cos_ + rot * sin_
+
+    outs = []
+    for t in (q, k, v):
+        outs.append(None if t is None else _rope(t, sin, cos))
+    return tuple(outs)

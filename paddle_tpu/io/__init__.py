@@ -1,0 +1,17 @@
+"""paddle.io parity: Dataset / DataLoader / samplers.
+
+Upstream uses multiprocess workers + a C++ BlockingQueue feeding pinned
+host memory (SURVEY.md §2.1 "DataLoader C++ core").  On TPU the input
+pipeline is host-side numpy batching + async ``jax.device_put``
+double-buffering; XLA overlaps the H2D copy with the previous step, so a
+threaded prefetcher replaces the C++ queue (profiles will tell if a
+native ring buffer is ever needed — §7.0 defers it).
+"""
+
+from .dataset import (  # noqa
+    Dataset, IterableDataset, TensorDataset, ComposeDataset,
+    ChainDataset, Subset, ConcatDataset, random_split)
+from .sampler import (  # noqa
+    Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
+    BatchSampler, DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa

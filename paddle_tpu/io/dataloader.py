@@ -1,0 +1,155 @@
+"""DataLoader (parity: python/paddle/io/dataloader/).
+
+Threaded prefetch instead of upstream's fork-based workers + C++
+BlockingQueue: TPU hosts feed from numpy; the expensive part (H2D) is
+async under jax, so a small thread pool + bounded queue gives the same
+overlap the BufferedReader provides upstream.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, List, Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples; mirrors paddle's default_collate_fn."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s.numpy()) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = list(zip(*batch))
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch])
+                for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return Tensor(np.asarray(batch))
+
+
+class _PrefetchIterator:
+    def __init__(self, gen_fn, buffer_size: int):
+        self._q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+        self._done = object()
+        self._err = None
+        self._stop = threading.Event()
+
+        def worker():
+            try:
+                for item in gen_fn():
+                    # bounded put with a stop check so an abandoned
+                    # iterator doesn't park this thread (and its buffered
+                    # batches) forever
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if self._stop.is_set():
+                        return
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                # the sentinel must arrive or the consumer blocks forever;
+                # keep trying unless the iterator was abandoned
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(self._done, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._t = threading.Thread(target=worker, daemon=True)
+        self._t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is self._done:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self):
+        self._stop.set()
+
+    def __del__(self):
+        self._stop.set()
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = max(prefetch_factor, 1)
+        self.use_buffer_reader = use_buffer_reader
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            if batch_size is None:
+                self.batch_sampler = None
+                self.batch_size = None
+            else:
+                self.batch_sampler = BatchSampler(
+                    dataset, shuffle=shuffle, batch_size=batch_size,
+                    drop_last=drop_last)
+
+    def _generate(self):
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if self.batch_size and len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.dataset[i]
+            return
+        for indices in self.batch_sampler:
+            batch = [self.dataset[i] for i in indices]
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self.use_buffer_reader:
+            return _PrefetchIterator(self._generate, self.prefetch_factor)
+        return self._generate()
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)

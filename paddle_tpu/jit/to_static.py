@@ -1,0 +1,117 @@
+"""@to_static → jax.jit of the functional form."""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..tensor import Tensor
+from ..nn.layer import Layer
+from ..nn import functional_call as F
+from ..framework import random as _random
+
+
+class StaticFunction:
+    """Callable wrapper: caches one compiled XLA program per input
+    signature (shape/dtype), like upstream's program cache keyed on
+    input spec."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 input_spec=None, full_graph=True):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: Dict[Any, Any] = {}
+        functools.update_wrapper(self, fn)
+
+    def _get_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def __call__(self, *args, **kwargs):
+        layer, call_args = self._get_layer(args)
+        arg_vals = tuple(a._value if isinstance(a, Tensor) else a
+                         for a in call_args)
+        if layer is None:
+            jitted = self._cache.get("fn")
+            if jitted is None:
+                def pure(*vals):
+                    wrapped = [Tensor(v) for v in vals]
+                    out = self._fn(*wrapped, **kwargs)
+                    return F.unwrap_structure(out)
+                jitted = jax.jit(pure)
+                self._cache["fn"] = jitted
+            out_vals = jitted(*arg_vals)
+            return jax.tree_util.tree_map(Tensor, out_vals)
+
+        # Layer-bound: params/buffers become traced inputs
+        key = "layer"
+        jitted = self._cache.get(key)
+        if jitted is None:
+            fn = self._fn
+
+            def pure(params, frozen, buffers, rng_key, *vals):
+                with F.bind(layer, params, buffers, frozen) as holder:
+                    from ..autograd import tape as _tape
+                    with _random.key_provider(
+                            _random.make_split_provider(rng_key)):
+                        wrapped = [Tensor(v) for v in vals]
+                        out = fn(*wrapped, **kwargs)
+                return F.unwrap_structure(out), holder.get("buffers", {})
+
+            jitted = jax.jit(pure)
+            self._cache[key] = jitted
+        params = F.param_dict(layer)
+        frozen = F.frozen_dict(layer)
+        buffers = F.buffer_dict(layer)
+        rng_key = _random.default_generator().draw_key()
+        out_vals, new_buffers = jitted(params, frozen, buffers, rng_key,
+                                       *arg_vals)
+        # commit buffer updates (BN running stats)
+        name_to_buf = dict(layer.named_buffers())
+        for n, v in new_buffers.items():
+            if n in name_to_buf and name_to_buf[n] is not None:
+                name_to_buf[n]._value = v
+        return jax.tree_util.tree_map(Tensor, out_vals)
+
+    @property
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Decorator/wrapper; works on functions and Layers."""
+
+    def wrap(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            sf = StaticFunction(layer.forward, layer=layer,
+                                input_spec=input_spec)
+            layer.forward = sf
+            return layer
+        return StaticFunction(fn, input_spec=input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+class TracedLayer:
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._fn = static_fn
+
+    def __call__(self, *args):
+        return self._fn(*args)

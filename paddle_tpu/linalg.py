@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (python/paddle/tensor/linalg.py exports)."""
+
+from .ops.linalg import (  # noqa
+    matmul, bmm, mm, dot, mv, cross, trace, norm, dist, cholesky,
+    cholesky_solve, qr, svd, eig, eigh, eigvals, eigvalsh, inverse, inv,
+    pinv, solve, triangular_solve, lstsq, matrix_power, matrix_rank, det,
+    slogdet, cond, lu, multi_dot, corrcoef, cov, householder_product)

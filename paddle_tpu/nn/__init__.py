@@ -1,0 +1,33 @@
+"""paddle.nn parity surface (python/paddle/nn/)."""
+
+from .layer import Layer  # noqa
+from .param_attr import ParamAttr  # noqa
+from . import initializer  # noqa
+from . import functional  # noqa
+from .common import (  # noqa
+    Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
+    Flatten, Identity, Pad1D, Pad2D, Upsample, PixelShuffle,
+    CosineSimilarity, Bilinear)
+from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa
+from .pooling import (  # noqa
+    MaxPool2D, AvgPool2D, MaxPool1D, AvgPool1D, AdaptiveAvgPool2D,
+    AdaptiveMaxPool2D, AdaptiveAvgPool1D)
+from .norm import (  # noqa
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm2D, LocalResponseNorm)
+from .activation_layers import (  # noqa
+    ReLU, ReLU6, LeakyReLU, ELU, SELU, CELU, GELU, Silu, Swish, Hardswish,
+    Sigmoid, LogSigmoid, Hardsigmoid, Hardtanh, Tanh, Tanhshrink, Softplus,
+    Softsign, Softshrink, Hardshrink, Mish, ThresholdedReLU, Maxout, GLU,
+    Softmax, LogSoftmax, PReLU)
+from .container import (  # noqa
+    Sequential, LayerList, ParameterList, LayerDict)
+from .loss import (  # noqa
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss)
+from .transformer import (  # noqa
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer)
+from . import functional_call  # noqa
+from .clip_grad import ClipGradByNorm, ClipGradByValue, ClipGradByGlobalNorm  # noqa
+from .utils import utils  # noqa

@@ -1,0 +1,83 @@
+"""Activation layers (parity: python/paddle/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+from .. import ops
+from .layer import Layer
+from . import initializer as I
+
+
+def _make(name, op_name=None, **fixed):
+    op = getattr(ops, op_name or name.lower())
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            self._kwargs = {**fixed}
+            # positional args map onto the op's extra params in order
+            self._args = args
+            self._kwargs.update({k: v for k, v in kwargs.items()
+                                 if k != "name"})
+
+        def forward(self, x):
+            return op(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _make("ReLU", "relu")
+ReLU6 = _make("ReLU6", "relu6")
+LeakyReLU = _make("LeakyReLU", "leaky_relu")
+ELU = _make("ELU", "elu")
+SELU = _make("SELU", "selu")
+CELU = _make("CELU", "celu")
+GELU = _make("GELU", "gelu")
+Silu = _make("Silu", "silu")
+Swish = _make("Swish", "swish")
+Hardswish = _make("Hardswish", "hardswish")
+Sigmoid = _make("Sigmoid", "sigmoid")
+LogSigmoid = _make("LogSigmoid", "log_sigmoid")
+Hardsigmoid = _make("Hardsigmoid", "hardsigmoid")
+Hardtanh = _make("Hardtanh", "hardtanh")
+Tanh = _make("Tanh", "tanh")
+Tanhshrink = _make("Tanhshrink", "tanhshrink")
+Softplus = _make("Softplus", "softplus")
+Softsign = _make("Softsign", "softsign")
+Softshrink = _make("Softshrink", "softshrink")
+Hardshrink = _make("Hardshrink", "hardshrink")
+Mish = _make("Mish", "mish")
+ThresholdedReLU = _make("ThresholdedReLU", "thresholded_relu")
+Maxout = _make("Maxout", "maxout")
+GLU = _make("GLU", "glu")
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return ops.softmax(x, axis=self.axis)
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return ops.log_softmax(x, axis=self.axis)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter(
+            shape=[num_parameters], attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return ops.prelu(x, self.weight, self._data_format)

@@ -1,0 +1,94 @@
+"""Gradient clipping (parity: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm is load-bearing for the GPT config's
+HybridParallelOptimizer, SURVEY.md §3.4)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor(g._value * scale)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Scale all grads by clip_norm/global_norm when global_norm exceeds
+    clip_norm.  In hybrid parallel the square-sums are summed across
+    mp/pp/sharding groups before the sqrt — HybridParallelOptimizer calls
+    ``_comm_sq_sum`` hook for that (psum over the relevant mesh axes)."""
+
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self._comm_hook = None  # set by HybridParallelOptimizer
+
+    def _dygraph_clip(self, params_grads):
+        sq = None
+        for _, g in params_grads:
+            if g is None:
+                continue
+            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            sq = s if sq is None else sq + s
+        if sq is None:
+            return params_grads
+        if self._comm_hook is not None:
+            sq = self._comm_hook(sq)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, Tensor((g._value.astype(jnp.float32) * scale
+                                   ).astype(g._value.dtype))))
+        return out
+
+    def pure_clip(self, grads):
+        """Pure-array version for the jitted optimizer path: grads is a
+        dict name→array; returns scaled dict."""
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                 for g in grads.values())
+        if self._comm_hook is not None:
+            sq = self._comm_hook(sq)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return {n: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for n, g in grads.items()}

@@ -1,0 +1,108 @@
+"""Convolution layers (parity: python/paddle/nn/layer/conv.py).
+
+Weight layout matches paddle: [out_c, in_c/groups, *kernel]; transpose
+conv: [in_c, out_c/groups, *kernel].
+"""
+
+from __future__ import annotations
+
+from .. import ops
+from .layer import Layer
+from . import initializer as I
+
+
+def _pair(v, n=2):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd,
+                 stride=1, padding=0, dilation=1, groups=1,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NCHW", transpose=False, output_padding=0):
+        super().__init__()
+        self._in_channels = in_channels
+        self._out_channels = out_channels
+        self._kernel_size = _pair(kernel_size, nd)
+        self._stride = _pair(stride, nd)
+        self._padding = padding
+        self._dilation = _pair(dilation, nd)
+        self._groups = groups
+        self._data_format = data_format
+        self._output_padding = output_padding
+        if transpose:
+            w_shape = [in_channels, out_channels // groups,
+                       *self._kernel_size]
+        else:
+            w_shape = [out_channels, in_channels // groups,
+                       *self._kernel_size]
+        fan_in = (in_channels // groups) * int(
+            __import__("numpy").prod(self._kernel_size))
+        self.weight = self.create_parameter(
+            shape=w_shape, attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+
+class Conv1D(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv1d(x, self.weight, self.bias, self._stride,
+                          self._padding, self._dilation, self._groups)
+
+
+class Conv2D(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv2d(x, self.weight, self.bias, self._stride,
+                          self._padding, self._dilation, self._groups,
+                          self._data_format)
+
+    def extra_repr(self):
+        return (f"{self._in_channels}, {self._out_channels}, "
+                f"kernel_size={self._kernel_size}, stride={self._stride}, "
+                f"padding={self._padding}")
+
+
+class Conv3D(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride,
+                         padding, dilation, groups, padding_mode,
+                         weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return ops.conv3d(x, self.weight, self.bias, self._stride,
+                          self._padding, self._dilation, self._groups)
+
+
+class Conv2DTranspose(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv2d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._dilation, self._groups, output_size)

@@ -1,0 +1,70 @@
+"""paddle.nn.functional parity surface — re-exports from the op table
+(python/paddle/nn/functional/ in upstream is itself thin wrappers over
+_C_ops; here the op table IS the functional API)."""
+
+from ...ops.activation import (  # noqa
+    relu, relu6, leaky_relu, prelu, rrelu, elu, selu, celu, gelu, silu,
+    swish, hardswish, sigmoid, log_sigmoid, hardsigmoid, hardtanh,
+    tanhshrink, softplus, softsign, softshrink, hardshrink, mish, tanh,
+    softmax, log_softmax, gumbel_softmax, glu, maxout, thresholded_relu)
+from ...ops.nn_ops import (  # noqa
+    conv1d, conv2d, conv3d, conv2d_transpose, max_pool1d, max_pool2d,
+    avg_pool1d, avg_pool2d, adaptive_avg_pool1d, adaptive_avg_pool2d,
+    adaptive_max_pool2d, layer_norm, rms_norm, instance_norm, group_norm,
+    local_response_norm, dropout, dropout2d, dropout3d, alpha_dropout,
+    embedding, cross_entropy, softmax_with_cross_entropy,
+    binary_cross_entropy, binary_cross_entropy_with_logits, mse_loss,
+    l1_loss, smooth_l1_loss, nll_loss, kl_div, margin_ranking_loss,
+    hinge_embedding_loss, cosine_similarity, cosine_embedding_loss,
+    scaled_dot_product_attention, interpolate, upsample, pixel_shuffle,
+    pixel_unshuffle, channel_shuffle, temporal_shift, linear)
+from ...ops.manipulation import pad, unfold  # noqa
+from ...ops.creation import one_hot  # noqa
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW"):
+    from ...ops import nn_ops
+    if training:
+        out, _, _ = nn_ops.batch_norm_train(
+            x, running_mean, running_var, weight, bias, momentum=momentum,
+            epsilon=epsilon, data_format=data_format)
+        return out
+    return nn_ops.batch_norm_eval(x, running_mean, running_var, weight,
+                                  bias, epsilon=epsilon,
+                                  data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    from ... import ops
+    norm = ops.norm(x, p=float(p), axis=axis, keepdim=True)
+    return ops.divide(x, ops.maximum(norm, ops.full_like(norm, epsilon)))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    training=True, name=None):
+    """Paddle flash_attention API (upstream wraps the CUDA flashattn lib,
+    paddle/phi/kernels/gpu/flash_attn_kernel.cu).  Here: Pallas TPU flash
+    kernel when available, XLA attention otherwise."""
+    from ...ops import pallas_ops
+    out = pallas_ops.flash_attention(query, key, value, causal=causal,
+                                     dropout=dropout, training=training)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    from ... import ops
+    import jax.numpy as jnp
+    from ...ops._primitive import unwrap
+    from ...tensor import Tensor
+    xv = unwrap(x)
+    if maxlen is None:
+        maxlen = int(xv.max())
+    rng = jnp.arange(maxlen)
+    mask = rng[None, :] < xv[..., None]
+    from ...framework import dtype as dtypes
+    return Tensor(mask.astype(dtypes.to_jax_dtype(dtype)))

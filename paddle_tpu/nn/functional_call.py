@@ -1,0 +1,106 @@
+"""Functional execution of stateful Layers — the bridge to jax.jit.
+
+Paddle fuses a whole train step into one graph via ``@to_static`` +
+StandaloneExecutor (SURVEY.md §3.5).  The TPU-native equivalent: run the
+user's imperative ``Layer`` under a *rebinding context* where every
+Parameter/buffer handle temporarily holds a traced value, so
+``jax.jit``/``jax.value_and_grad`` see a pure function
+
+    (params, buffers, inputs, key) -> (loss/outputs, new_buffers)
+
+No user code changes — the same ``forward`` that runs eagerly traces
+functionally, which is what lets Model.fit/`to_static` compile the step
+while ``loss.backward()`` keeps working eagerly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Tuple
+
+import jax
+
+from ..tensor import Tensor
+from ..autograd import tape as _tape
+from ..framework import random as _random
+
+
+def param_dict(layer) -> Dict[str, Any]:
+    """name → jax array for all trainable parameters."""
+    return {n: p._value for n, p in layer.named_parameters()
+            if not p.stop_gradient}
+
+
+def frozen_dict(layer) -> Dict[str, Any]:
+    return {n: p._value for n, p in layer.named_parameters()
+            if p.stop_gradient}
+
+
+def buffer_dict(layer) -> Dict[str, Any]:
+    return {n: b._value for n, b in layer.named_buffers()
+            if b is not None}
+
+
+@contextlib.contextmanager
+def bind(layer, params: Dict[str, Any] = None,
+         buffers: Dict[str, Any] = None, frozen: Dict[str, Any] = None):
+    """Temporarily swap parameter/buffer values (possibly tracers) into
+    the layer tree; restore originals on exit.  Buffer mutations made by
+    forward (e.g. BN running stats) are captured in ``captured_buffers``.
+    """
+    name_to_param = dict(layer.named_parameters())
+    name_to_buf = dict(layer.named_buffers())
+    saved_p = {n: p._value for n, p in name_to_param.items()}
+    saved_b = {n: (b._value if b is not None else None)
+               for n, b in name_to_buf.items()}
+    try:
+        if params:
+            for n, v in params.items():
+                name_to_param[n]._value = v
+        if frozen:
+            for n, v in frozen.items():
+                name_to_param[n]._value = v
+        if buffers:
+            for n, v in buffers.items():
+                if name_to_buf.get(n) is not None:
+                    name_to_buf[n]._value = v
+        holder = {}
+        yield holder
+        holder["buffers"] = {n: b._value for n, b in name_to_buf.items()
+                             if b is not None}
+    finally:
+        for n, p in name_to_param.items():
+            p._value = saved_p[n]
+        for n, b in name_to_buf.items():
+            if b is not None and saved_b[n] is not None:
+                b._value = saved_b[n]
+
+
+def functional_call(layer, params, buffers, args, kwargs=None, key=None,
+                    frozen=None):
+    """Pure-functional forward: returns (outputs, new_buffers).
+
+    Run with the tape disabled (grads come from jax.grad around this) and
+    with a key provider threading ``key`` into dropout etc.
+    """
+    kwargs = kwargs or {}
+    ctx = (_random.key_provider(_random.make_split_provider(key))
+           if key is not None else contextlib.nullcontext())
+    with bind(layer, params, buffers, frozen) as holder:
+        with _tape.no_grad_ctx():
+            with ctx:
+                wrapped = [Tensor(a) if not isinstance(a, Tensor) else a
+                           for a in args]
+                out = layer(*wrapped, **kwargs)
+    return out, holder.get("buffers", {})
+
+
+def unwrap_structure(out):
+    """Tensor tree → jax array tree (for returning through jit)."""
+    if isinstance(out, Tensor):
+        return out._value
+    if isinstance(out, (list, tuple)):
+        return type(out)(unwrap_structure(o) for o in out)
+    if isinstance(out, dict):
+        return {k: unwrap_structure(v) for k, v in out.items()}
+    return out

@@ -1,0 +1,202 @@
+"""Weight initializers (parity: python/paddle/nn/initializer/).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing
+from the framework RNG (``framework.random.next_key``), so
+``paddle.seed`` makes init deterministic like upstream's Philox path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework import dtype as dtypes
+from ..framework import random as _random
+
+
+def _fan_in_out(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weight is [in, out]
+        return shape[0], shape[1]
+    # conv weight [out_c, in_c/groups, *k]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value,
+                        dtypes.to_jax_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        return (jax.random.normal(k, tuple(shape), jnp.float32)
+                * self.std + self.mean).astype(dtypes.to_jax_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        out = jax.random.truncated_normal(k, self.a, self.b, tuple(shape),
+                                          jnp.float32)
+        return (out * self.std + self.mean).astype(
+            dtypes.to_jax_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        return jax.random.uniform(
+            k, tuple(shape), jnp.float32, self.low, self.high
+        ).astype(dtypes.to_jax_dtype(dtype))
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = _random.next_key()
+        return (jax.random.normal(k, tuple(shape), jnp.float32) * std
+                ).astype(dtypes.to_jax_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self._fan_in, self._fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = _random.next_key()
+        return jax.random.uniform(k, tuple(shape), jnp.float32,
+                                  -limit, limit).astype(
+            dtypes.to_jax_dtype(dtype))
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = (math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+                if self.nonlinearity in ("relu", "leaky_relu") else 1.0)
+        std = gain / math.sqrt(fi)
+        k = _random.next_key()
+        return (jax.random.normal(k, tuple(shape), jnp.float32) * std
+                ).astype(dtypes.to_jax_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        gain = (math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+                if self.nonlinearity in ("relu", "leaky_relu") else 1.0)
+        limit = gain * math.sqrt(3.0 / fi)
+        k = _random.next_key()
+        return jax.random.uniform(k, tuple(shape), jnp.float32,
+                                  -limit, limit).astype(
+            dtypes.to_jax_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ..tensor import Tensor
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v.numpy()
+        arr = jnp.asarray(np.asarray(v), dtype=dtypes.to_jax_dtype(dtype))
+        assert tuple(arr.shape) == tuple(shape), \
+            f"Assign shape {arr.shape} != {tuple(shape)}"
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        k = _random.next_key()
+        return (jax.nn.initializers.orthogonal(
+            scale=self.gain)(k, tuple(shape), jnp.float32)
+        ).astype(dtypes.to_jax_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        out = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        k_center = tuple(s // 2 for s in shape[2:])
+        for i in range(min(oc, ic * self.groups)):
+            idx = (i, i % ic) + k_center
+            out[idx] = 1.0
+        return jnp.asarray(out, dtype=dtypes.to_jax_dtype(dtype))
+
+
+# paddle also exposes functional-style names
+constant = Constant
+normal = Normal
+uniform = Uniform
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    return 1.0

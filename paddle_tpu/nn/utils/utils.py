@@ -1,0 +1,23 @@
+"""nn.utils (parity: python/paddle/nn/utils/)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor import Tensor
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate(
+        [p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    for p in parameters:
+        n = int(jnp.prod(jnp.asarray(p._value.shape))) if p._value.shape \
+            else 1
+        p._value = v[offset:offset + n].reshape(p._value.shape).astype(
+            p._value.dtype)
+        offset += n

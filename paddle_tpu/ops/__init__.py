@@ -1,0 +1,104 @@
+"""The op library: Paddle op names → jax-traceable functions.
+
+This package is the TPU analog of PHI's kernel library + the generated
+C++ API (SURVEY.md §2.1 "PHI C++ API (codegen)"): one table, every op a
+pure function, dispatch at trace time.  ``OP_TABLE`` is the registry the
+static-graph shim and parity audits consume.
+
+Tensor methods (``x.sum()``, ``x.reshape(...)``) are attached here to
+avoid a circular import at tensor.py definition time — the analog of
+upstream's monkey-patched ``Tensor`` methods
+(python/paddle/tensor/__init__.py ``tensor_method_func`` list).
+"""
+
+from ._primitive import OP_TABLE, primitive, apply_closure, unwrap  # noqa
+from .math import *  # noqa
+from .creation import *  # noqa
+from .manipulation import *  # noqa
+from .linalg import *  # noqa
+from .logic import *  # noqa
+from .activation import *  # noqa
+from .nn_ops import *  # noqa
+
+from ..tensor import Tensor as _Tensor
+
+# ---------------------------------------------------------------------------
+# Attach op methods to Tensor (paddle patches ~300 methods; we cover the
+# commonly used surface and grow as model families require).
+# ---------------------------------------------------------------------------
+_METHOD_OPS = [
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "maximum", "minimum", "abs", "neg", "sign", "sqrt",
+    "rsqrt", "square", "exp", "log", "log2", "log10", "log1p", "sin",
+    "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "floor",
+    "ceil", "round", "trunc", "reciprocal", "erf", "clip", "lerp",
+    "scale", "increment",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "std", "var", "argmax", "argmin",
+    "cumsum", "cumprod", "logsumexp", "all", "any", "median", "topk",
+    "sort", "argsort", "count_nonzero", "nansum", "nanmean", "kthvalue",
+    # manipulation
+    "reshape", "transpose", "flatten", "squeeze", "unsqueeze", "tile",
+    "expand", "expand_as", "broadcast_to", "flip", "roll", "gather",
+    "gather_nd", "scatter", "index_select", "masked_fill", "split",
+    "chunk", "unbind", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "moveaxis", "swapaxes", "pad", "unique", "nonzero",
+    "masked_select", "tolist", "diagonal", "tril", "triu",
+    # linalg
+    "matmul", "mm", "bmm", "dot", "norm", "dist", "trace", "inverse",
+    "cholesky", "t",
+    # logic
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_xor",
+    "logical_not", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "bitwise_not", "allclose", "isclose", "equal_all", "isnan", "isinf",
+    "isfinite",
+    # creation-ish
+    "zeros_like", "ones_like", "full_like",
+]
+
+_g = globals()
+for _name in _METHOD_OPS:
+    if _name in _g and not hasattr(_Tensor, _name):
+        setattr(_Tensor, _name, _g[_name])
+
+# in-place variants: out-of-place op + buffer swap (paddle `op_` parity)
+_INPLACE_OPS = ["add", "subtract", "multiply", "divide", "clip", "scale",
+                "floor", "ceil", "exp", "sqrt", "rsqrt", "reciprocal",
+                "round", "remainder", "tanh", "squeeze", "unsqueeze",
+                "reshape", "flatten"]
+
+
+def _make_inplace(op_name):
+    fn = _g[op_name]
+
+    def method(self, *args, **kwargs):
+        out = fn(self, *args, **kwargs)
+        self._value = out._value
+        return self
+
+    method.__name__ = op_name + "_"
+    return method
+
+
+for _name in _INPLACE_OPS:
+    if _name in _g:
+        setattr(_Tensor, _name + "_", _make_inplace(_name))
+
+
+def _uniform_(self, min=-1.0, max=1.0, seed=0):
+    from .creation import uniform as _uniform
+    self._value = _uniform(self.shape, dtype=self.dtype, min=min,
+                           max=max, seed=seed)._value
+    return self
+
+
+def _normal_(self, mean=0.0, std=1.0):
+    from .creation import normal as _normal
+    self._value = _normal(mean, std, self.shape).astype(self.dtype)._value
+    return self
+
+
+_Tensor.uniform_ = _uniform_
+_Tensor.normal_ = _normal_

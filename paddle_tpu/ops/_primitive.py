@@ -1,0 +1,128 @@
+"""Op dispatch: the TPU-native analog of PHI's kernel registry.
+
+Upstream maps (op, backend, layout, dtype) → a C++/CUDA kernel through
+``phi::KernelFactory`` (paddle/phi/core/kernel_registry.h — SURVEY.md
+§2.1 "Kernel registry & dispatch").  Here every op is a *pure jax
+function over arrays*; the ``primitive`` decorator provides the uniform
+entry path that upstream's generated ``*_ad_func`` wrappers provide:
+
+  1. unwrap Tensor args → jax arrays (snapshot for the tape),
+  2. AMP auto-cast hook (set by paddle_tpu.amp when an auto_cast scope
+     is active — the analog of the amp logic in eager ad_funcs),
+  3. run the jax fn (XLA executes async on the device),
+  4. wrap outputs, propagate stop_gradient, record a tape node,
+  5. optional NaN/Inf scan under FLAGS_check_nan_inf.
+
+``OP_TABLE`` maps Paddle op names → wrapped callables, which is what the
+static-graph shim and the YAML-parity audit consume.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..autograd import tape as _tape
+from .. import flags as _flags
+
+OP_TABLE: Dict[str, Callable] = {}
+
+# AMP hook: amp.auto_cast installs a callable (opname, vals) -> vals.
+_amp_hook: Optional[Callable] = None
+
+
+def set_amp_hook(hook: Optional[Callable]) -> None:
+    global _amp_hook
+    _amp_hook = hook
+
+
+def _wrap_out(v) -> Tensor:
+    return Tensor(v, stop_gradient=True)
+
+
+def _check_nan_inf(name: str, outs) -> None:
+    for o in outs:
+        v = o._value
+        if jnp.issubdtype(v.dtype, jnp.inexact) and not isinstance(
+                v, jax.core.Tracer):
+            bad = bool(jnp.any(~jnp.isfinite(v)))
+            if bad:
+                raise FloatingPointError(
+                    f"FLAGS_check_nan_inf: op '{name}' produced NaN/Inf")
+
+
+def primitive(fn=None, *, name: Optional[str] = None,
+              nondiff: Sequence[int] = ()):
+    """Wrap a pure jax function into a Tensor-level op.
+
+    ``nondiff``: positional indices that must never be differentiated
+    (e.g. integer index tensors)."""
+
+    def deco(f):
+        opname = name or f.__name__
+        nset = frozenset(nondiff)
+
+        def wrapper(*args, **kwargs):
+            diff_idx = []
+            vals = []
+            for i, a in enumerate(args):
+                if isinstance(a, Tensor):
+                    vals.append(a._value)
+                    if (not a.stop_gradient and i not in nset
+                            and jnp.issubdtype(a._value.dtype, jnp.inexact)):
+                        diff_idx.append(i)
+                else:
+                    vals.append(a)
+            if _amp_hook is not None:
+                vals = _amp_hook(opname, vals)
+            out_vals = f(*vals, **kwargs)
+            multi = isinstance(out_vals, tuple)
+            outs = tuple(_wrap_out(v)
+                         for v in (out_vals if multi else (out_vals,)))
+            if diff_idx and _tape.is_grad_enabled():
+                for o in outs:
+                    o._produced = True
+                    if jnp.issubdtype(o._value.dtype, jnp.inexact):
+                        o.stop_gradient = False
+                _tape.record(f, args, vals, kwargs, diff_idx, outs, opname)
+            if _flags.flag("FLAGS_check_nan_inf"):
+                _check_nan_inf(opname, outs)
+            return outs if multi else outs[0]
+
+        wrapper.__name__ = opname
+        wrapper.__doc__ = f.__doc__
+        wrapper.raw = f
+        OP_TABLE[opname] = wrapper
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
+
+
+def apply_closure(f: Callable, diff_tensors: Sequence[Tensor],
+                  name: str = "closure_op"):
+    """Run a per-call closure over the given differentiable tensors and
+    record it on the tape.  Used for ops whose non-tensor config can't be
+    expressed as static kwargs (e.g. __getitem__ with mixed indices)."""
+    vals = [t._value for t in diff_tensors]
+    out_vals = f(*vals)
+    multi = isinstance(out_vals, tuple)
+    outs = tuple(_wrap_out(v) for v in (out_vals if multi else (out_vals,)))
+    diff_idx = [i for i, t in enumerate(diff_tensors)
+                if not t.stop_gradient
+                and jnp.issubdtype(t._value.dtype, jnp.inexact)]
+    if diff_idx and _tape.is_grad_enabled():
+        for o in outs:
+            o._produced = True
+            if jnp.issubdtype(o._value.dtype, jnp.inexact):
+                o.stop_gradient = False
+        _tape.record(f, diff_tensors, vals, {}, diff_idx, outs, name)
+    return outs if multi else outs[0]
+
+
+def unwrap(x):
+    """Tensor|array|scalar → jax-compatible value."""
+    return x._value if isinstance(x, Tensor) else x

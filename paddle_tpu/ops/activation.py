@@ -1,0 +1,187 @@
+"""Activation ops (parity: python/paddle/nn/functional/activation.py →
+phi activation kernels).  Pure elementwise — XLA fuses these into the
+producing matmul/conv on TPU."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive
+
+
+@primitive
+def relu(x):
+    return jax.nn.relu(x)
+
+
+@primitive
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+@primitive
+def leaky_relu(x, negative_slope=0.01):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@primitive
+def prelu(x, weight, data_format="NCHW"):
+    if weight.size > 1:
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+        shape[ch_axis] = weight.size
+        weight = weight.reshape(shape)
+    return jnp.where(x > 0, x, weight * x)
+
+
+@primitive
+def rrelu(x, lower=0.125, upper=0.3333333333333333, training=False):
+    neg_slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, neg_slope * x)
+
+
+@primitive
+def elu(x, alpha=1.0):
+    return jax.nn.elu(x, alpha)
+
+
+@primitive
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@primitive
+def celu(x, alpha=1.0):
+    return jax.nn.celu(x, alpha)
+
+
+@primitive
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@primitive
+def silu(x):
+    return jax.nn.silu(x)
+
+
+@primitive
+def swish(x):
+    return jax.nn.silu(x)
+
+
+@primitive
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+@primitive
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+@primitive
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@primitive
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+@primitive
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+@primitive
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+@primitive
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x,
+                     jnp.log1p(jnp.exp(beta * jnp.minimum(
+                         x, threshold / beta))) / beta)
+
+
+@primitive
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+@primitive
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold,
+                               jnp.zeros_like(x)))
+
+
+@primitive
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, jnp.zeros_like(x))
+
+
+@primitive
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@primitive
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@primitive
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ..framework import dtype as dtypes
+        x = x.astype(dtypes.to_jax_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@primitive
+def log_softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        from ..framework import dtype as dtypes
+        x = x.astype(dtypes.to_jax_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@primitive
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    from ..framework import random as _random
+    key = _random.next_key()
+    g = jax.random.gumbel(key, x.shape, x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        # straight-through: forward one-hot, backward soft
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx,
+                                    jnp.ones_like(idx, dtype=y.dtype),
+                                    axis=axis, inplace=False)
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+@primitive
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@primitive
+def maxout(x, groups, axis=1):
+    c = x.shape[axis]
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@primitive
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, jnp.full_like(x, value))

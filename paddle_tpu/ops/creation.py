@@ -1,0 +1,300 @@
+"""Tensor creation ops (parity: python/paddle/tensor/creation.py +
+python/paddle/tensor/random.py).
+
+Random ops draw concrete keys from the framework generator
+(`paddle_tpu.framework.random`) so eager behaviour matches Paddle's
+stateful Philox streams; inside a jitted step the key provider installed
+by the functional runner supplies traced keys instead.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive, unwrap, OP_TABLE
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+from ..framework import random as _random
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return (default.np_dtype if isinstance(default, dtypes.DType)
+                else default)
+    return dtypes.to_jax_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s
+                 for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape),
+                            _dt(dtype, dtypes.default_float_dtype())))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape),
+                           _dt(dtype, dtypes.default_float_dtype())))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dt = np.bool_
+        elif isinstance(fill_value, int):
+            dt = np.int64
+        elif isinstance(fill_value, float):
+            dt = dtypes.default_float_dtype().np_dtype
+        else:
+            dt = None
+    else:
+        dt = dtypes.to_jax_dtype(dtype)
+    return Tensor(jnp.full(_shape(shape), fill_value, dt))
+
+
+@primitive
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=_dt(dtype))
+
+
+@primitive
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=_dt(dtype))
+
+
+@primitive
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=_dt(dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if any(isinstance(v, float) for v in (start, end, step)):
+            dtype = dtypes.default_float_dtype()
+        else:
+            dtype = dtypes.int64
+    return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype, dtypes.default_float_dtype())))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=unwrap(base),
+                               dtype=_dt(dtype, dtypes.default_float_dtype())))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns else None,
+                          dtype=_dt(dtype, dtypes.default_float_dtype())))
+
+
+@primitive
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        n = x.shape[0] + abs(offset)
+        base = jnp.full((n, n), padding_value, dtype=x.dtype)
+        return base + jnp.diag(x, k=offset) - jnp.diag(
+            jnp.full((x.shape[0],), padding_value, dtype=x.dtype), k=offset)
+    return jnp.diag(x, k=offset)
+
+
+@primitive
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@primitive
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def _embed(v):
+        return jnp.diag(v, k=offset)
+    flat = x.reshape((-1, x.shape[-1]))
+    out = jax.vmap(_embed)(flat)
+    n = out.shape[-1]
+    out = out.reshape(x.shape[:-1] + (n, n))
+    return jnp.moveaxis(jnp.moveaxis(out, -2, dim1 if dim1 >= 0 else
+                                     out.ndim + dim1), -1,
+                        dim2 if dim2 >= 0 else out.ndim + dim2) \
+        if (dim1, dim2) != (-2, -1) else out
+
+
+@primitive
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@primitive
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, k=offset, m=col or row)
+    return Tensor(jnp.stack([r, c]).astype(dtypes.to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    arrays = [unwrap(a) for a in (args[0] if len(args) == 1
+                                  and isinstance(args[0], (list, tuple))
+                                  else args)]
+    return [Tensor(m) for m in jnp.meshgrid(*arrays, indexing="ij")]
+
+
+@primitive
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+@primitive
+def cast(x, dtype):
+    return x.astype(dtypes.to_jax_dtype(dtype))
+
+
+@primitive(name="one_hot")
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@primitive
+def complex(real, imag):
+    return jax.lax.complex(real, imag)
+
+
+def clone(x):
+    return assign(x)
+
+
+# -- random ops -------------------------------------------------------------
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    key = _random.next_key()
+    return Tensor(jax.random.normal(
+        key, _shape(shape), _dt(dtype, dtypes.default_float_dtype())))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = unwrap(mean), unwrap(std)
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        key = _random.next_key()
+        return Tensor(jax.random.normal(key, shp,
+                                        dtypes.default_float_dtype().np_dtype)
+                      * s + m)
+    key = _random.next_key()
+    return Tensor(jax.random.normal(
+        key, _shape(shape if shape is not None else [1]),
+        dtypes.default_float_dtype().np_dtype) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = (jax.random.PRNGKey(seed) if seed else _random.next_key())
+    return Tensor(jax.random.uniform(
+        key, _shape(shape), _dt(dtype, dtypes.default_float_dtype()),
+        minval=float(unwrap(min)), maxval=float(unwrap(max))))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return Tensor(jax.random.randint(
+        key, _shape(shape), int(low), int(high),
+        _dt(dtype, dtypes.int64)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, unwrap(x).shape,
+                   dtype or dtypes.convert_dtype(unwrap(x).dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    key = _random.next_key()
+    return Tensor(jax.random.permutation(key, int(n)).astype(
+        dtypes.to_jax_dtype(dtype)))
+
+
+def bernoulli(x, name=None):
+    key = _random.next_key()
+    xv = unwrap(x)
+    return Tensor(jax.random.bernoulli(key, xv).astype(xv.dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = _random.next_key()
+    xv = unwrap(x)
+    logits = jnp.log(jnp.maximum(xv, 1e-30))
+    if xv.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(num_samples,)) \
+            if replacement else jax.random.choice(
+                key, xv.shape[0], shape=(num_samples,), replace=False,
+                p=xv / xv.sum())
+        return Tensor(out.astype(jnp.int64))
+    outs = []
+    for i in range(xv.shape[0]):
+        k = jax.random.fold_in(key, i)
+        if replacement:
+            outs.append(jax.random.categorical(k, logits[i],
+                                               shape=(num_samples,)))
+        else:
+            outs.append(jax.random.choice(k, xv.shape[1],
+                                          shape=(num_samples,),
+                                          replace=False,
+                                          p=xv[i] / xv[i].sum()))
+    return Tensor(jnp.stack(outs).astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    key = _random.next_key()
+    xv = unwrap(x)
+    return Tensor(jax.random.poisson(key, xv).astype(xv.dtype))
+
+
+def rand_like(x, dtype=None):
+    return rand(unwrap(x).shape, dtype or str(unwrap(x).dtype))
+
+
+def randn_like(x, dtype=None):
+    return randn(unwrap(x).shape, dtype or str(unwrap(x).dtype))

@@ -1,0 +1,233 @@
+"""Linear algebra ops (parity: python/paddle/tensor/linalg.py).
+
+matmul is THE op on TPU — it maps straight onto the MXU.  Everything here
+lowers through jnp/lax so XLA tiles it; no hand-written GEMM needed
+(upstream needs funcs::Blas → cuBLAS, SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive, unwrap
+from ..tensor import Tensor
+
+
+@primitive
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@primitive
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+@primitive
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@primitive
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@primitive
+def cross(x, y, axis=9):
+    if axis == 9:
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@primitive
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@primitive
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == "nuc":
+        s = jnp.linalg.svd(x, compute_uv=False)
+        return jnp.sum(s, axis=-1, keepdims=keepdim)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+@primitive
+def dist(x, y, p=2.0):
+    d = x - y
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+
+@primitive
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2) if upper else L
+
+
+@primitive
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@primitive
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@primitive
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2)  # paddle returns V, not V^H
+
+
+@primitive
+def eig(x):
+    # jnp.linalg.eig is CPU-only in jax; run on host.
+    import numpy as np
+    w, v = np.linalg.eig(jax.device_get(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@primitive
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@primitive
+def eigvals(x):
+    import numpy as np
+    return jnp.asarray(np.linalg.eigvals(jax.device_get(x)))
+
+
+@primitive
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@primitive
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+inv = inverse
+
+
+@primitive
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@primitive
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@primitive
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@primitive
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@primitive
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@primitive
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol).astype(jnp.int64)
+
+
+@primitive
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@primitive
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@primitive
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@primitive
+def lu(x, pivot=True):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_, (piv + 1).astype(jnp.int32)  # paddle pivots are 1-based
+
+
+@primitive
+def householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+def einsum(equation, *operands):
+    from ._primitive import apply_closure
+    ops = [o if isinstance(o, Tensor) else Tensor(o) for o in operands]
+
+    def _f(*vals):
+        return jnp.einsum(equation, *vals)
+
+    return apply_closure(_f, ops, name="einsum")
+
+
+@primitive
+def multi_dot(xs):
+    return jnp.linalg.multi_dot(xs)
+
+
+@primitive
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@primitive
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@primitive
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    if min == 0 and max == 0:
+        range_ = None
+    else:
+        range_ = (min, max)
+    h, _ = jnp.histogram(x, bins=bins, range=range_, weights=weight,
+                         density=density)
+    return h if density else h.astype(jnp.int64)

@@ -1,0 +1,94 @@
+"""Comparison / logical / bitwise ops (parity: python/paddle/tensor/
+logic.py + compare kernels)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ._primitive import primitive
+
+
+@primitive
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@primitive
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@primitive
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@primitive
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@primitive
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@primitive
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@primitive
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@primitive
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@primitive
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@primitive
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@primitive
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@primitive
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@primitive
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@primitive
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@primitive
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+@primitive
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+def is_empty(x):
+    from ..tensor import Tensor
+    from ._primitive import unwrap
+    return Tensor(unwrap(x).size == 0)

@@ -1,0 +1,488 @@
+"""Shape/layout manipulation ops (parity: python/paddle/tensor/
+manipulation.py).  All are XLA-friendly metadata ops — reshape/transpose
+are free on TPU when XLA can fuse them into neighbouring computations.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive, apply_closure, unwrap
+
+_pyslice = slice  # the paddle-style `slice` op below shadows the builtin
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+
+
+@primitive
+def reshape(x, shape):
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, shape)
+
+
+@primitive
+def transpose(x, perm):
+    return jnp.transpose(x, axes=tuple(int(p) for p in perm))
+
+
+def t(x):
+    nd = unwrap(x).ndim
+    if nd < 2:
+        from .creation import assign
+        return assign(x)
+    return transpose(x, list(range(nd))[::-1])
+
+
+@primitive
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    start = start_axis % nd if nd else 0
+    stop = stop_axis % nd if nd else 0
+    new_shape = (x.shape[:start]
+                 + (int(np.prod(x.shape[start:stop + 1]) or 1),)
+                 + x.shape[stop + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@primitive
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a % x.ndim for a in axis if x.shape[a % x.ndim] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    axis = axis % x.ndim
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@primitive
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        out = x
+        for a in sorted(int(v) for v in axis):
+            out = jnp.expand_dims(out, a)
+        return out
+    return jnp.expand_dims(x, int(axis))
+
+
+def concat(x, axis=0, name=None):
+    tensors = [v for v in x]
+    axis = int(unwrap(axis))
+
+    def _f(*vals):
+        return jnp.concatenate(vals, axis=axis)
+
+    wrapped = [v if isinstance(v, Tensor) else Tensor(v) for v in tensors]
+    return apply_closure(_f, wrapped, name="concat")
+
+
+def stack(x, axis=0, name=None):
+    wrapped = [v if isinstance(v, Tensor) else Tensor(v) for v in x]
+
+    def _f(*vals):
+        return jnp.stack(vals, axis=int(axis))
+
+    return apply_closure(_f, wrapped, name="stack")
+
+
+@primitive
+def split_p(x, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis=axis))
+    # sections is a list of sizes; -1 means "rest"
+    sizes = list(sections)
+    if -1 in sizes:
+        rest = x.shape[axis] - sum(s for s in sizes if s != -1)
+        sizes[sizes.index(-1)] = rest
+    idx = np.cumsum(sizes)[:-1].tolist()
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    return list(split_p(x, num_or_sections, int(unwrap(axis))))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+@primitive
+def unbind_p(x, axis):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+def unbind(x, axis=0):
+    return list(unbind_p(x, int(axis)))
+
+
+@primitive
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@primitive
+def expand(x, shape):
+    shape = tuple(int(s) for s in shape)
+    # paddle allows -1 = keep dim
+    x_shape = (1,) * (len(shape) - x.ndim) + x.shape
+    tgt = tuple(xs if s == -1 else s for s, xs in zip(shape, x_shape))
+    return jnp.broadcast_to(jnp.reshape(x, x_shape), tgt)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, unwrap(y).shape)
+
+
+@primitive
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    vals = [unwrap(v) for v in inputs]
+    shape = jnp.broadcast_shapes(*[v.shape for v in vals])
+    return [broadcast_to(v, shape) for v in inputs]
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@primitive
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+@primitive
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@primitive
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@primitive(nondiff=(1,))
+def gather(x, index, axis=0):
+    axis = int(unwrap(axis) if not isinstance(axis, int) else axis)
+    return jnp.take(x, index.reshape(-1) if index.ndim > 1 else index,
+                    axis=axis)
+
+
+@primitive(nondiff=(1,))
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@primitive(nondiff=(1,))
+def take_along_axis(x, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(jnp.broadcast_shapes(
+            tuple(1 if i == axis % x.ndim else s
+                  for i, s in enumerate(x.shape)),
+            indices.shape))
+        shape[axis % x.ndim] = indices.shape[axis % x.ndim]
+        indices = jnp.broadcast_to(indices, tuple(shape))
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@primitive(nondiff=(1,))
+def put_along_axis(x, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True):
+    values = jnp.asarray(values, dtype=x.dtype)
+    values = jnp.broadcast_to(values, indices.shape)
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis,
+                                  inplace=False)
+    at = jnp.take_along_axis(x, indices, axis=axis)
+    if reduce in ("add", "sum"):
+        upd = at + values if include_self else values
+    elif reduce in ("mul", "multiply"):
+        upd = at * values if include_self else values
+    elif reduce == "amax":
+        upd = jnp.maximum(at, values)
+    elif reduce == "amin":
+        upd = jnp.minimum(at, values)
+    else:
+        raise ValueError(f"unsupported reduce {reduce}")
+    return jnp.put_along_axis(x, indices, upd, axis=axis, inplace=False)
+
+
+@primitive(nondiff=(1,))
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates, mode="drop")
+    return x.at[index].set(jnp.zeros_like(updates), mode="drop"
+                           ).at[index].add(updates, mode="drop")
+
+
+@primitive(nondiff=(1,))
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from .creation import zeros
+    z = zeros(shape, dtype=dtypes.convert_dtype(unwrap(updates).dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+@primitive(nondiff=(1,))
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@primitive(nondiff=(1,))
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@primitive(nondiff=(1,))
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    v = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(v)
+    return jnp.moveaxis(out, 0, axis)
+
+
+@primitive(nondiff=(1,))
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def masked_select(x, mask, name=None):
+    xv, mv = unwrap(x), unwrap(mask)
+    # dynamic output shape: eager-only (jax boolean indexing works outside jit)
+    return Tensor(xv[mv])
+
+
+@primitive(nondiff=(1,))
+def masked_fill(x, mask, value):
+    value = jnp.asarray(value, dtype=x.dtype)
+    return jnp.where(mask, value, x)
+
+
+@primitive(nondiff=(0,))
+def where(condition, x=None, y=None):
+    return jnp.where(condition, x, y)
+
+
+def where_single(condition):
+    cv = unwrap(condition)
+    return [Tensor(i.astype(jnp.int64)) for i in jnp.nonzero(cv)]
+
+
+def nonzero(x, as_tuple=False):
+    xv = unwrap(x)
+    idx = jnp.nonzero(xv)
+    if as_tuple:
+        return [Tensor(i.astype(jnp.int64)) for i in idx]
+    return Tensor(jnp.stack(idx, axis=1).astype(jnp.int64))
+
+
+@primitive
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle convention: pad applies to the last len(pad)//2 spatial dims
+        # in (W), (W,H), ... order depending on data_format
+        k = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        if data_format.endswith("C"):  # NHWC-like: spatial dims before C
+            spatial = list(range(1, 1 + k))
+        else:  # NCHW-like: spatial dims after C
+            spatial = list(range(nd - k, nd))
+        for i, d in enumerate(reversed(spatial)):
+            cfg[d] = (pad[2 * i], pad[2 * i + 1])
+    if mode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@primitive
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xv = unwrap(x)
+    res = jnp.unique(xv, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(res)
+    out = [Tensor(res[0])]
+    for r in res[1:]:
+        out.append(Tensor(r.astype(dtypes.to_jax_dtype(dtype))))
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    xv = np.asarray(unwrap(x))
+    if axis is None:
+        flat = xv.reshape(-1)
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+        vals = flat[keep]
+        outs = [Tensor(jnp.asarray(vals))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+        if return_counts:
+            idx = np.nonzero(keep)[0]
+            cnt = np.diff(np.append(idx, flat.size))
+            outs.append(Tensor(jnp.asarray(cnt.astype(np.int64))))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError("unique_consecutive with axis")
+
+
+@primitive
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+@primitive
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+transpose_ = None  # no in-place transpose
+
+
+@primitive
+def slice_op(x, axes, starts, ends):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = _pyslice(int(st), int(en))
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    starts = [int(unwrap(s)) for s in starts]
+    ends = [int(unwrap(e)) for e in ends]
+    return slice_op(x, list(axes), starts, ends)
+
+
+@primitive
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = _pyslice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+
+@primitive
+def crop(x, shape=None, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    shape = shape or x.shape
+    idx = tuple(_pyslice(int(o), int(o) + int(s))
+                for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def getitem(x, idx):
+    """__getitem__: normalise Tensor indices into arrays and run as a
+    closure op so gradient flows to x only."""
+    def norm(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        if isinstance(i, _pyslice):
+            return _pyslice(
+                int(unwrap(i.start)) if i.start is not None else None,
+                int(unwrap(i.stop)) if i.stop is not None else None,
+                int(unwrap(i.step)) if i.step is not None else None)
+        return i
+
+    if isinstance(idx, tuple):
+        jidx = tuple(norm(i) for i in idx)
+    else:
+        jidx = norm(idx)
+
+    def _f(xv):
+        return xv[jidx]
+
+    return apply_closure(_f, [x], name="getitem")
+
+
+@primitive
+def as_strided(x, shape, stride, offset=0):
+    raise NotImplementedError("as_strided has no XLA equivalent")
+
+
+@primitive
+def view(x, shape_or_dtype):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(int(s) for s in shape_or_dtype))
+    return x.view(dtypes.to_jax_dtype(shape_or_dtype))
+
+
+@primitive
+def unfold(x, kernel_size, strides=1, paddings=0, dilations=1):
+    # im2col for NCHW input: returns [N, C*kh*kw, L]
+    ks = (kernel_size if isinstance(kernel_size, (list, tuple))
+          else (kernel_size, kernel_size))
+    st = strides if isinstance(strides, (list, tuple)) else (strides,) * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else (paddings,) * 2
+    dl = (dilations if isinstance(dilations, (list, tuple))
+          else (dilations,) * 2)
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+    oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+    ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+    cols = []
+    for i in range(ks[0]):
+        for j in range(ks[1]):
+            patch = xp[:, :, i * dl[0]:i * dl[0] + oh * st[0]:st[0],
+                       j * dl[1]:j * dl[1] + ow * st[1]:st[1]]
+            cols.append(patch.reshape(n, c, -1))
+    return jnp.concatenate(cols, axis=1).reshape(n, c * ks[0] * ks[1], -1)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    iv = unwrap(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+    in_range = (iv >= lo) & (iv < hi)
+    return Tensor(jnp.where(in_range, iv - lo, ignore_value))
+
+
+@primitive
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@primitive
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@primitive
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def tolist(x):
+    return unwrap(x).tolist()

@@ -1,0 +1,609 @@
+"""Elementwise math + reductions.
+
+Parity targets: python/paddle/tensor/math.py and the corresponding PHI
+kernels (paddle/phi/kernels/ elementwise/reduce families — SURVEY.md
+§2.1/"PHI GPU kernels").  Every op is a pure jnp function; XLA fuses
+elementwise chains into surrounding matmuls on TPU, which is exactly the
+optimization Paddle implements by hand with its ElementwiseKernel /
+reduce templates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive, unwrap
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# -- binary elementwise -----------------------------------------------------
+@primitive
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@primitive
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@primitive
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@primitive
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@primitive
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@primitive
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+@primitive
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@primitive
+def elementwise_pow(x, y):
+    return jnp.power(x, y)
+
+
+@primitive
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@primitive
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@primitive
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@primitive
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@primitive
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@primitive
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@primitive
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@primitive
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@primitive
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@primitive
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# -- unary elementwise ------------------------------------------------------
+@primitive
+def neg(x):
+    return jnp.negative(x)
+
+
+@primitive
+def abs(x):
+    return jnp.abs(x)
+
+
+@primitive
+def sign(x):
+    return jnp.sign(x)
+
+
+@primitive
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@primitive
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+@primitive
+def square(x):
+    return jnp.square(x)
+
+
+@primitive
+def exp(x):
+    return jnp.exp(x)
+
+
+@primitive
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@primitive
+def log(x):
+    return jnp.log(x)
+
+
+@primitive
+def log2(x):
+    return jnp.log2(x)
+
+
+@primitive
+def log10(x):
+    return jnp.log10(x)
+
+
+@primitive
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@primitive
+def sin(x):
+    return jnp.sin(x)
+
+
+@primitive
+def cos(x):
+    return jnp.cos(x)
+
+
+@primitive
+def tan(x):
+    return jnp.tan(x)
+
+
+@primitive
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@primitive
+def acos(x):
+    return jnp.arccos(x)
+
+
+@primitive
+def atan(x):
+    return jnp.arctan(x)
+
+
+@primitive
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@primitive
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@primitive
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@primitive
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@primitive
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@primitive
+def floor(x):
+    return jnp.floor(x)
+
+
+@primitive
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@primitive
+def round(x, decimals=0):
+    return jnp.round(x, decimals)
+
+
+@primitive
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@primitive
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@primitive
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@primitive
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+@primitive
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+@primitive
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@primitive
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+@primitive
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@primitive
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@primitive
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@primitive
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@primitive
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@primitive
+def angle(x):
+    return jnp.angle(x)
+
+
+@primitive
+def conj(x):
+    return jnp.conj(x)
+
+
+@primitive
+def real(x):
+    return jnp.real(x)
+
+
+@primitive
+def imag(x):
+    return jnp.imag(x)
+
+
+# -- predicates -------------------------------------------------------------
+@primitive
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@primitive
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@primitive
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+# -- reductions -------------------------------------------------------------
+@primitive
+def sum(x, axis=None, dtype=None, keepdim=False):
+    dt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return jnp.sum(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@primitive
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def prod(x, axis=None, keepdim=False, dtype=None):
+    dt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return jnp.prod(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@primitive
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@primitive
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@primitive
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    dt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    return jnp.nansum(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@primitive
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtypes.to_jax_dtype(dtype))
+
+
+@primitive
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=_axis(axis), keepdims=keepdim)
+    return out.astype(dtypes.to_jax_dtype(dtype))
+
+
+@primitive
+def cumsum(x, axis=None, dtype=None):
+    dt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=int(axis), dtype=dt)
+
+
+@primitive
+def cumprod(x, dim=None, dtype=None):
+    dt = dtypes.to_jax_dtype(dtype) if dtype is not None else None
+    if dim is None:
+        x = jnp.ravel(x)
+        dim = 0
+    return jnp.cumprod(x, axis=int(dim), dtype=dt)
+
+
+@primitive
+def cummax(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    vals = jax.lax.associative_scan(jnp.maximum, x, axis=int(axis))
+    return vals
+
+
+@primitive
+def cummin(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jax.lax.associative_scan(jnp.minimum, x, axis=int(axis))
+
+
+@primitive
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim
+                             ).astype(jnp.int64)
+
+
+@primitive
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def kthvalue(x, k, axis=-1, keepdim=False):
+    srt = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    val = jnp.take(srt, k - 1, axis=axis)
+    ind = jnp.take(idx, k - 1, axis=axis).astype(jnp.int64)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        ind = jnp.expand_dims(ind, axis)
+    return val, ind
+
+
+@primitive
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+        v, i = jax.lax.top_k(xm if largest else -xm, k)
+        if not largest:
+            v = -v
+        return (jnp.moveaxis(v, -1, axis),
+                jnp.moveaxis(i, -1, axis).astype(jnp.int64))
+    v, i = jax.lax.top_k(x if largest else -x, k)
+    if not largest:
+        v = -v
+    return v, i.astype(jnp.int64)
+
+
+@primitive
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=True)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@primitive
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=axis, stable=True)
+    out = jnp.flip(out, axis=axis) if descending else out
+    return out.astype(jnp.int64)
+
+
+@primitive
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@primitive
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+@primitive
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@primitive
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0, axis=axis)
+
+
+# -- non-primitive conveniences (python-level, compose primitives) ---------
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    out = jnp.allclose(unwrap(x), unwrap(y), rtol=float(rtol),
+                       atol=float(atol), equal_nan=equal_nan)
+    return Tensor(out)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return Tensor(jnp.isclose(unwrap(x), unwrap(y), rtol=float(rtol),
+                              atol=float(atol), equal_nan=equal_nan))
+
+
+def equal_all(x, y):
+    return Tensor(jnp.array_equal(unwrap(x), unwrap(y)))
+
+
+def numel(x):
+    return Tensor(np.prod(unwrap(x).shape).astype(np.int64))
+
+
+@primitive
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@primitive
+def increment(x, value=1.0):
+    return x + value
+
+
+@primitive
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    return jnp.take_along_axis(
+        stacked, index.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+    )[0]
+
+
+@primitive
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * (x @ y)
+
+
+@primitive
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@primitive
+def outer(x, y):
+    return jnp.outer(x, y)

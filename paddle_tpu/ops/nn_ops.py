@@ -1,0 +1,808 @@
+"""Neural-net structural ops: conv / pool / norm / dropout / embedding /
+losses / attention.
+
+Parity: python/paddle/nn/functional/ + the phi conv/pool/norm kernels
+(paddle/phi/kernels/gpudnn — SURVEY.md §2.1 "PHI GPU kernels").  Convs
+lower to ``lax.conv_general_dilated`` which XLA maps onto the MXU; there
+is no cuDNN-equivalent library to wrap.  Paddle's default layout NCHW is
+kept at the API level; XLA:TPU internally re-lays out as needed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive, unwrap
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+from ..framework import random as _random
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, nd):
+    """Normalise paddle padding spec → lax spec."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    padding = list(padding)
+    if len(padding) == nd:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nd:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(nd)]
+    raise ValueError(f"bad padding {padding!r}")
+
+
+# ---------------------------------------------------------------------------
+# Convolutions
+# ---------------------------------------------------------------------------
+@primitive
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW"):
+    stride, dilation = _pair(stride), _pair(dilation)
+    pad = _conv_padding(padding, 2)
+    if data_format == "NCHW":
+        dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+    else:
+        dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                            ("NHWC", "OIHW", "NHWC"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=(jnp.float32 if x.dtype == jnp.bfloat16
+                                else None))
+    if out.dtype != x.dtype:
+        out = out.astype(x.dtype)
+    if bias is not None:
+        b = bias.reshape((1, -1, 1, 1) if data_format == "NCHW"
+                         else (1, 1, 1, -1))
+        out = out + b
+    return out
+
+
+@primitive
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL"):
+    stride = _pair(stride, 1)
+    dilation = _pair(dilation, 1)
+    pad = _conv_padding(padding, 1)
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        ("NCH", "OIH", "NCH"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+@primitive
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    pad = _conv_padding(padding, 3)
+    dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+@primitive
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCHW"):
+    stride = _pair(stride)
+    dilation = _pair(dilation)
+    opad = _pair(output_padding)
+    if isinstance(padding, str):
+        raise NotImplementedError("str padding for conv_transpose")
+    pad = _conv_padding(padding, 2)
+    # weight layout: paddle conv2d_transpose weight is [in, out/groups, kh, kw]
+    kh, kw = weight.shape[2], weight.shape[3]
+    pads = []
+    for i, (lo, hi) in enumerate(pad):
+        k = (kh, kw)[i]
+        eff_k = (k - 1) * dilation[i] + 1
+        pads.append((eff_k - 1 - lo, eff_k - 1 - hi + opad[i]))
+    # grouped transpose conv: run per group (groups usually 1)
+    w = jnp.flip(weight, axis=(2, 3))
+    w = jnp.swapaxes(w, 0, 1)  # → [out/groups, in, kh, kw]
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+    if groups == 1:
+        out = jax.lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn)
+    else:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = []
+        for xg, wg in zip(xs, ws):
+            wg = jnp.swapaxes(jnp.flip(wg, axis=(2, 3)), 0, 1)
+            outs.append(jax.lax.conv_general_dilated(
+                xg, wg, window_strides=(1, 1), padding=pads,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    xg.shape, wg.shape, ("NCHW", "OIHW", "NCHW"))))
+        out = jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling
+# ---------------------------------------------------------------------------
+def _pool(x, kernel, stride, padding, init, op, data_format="NCHW",
+          count_include_pad=True, is_avg=False, ceil_mode=False):
+    k = _pair(kernel)
+    s = _pair(stride if stride is not None else kernel)
+    pad = _conv_padding(padding, 2)
+    if ceil_mode and not isinstance(pad, str):
+        # extend high-side padding so the window count rounds up
+        spatial = (x.shape[2:4] if data_format == "NCHW"
+                   else x.shape[1:3])
+        pad = [(lo, hi + (-(dim + lo + hi - kk) % ss))
+               for (lo, hi), dim, kk, ss in zip(pad, spatial, k, s)]
+    if data_format == "NCHW":
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            padding_cfg = [(0, 0), (0, 0)] + list(pad)
+    else:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            padding_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+    out = jax.lax.reduce_window(x, init, op, dims, strides, padding_cfg)
+    if is_avg:
+        if count_include_pad or (isinstance(pad, str) and pad == "VALID") \
+                or (not isinstance(pad, str)
+                    and all(p == (0, 0) for p in pad)):
+            out = out / np.prod(k)
+        else:
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
+                                        strides, padding_cfg)
+            out = out / cnt
+    return out
+
+
+@primitive
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    out = _pool(x, kernel_size, stride, padding, neg, jax.lax.max,
+                data_format, ceil_mode=ceil_mode)
+    if not return_mask:
+        return out
+    # indices into the flattened spatial dims (paddle convention),
+    # computed by patch extraction + argmax (ties: first wins)
+    if data_format != "NCHW":
+        raise NotImplementedError("return_mask expects NCHW")
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    pad = _conv_padding(padding, 2)
+    if isinstance(pad, str):
+        raise NotImplementedError("return_mask with str padding")
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), pad[0], pad[1]],
+                 constant_values=neg)
+    oh, ow = out.shape[2], out.shape[3]
+    patches = []
+    flat_idx = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patch = xp[:, :, i:i + oh * s[0]:s[0], j:j + ow * s[1]:s[1]]
+            patches.append(patch)
+            rows = (jnp.arange(oh) * s[0] + i - pad[0][0])[:, None]
+            cols = (jnp.arange(ow) * s[1] + j - pad[1][0])[None, :]
+            flat_idx.append(rows * w + cols)
+    stacked = jnp.stack(patches, axis=-1)            # n,c,oh,ow,kk
+    idx_map = jnp.stack([jnp.broadcast_to(f, (oh, ow))
+                         for f in flat_idx], axis=-1)  # oh,ow,kk
+    which = jnp.argmax(stacked, axis=-1)             # n,c,oh,ow
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idx_map, (n, c, oh, ow, len(patches))),
+        which[..., None], axis=-1)[..., 0].astype(jnp.int64)
+    return out, mask
+
+
+@primitive
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    out = _pool(x, kernel_size, stride, padding, 0.0, jax.lax.add,
+                data_format, count_include_pad=not exclusive, is_avg=True,
+                ceil_mode=ceil_mode)
+    if divisor_override:
+        k = _pair(kernel_size)
+        out = out * (np.prod(k) / divisor_override)
+    return out
+
+
+@primitive
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False):
+    k = (kernel_size,) if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = (stride,) if isinstance(stride, int) else (
+        k if stride is None else tuple(stride))
+    if isinstance(s, tuple) and len(s) != 1:
+        s = (s[0],)
+    p = _conv_padding(padding, 1)
+    neg = -jnp.inf
+    cfg = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
+    return jax.lax.reduce_window(x, neg, jax.lax.max, (1, 1) + k,
+                                 (1, 1) + s, cfg)
+
+
+@primitive
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False):
+    k = (kernel_size,) if isinstance(kernel_size, int) else tuple(kernel_size)
+    s = (stride,) if isinstance(stride, int) else (
+        k if stride is None else tuple(stride))
+    if isinstance(s, tuple) and len(s) != 1:
+        s = (s[0],)
+    p = _conv_padding(padding, 1)
+    cfg = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
+    out = jax.lax.reduce_window(x, 0.0, jax.lax.add, (1, 1) + k,
+                                (1, 1) + s, cfg)
+    if exclusive and not isinstance(p, str) and any(
+            pp != (0, 0) for pp in p):
+        cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                    (1, 1) + k, (1, 1) + s, cfg)
+        return out / cnt
+    return out / k[0]
+
+
+@primitive
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    oh, ow = _pair(output_size)
+    if data_format != "NCHW":
+        raise NotImplementedError("adaptive pool expects NCHW")
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        out = x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+        return out
+    # general case: mean over variable windows
+    rows = [x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh), :].mean(
+        axis=2, keepdims=True) for i in range(oh)]
+    xr = jnp.concatenate(rows, axis=2)
+    cols = [xr[:, :, :, (j * w) // ow:-(-((j + 1) * w) // ow)].mean(
+        axis=3, keepdims=True) for j in range(ow)]
+    return jnp.concatenate(cols, axis=3)
+
+
+@primitive
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, oh, h // oh, ow, w // ow).max(axis=(3, 5))
+    rows = [x[:, :, (i * h) // oh:-(-((i + 1) * h) // oh), :].max(
+        axis=2, keepdims=True) for i in range(oh)]
+    xr = jnp.concatenate(rows, axis=2)
+    cols = [xr[:, :, :, (j * w) // ow:-(-((j + 1) * w) // ow)].max(
+        axis=3, keepdims=True) for j in range(ow)]
+    return jnp.concatenate(cols, axis=3)
+
+
+@primitive
+def adaptive_avg_pool1d(x, output_size):
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    n, c, l = x.shape
+    if l % o == 0:
+        return x.reshape(n, c, o, l // o).mean(axis=3)
+    segs = [x[:, :, (i * l) // o:-(-((i + 1) * l) // o)].mean(
+        axis=2, keepdims=True) for i in range(o)]
+    return jnp.concatenate(segs, axis=2)
+
+
+# ---------------------------------------------------------------------------
+# Normalisation
+# ---------------------------------------------------------------------------
+@primitive
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@primitive
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
+    ms = jnp.mean(jnp.square(x), axis=axes, keepdims=True)
+    out = x * jax.lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@primitive
+def batch_norm_train(x, running_mean, running_var, weight, bias,
+                     momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    """Training-mode BN.  Returns (out, new_mean, new_var); the Layer
+    handles the running-stat buffer swap (paddle momentum convention:
+    running = momentum*running + (1-momentum)*batch)."""
+    ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+        var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    n = x.size / x.shape[ch_axis]
+    unbiased_var = var * (n / max(n - 1.0, 1.0))
+    new_mean = momentum * running_mean + (1.0 - momentum) * mean
+    new_var = momentum * running_var + (1.0 - momentum) * unbiased_var
+    return out, new_mean, new_var
+
+
+@primitive
+def batch_norm_eval(x, running_mean, running_var, weight, bias,
+                    epsilon=1e-5, data_format="NCHW"):
+    ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - running_mean.reshape(shape)) * jax.lax.rsqrt(
+        running_var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@primitive
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = [1, -1] + [1] * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+@primitive
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW"):
+    if data_format != "NCHW":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * jax.lax.rsqrt(var + epsilon)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    if data_format != "NCHW":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+@primitive
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    sq = jnp.square(x)
+    c = x.shape[1]
+    half = size // 2
+    padded = jnp.pad(sq, [(0, 0), (half, size - half - 1)] +
+                     [(0, 0)] * (x.ndim - 2))
+    acc = sum(padded[:, i:i + c] for i in range(size))
+    return x / jnp.power(k + alpha * acc / size, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout & embedding
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from .math import scale as _scale
+            return _scale(x, 1.0 - p)
+        from .creation import assign
+        return assign(x)
+    key = _random.next_key()
+
+    from ._primitive import apply_closure
+
+    def _f(xv):
+        shape = list(xv.shape)
+        if axis is not None:
+            ax = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in ax else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, xv / (1.0 - p), jnp.zeros_like(xv))
+        return jnp.where(keep, xv, jnp.zeros_like(xv))
+
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    return apply_closure(_f, [xt], name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p=p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        from .creation import assign
+        return assign(x)
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = _random.next_key()
+    from ._primitive import apply_closure
+
+    def _f(xv):
+        keep = jax.random.bernoulli(key, 1.0 - p, xv.shape)
+        a = ((1.0 - p) * (1.0 + p * alpha_p ** 2)) ** -0.5
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, xv, jnp.full_like(xv, alpha_p)) + b
+
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    return apply_closure(_f, [xt], name="alpha_dropout")
+
+
+@primitive(nondiff=(0,))
+def embedding(x, weight, padding_idx=None, sparse=False):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, jnp.zeros_like(out), out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@primitive(nondiff=(1,))
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0):
+    logits = input
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
+    else:
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    if soft_label or (label.ndim == logits.ndim
+                      and label.shape[axis] == logits.shape[axis]
+                      and jnp.issubdtype(label.dtype, jnp.floating)):
+        soft = label
+        if label_smoothing > 0:
+            n = logits.shape[axis]
+            soft = soft * (1 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(soft * logp, axis=axis)
+    else:
+        lbl = label
+        if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        n = logits.shape[axis]
+        oh = jax.nn.one_hot(lbl, n, axis=axis, dtype=logp.dtype)
+        if label_smoothing > 0:
+            oh = oh * (1 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(oh * logp, axis=axis)
+        # weight and ignore_index compose: per-sample w, zeroed where
+        # ignored; mean divides by the sum of effective weights
+        # (paddle softmax_with_cross_entropy semantics)
+        eff_w = None
+        if weight is not None:
+            w = weight._value if hasattr(weight, "_value") else \
+                jnp.asarray(weight)
+            eff_w = jnp.take(w, jnp.clip(lbl, 0, n - 1))
+        if ignore_index >= 0:
+            valid = (lbl != ignore_index).astype(loss.dtype)
+            eff_w = valid if eff_w is None else eff_w * valid
+        if eff_w is not None:
+            loss = loss * eff_w
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(eff_w), 1e-12)
+    return _reduce_loss(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    from .manipulation import unsqueeze as _unsq
+    if not soft_label:
+        loss = _unsq(loss, axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@primitive
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None):
+    softplus_term = jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_w * (
+            softplus_term + jnp.maximum(-logit, 0.0))
+    else:
+        loss = jnp.maximum(logit, 0.0) - logit * label + softplus_term
+    if weight is not None:
+        loss = loss * weight
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def mse_loss(input, label, reduction="mean"):
+    return _reduce_loss(jnp.square(input - label), reduction)
+
+
+@primitive
+def l1_loss(input, label, reduction="mean"):
+    return _reduce_loss(jnp.abs(input - label), reduction)
+
+
+@primitive
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    d = jnp.abs(input - label)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    # paddle returns huber-style with delta scaling
+    return _reduce_loss(loss * delta, reduction)
+
+
+@primitive(nondiff=(1,))
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lbl = label
+    n = input.shape[-1] if input.ndim == lbl.ndim + 1 else input.shape[1]
+    safe_lbl = jnp.clip(lbl, 0, n - 1)
+    loss = -jnp.take_along_axis(input, safe_lbl[..., None], axis=-1)[..., 0] \
+        if input.ndim == lbl.ndim + 1 else -jnp.take_along_axis(
+            input, safe_lbl[:, None], axis=1)[:, 0]
+    eff_w = None
+    if weight is not None:
+        w = weight._value if hasattr(weight, "_value") else \
+            jnp.asarray(weight)
+        eff_w = jnp.take(w, safe_lbl)
+    if ignore_index >= 0:
+        valid = (lbl != ignore_index).astype(loss.dtype)
+        eff_w = valid if eff_w is None else eff_w * valid
+    if eff_w is not None:
+        loss = loss * eff_w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(eff_w), 1e-12)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.maximum(label, 1e-12)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1.0, input,
+                     jnp.maximum(margin - input, 0.0))
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    sim = cosine_similarity(input1, input2, axis=-1)
+    from ..tensor import Tensor as _T
+    from ._primitive import apply_closure
+    lv = unwrap(label)
+
+    def _f(simv):
+        loss = jnp.where(lv == 1, 1.0 - simv,
+                         jnp.maximum(simv - margin, 0.0))
+        return _reduce_loss(loss, reduction)
+
+    return apply_closure(_f, [sim], name="cosine_embedding_loss")
+
+
+# ---------------------------------------------------------------------------
+# Attention (XLA path; Pallas flash kernel lives in ops/pallas_ops.py)
+# ---------------------------------------------------------------------------
+@primitive(name="scaled_dot_product_attention", nondiff=(3, 4))
+def _sdpa(query, key, value, attn_mask, dropout_key, dropout_p=0.0,
+          is_causal=False):
+    """Inputs [batch, seq, heads, head_dim] (paddle convention)."""
+    q = jnp.swapaxes(query, 1, 2)  # → B,H,S,D
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits,
+                               jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_key is not None and dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros_like(probs))
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
+    dk = _random.next_key() if (dropout_p > 0.0 and training) else None
+    return _sdpa(query, key, value, attn_mask, dk, dropout_p=dropout_p,
+                 is_causal=is_causal)
+
+
+# ---------------------------------------------------------------------------
+# Interpolate / vision ops
+# ---------------------------------------------------------------------------
+@primitive
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+    else:
+        n, h, w, c = x.shape
+    if size is None:
+        sf = (scale_factor if isinstance(scale_factor, (list, tuple))
+              else (scale_factor, scale_factor))
+        size = (int(h * sf[0]), int(w * sf[1]))
+    size = tuple(int(s) for s in size)
+    method = {"nearest": "nearest", "bilinear": "bilinear",
+              "bicubic": "bicubic", "area": "linear"}.get(mode, mode)
+    if data_format == "NCHW":
+        out = jax.image.resize(x, (n, c) + size, method=method)
+    else:
+        out = jax.image.resize(x, (n,) + size + (c,), method=method)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       align_corners=align_corners, data_format=data_format)
+
+
+@primitive
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = upscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+    return out.reshape(n, c // (r * r), h * r, w * r)
+
+
+@primitive
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = downscale_factor
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+    return out.reshape(n, c * r * r, h // r, w // r)
+
+
+@primitive
+def channel_shuffle(x, groups, data_format="NCHW"):
+    n, c, h, w = x.shape
+    out = x.reshape(n, groups, c // groups, h, w)
+    out = jnp.swapaxes(out, 1, 2)
+    return out.reshape(n, c, h, w)
+
+
+@primitive
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xr = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    left = jnp.concatenate([xr[:, 1:, :fold], jnp.zeros_like(
+        xr[:, :1, :fold])], axis=1)
+    right = jnp.concatenate([jnp.zeros_like(xr[:, :1, fold:2 * fold]),
+                             xr[:, :-1, fold:2 * fold]], axis=1)
+    rest = xr[:, :, 2 * fold:]
+    return jnp.concatenate([left, right, rest], axis=2).reshape(
+        nt, c, h, w)
+
+
+@primitive
+def linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out

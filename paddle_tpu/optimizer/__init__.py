@@ -1,0 +1,6 @@
+"""paddle.optimizer parity surface."""
+
+from . import lr  # noqa
+from .optimizer import (  # noqa
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Lamb,
+    Adadelta, Adamax, L2Decay, L1Decay)

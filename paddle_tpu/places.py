@@ -1,0 +1,148 @@
+"""Place / device abstraction.
+
+Paddle identifies where a tensor lives with ``Place`` objects
+(upstream: paddle/phi/common/place.h — CPUPlace/GPUPlace/XPUPlace/
+CustomPlace).  On the TPU build a Place is a thin handle over a
+``jax.Device``: ``TPUPlace(i)`` ↦ i-th accelerator device,
+``CPUPlace()`` ↦ host.  ``paddle.set_device("tpu:0")`` selects the
+default placement used by creation ops; XLA owns streams and memory so a
+DeviceContext equivalent is unnecessary (SURVEY.md §2.1 DeviceContext
+row).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+
+class Place:
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self._device_id = int(device_id)
+
+    def get_device_id(self) -> int:
+        return self._device_id
+
+    def __eq__(self, other):
+        return (type(self) is type(other)
+                and self._device_id == other._device_id)
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._device_id))
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self._device_id})"
+
+    def jax_device(self) -> Optional[jax.Device]:
+        """Resolve to a jax.Device (None = let jax use its default)."""
+        kind = self.device_type
+        if kind == "cpu":
+            return jax.devices("cpu")[0]
+        devs = jax.local_devices()
+        accel = [d for d in devs if d.platform != "cpu"] or devs
+        return accel[self._device_id % len(accel)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# Compat aliases: scripts written for GPU Paddle say CUDAPlace/gpu — map
+# them onto the accelerator present (TPU here).
+class CUDAPlace(TPUPlace):
+    device_type = "gpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class XPUPlace(TPUPlace):
+    device_type = "xpu"
+
+
+class CustomPlace(TPUPlace):
+    def __init__(self, dev_type: str = "tpu", device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = dev_type
+
+
+_current_place: Place = None  # resolved lazily
+
+
+def _accelerator_present() -> bool:
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def _default_place() -> Place:
+    return TPUPlace(0) if _accelerator_present() else CPUPlace()
+
+
+def get_device() -> str:
+    p = _expected_place()
+    if isinstance(p, CPUPlace):
+        return "cpu"
+    return f"{p.device_type}:{p.get_device_id()}"
+
+
+def set_device(device: Union[str, Place]) -> Place:
+    """``paddle.set_device('tpu'|'gpu:0'|'cpu')``.  'gpu'/'cuda'/'xpu' are
+    accepted and mapped to the accelerator actually present."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return _current_place
+    dev = device.lower()
+    if ":" in dev:
+        kind, idx = dev.split(":", 1)
+        idx = int(idx)
+    else:
+        kind, idx = dev, 0
+    if kind == "cpu":
+        _current_place = CPUPlace()
+    elif kind in ("tpu", "gpu", "cuda", "xpu", "npu"):
+        _current_place = TPUPlace(idx)
+    else:
+        _current_place = CustomPlace(kind, idx)
+    return _current_place
+
+
+def _expected_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = _default_place()
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    accel = [d for d in jax.devices() if d.platform != "cpu"]
+    return len(accel) or len(jax.devices())

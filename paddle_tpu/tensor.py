@@ -1,0 +1,363 @@
+"""The Tensor: a mutable handle over an immutable ``jax.Array``.
+
+Paddle's ``phi::DenseTensor`` is an Allocation + meta living on a Place
+(upstream: paddle/phi/core/dense_tensor.h — SURVEY.md §2.1).  On TPU the
+storage is a PJRT buffer in HBM owned by jax; the Paddle-visible object
+is this wrapper.  Imperative mutation (``add_``, ``set_value``,
+optimizer updates) is a buffer swap on the wrapper — the underlying
+array is never mutated, which is what makes the same object usable both
+eagerly and as a leaf of a jit trace (``_value`` may temporarily hold a
+tracer during functional execution, see nn/functional_call).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .framework import dtype as dtypes
+from .places import Place, CPUPlace, _expected_place
+from .autograd import tape as _tape
+
+_param_counter = [0]
+
+
+def _auto_name(prefix: str) -> str:
+    _param_counter[0] += 1
+    return f"{prefix}_{_param_counter[0]}"
+
+
+class Tensor:
+    """Paddle-compatible tensor over a jax.Array."""
+
+    # let Tensor win binary ops against numpy arrays
+    __array_priority__ = 100
+
+    def __init__(self, value, dtype=None, place: Optional[Place] = None,
+                 stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        if dtype is not None:
+            jdt = dtypes.to_jax_dtype(dtype)
+        elif isinstance(value, (bool, int, float)) or (
+                isinstance(value, (list, tuple))):
+            # python floats default to the framework default dtype (fp32),
+            # matching paddle.to_tensor, not jnp's weak float32/float64.
+            probe = np.asarray(value)
+            if probe.dtype == np.float64:
+                jdt = dtypes.default_float_dtype().np_dtype
+            elif probe.dtype == np.int64:
+                jdt = np.int64
+            else:
+                jdt = None
+        else:
+            jdt = None
+        if isinstance(value, jax.Array) and place is None and (
+                jdt is None or value.dtype == jdt):
+            self._value = value
+        else:
+            dev = place.jax_device() if place is not None else None
+            arr = jnp.asarray(value, dtype=jdt)
+            self._value = jax.device_put(arr, dev) if dev is not None else arr
+        self.stop_gradient = bool(stop_gradient)
+        self.grad: Optional[Tensor] = None
+        self.name = name or _auto_name("generated_tensor")
+        self.persistable = False
+        self._retain_grads = False
+
+    # -- basic meta ---------------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    dim = ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return dtypes.convert_dtype(self._value.dtype)
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = list(self._value.devices())[0]
+            if dev.platform == "cpu":
+                return CPUPlace()
+        except Exception:
+            pass
+        return _expected_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        # leaf = not produced by a recorded op (set by the dispatcher)
+        return not getattr(self, "_produced", False)
+
+    @property
+    def T(self) -> "Tensor":
+        from . import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def mT(self) -> "Tensor":
+        from . import ops
+        perm = list(range(self.ndim))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        return ops.transpose(self, perm)
+
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self.shape[0]
+
+    def __repr__(self):
+        grad_flag = f", stop_gradient={self.stop_gradient}"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+                f"place={self.place}{grad_flag},\n       "
+                f"{np.asarray(self._value)!r})")
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self._value))
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args) -> Union[int, float, bool]:
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from . import ops
+        return ops.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def clone(self) -> "Tensor":
+        from . import ops
+        return ops.assign(self)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self.stop_gradient = True
+        return self
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._value),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, device_id: int = 0, blocking: bool = True) -> "Tensor":
+        from .places import TPUPlace
+        return Tensor(self._value, place=TPUPlace(device_id),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self) -> "Tensor":
+        return self.cpu()
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        dtype = kwargs.get("dtype")
+        device = kwargs.get("device")
+        for a in args:
+            if isinstance(a, (str, Place)):
+                device = a
+            else:
+                dtype = a
+        out = self
+        if dtype is not None:
+            out = out.astype(dtype)
+        if device is not None:
+            from .places import set_device  # resolve string → Place
+            if isinstance(device, str):
+                from . import places as _pl
+                kind = device.split(":")[0]
+                idx = int(device.split(":")[1]) if ":" in device else 0
+                device = (_pl.CPUPlace() if kind == "cpu"
+                          else _pl.TPUPlace(idx))
+            out = Tensor(out._value, place=device,
+                         stop_gradient=out.stop_gradient)
+        return out
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        _tape.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def retain_grads(self) -> None:
+        self._retain_grads = True
+
+    def register_hook(self, hook):
+        # VERIFY-vs-reference: eager grad hooks not yet wired into tape walk.
+        raise NotImplementedError(
+            "Tensor.register_hook is not supported yet on the TPU build")
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # -- mutation (buffer swap) --------------------------------------------
+    def set_value(self, value) -> None:
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value, dtype=self._value.dtype)
+
+    def copy_(self, other, blocking: bool = True) -> "Tensor":
+        self.set_value(other)
+        return self
+
+    def fill_(self, value) -> "Tensor":
+        self._value = jnp.full_like(self._value, value)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def _swap_value(self, new_value) -> None:
+        """Internal: replace the buffer (used by optimizers / functional
+        call). No dtype coercion."""
+        self._value = new_value
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        from . import ops
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value) -> None:
+        if isinstance(value, Tensor):
+            value = value._value
+        if isinstance(idx, Tensor):
+            idx = idx._value
+        if isinstance(idx, tuple):
+            idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        self._value = self._value.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self) -> bool:
+        return bool(self.numpy())
+
+    def __int__(self) -> int:
+        return int(self.numpy())
+
+    def __float__(self) -> float:
+        return float(self.numpy())
+
+    def __index__(self) -> int:
+        return int(self.numpy())
+
+    def __hash__(self):
+        return id(self)
+
+    def __deepcopy__(self, memo):
+        import copy as _copy
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            if k == "_value":
+                new._value = self._value  # jax arrays are immutable
+            else:
+                new.__dict__[k] = _copy.deepcopy(v, memo)
+        return new
+
+    # -- arithmetic dunders (delegate to the op table) ----------------------
+    def _op(self, name, *args, **kw):
+        from . import ops
+        return getattr(ops, name)(self, *args, **kw)
+
+    def __add__(self, o): return self._op("add", o)
+    def __radd__(self, o): return self._op("add", o)
+    def __sub__(self, o): return self._op("subtract", o)
+
+    def __rsub__(self, o):
+        from . import ops
+        return ops.subtract(o, self)
+
+    def __mul__(self, o): return self._op("multiply", o)
+    def __rmul__(self, o): return self._op("multiply", o)
+    def __truediv__(self, o): return self._op("divide", o)
+
+    def __rtruediv__(self, o):
+        from . import ops
+        return ops.divide(o, self)
+
+    def __floordiv__(self, o): return self._op("floor_divide", o)
+    def __mod__(self, o): return self._op("remainder", o)
+    def __pow__(self, o): return self._op("pow", o)
+
+    def __rpow__(self, o):
+        from . import ops
+        return ops.elementwise_pow(o, self)
+
+    def __matmul__(self, o): return self._op("matmul", o)
+    def __neg__(self): return self._op("neg")
+    def __abs__(self): return self._op("abs")
+    def __invert__(self): return self._op("logical_not")
+
+    def __eq__(self, o): return self._op("equal", o)
+    def __ne__(self, o): return self._op("not_equal", o)
+    def __lt__(self, o): return self._op("less_than", o)
+    def __le__(self, o): return self._op("less_equal", o)
+    def __gt__(self, o): return self._op("greater_than", o)
+    def __ge__(self, o): return self._op("greater_equal", o)
+
+    def __and__(self, o): return self._op("logical_and", o)
+    def __or__(self, o): return self._op("logical_or", o)
+    def __xor__(self, o): return self._op("logical_xor", o)
+
+
+class Parameter(Tensor):
+    """Trainable tensor: ``stop_gradient=False``, tracked by ``nn.Layer``."""
+
+    def __init__(self, value, dtype=None, name: Optional[str] = None,
+                 trainable: bool = True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable,
+                         name=name or _auto_name("param"))
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        # sharding annotation consumed by the jit/pjit path: a
+        # PartitionSpec-like tuple over mesh axis names, or None=replicated.
+        self.dist_spec = None
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """``paddle.to_tensor`` parity."""
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)

@@ -1,0 +1,6 @@
+"""paddle.vision parity (python/paddle/vision/)."""
+
+from . import models  # noqa
+from . import datasets  # noqa
+from . import transforms  # noqa
+from .models import LeNet, ResNet, resnet18, resnet50  # noqa

@@ -1,0 +1,10 @@
+"""vision datasets (parity: python/paddle/vision/datasets/).
+
+No network in this environment: MNIST/Cifar load from local files when
+present (same file formats as upstream) and fall back to deterministic
+synthetic data so the training loops/tests run anywhere.
+"""
+
+from .mnist import MNIST, FashionMNIST  # noqa
+from .cifar import Cifar10, Cifar100  # noqa
+from .synthetic import SyntheticImages  # noqa

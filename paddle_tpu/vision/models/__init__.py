@@ -1,0 +1,8 @@
+from .lenet import LeNet  # noqa
+from .resnet import (  # noqa
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152)
+from .vgg import VGG, vgg16, vgg19  # noqa
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa
+from .vision_transformer import (  # noqa
+    VisionTransformer, vit_b_16, vit_l_16)
+from .alexnet import AlexNet, alexnet  # noqa

@@ -1,0 +1,125 @@
+"""ViT (baseline config 5 names ViT-L/16 — BASELINE.json:11; upstream
+lives in PaddleClas, the layer set is core paddle.nn).
+
+Pure transformer on patches: all matmul/attention — the best-case
+MXU workload.  Attention uses flash_attention for long token counts.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ... import nn, ops
+from ...tensor import Tensor
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, patch_size,
+                              stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)                     # B, E, H/P, W/P
+        x = ops.flatten(x, 2)                # B, E, N
+        return ops.transpose(x, [0, 2, 1])   # B, N, E
+
+
+class MLP(nn.Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.act = nn.GELU()
+        self.fc2 = nn.Linear(hidden, dim)
+        self.drop = nn.Dropout(drop)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class Attention(nn.Layer):
+    def __init__(self, dim, num_heads, qkv_bias=True, attn_drop=0.0,
+                 proj_drop=0.0):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = dim // num_heads
+        self.qkv = nn.Linear(dim, dim * 3,
+                             bias_attr=None if qkv_bias else False)
+        self.proj = nn.Linear(dim, dim)
+        self.proj_drop = nn.Dropout(proj_drop)
+
+    def forward(self, x):
+        b, n, c = x.shape
+        qkv = ops.reshape(self.qkv(x), [b, n, 3, self.num_heads,
+                                        self.head_dim])
+        q = qkv[:, :, 0]
+        k = qkv[:, :, 1]
+        v = qkv[:, :, 2]
+        out = ops.scaled_dot_product_attention(q, k, v)
+        out = ops.reshape(out, [b, n, c])
+        return self.proj_drop(self.proj(out))
+
+
+class Block(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, qkv_bias=True,
+                 drop=0.0, attn_drop=0.0, epsilon=1e-6):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.attn = Attention(dim, num_heads, qkv_bias, attn_drop, drop)
+        self.norm2 = nn.LayerNorm(dim, epsilon=epsilon)
+        self.mlp = MLP(dim, int(dim * mlp_ratio), drop)
+
+    def forward(self, x):
+        x = x + self.attn(self.norm1(x))
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3,
+                 num_classes=1000, embed_dim=768, depth=12, num_heads=12,
+                 mlp_ratio=4.0, qkv_bias=True, drop_rate=0.0,
+                 attn_drop_rate=0.0, epsilon=1e-6, **kwargs):
+        super().__init__()
+        self.num_classes = num_classes
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans,
+                                      embed_dim)
+        num_patches = self.patch_embed.num_patches
+        self.cls_token = self.create_parameter(
+            shape=[1, 1, embed_dim],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_embed = self.create_parameter(
+            shape=[1, num_patches + 1, embed_dim],
+            default_initializer=nn.initializer.TruncatedNormal(std=0.02))
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            Block(embed_dim, num_heads, mlp_ratio, qkv_bias, drop_rate,
+                  attn_drop_rate, epsilon) for _ in range(depth)])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        if num_classes > 0:
+            self.head = nn.Linear(embed_dim, num_classes)
+
+    def forward(self, x):
+        b = x.shape[0]
+        x = self.patch_embed(x)
+        cls = ops.expand(self.cls_token, [b, 1, self.cls_token.shape[2]])
+        x = ops.concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        if self.num_classes > 0:
+            return self.head(x[:, 0])
+        return x
+
+
+def vit_b_16(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12,
+                             num_heads=12, **kwargs)
+
+
+def vit_l_16(pretrained=False, **kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24,
+                             num_heads=16, **kwargs)

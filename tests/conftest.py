@@ -1,0 +1,37 @@
+"""Test config: force CPU backend with a virtual 8-device mesh so
+distributed tests run without TPU hardware (SURVEY.md §4 "lessons":
+single-host fakes of multi-node via xla_force_host_platform_device_count).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# keep synthetic datasets small in tests
+os.environ.setdefault("PADDLE_TPU_SYNTH_N", "512")
+
+# The axon TPU plugin ignores the JAX_PLATFORMS env var; force via config
+# before any computation runs.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_state():
+    """Isolate tests: fresh tape, fresh RNG, no leaked mesh."""
+    import paddle_tpu as paddle
+    from paddle_tpu.autograd import tape
+    from paddle_tpu.distributed import collective
+    tape.reset_tape()
+    tape.set_grad_enabled(True)
+    paddle.seed(12345)
+    yield
+    tape.reset_tape()
+    tape.set_grad_enabled(True)
+    collective.set_mesh(None)

@@ -1,0 +1,131 @@
+"""Autograd tests (pattern: upstream test/legacy_test/test_imperative_*
+and test/autograd/)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def test_backward_simple_chain():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x  # y = x^3, dy/dx = 3x^2 = 12
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0], rtol=1e-6)
+
+
+def test_grad_accumulation_across_backwards():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).backward()
+    (x * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_fan_in_accumulation():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * x + x * 2 + x  # dy/dx = 2x + 3 = 9
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [9.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    z = x * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    from paddle_tpu.autograd.tape import tape_size
+    assert tape_size() == 0
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [4.0])
+    assert x.grad is None  # paddle.grad does not populate .grad
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    y = x * 3
+    y.backward(paddle.to_tensor([1.0, 2.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 6.0])
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.array([[1.0, 5.0], [3.0, 2.0]],
+                                  dtype=np.float32), stop_gradient=False)
+    vals, idx = paddle.topk(x, k=1, axis=1)
+    vals.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[0, 1], [1, 0]])
+
+
+def test_integer_output_no_grad():
+    x = paddle.to_tensor([1.0, 3.0, 2.0], stop_gradient=False)
+    idx = paddle.argmax(x)
+    assert idx.stop_gradient
+
+
+def test_pylayer():
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.5], stop_gradient=False)
+    y = Double.apply(x)
+    np.testing.assert_allclose(y.numpy(), [3.0])
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+def test_pylayer_mixed_with_ops():
+    class Square(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor
+            return grad * 2.0 * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Square.apply(x * 3)  # (3x)^2 → d/dx = 18x = 36
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [36.0])
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_grad_through_inplace_buffer_swap():
+    # value snapshot at record time must be used, not the mutated buffer
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x
+    x.set_value(np.array([100.0], dtype=np.float32))
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0])  # 2*x_old

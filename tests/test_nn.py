@@ -1,0 +1,203 @@
+"""nn.Layer and layer zoo tests (pattern: upstream test_layers.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_grad():
+    layer = nn.Linear(4, 3)
+    x = paddle.randn([2, 4])
+    out = layer(x)
+    assert out.shape == [2, 3]
+    out.sum().backward()
+    assert layer.weight.grad is not None
+    assert layer.weight.grad.shape == [4, 3]
+    assert layer.bias.grad.shape == [3]
+
+
+def test_linear_vs_numpy():
+    layer = nn.Linear(4, 3)
+    x_np = np.random.rand(2, 4).astype(np.float32)
+    out = layer(paddle.to_tensor(x_np))
+    expect = x_np @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+
+def test_conv2d():
+    conv = nn.Conv2D(3, 8, 3, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    out = conv(x)
+    assert out.shape == [2, 8, 16, 16]
+    conv_s = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    assert conv_s(x).shape == [2, 8, 8, 8]
+
+
+def test_conv2d_vs_torch():
+    torch = pytest.importorskip("torch")
+    conv = nn.Conv2D(2, 4, 3, padding=1, bias_attr=False)
+    x_np = np.random.rand(1, 2, 8, 8).astype(np.float32)
+    out = conv(paddle.to_tensor(x_np)).numpy()
+    tconv = torch.nn.Conv2d(2, 4, 3, padding=1, bias=False)
+    with torch.no_grad():
+        tconv.weight.copy_(torch.from_numpy(conv.weight.numpy()))
+        expect = tconv(torch.from_numpy(x_np)).numpy()
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_pools():
+    x = paddle.randn([2, 3, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [2, 3, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+    x_np = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    out = nn.AvgPool2D(2, 2)(paddle.to_tensor(x_np)).numpy()
+    expect = x_np.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5])
+    bn.train()
+    out = bn(x)
+    # normalized output: near-zero mean/unit var per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-5
+    assert abs(o.std() - 1.0) < 1e-2
+    # running stats moved off init
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 5, 5]
+
+
+def test_layernorm_vs_numpy():
+    ln = nn.LayerNorm(8)
+    x_np = np.random.rand(2, 4, 8).astype(np.float32)
+    out = ln(paddle.to_tensor(x_np)).numpy()
+    mean = x_np.mean(-1, keepdims=True)
+    var = x_np.var(-1, keepdims=True)
+    expect = (x_np - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    idx = paddle.to_tensor([[1, 2], [3, 4]])
+    out = emb(idx)
+    assert out.shape == [2, 2, 4]
+    out.sum().backward()
+    assert emb.weight.grad is not None
+
+
+def test_dropout_train_eval():
+    drop = nn.Dropout(0.5)
+    x = paddle.ones([100, 100])
+    drop.train()
+    out = drop(x)
+    frac_zero = float((out.numpy() == 0).mean())
+    assert 0.3 < frac_zero < 0.7
+    # upscale_in_train: survivors are scaled by 1/(1-p)
+    nz = out.numpy()[out.numpy() != 0]
+    np.testing.assert_allclose(nz, 2.0, rtol=1e-5)
+    drop.eval()
+    np.testing.assert_array_equal(drop(x).numpy(), x.numpy())
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert seq(paddle.randn([3, 4])).shape == [3, 2]
+    assert len(seq) == 3
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll)) == 3
+
+
+def test_state_dict_roundtrip():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = net.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    net2.set_state_dict(sd)
+    np.testing.assert_array_equal(net2.state_dict()["0.weight"].numpy(),
+                                  sd["0.weight"].numpy())
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_named_parameters_unique():
+    net = nn.Sequential(nn.Linear(4, 4), nn.Linear(4, 4))
+    names = [n for n, _ in net.named_parameters()]
+    assert len(names) == len(set(names)) == 4
+
+
+def test_losses():
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    ce = nn.CrossEntropyLoss()
+    loss = ce(logits, labels)
+    assert loss.shape == []
+    # manual reference
+    import scipy.special as sp
+    logp = sp.log_softmax(logits.numpy(), axis=-1)
+    expect = -logp[np.arange(4), labels.numpy()].mean()
+    np.testing.assert_allclose(loss.numpy(), expect, rtol=1e-5)
+
+    mse = nn.MSELoss()
+    a, b = paddle.randn([3, 3]), paddle.randn([3, 3])
+    np.testing.assert_allclose(mse(a, b).numpy(),
+                               ((a.numpy() - b.numpy()) ** 2).mean(),
+                               rtol=1e-5)
+
+
+def test_cross_entropy_with_2d_label():
+    # paddle convention: label [N, 1] works too
+    logits = paddle.to_tensor(np.random.randn(4, 5).astype(np.float32))
+    labels = paddle.to_tensor(np.array([[0], [1], [2], [3]]))
+    loss = nn.CrossEntropyLoss()(logits, labels)
+    assert loss.shape == []
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 6, 16])
+    out = mha(x, x, x)
+    assert out.shape == [2, 6, 16]
+    out.sum().backward()
+    assert mha.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 2)
+    x = paddle.randn([2, 5, 16])
+    assert enc(x).shape == [2, 5, 16]
+
+
+def test_layer_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+
+
+def test_layer_to_dtype():
+    net = nn.Linear(2, 2)
+    net.to(dtype="float16")
+    assert net.weight.dtype == paddle.float16
+
+
+def test_hooks():
+    calls = []
+    net = nn.Linear(2, 2)
+    h = net.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    net(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    net(paddle.randn([1, 2]))
+    assert calls == [1]

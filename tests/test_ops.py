@@ -1,0 +1,283 @@
+"""Op unit tests vs numpy oracle — the OpTest pattern from upstream
+test/legacy_test/op_test.py (SURVEY.md §4): run the op, compare with
+numpy, check gradients numerically via finite differences.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar-valued f at x (numpy)."""
+    x = x.astype(np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy()
+        xp[i] += eps
+        xm = x.copy()
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+def check_grad(op, x_np, atol=1e-2, **kwargs):
+    """Analytic grad (tape) vs numeric grad of sum(op(x))."""
+    x = paddle.to_tensor(x_np.astype(np.float32), stop_gradient=False)
+    out = op(x, **kwargs)
+    out.sum().backward()
+    analytic = x.grad.numpy().astype(np.float64)
+
+    def f(xv):
+        t = paddle.to_tensor(xv.astype(np.float32))
+        return float(op(t, **kwargs).sum().numpy())
+
+    numeric = numeric_grad(f, x_np)
+    np.testing.assert_allclose(analytic, numeric, atol=atol, rtol=1e-2)
+
+
+class TestElementwise:
+    def test_binary_vs_numpy(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_allclose(paddle.add(ta, tb).numpy(), a + b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.multiply(ta, tb).numpy(), a * b,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.divide(ta, tb).numpy(), a / b,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.maximum(ta, tb).numpy(),
+                                   np.maximum(a, b))
+        np.testing.assert_allclose(paddle.pow(ta, 2.0).numpy(), a ** 2,
+                                   rtol=1e-5)
+
+    def test_unary_vs_numpy(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sqrt(t).numpy(), np.sqrt(a),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(paddle.exp(t).numpy(), np.exp(a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.log(t).numpy(), np.log(a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.tanh(t).numpy(), np.tanh(a),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.floor(t).numpy(), np.floor(a))
+
+    def test_broadcasting(self):
+        a = np.random.rand(3, 1, 4).astype(np.float32)
+        b = np.random.rand(2, 1).astype(np.float32)
+        out = paddle.add(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a + b, rtol=1e-6)
+
+    def test_grad_mul(self):
+        check_grad(lambda x: x * x, np.random.rand(3, 3))
+
+    def test_grad_exp(self):
+        check_grad(paddle.exp, np.random.rand(3, 3))
+
+    def test_grad_sqrt(self):
+        check_grad(paddle.sqrt, np.random.rand(3, 3) + 0.5)
+
+
+class TestReductions:
+    def test_sum_axes(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.sum(t).numpy(), a.sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(paddle.sum(t, axis=1).numpy(),
+                                   a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.sum(t, axis=[0, 2], keepdim=True).numpy(),
+            a.sum((0, 2), keepdims=True), rtol=1e-5)
+
+    def test_mean_max_min(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.mean(t, axis=0).numpy(),
+                                   a.mean(0), rtol=1e-6)
+        np.testing.assert_allclose(paddle.max(t, axis=1).numpy(), a.max(1))
+        np.testing.assert_allclose(paddle.min(t).numpy(), a.min())
+
+    def test_argmax_topk_sort(self):
+        a = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype=np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.argmax(t, axis=1).numpy().tolist() == [0, 1]
+        vals, idx = paddle.topk(t, k=2, axis=1)
+        np.testing.assert_allclose(vals.numpy(), [[3, 2], [5, 4]])
+        assert idx.numpy().tolist() == [[0, 2], [1, 2]]
+        np.testing.assert_allclose(paddle.sort(t, axis=1).numpy(),
+                                   np.sort(a, 1))
+
+    def test_cumsum(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(a), axis=1).numpy(),
+            np.cumsum(a, 1), rtol=1e-5)
+
+    def test_grad_mean(self):
+        check_grad(lambda x: x.mean(), np.random.rand(4, 4))
+
+    def test_logsumexp(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        from scipy.special import logsumexp as sp_lse
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(a), axis=1).numpy(),
+            sp_lse(a, axis=1), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+        t = paddle.to_tensor(a)
+        assert paddle.reshape(t, [4, 6]).shape == [4, 6]
+        assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(t, 1).shape == [2, 12]
+
+    def test_concat_stack_split(self):
+        a = paddle.ones([2, 3])
+        b = paddle.zeros([2, 3])
+        assert paddle.concat([a, b], axis=0).shape == [4, 3]
+        assert paddle.stack([a, b], axis=0).shape == [2, 2, 3]
+        parts = paddle.split(paddle.ones([6, 2]), 3, axis=0)
+        assert len(parts) == 3 and parts[0].shape == [2, 2]
+        parts = paddle.split(paddle.ones([7, 2]), [2, 5], axis=0)
+        assert parts[1].shape == [5, 2]
+        parts = paddle.split(paddle.ones([7, 2]), [2, -1], axis=0)
+        assert parts[1].shape == [5, 2]
+
+    def test_squeeze_unsqueeze_tile_expand(self):
+        t = paddle.ones([1, 3, 1])
+        assert paddle.squeeze(t).shape == [3]
+        assert paddle.squeeze(t, axis=0).shape == [3, 1]
+        assert paddle.unsqueeze(t, 0).shape == [1, 1, 3, 1]
+        assert paddle.tile(paddle.ones([2]), [3, 2]).shape == [3, 4]
+        assert paddle.expand(paddle.ones([1, 3]), [4, 3]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = paddle.to_tensor(np.arange(12).reshape(4, 3).astype(np.float32))
+        idx = paddle.to_tensor([0, 2])
+        np.testing.assert_allclose(paddle.gather(x, idx).numpy(),
+                                   [[0, 1, 2], [6, 7, 8]])
+        upd = paddle.to_tensor(np.ones((2, 3), dtype=np.float32))
+        out = paddle.scatter(x, idx, upd)
+        np.testing.assert_allclose(out.numpy()[0], [1, 1, 1])
+        np.testing.assert_allclose(out.numpy()[1], [3, 4, 5])
+
+    def test_where_masked_fill(self):
+        c = paddle.to_tensor([True, False, True])
+        a = paddle.to_tensor([1.0, 2.0, 3.0])
+        b = paddle.to_tensor([9.0, 9.0, 9.0])
+        np.testing.assert_allclose(paddle.where(c, a, b).numpy(), [1, 9, 3])
+        m = paddle.to_tensor([False, True, False])
+        np.testing.assert_allclose(
+            ops.masked_fill(a, m, -1.0).numpy(), [1, -1, 3])
+
+    def test_pad(self):
+        x = paddle.ones([1, 1, 2, 2])
+        out = ops.pad(x, [1, 1, 1, 1])  # pads H and W (NCHW)
+        assert out.shape == [1, 1, 4, 4]
+
+    def test_grad_through_reshape_concat(self):
+        a = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32),
+                             stop_gradient=False)
+        b = paddle.to_tensor(np.random.rand(2, 3).astype(np.float32),
+                             stop_gradient=False)
+        out = paddle.concat([a.reshape([6]), b.reshape([6])], axis=0)
+        out.sum().backward()
+        np.testing.assert_allclose(a.grad.numpy(), np.ones((2, 3)))
+        np.testing.assert_allclose(b.grad.numpy(), np.ones((2, 3)))
+
+
+class TestLinalg:
+    def test_matmul_shapes(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=False, transpose_y=False)
+        assert out.shape == [2, 3, 5]
+
+    def test_matmul_transpose_flags(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 5).astype(np.float32)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_grad_matmul(self):
+        a_np = np.random.rand(3, 4)
+        b = paddle.to_tensor(np.random.rand(4, 2).astype(np.float32))
+        check_grad(lambda x: paddle.matmul(x, b), a_np)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        out = ops.einsum("ij,jk->ik", paddle.to_tensor(a),
+                         paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+    def test_norm_inverse(self):
+        a = np.random.rand(3, 3).astype(np.float32) + np.eye(
+            3, dtype=np.float32) * 3
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.norm(t).numpy(),
+                                   np.linalg.norm(a), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(paddle.inverse(t), t).numpy(), np.eye(3),
+            atol=1e-4)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name,ref", [
+        ("relu", lambda x: np.maximum(x, 0)),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("tanh", np.tanh),
+    ])
+    def test_vs_numpy(self, name, ref):
+        a = np.random.randn(3, 4).astype(np.float32)
+        out = getattr(ops, name)(paddle.to_tensor(a))
+        np.testing.assert_allclose(out.numpy(), ref(a), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_softmax(self):
+        a = np.random.randn(3, 4).astype(np.float32)
+        out = ops.softmax(paddle.to_tensor(a), axis=-1)
+        e = np.exp(a - a.max(-1, keepdims=True))
+        np.testing.assert_allclose(out.numpy(), e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(out.numpy().sum(-1), np.ones(3),
+                                   rtol=1e-6)
+
+    def test_gelu_grad(self):
+        check_grad(ops.gelu, np.random.randn(3, 3))
+
+
+class TestRandom:
+    def test_seed_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(7)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_uniform_range(self):
+        t = paddle.uniform([1000], min=-2.0, max=3.0)
+        assert t.numpy().min() >= -2.0 and t.numpy().max() <= 3.0
+
+    def test_randint(self):
+        t = paddle.randint(0, 5, [100])
+        assert t.dtype == paddle.int64
+        assert t.numpy().min() >= 0 and t.numpy().max() < 5
+
+    def test_randperm(self):
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))

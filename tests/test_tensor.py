@@ -1,0 +1,102 @@
+"""Tensor basics (modeled on upstream test/legacy_test tensor tests)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert t.dtype == paddle.float32
+    t = paddle.to_tensor([1, 2])
+    assert t.dtype == paddle.int64
+    t = paddle.to_tensor(np.zeros((2, 3), dtype=np.float64))
+    assert t.dtype == paddle.float64
+    t = paddle.to_tensor([True, False])
+    assert t.dtype == paddle.bool
+
+
+def test_shape_meta():
+    t = paddle.zeros([2, 3, 4])
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert len(t) == 2
+
+
+def test_arithmetic_dunders():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2.0 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((2.0 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose(abs(paddle.to_tensor([-1.0])).numpy(), [1])
+
+
+def test_comparison():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert (a > 1.5).numpy().tolist() == [False, True, True]
+    assert (a == 2.0).numpy().tolist() == [False, True, False]
+
+
+def test_indexing():
+    t = paddle.to_tensor(np.arange(12).reshape(3, 4).astype(np.float32))
+    np.testing.assert_allclose(t[0].numpy(), [0, 1, 2, 3])
+    np.testing.assert_allclose(t[1, 2].numpy(), 6)
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    np.testing.assert_allclose(t[0:2, 0:2].numpy(), [[0, 1], [4, 5]])
+    idx = paddle.to_tensor([0, 2])
+    np.testing.assert_allclose(t[idx].numpy(), [[0, 1, 2, 3],
+                                                [8, 9, 10, 11]])
+
+
+def test_setitem():
+    t = paddle.zeros([3, 3])
+    t[1, 1] = 5.0
+    assert t.numpy()[1, 1] == 5.0
+    t[0] = paddle.ones([3])
+    np.testing.assert_allclose(t.numpy()[0], [1, 1, 1])
+
+
+def test_inplace_ops():
+    t = paddle.to_tensor([1.0, 2.0])
+    t.add_(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(t.numpy(), [2, 3])
+    t.scale_(2.0)
+    np.testing.assert_allclose(t.numpy(), [4, 6])
+    t.zero_()
+    np.testing.assert_allclose(t.numpy(), [0, 0])
+
+
+def test_astype_cast():
+    t = paddle.to_tensor([1.7, 2.3])
+    assert t.astype("int32").dtype == paddle.int32
+    assert t.astype(paddle.float64).dtype == paddle.float64
+    assert paddle.cast(t, "int64").dtype == paddle.int64
+
+
+def test_item_and_conversion():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == 3.5
+    assert float(t) == 3.5
+    assert paddle.to_tensor(2).item() == 2
+
+
+def test_detach_clone():
+    t = paddle.to_tensor([1.0], stop_gradient=False)
+    d = t.detach()
+    assert d.stop_gradient
+    c = t.clone()
+    assert not c.stop_gradient  # clone tracks grad
+
+
+def test_numpy_roundtrip():
+    arr = np.random.rand(4, 5).astype(np.float32)
+    t = paddle.to_tensor(arr)
+    np.testing.assert_array_equal(t.numpy(), arr)
