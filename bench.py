@@ -1,0 +1,80 @@
+"""Benchmark: GPT-2-small causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: the ERNIE/GPT class of baseline configs (BASELINE.json:9-10)
+reduced to one chip — bf16 train step (fwd+bwd+AdamW) of a 124M-param
+GPT-2-small at batch 8 × seq 1024, compiled to a single XLA program.
+
+vs_baseline: BASELINE.md records no published reference numbers
+("published": {} — empty reference mount), so the denominator is the
+community-typical per-A100 figure for GPT-2-small-class training used
+as the provisional bar: 25k tokens/s/GPU.  Replace when real reference
+numbers exist.
+"""
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_TOKENS_PER_SEC = 25_000.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, amp
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.runner import DistributedRunner
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                    num_hidden_layers=12, num_attention_heads=12,
+                    intermediate_size=3072,
+                    max_position_embeddings=1024,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0,
+                    use_flash_attention=True)
+    batch, seq = 8, 1024
+    net = GPTForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=net.parameters(),
+                          multi_precision=True)
+    # O2: bf16 params + fp32 master weights in the optimizer
+    amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    mesh = collective.build_mesh({})
+    collective.set_mesh(mesh)
+    runner = DistributedRunner(net, opt, GPTPretrainingCriterion(),
+                               mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+
+    # compile + warmup (float() forces a full device sync)
+    float(runner.train_step([x], [y]))
+    float(runner.train_step([x], [y]))
+
+    steps = 10
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = runner.train_step([x], [y])
+    jax.block_until_ready(runner._opt_state)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    print(json.dumps({
+        "metric": "gpt2_small_bf16_train_tokens_per_sec_1chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,7 @@ from .communication import (  # noqa
 from . import fleet  # noqa
 from . import sharding  # noqa
 from .collective import split, get_mesh, set_mesh  # noqa
+from .runner import DistributedRunner  # noqa
 from .fleet.recompute import recompute  # noqa
 from . import checkpoint  # noqa
 

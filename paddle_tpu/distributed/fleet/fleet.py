@@ -33,7 +33,7 @@ class Fleet:
         _set_hybrid_parallel_group(self._hcg)
         # MP rng tracker: shared global seed, distinct local seed per mp
         # rank (paddle's tensor_init_seed semantics)
-        from ....framework import random as _random
+        from ...framework import random as _random
         seed = self._strategy.tensor_parallel_configs.get(
             "tensor_init_seed", -1)
         if seed is None or seed < 0:

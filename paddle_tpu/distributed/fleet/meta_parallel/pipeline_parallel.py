@@ -82,11 +82,10 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x_micro: Any,
         outs0 = jnp.zeros((num_micro,) + xs.shape[1:], xs.dtype)
         (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(T))
         # broadcast last stage's outputs to all pp ranks so the loss is
-        # computed everywhere (replicated output contract)
-        outs = lax.ppermute(
-            outs, "pp",
-            [(num_stages - 1, i) for i in range(num_stages)]) \
-            if num_stages > 1 else outs
+        # computed everywhere (replicated output contract): mask + psum
+        if num_stages > 1:
+            is_last = (stage == num_stages - 1).astype(outs.dtype)
+            outs = lax.psum(outs * is_last, "pp")
         return outs
 
     spec_params = jax.tree_util.tree_map(

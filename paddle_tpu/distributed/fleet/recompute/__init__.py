@@ -23,27 +23,85 @@ from ....autograd import tape as _tape
 from ....framework import random as _random
 
 
+def _captured_params(function) -> list:
+    """Trainable Parameters the callable reaches through self/closure —
+    they must be declared as tape inputs so grads flow to them (upstream
+    gets this for free from the autograd engine re-running forward)."""
+    from ....nn.layer import Layer
+    found = {}
+
+    def visit_layer(layer):
+        for _, p in layer.named_parameters():
+            if not p.stop_gradient:
+                found[id(p)] = p
+
+    def visit(v, depth=0):
+        if depth > 2:
+            return
+        if isinstance(v, Layer):
+            visit_layer(v)
+        elif isinstance(v, Tensor):
+            if not v.stop_gradient:
+                found[id(v)] = v
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                visit(item, depth + 1)
+        elif isinstance(v, dict):
+            for item in v.values():
+                visit(item, depth + 1)
+
+    self_obj = getattr(function, "__self__", None)
+    if isinstance(self_obj, Layer):
+        visit_layer(self_obj)
+    if isinstance(function, Layer):
+        visit_layer(function)
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            visit(cell.cell_contents)
+        except ValueError:
+            continue
+    return list(found.values())
+
+
 def recompute(function: Callable, *args, **kwargs):
     preserve = kwargs.pop("preserve_rng_state", True)
-    use_reentrant = kwargs.pop("use_reentrant", True)
+    kwargs.pop("use_reentrant", True)
 
     tensor_args = [a for a in args if isinstance(a, Tensor)]
-    # Snapshot RNG so eager replay is deterministic (paddle semantics)
+    params = _captured_params(function)
+    all_inputs = tensor_args + params
+    # Snapshot RNG so the vjp replay draws the SAME keys as the first
+    # run, while the first run still advances the global generator so
+    # consecutive recomputed blocks get decorrelated dropout (paddle's
+    # rng-state-replay semantics).
     rng_state = _random.get_rng_state() if preserve else None
+    first_run = [True]
 
     def pure_fn(*vals):
+        arg_vals = vals[:len(tensor_args)]
+        param_vals = vals[len(tensor_args):]
         wrapped = []
-        it = iter(vals)
+        it = iter(arg_vals)
         for a in args:
             wrapped.append(Tensor(next(it)) if isinstance(a, Tensor)
                            else a)
-        if rng_state is not None:
+        replay = rng_state is not None and not first_run[0]
+        if replay:
             saved = _random.get_rng_state()
             _random.set_rng_state(rng_state)
+        first_run[0] = False
+        # rebind captured params to the traced values; suppress nested
+        # tape recording (this subgraph is one atomic tape node)
+        old_vals = [p._value for p in params]
+        for p, v in zip(params, param_vals):
+            p._value = v
         try:
-            out = function(*wrapped, **kwargs)
+            with _tape.no_grad_ctx():
+                out = function(*wrapped, **kwargs)
         finally:
-            if rng_state is not None:
+            for p, v in zip(params, old_vals):
+                p._value = v
+            if replay:
                 _random.set_rng_state(saved)
         if isinstance(out, (tuple, list)):
             return tuple(o._value if isinstance(o, Tensor) else o
@@ -53,7 +111,7 @@ def recompute(function: Callable, *args, **kwargs):
     ckpt_fn = jax.checkpoint(pure_fn)
 
     from ....ops._primitive import apply_closure
-    return apply_closure(lambda *vals: ckpt_fn(*vals), tensor_args,
+    return apply_closure(lambda *vals: ckpt_fn(*vals), all_inputs,
                          name="recompute")
 
 

@@ -1,0 +1,229 @@
+"""DistributedRunner: one jitted train step over the global Mesh.
+
+This is the TPU replacement for the whole of upstream's distributed
+execution machinery — Reducer buckets, ShardingOptimizer passes,
+FleetExecutor (SURVEY.md §2.1) — collapsed into sharding placement +
+one XLA compile:
+
+* dp / sharding axes: batch sharded on ('dp','sharding'); the gradient
+  all-reduce (dp) or reduce-scatter (ZeRO-2) is emitted by XLA from the
+  placement of grads/optimizer state.
+* mp axis: parameters carry PartitionSpecs from the mp layers; the
+  Megatron collectives emerge from SPMD propagation.
+* ZeRO stage 1/2/3 (GroupSharded parity): stage 1 shards optimizer
+  state, stage 2 additionally constrains grads, stage 3 shards the
+  params themselves — all expressed as NamedShardings, implementing the
+  cross-replica weight-update sharding of PAPERS.md entry 4.
+
+Used by fleet-driven training loops, __graft_entry__.dryrun_multichip,
+and bench.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..tensor import Tensor
+from ..nn import functional_call as F
+from ..framework import random as _random
+from . import collective as coll
+from .fleet.meta_parallel.sharding_parallel import shard_spec_for
+
+
+def _data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("dp", "sharding")
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+class DistributedRunner:
+    def __init__(self, network, optimizer, loss_fn=None,
+                 mesh: Optional[Mesh] = None, sharding_stage: int = 0,
+                 accumulate_steps: int = 1):
+        self.network = network
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.mesh = mesh or coll.ensure_mesh()
+        self.sharding_stage = sharding_stage
+        self.accumulate_steps = accumulate_steps
+        self._step_fn = None
+        self._opt_state = None
+        self._placed = False
+
+    # -- sharding assignment -------------------------------------------------
+    def _param_spec(self, p) -> P:
+        if getattr(p, "dist_spec", None) is not None:
+            return P(*p.dist_spec)
+        if self.sharding_stage >= 3:
+            size = int(self.mesh.shape.get("sharding", 1))
+            if size > 1:
+                return P(*shard_spec_for(p.shape, size))
+        return P()
+
+    def _state_spec(self, pspec: P, leaf) -> P:
+        """Optimizer-state leaf sharding: follow the param, except under
+        ZeRO-1/2 where flat state shards on the 'sharding' axis."""
+        if np.ndim(leaf) == 0:
+            return P()
+        if self.sharding_stage >= 1:
+            size = int(self.mesh.shape.get("sharding", 1))
+            if size > 1 and pspec == P():
+                return P(*shard_spec_for(np.shape(leaf), size))
+        return pspec if len(pspec) <= np.ndim(leaf) else P()
+
+    def _shard(self, value, spec: P):
+        return jax.device_put(value, NamedSharding(self.mesh, spec))
+
+    def place(self):
+        """Device-put params/state with their shardings (done once)."""
+        name_to_param = dict(self.network.named_parameters())
+        self._pspecs = {n: self._param_spec(p)
+                        for n, p in name_to_param.items()}
+        # which params receive weight decay (apply_decay_param_fun /
+        # per-param regularizer parity with the eager step())
+        self._decay_mask = {
+            n: bool(self.optimizer._param_decay(p) != 0.0)
+            for n, p in name_to_param.items()}
+        for n, p in name_to_param.items():
+            p._value = self._shard(p._value, self._pspecs[n])
+        params = F.param_dict(self.network)
+        if self._opt_state is None:
+            self._opt_state = self.optimizer.init_state_tree(params)
+        placed_state = {}
+        for n, st in self._opt_state.items():
+            pspec = self._pspecs.get(n, P())
+            placed_state[n] = {
+                k: self._shard(v, self._state_spec(pspec, v))
+                for k, v in st.items()}
+        self._opt_state = placed_state
+        self._placed = True
+
+    # -- the compiled step ---------------------------------------------------
+    def _build(self):
+        net = self.network
+        loss_layer = self.loss_fn
+        mesh = self.mesh
+        daxes = _data_axes(mesh)
+        pspecs = None  # bound at call; closure reads self._pspecs
+        opt = self.optimizer
+        stage = self.sharding_stage
+        runner = self
+
+        acc = max(int(self.accumulate_steps), 1)
+
+        def step(params, frozen, buffers, opt_state, lr, key, *data):
+            n_in = self._n_inputs
+            if daxes:
+                data = tuple(
+                    jax.lax.with_sharding_constraint(
+                        d, NamedSharding(mesh, P(daxes)))
+                    for d in data)
+
+            def loss_of(p, micro_data, micro_key):
+                inputs = [Tensor(v) for v in micro_data[:n_in]]
+                labels = [Tensor(v) for v in micro_data[n_in:]]
+                with F.bind(net, p, buffers, frozen) as holder:
+                    from ..autograd import tape as _tape
+                    with _tape.no_grad_ctx():
+                        with _random.key_provider(
+                                _random.make_split_provider(micro_key)):
+                            out = net(*inputs)
+                            if loss_layer is not None:
+                                outs = out if isinstance(out, (list, tuple)) \
+                                    else [out]
+                                loss = loss_layer(*outs, *labels)
+                            else:
+                                loss = out
+                return loss._value.astype(jnp.float32), holder.get(
+                    "buffers", {})
+
+            if acc == 1:
+                (loss_val, new_buf), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(params, data, key)
+            else:
+                # gradient accumulation (paddle gradient_merge parity):
+                # microbatch loop compiled as lax.scan, grads averaged
+                micro = tuple(
+                    d.reshape((acc, d.shape[0] // acc) + d.shape[1:])
+                    for d in data)
+
+                def body(carry, xs):
+                    g_acc, l_acc = carry
+                    md, mk = xs
+                    (l, nb), g = jax.value_and_grad(
+                        loss_of, has_aux=True)(params, md, mk)
+                    g_acc = jax.tree_util.tree_map(
+                        lambda a, b: a + b, g_acc, g)
+                    return (g_acc, l_acc + l), nb
+
+                g0 = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.result_type(p)),
+                    params)
+                keys = jax.random.split(key, acc)
+                (grads, loss_sum), bufs = jax.lax.scan(
+                    body, (g0, jnp.asarray(0.0, jnp.float32)),
+                    (micro, keys))
+                grads = jax.tree_util.tree_map(lambda g: g / acc, grads)
+                loss_val = loss_sum / acc
+                new_buf = jax.tree_util.tree_map(lambda b: b[-1], bufs)
+            if stage >= 2:
+                size = int(mesh.shape.get("sharding", 1))
+                if size > 1:
+                    grads = {
+                        n: jax.lax.with_sharding_constraint(
+                            g, NamedSharding(
+                                mesh, P(*shard_spec_for(g.shape, size))))
+                        for n, g in grads.items()}
+            new_params, new_state = opt.apply_gradients_tree(
+                params, grads, opt_state, lr,
+                decay_mask=runner._decay_mask)
+            # pin updated params back to their canonical shardings so the
+            # ZeRO-1 weight-update all-gather happens here, not lazily
+            new_params = {
+                n: jax.lax.with_sharding_constraint(
+                    v, NamedSharding(mesh, runner._pspecs.get(n, P())))
+                for n, v in new_params.items()}
+            return loss_val, new_params, new_state, new_buf
+
+        return jax.jit(step, donate_argnums=(0, 3))
+
+    def train_step(self, inputs, labels) -> float:
+        """Run one compiled step; commits params/state/buffers."""
+        if not self._placed:
+            self.place()
+        if self._step_fn is None:
+            self._step_fn = self._build()
+        net = self.network
+        inputs_v = [i._value if isinstance(i, Tensor)
+                    else jnp.asarray(np.asarray(i)) for i in
+                    (inputs if isinstance(inputs, (list, tuple))
+                     else [inputs])]
+        labels_v = [l._value if isinstance(l, Tensor)
+                    else jnp.asarray(np.asarray(l)) for l in
+                    (labels if isinstance(labels, (list, tuple))
+                     else [labels])]
+        if getattr(self, "_n_inputs", None) is None:
+            self._n_inputs = len(inputs_v)
+        elif self._n_inputs != len(inputs_v):
+            # the compiled step is specialised on the input/label split
+            raise ValueError(
+                f"DistributedRunner was compiled for {self._n_inputs} "
+                f"inputs, got {len(inputs_v)}; create a new runner")
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        key = _random.default_generator().draw_key()
+        loss, new_p, new_s, new_buf = self._step_fn(
+            F.param_dict(net), F.frozen_dict(net), F.buffer_dict(net),
+            self._opt_state, lr, key, *inputs_v, *labels_v)
+        name_to_param = dict(net.named_parameters())
+        for n, v in new_p.items():
+            name_to_param[n]._value = v
+        self._opt_state = new_s
+        name_to_buf = dict(net.named_buffers())
+        for n, v in new_buf.items():
+            if n in name_to_buf and name_to_buf[n] is not None:
+                name_to_buf[n]._value = v
+        return loss

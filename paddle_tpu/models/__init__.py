@@ -1,0 +1,13 @@
+"""NLP model families (the PaddleNLP-layer models the baseline configs
+name: GPT-3 for config 4, BERT/ERNIE for config 3 — BASELINE.json:9-10).
+
+Built from fleet.meta_parallel layers so the same model runs serial
+(single chip), tensor-parallel, and pipelined depending on the mesh.
+"""
+
+from .gpt import (  # noqa
+    GPTConfig, GPTModel, GPTForCausalLM, GPTPretrainingCriterion,
+    GPTForCausalLMPipe, gpt_tiny, gpt2_small, gpt3_1p3b)
+from .bert import (  # noqa
+    BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
+    bert_tiny, bert_base, ernie_3_base)

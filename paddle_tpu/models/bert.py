@@ -1,0 +1,223 @@
+"""BERT / ERNIE family (baseline config 3: ERNIE-3.0/BERT-base
+pretraining, AMP + sharding stage-2 — BASELINE.json:9; upstream impl in
+PaddleNLP bert/ernie modeling.py over core nn layers).
+
+ERNIE-3.0-base shares BERT's architecture at this layer (the ERNIE
+differences are pretraining tasks/data); we provide the MLM+NSP heads
+that the pretraining benchmark exercises.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from .. import ops
+from .. import nn
+from ..nn import initializer as I
+from ..distributed.fleet.meta_parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    use_flash_attention: bool = True
+
+
+def bert_tiny(**kw):
+    return BertConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, intermediate_size=128,
+                      max_position_embeddings=128,
+                      hidden_dropout_prob=0.0,
+                      attention_probs_dropout_prob=0.0, **kw)
+
+
+def bert_base(**kw):
+    return BertConfig(**kw)
+
+
+def ernie_3_base(**kw):
+    return BertConfig(vocab_size=40000, **kw)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = nn.ParamAttr(initializer=I.Normal(
+            0.0, config.initializer_range))
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=init)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size, weight_attr=init)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        b, s = input_ids.shape
+        if position_ids is None:
+            position_ids = ops.expand(
+                ops.unsqueeze(ops.arange(0, s, 1, dtype="int64"), 0),
+                [b, s])
+        if token_type_ids is None:
+            token_type_ids = ops.zeros([b, s], dtype="int64")
+        emb = self.word_embeddings(input_ids) \
+            + self.position_embeddings(position_ids) \
+            + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        self.use_flash = config.use_flash_attention
+        self.attn_drop = config.attention_probs_dropout_prob
+        init = nn.ParamAttr(initializer=I.Normal(
+            0.0, config.initializer_range))
+        self.qkv = ColumnParallelLinear(config.hidden_size,
+                                        3 * config.hidden_size,
+                                        weight_attr=init,
+                                        gather_output=False)
+        self.out = RowParallelLinear(config.hidden_size,
+                                     config.hidden_size, weight_attr=init,
+                                     input_is_parallel=True)
+
+    def forward(self, x, attn_mask=None):
+        b, s, h = x.shape
+        qkv = ops.reshape(self.qkv(x), [b, s, 3, self.num_heads,
+                                        self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.use_flash and attn_mask is None:
+            from ..nn.functional import flash_attention
+            out, _ = flash_attention(q, k, v, causal=False,
+                                     dropout=self.attn_drop,
+                                     training=self.training)
+        else:
+            out = ops.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.attn_drop,
+                training=self.training)
+        out = ops.reshape(out, [b, s, h])
+        return self.out(out)
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = nn.ParamAttr(initializer=I.Normal(
+            0.0, config.initializer_range))
+        self.attention = BertSelfAttention(config)
+        self.ln1 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_eps)
+        self.fc1 = ColumnParallelLinear(config.hidden_size,
+                                        config.intermediate_size,
+                                        weight_attr=init,
+                                        gather_output=False)
+        self.fc2 = RowParallelLinear(config.intermediate_size,
+                                     config.hidden_size, weight_attr=init,
+                                     input_is_parallel=True)
+        self.ln2 = nn.LayerNorm(config.hidden_size,
+                                epsilon=config.layer_norm_eps)
+        self.dropout1 = nn.Dropout(config.hidden_dropout_prob)
+        self.dropout2 = nn.Dropout(config.hidden_dropout_prob)
+        self.act = getattr(ops, config.hidden_act)
+
+    def forward(self, x, attn_mask=None):
+        # post-LN (BERT convention)
+        x = self.ln1(x + self.dropout1(self.attention(x, attn_mask)))
+        x = self.ln2(x + self.dropout2(self.fc2(self.act(self.fc1(x)))))
+        return x
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        return ops.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [b, s] padding mask → additive [b, 1, 1, s]
+            neg = -1e4
+            attention_mask = (
+                1.0 - attention_mask.astype("float32")) * neg
+            attention_mask = ops.unsqueeze(attention_mask, [1, 2])
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = self.pooler(x)
+        return x, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM (tied-embedding head) + NSP."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.config = config
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.transform_ln = nn.LayerNorm(config.hidden_size,
+                                         epsilon=config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter(
+            shape=[config.vocab_size], is_bias=True)
+        self.nsp = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        h = self.transform_ln(ops.gelu(self.transform(seq)))
+        w = self.bert.embeddings.word_embeddings.weight
+        mlm_logits = ops.matmul(h, w, transpose_y=True) + self.mlm_bias
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        self.vocab_size = vocab_size
+        self.mlm_loss = nn.CrossEntropyLoss(ignore_index=-100,
+                                            reduction="mean")
+        self.nsp_loss = nn.CrossEntropyLoss()
+
+    def forward(self, mlm_logits, nsp_logits, masked_labels,
+                next_sentence_labels=None):
+        loss = self.mlm_loss(
+            ops.reshape(mlm_logits, [-1, self.vocab_size]),
+            ops.reshape(masked_labels, [-1]))
+        if next_sentence_labels is not None:
+            loss = loss + self.nsp_loss(
+                nsp_logits, ops.reshape(next_sentence_labels, [-1]))
+        return loss

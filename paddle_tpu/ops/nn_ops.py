@@ -554,7 +554,7 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
             w = weight._value if hasattr(weight, "_value") else \
                 jnp.asarray(weight)
             eff_w = jnp.take(w, jnp.clip(lbl, 0, n - 1))
-        if ignore_index >= 0:
+        if ignore_index is not None:
             valid = (lbl != ignore_index).astype(loss.dtype)
             eff_w = valid if eff_w is None else eff_w * valid
         if eff_w is not None:
@@ -635,7 +635,7 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
         w = weight._value if hasattr(weight, "_value") else \
             jnp.asarray(weight)
         eff_w = jnp.take(w, safe_lbl)
-    if ignore_index >= 0:
+    if ignore_index is not None:
         valid = (lbl != ignore_index).astype(loss.dtype)
         eff_w = valid if eff_w is None else eff_w * valid
     if eff_w is not None:
