@@ -7,3 +7,5 @@ from .parallel_wrappers import (  # noqa
     TensorParallel, PipelineParallelWrapper)
 from .sharding_parallel import (  # noqa
     GroupShardedStage2, GroupShardedStage3, GroupShardedOptimizerStage2)
+from .context_parallel import (  # noqa
+    ring_flash_attention, ulysses_attention, split_sequence)

@@ -1,0 +1,205 @@
+"""Context parallelism over the 'sep' mesh axis — ring attention and
+Ulysses (DeepSpeed-Ulysses-style) attention.
+
+Parity targets (SURVEY.md §5.7 "Long-context / sequence parallelism"):
+upstream's `sep_degree` axis in fleet hybrid topology
+(python/paddle/distributed/fleet/base/topology.py) plus PaddleNLP's
+`ring_flash_attention.py` (ring p2p of K/V blocks with online-softmax
+rescaling).  Upstream implements these with NCCL p2p send/recv and
+manual autograd ops; here both are TPU-native SPMD programs:
+
+* **Ring attention**: `jax.shard_map` over the 'sep' axis; each shard
+  holds Q/K/V for its sequence slice and rotates the K/V block around
+  the ICI ring with `lax.ppermute` (bandwidth-optimal on a torus),
+  carrying online-softmax (m, l, acc) statistics — Q never moves.  The
+  rotation loop is a `lax.scan`, so `jax.grad` differentiates it
+  directly (ppermute is linear and has an exact transpose); no manual
+  backward pass is needed, unlike the reference's hand-written grad op.
+
+* **Ulysses attention**: two `lax.all_to_all`s re-shard [B,S/n,H,D] →
+  [B,S,H/n,D] so each shard computes full-sequence attention for a
+  head subset, then the inverse all_to_all restores sequence sharding.
+
+Both run *inside* a jit-compiled step: the surrounding model stays in
+GSPMD (sharding-constraint) style, and the shard_map region is the only
+place where per-shard scheduling is explicit.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ....ops._primitive import primitive
+from ....ops.nn_ops import _sdpa
+from ... import collective as coll
+
+
+def _plain_attention(q, k, v, causal):
+    return _sdpa.raw(q, k, v, None, None, is_causal=causal)
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# per-shard ring attention ([B, S_local, H, D] in/out)
+# ---------------------------------------------------------------------------
+def _online_update(q, k_blk, v_blk, m, l, acc, mask):
+    """One blockwise online-softmax accumulation step.
+
+    q: [B,H,Sq,D] f32; k_blk/v_blk: [B,H,Sk,D] f32; m,l: [B,H,Sq,1];
+    acc: [B,H,Sq,D]; mask: [Sq,Sk] bool or None."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m, m_cur)
+    # rows fully masked so far keep m == _NEG_INF; exp(s - m) stays safe
+    p = jnp.exp(s - m_new)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk)
+    return m_new, l_new, acc_new
+
+
+def _ring_attention_shard(q, k, v, *, causal: bool, axis_name: str,
+                          n_shards: int):
+    """Per-shard ring attention body (runs under shard_map).
+
+    q/k/v: [B, S_local, H, D] — this rank's sequence slice."""
+    b, s_loc, h, d = q.shape
+    idx = lax.axis_index(axis_name)
+    qf = jnp.einsum("bshd->bhsd", q).astype(jnp.float32)
+    kf = jnp.einsum("bshd->bhsd", k).astype(jnp.float32)
+    vf = jnp.einsum("bshd->bhsd", v).astype(jnp.float32)
+
+    m0 = jnp.full((b, h, s_loc, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc, 1), jnp.float32)
+    a0 = jnp.zeros((b, h, s_loc, d), jnp.float32)
+    # ring: at step t this rank holds the K/V block that originated at
+    # rank (idx + t) mod n; after the update the block moves one hop
+    # "left" so blocks sweep the whole sequence in n steps.
+    perm = [(j, (j - 1) % n_shards) for j in range(n_shards)]
+
+    q_pos = idx * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
+
+    def step(carry, t):
+        k_c, v_c, m, l, acc = carry
+        src = (idx + t) % n_shards
+        if causal:
+            k_pos = src * s_loc + lax.broadcasted_iota(
+                jnp.int32, (s_loc, s_loc), 1)
+            mask = q_pos >= k_pos
+        else:
+            mask = None
+        m, l, acc = _online_update(qf, k_c, v_c, m, l, acc, mask)
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        return (k_c, v_c, m, l, acc), None
+
+    (_, _, m, l, acc), _ = lax.scan(
+        step, (kf, vf, m0, l0, a0), jnp.arange(n_shards))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhsd->bshd", out).astype(q.dtype)
+
+
+def _ulysses_attention_shard(q, k, v, *, causal: bool, axis_name: str,
+                             n_shards: int):
+    """Per-shard Ulysses: all_to_all seq↔heads, full-seq attention on a
+    head subset, inverse all_to_all.  q/k/v: [B, S_local, H, D]."""
+    def seq_to_heads(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = _plain_attention(qg, kg, vg, causal)
+    # [B, S, H/n, D] -> [B, S/n, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# global-tensor entry points (usable inside a jit'ed train step)
+# ---------------------------------------------------------------------------
+def _batch_axes(mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("dp", "sharding")
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+def _cp_shard_map(shard_fn, q, k, v, causal, mesh, seq_axis):
+    n = int(mesh.shape[seq_axis])
+    baxes = _batch_axes(mesh)
+    # keep the head dim sharded over mp so TP attention stays local
+    head_ax = "mp" if int(mesh.shape.get("mp", 1)) > 1 else None
+    spec = P(baxes if baxes else None, seq_axis, head_ax, None)
+    fn = functools.partial(shard_fn, causal=causal, axis_name=seq_axis,
+                           n_shards=n)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _ring_attention_impl(query, key, value, causal=False,
+                         seq_axis: str = "sep", mesh=None):
+    mesh = mesh or coll.get_mesh()
+    if (mesh is None or seq_axis not in mesh.axis_names
+            or int(mesh.shape[seq_axis]) <= 1):
+        return _plain_attention(query, key, value, causal)
+    if query.shape[1] % int(mesh.shape[seq_axis]) != 0:
+        raise ValueError(
+            f"ring attention: sep degree {int(mesh.shape[seq_axis])} "
+            f"must divide seq len {query.shape[1]}")
+    return _cp_shard_map(_ring_attention_shard, query, key, value,
+                         causal, mesh, seq_axis)
+
+
+def _ulysses_attention_impl(query, key, value, causal=False,
+                            seq_axis: str = "sep", mesh=None):
+    mesh = mesh or coll.get_mesh()
+    if (mesh is None or seq_axis not in mesh.axis_names
+            or int(mesh.shape[seq_axis]) <= 1):
+        return _plain_attention(query, key, value, causal)
+    n = int(mesh.shape[seq_axis])
+    if query.shape[2] % n != 0:
+        raise ValueError(
+            f"ulysses attention: sep degree {n} must divide "
+            f"num heads {query.shape[2]}")
+    return _cp_shard_map(_ulysses_attention_shard, query, key, value,
+                         causal, mesh, seq_axis)
+
+
+@primitive(name="ring_flash_attention")
+def ring_flash_attention(query, key, value, causal=False,
+                         seq_axis: str = "sep", mesh=None):
+    """Ring (context-parallel) attention over the 'sep' mesh axis.
+
+    [B, S, H, D] global-view tensors in and out; with sep_degree == 1
+    this is ordinary attention, so models can call it unconditionally."""
+    return _ring_attention_impl(query, key, value, causal=causal,
+                                seq_axis=seq_axis, mesh=mesh)
+
+
+@primitive(name="ulysses_attention")
+def ulysses_attention(query, key, value, causal=False,
+                      seq_axis: str = "sep", mesh=None):
+    """Ulysses (head-scatter all-to-all) attention over 'sep'."""
+    return _ulysses_attention_impl(query, key, value, causal=causal,
+                                   seq_axis=seq_axis, mesh=mesh)
+
+
+def split_sequence(x, seq_axis: str = "sep", dim: int = 1):
+    """Sharding-constrain dim ``dim`` of ``x`` onto the sep axis —
+    the analog of upstream's split_sequence scatter utility."""
+    from .mp_layers import _constrain_op
+    spec = [None] * x.ndim
+    spec[dim] = seq_axis
+    return _constrain_op(x, spec=tuple(spec))

@@ -81,12 +81,17 @@ class DistributedRunner:
     def place(self):
         """Device-put params/state with their shardings (done once)."""
         name_to_param = dict(self.network.named_parameters())
+        self._name_to_param = name_to_param
+        self._name_to_buf = dict(self.network.named_buffers())
         self._pspecs = {n: self._param_spec(p)
                         for n, p in name_to_param.items()}
-        # which params receive weight decay (apply_decay_param_fun /
-        # per-param regularizer parity with the eager step())
-        self._decay_mask = {
-            n: bool(self.optimizer._param_decay(p) != 0.0)
+        # per-param weight-decay coefficient and LR multiplier
+        # (ParamAttr regularizer / learning_rate parity with step())
+        self._decay_coeffs = {
+            n: float(self.optimizer._param_decay(p))
+            for n, p in name_to_param.items()}
+        self._lr_scales = {
+            n: float(p.optimize_attr.get("learning_rate", 1.0))
             for n, p in name_to_param.items()}
         for n, p in name_to_param.items():
             p._value = self._shard(p._value, self._pspecs[n])
@@ -108,25 +113,34 @@ class DistributedRunner:
         loss_layer = self.loss_fn
         mesh = self.mesh
         daxes = _data_axes(mesh)
-        pspecs = None  # bound at call; closure reads self._pspecs
         opt = self.optimizer
         stage = self.sharding_stage
         runner = self
 
         acc = max(int(self.accumulate_steps), 1)
 
+        sep = int(mesh.shape.get("sep", 1))
+
         def step(params, frozen, buffers, opt_state, lr, key, *data):
             n_in = self._n_inputs
-            if daxes:
+            if daxes or sep > 1:
+                # batch dim on dp/sharding; seq dim (axis 1) on sep when
+                # context parallelism is on (SURVEY.md §5.7)
+                def dspec(d):
+                    spec = [daxes if daxes else None]
+                    if sep > 1 and d.ndim >= 2 and d.shape[1] % sep == 0:
+                        spec.append("sep")
+                    return P(*spec)
+
                 data = tuple(
                     jax.lax.with_sharding_constraint(
-                        d, NamedSharding(mesh, P(daxes)))
+                        d, NamedSharding(mesh, dspec(d)))
                     for d in data)
 
-            def loss_of(p, micro_data, micro_key):
+            def loss_of(p, bufs_in, micro_data, micro_key):
                 inputs = [Tensor(v) for v in micro_data[:n_in]]
                 labels = [Tensor(v) for v in micro_data[n_in:]]
-                with F.bind(net, p, buffers, frozen) as holder:
+                with F.bind(net, p, bufs_in, frozen) as holder:
                     from ..autograd import tape as _tape
                     with _tape.no_grad_ctx():
                         with _random.key_provider(
@@ -143,33 +157,36 @@ class DistributedRunner:
 
             if acc == 1:
                 (loss_val, new_buf), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(params, data, key)
+                    loss_of, has_aux=True)(params, buffers, data, key)
             else:
                 # gradient accumulation (paddle gradient_merge parity):
-                # microbatch loop compiled as lax.scan, grads averaged
+                # microbatch loop compiled as lax.scan, grads averaged;
+                # buffers (e.g. BN running stats) thread through the
+                # carry so each microbatch sees the previous update
                 micro = tuple(
                     d.reshape((acc, d.shape[0] // acc) + d.shape[1:])
                     for d in data)
 
                 def body(carry, xs):
-                    g_acc, l_acc = carry
+                    g_acc, l_acc, bufs_c = carry
                     md, mk = xs
                     (l, nb), g = jax.value_and_grad(
-                        loss_of, has_aux=True)(params, md, mk)
+                        loss_of, has_aux=True)(params, bufs_c, md, mk)
+                    bufs_c = {**bufs_c, **nb}
                     g_acc = jax.tree_util.tree_map(
                         lambda a, b: a + b, g_acc, g)
-                    return (g_acc, l_acc + l), nb
+                    return (g_acc, l_acc + l, bufs_c), None
 
                 g0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.result_type(p)),
                     params)
                 keys = jax.random.split(key, acc)
-                (grads, loss_sum), bufs = jax.lax.scan(
-                    body, (g0, jnp.asarray(0.0, jnp.float32)),
+                (grads, loss_sum, new_buf), _ = jax.lax.scan(
+                    body,
+                    (g0, jnp.asarray(0.0, jnp.float32), dict(buffers)),
                     (micro, keys))
                 grads = jax.tree_util.tree_map(lambda g: g / acc, grads)
                 loss_val = loss_sum / acc
-                new_buf = jax.tree_util.tree_map(lambda b: b[-1], bufs)
             if stage >= 2:
                 size = int(mesh.shape.get("sharding", 1))
                 if size > 1:
@@ -180,7 +197,8 @@ class DistributedRunner:
                         for n, g in grads.items()}
             new_params, new_state = opt.apply_gradients_tree(
                 params, grads, opt_state, lr,
-                decay_mask=runner._decay_mask)
+                decay_coeffs=runner._decay_coeffs,
+                lr_scales=runner._lr_scales)
             # pin updated params back to their canonical shardings so the
             # ZeRO-1 weight-update all-gather happens here, not lazily
             new_params = {
@@ -193,6 +211,10 @@ class DistributedRunner:
 
     def train_step(self, inputs, labels) -> float:
         """Run one compiled step; commits params/state/buffers."""
+        # the runner's mesh is the source of truth: models that consult
+        # the global mesh (e.g. context-parallel attention) must see it
+        # during tracing
+        coll.set_mesh(self.mesh)
         if not self._placed:
             self.place()
         if self._step_fn is None:
@@ -215,15 +237,22 @@ class DistributedRunner:
                 f"inputs, got {len(inputs_v)}; create a new runner")
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         key = _random.default_generator().draw_key()
+        # name→wrapper maps are invariant after place(); only the value
+        # dicts are rebuilt per step (avoids 5 module-tree walks/step)
+        if getattr(self, "_frozen_vals", None) is None:
+            self._frozen_vals = F.frozen_dict(net)
+        params = {n: p._value for n, p in self._name_to_param.items()
+                  if not p.stop_gradient}
+        bufs = {n: b._value for n, b in self._name_to_buf.items()
+                if b is not None}
         loss, new_p, new_s, new_buf = self._step_fn(
-            F.param_dict(net), F.frozen_dict(net), F.buffer_dict(net),
+            params, self._frozen_vals, bufs,
             self._opt_state, lr, key, *inputs_v, *labels_v)
-        name_to_param = dict(net.named_parameters())
         for n, v in new_p.items():
-            name_to_param[n]._value = v
+            self._name_to_param[n]._value = v
         self._opt_state = new_s
-        name_to_buf = dict(net.named_buffers())
         for n, v in new_buf.items():
-            if n in name_to_buf and name_to_buf[n] is not None:
-                name_to_buf[n]._value = v
+            b = self._name_to_buf.get(n)
+            if b is not None:
+                b._value = v
         return loss

@@ -41,6 +41,10 @@ class GPTConfig:
     recompute: bool = False
     # parallel knobs (informational; actual sharding comes from specs)
     tensor_parallel_degree: int = 1
+    # context-parallel attention over the 'sep' mesh axis when its
+    # degree > 1: "ring" (ppermute K/V rotation) or "ulysses"
+    # (head-scatter all_to_all).  SURVEY.md §5.7.
+    context_parallel: str = "ring"
 
 
 def gpt_tiny(**kw):
@@ -94,6 +98,7 @@ class GPTAttention(nn.Layer):
         self.hidden_size = config.hidden_size
         self.use_flash = config.use_flash_attention
         self.attn_drop = config.attention_probs_dropout_prob
+        self.context_parallel = config.context_parallel
         init = nn.ParamAttr(initializer=I.Normal(
             0.0, config.initializer_range))
         self.qkv_proj = ColumnParallelLinear(
@@ -110,7 +115,22 @@ class GPTAttention(nn.Layer):
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
-        if self.use_flash:
+        from ..distributed import collective as coll
+        mesh = coll.get_mesh()
+        sep = int(mesh.shape.get("sep", 1)) if mesh is not None else 1
+        if sep > 1:
+            # context-parallel attention: the seq dim is sharded on 'sep'
+            if self.attn_drop > 0.0 and self.training:
+                raise ValueError(
+                    "context-parallel attention does not support "
+                    "attention dropout; set "
+                    "attention_probs_dropout_prob=0.0 when sep_degree>1")
+            from ..nn.functional import (ring_flash_attention,
+                                         ulysses_attention)
+            cp = (ulysses_attention if self.context_parallel == "ulysses"
+                  else ring_flash_attention)
+            out = cp(q, k, v, causal=True)
+        elif self.use_flash:
             from ..nn.functional import flash_attention
             out, _ = flash_attention(q, k, v, causal=True,
                                      dropout=self.attn_drop,

@@ -56,6 +56,23 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
+def ring_flash_attention(query, key, value, causal=False,
+                         seq_axis="sep", name=None):
+    """Ring (context-parallel) attention over the 'sep' mesh axis
+    (parity: PaddleNLP ring_flash_attention — SURVEY.md §5.7)."""
+    from ...distributed.fleet.meta_parallel.context_parallel import \
+        ring_flash_attention as _ring
+    return _ring(query, key, value, causal=causal, seq_axis=seq_axis)
+
+
+def ulysses_attention(query, key, value, causal=False, seq_axis="sep",
+                      name=None):
+    """Ulysses head-scatter all-to-all attention over 'sep'."""
+    from ...distributed.fleet.meta_parallel.context_parallel import \
+        ulysses_attention as _uly
+    return _uly(query, key, value, causal=causal, seq_axis=seq_axis)
+
+
 def sequence_mask(x, maxlen=None, dtype="int64"):
     from ... import ops
     import jax.numpy as jnp

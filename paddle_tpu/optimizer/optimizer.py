@@ -164,10 +164,15 @@ class Optimizer:
     def apply_gradients_tree(self, params: Dict[str, Any],
                              grads: Dict[str, Any],
                              state: Dict[str, Any], lr,
-                             decay_mask: Optional[Dict[str, bool]] = None
+                             decay_coeffs: Optional[Dict[str, float]] = None,
+                             lr_scales: Optional[Dict[str, float]] = None
                              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """Pure: (params, grads, state, lr) → (new_params, new_state).
-        Used inside jit — one fused XLA update over all tensors."""
+        Used inside jit — one fused XLA update over all tensors.
+
+        ``decay_coeffs``/``lr_scales``: per-param weight-decay coefficient
+        and LR multiplier (ParamAttr regularizer / learning_rate parity
+        with the eager step())."""
         if self._grad_clip is not None and hasattr(self._grad_clip,
                                                    "pure_clip"):
             grads = self._grad_clip.pure_clip(grads)
@@ -177,19 +182,20 @@ class Optimizer:
             if g is None:
                 new_p[n], new_s[n] = v, state[n]
                 continue
-            decay = self._weight_decay
-            if decay_mask is not None and not decay_mask.get(n, True):
-                decay = 0.0
+            decay = self._weight_decay if decay_coeffs is None \
+                else decay_coeffs.get(n, self._weight_decay)
+            plr = lr if lr_scales is None \
+                else lr * lr_scales.get(n, 1.0)
             st = state[n]
             if "master_weight" in st:
                 mw = st["master_weight"]
-                nmw, nst = self._update(mw, g.astype(jnp.float32), st, lr,
-                                        decay)
+                nmw, nst = self._update(mw, g.astype(jnp.float32), st,
+                                        plr, decay)
                 nst["master_weight"] = nmw
                 new_p[n] = nmw.astype(v.dtype)
                 new_s[n] = nst
             else:
-                new_p[n], new_s[n] = self._update(v, g, st, lr, decay)
+                new_p[n], new_s[n] = self._update(v, g, st, plr, decay)
         return new_p, new_s
 
     # -- checkpoint ---------------------------------------------------------

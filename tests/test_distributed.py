@@ -302,3 +302,63 @@ def test_runner_rejects_changed_input_count():
     r.train_step([x], [y])
     with pytest.raises(ValueError):
         r.train_step([x, x], [])
+
+
+def test_runner_per_param_decay_coeff():
+    """Per-param regularizer coeff must survive into the jitted step
+    (not collapse to the optimizer's global weight_decay)."""
+    _need_devices(1)
+
+    def build(coeff):
+        paddle.seed(4)
+        net = nn.Sequential(nn.Linear(4, 4))
+        net[0].weight.regularizer = optimizer.L2Decay(coeff)
+        opt = optimizer.AdamW(learning_rate=0.1,
+                              parameters=net.parameters(),
+                              weight_decay=0.5)
+        return net, opt
+
+    x = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+    y = (x.sum(1) % 2).astype(np.int64)
+
+    # eager oracle
+    n1, o1 = build(0.01)
+    out = n1(paddle.to_tensor(x))
+    nn.CrossEntropyLoss()(out, paddle.to_tensor(y)).backward()
+    o1.step()
+    w_eager = n1[0].weight.numpy()
+
+    n2, o2 = build(0.01)
+    r = DistributedRunner(n2, o2, nn.CrossEntropyLoss(),
+                          mesh=collective.build_mesh({}))
+    r.train_step([x], [y])
+    np.testing.assert_allclose(w_eager, n2[0].weight.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_accumulation_threads_bn_buffers():
+    """BN running stats must advance once per microbatch under
+    accumulate_steps, matching the serial microbatch loop."""
+    _need_devices(1)
+    x = np.random.RandomState(3).rand(16, 8).astype(np.float32) * 3 + 1
+    y = (x.sum(1) % 2).astype(np.int64)
+
+    def build():
+        paddle.seed(6)
+        return nn.Sequential(nn.Linear(8, 8), nn.BatchNorm1D(8),
+                             nn.Linear(8, 2))
+
+    # serial oracle: 4 eager microbatch forwards
+    n1 = build()
+    for i in range(4):
+        n1(paddle.to_tensor(x[i * 4:(i + 1) * 4]))
+    mean_ref = dict(n1.named_buffers())["1._mean"].numpy()
+
+    n2 = build()
+    opt = optimizer.SGD(0.0, parameters=n2.parameters())
+    r = DistributedRunner(n2, opt, nn.CrossEntropyLoss(),
+                          mesh=collective.build_mesh({}),
+                          accumulate_steps=4)
+    r.train_step([x], [y])
+    mean_acc = dict(n2.named_buffers())["1._mean"].numpy()
+    np.testing.assert_allclose(mean_ref, mean_acc, rtol=1e-4, atol=1e-5)
