@@ -62,6 +62,12 @@ def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(ensure_mesh(), PartitionSpec(*spec))
 
 
+def data_axes(mesh: Mesh):
+    """Mesh axes that carry the batch dimension (>1 only)."""
+    return tuple(a for a in ("dp", "sharding")
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
           weight_attr=None, bias_attr=None, name=None):
     """paddle.distributed.split — megatron-style parallel embedding/fc

@@ -92,22 +92,27 @@ def _ring_attention_shard(q, k, v, *, causal: bool, axis_name: str,
 
     q_pos = idx * s_loc + lax.broadcasted_iota(jnp.int32, (s_loc, s_loc), 0)
 
+    def _mask_for(src):
+        if not causal:
+            return None
+        k_pos = src * s_loc + lax.broadcasted_iota(
+            jnp.int32, (s_loc, s_loc), 1)
+        return q_pos >= k_pos
+
     def step(carry, t):
         k_c, v_c, m, l, acc = carry
-        src = (idx + t) % n_shards
-        if causal:
-            k_pos = src * s_loc + lax.broadcasted_iota(
-                jnp.int32, (s_loc, s_loc), 1)
-            mask = q_pos >= k_pos
-        else:
-            mask = None
-        m, l, acc = _online_update(qf, k_c, v_c, m, l, acc, mask)
+        m, l, acc = _online_update(qf, k_c, v_c, m, l, acc,
+                                   _mask_for((idx + t) % n_shards))
         k_c = lax.ppermute(k_c, axis_name, perm)
         v_c = lax.ppermute(v_c, axis_name, perm)
         return (k_c, v_c, m, l, acc), None
 
-    (_, _, m, l, acc), _ = lax.scan(
-        step, (kf, vf, m0, l0, a0), jnp.arange(n_shards))
+    # scan rotates n-1 times; the last block is consumed outside the
+    # loop so no final (discarded) ppermute pair rides the ICI
+    (k_c, v_c, m, l, acc), _ = lax.scan(
+        step, (kf, vf, m0, l0, a0), jnp.arange(n_shards - 1))
+    m, l, acc = _online_update(qf, k_c, v_c, m, l, acc,
+                               _mask_for((idx + n_shards - 1) % n_shards))
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.einsum("bhsd->bshd", out).astype(q.dtype)
 
@@ -132,8 +137,7 @@ def _ulysses_attention_shard(q, k, v, *, causal: bool, axis_name: str,
 # global-tensor entry points (usable inside a jit'ed train step)
 # ---------------------------------------------------------------------------
 def _batch_axes(mesh) -> Tuple[str, ...]:
-    return tuple(a for a in ("dp", "sharding")
-                 if a in mesh.axis_names and mesh.shape[a] > 1)
+    return coll.data_axes(mesh)
 
 
 def _cp_shard_map(shard_fn, q, k, v, causal, mesh, seq_axis):
@@ -169,10 +173,17 @@ def _ulysses_attention_impl(query, key, value, causal=False,
             or int(mesh.shape[seq_axis]) <= 1):
         return _plain_attention(query, key, value, causal)
     n = int(mesh.shape[seq_axis])
-    if query.shape[2] % n != 0:
+    if query.shape[1] % n != 0:
         raise ValueError(
             f"ulysses attention: sep degree {n} must divide "
-            f"num heads {query.shape[2]}")
+            f"seq len {query.shape[1]}")
+    # heads are sharded over mp first inside the shard_map, so each
+    # rank's head slice must still split n ways for the all_to_all
+    mp = int(mesh.shape.get("mp", 1))
+    if query.shape[2] % (n * mp) != 0:
+        raise ValueError(
+            f"ulysses attention: num heads {query.shape[2]} must be "
+            f"divisible by sep_degree*mp_degree = {n}*{mp}")
     return _cp_shard_map(_ulysses_attention_shard, query, key, value,
                          causal, mesh, seq_axis)
 

@@ -35,21 +35,22 @@ from . import collective as coll
 from .fleet.meta_parallel.sharding_parallel import shard_spec_for
 
 
-def _data_axes(mesh: Mesh) -> Tuple[str, ...]:
-    return tuple(a for a in ("dp", "sharding")
-                 if a in mesh.axis_names and mesh.shape[a] > 1)
+_data_axes = coll.data_axes
 
 
 class DistributedRunner:
     def __init__(self, network, optimizer, loss_fn=None,
                  mesh: Optional[Mesh] = None, sharding_stage: int = 0,
-                 accumulate_steps: int = 1):
+                 accumulate_steps: int = 1, input_specs=None):
         self.network = network
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or coll.ensure_mesh()
         self.sharding_stage = sharding_stage
         self.accumulate_steps = accumulate_steps
+        # per-input PartitionSpec overrides (position → PartitionSpec or
+        # None to keep the tensor out of the dspec heuristic below)
+        self.input_specs = input_specs
         self._step_fn = None
         self._opt_state = None
         self._placed = False
@@ -123,19 +124,28 @@ class DistributedRunner:
 
         def step(params, frozen, buffers, opt_state, lr, key, *data):
             n_in = self._n_inputs
-            if daxes or sep > 1:
+            overrides = runner.input_specs or {}
+            if daxes or sep > 1 or overrides:
                 # batch dim on dp/sharding; seq dim (axis 1) on sep when
-                # context parallelism is on (SURVEY.md §5.7)
-                def dspec(d):
+                # context parallelism is on (SURVEY.md §5.7).  The
+                # heuristic can be wrong for non-sequence side inputs —
+                # input_specs={idx: PartitionSpec(...)|None} overrides it.
+                def dspec(i, d):
+                    if i in overrides:
+                        return overrides[i]
                     spec = [daxes if daxes else None]
                     if sep > 1 and d.ndim >= 2 and d.shape[1] % sep == 0:
                         spec.append("sep")
                     return P(*spec)
 
-                data = tuple(
-                    jax.lax.with_sharding_constraint(
-                        d, NamedSharding(mesh, dspec(d)))
-                    for d in data)
+                def place(i, d):
+                    s = dspec(i, d)
+                    if s is None:
+                        return d
+                    return jax.lax.with_sharding_constraint(
+                        d, NamedSharding(mesh, s))
+
+                data = tuple(place(i, d) for i, d in enumerate(data))
 
             def loss_of(p, bufs_in, micro_data, micro_key):
                 inputs = [Tensor(v) for v in micro_data[:n_in]]
@@ -211,10 +221,17 @@ class DistributedRunner:
 
     def train_step(self, inputs, labels) -> float:
         """Run one compiled step; commits params/state/buffers."""
-        # the runner's mesh is the source of truth: models that consult
-        # the global mesh (e.g. context-parallel attention) must see it
-        # during tracing
+        # the runner's mesh is the source of truth while the step traces
+        # (context-parallel attention consults it); restored afterwards
+        # so eager eval outside the runner doesn't inherit it
+        prev_mesh = coll.get_mesh()
         coll.set_mesh(self.mesh)
+        try:
+            return self._train_step_inner(inputs, labels)
+        finally:
+            coll.set_mesh(prev_mesh)
+
+    def _train_step_inner(self, inputs, labels) -> float:
         if not self._placed:
             self.place()
         if self._step_fn is None:
@@ -239,14 +256,14 @@ class DistributedRunner:
         key = _random.default_generator().draw_key()
         # name→wrapper maps are invariant after place(); only the value
         # dicts are rebuilt per step (avoids 5 module-tree walks/step)
-        if getattr(self, "_frozen_vals", None) is None:
-            self._frozen_vals = F.frozen_dict(net)
         params = {n: p._value for n, p in self._name_to_param.items()
                   if not p.stop_gradient}
+        frozen = {n: p._value for n, p in self._name_to_param.items()
+                  if p.stop_gradient}
         bufs = {n: b._value for n, b in self._name_to_buf.items()
                 if b is not None}
         loss, new_p, new_s, new_buf = self._step_fn(
-            params, self._frozen_vals, bufs,
+            params, frozen, bufs,
             self._opt_state, lr, key, *inputs_v, *labels_v)
         for n, v in new_p.items():
             self._name_to_param[n]._value = v

@@ -2,4 +2,5 @@
 Grown as features land; nn.functional fused ops alias the main ops
 (XLA fuses them anyway, which is the whole point on TPU)."""
 
+from . import distributed  # noqa
 from . import nn  # noqa

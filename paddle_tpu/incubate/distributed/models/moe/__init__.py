@@ -1,0 +1,6 @@
+"""paddle.incubate.distributed.models.moe parity (SURVEY.md §2.2 "EP")."""
+
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa
+from .moe_layer import (ExpertLayer, GroupedExpertsFFN,  # noqa
+                        MoELayer)
+from .utils import global_gather, global_scatter  # noqa

@@ -1,0 +1,152 @@
+"""MoE gating (parity: python/paddle/incubate/distributed/models/moe/
+gate/ — NaiveGate, GShardGate, SwitchGate; SURVEY.md §2.2 "EP (expert
+parallel / MoE)").
+
+TPU-native formulation: instead of upstream's index-based scatter
+(assign_pos / scatter CUDA kernels), gating produces dense
+``combine_weights``/``dispatch_mask`` tensors of static shape
+[tokens, experts, capacity] (the GShard paper's einsum formulation).
+Static shapes keep the whole MoE block jit-compilable and let the
+dispatch/combine run as batched matmuls on the MXU; token-drop beyond
+capacity is the standard capacity_factor semantics.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .....tensor import Tensor
+from .....nn.layer import Layer
+from .....nn import initializer as I
+from ..... import ops
+
+
+def _topk_gating_values(logits, k: int, capacity: int,
+                        aux_loss_mode: str = "gshard"):
+    """Pure-jnp gating core.
+
+    logits: [T, E] float.  Returns (combine [T,E,C], dispatch [T,E,C],
+    aux_loss scalar).  Gradients flow through combine (gate probs) and
+    aux_loss; the routing itself (argmax, positions) is integral.
+    """
+    T, E = logits.shape
+    C = capacity
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    masks = []          # k one-hot [T, E] routing masks
+    gates = []          # k [T] selected-prob vectors
+    remaining = probs
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+        masks.append(m)
+        gates.append(jnp.sum(probs * m, axis=-1))
+        remaining = remaining * (1.0 - m)
+
+    # aux (load-balance) loss on the top-1 assignment: E * Σ_e f_e·p_e
+    # (Switch Transformer eq. 4 / GShard l_aux).
+    f = jnp.mean(masks[0], axis=0)            # fraction routed to e
+    p = jnp.mean(probs, axis=0)               # mean router prob for e
+    aux_loss = E * jnp.sum(f * p)
+
+    # buffer positions: slot-major cumulative count per expert so the
+    # k-th choice queues behind all first choices (GShard order).
+    positions = []
+    prev_count = jnp.zeros((E,), jnp.float32)
+    for m in masks:
+        pos = jnp.cumsum(m, axis=0) - 1.0 + prev_count[None, :]
+        prev_count = prev_count + jnp.sum(m, axis=0)
+        positions.append(pos)
+
+    keep = [m * (pos < C) for m, pos in zip(masks, positions)]
+
+    # renormalise kept gate values over the k choices
+    gate_sum = sum(g * jnp.sum(kp, axis=-1)
+                   for g, kp in zip(gates, keep))
+    denom = jnp.maximum(gate_sum, 1e-9)
+
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    for g, kp, pos in zip(gates, keep, positions):
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                              dtype=jnp.float32)      # [T, E, C]
+        w = (g / denom)[:, None] * kp                 # [T, E]
+        combine = combine + w[:, :, None] * slot * kp[:, :, None]
+
+    dispatch = (combine > 0.0).astype(jnp.float32)
+    return combine, dispatch, aux_loss
+
+
+@ops.primitive(name="topk_gating")
+def topk_gating(logits, k=2, capacity=0):
+    return _topk_gating_values(logits, k=k, capacity=capacity)
+
+
+class BaseGate(Layer):
+    def __init__(self, d_model: int, num_experts: int, top_k: int,
+                 capacity_factor: float = 1.5, weight_attr=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter(
+            shape=[d_model, num_experts], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.loss: Optional[Tensor] = None   # set each forward (upstream
+        #                                      convention: gate.get_loss())
+
+    def capacity(self, num_tokens: int) -> int:
+        c = int(math.ceil(num_tokens * self.top_k * self.capacity_factor
+                          / self.num_experts))
+        return max(c, self.top_k)
+
+    def get_loss(self, clear: bool = True) -> Optional[Tensor]:
+        loss = self.loss
+        if clear:
+            self.loss = None
+        return loss
+
+    def forward(self, x):
+        """x: [T, d_model] → (combine [T,E,C], dispatch [T,E,C])."""
+        logits = ops.matmul(x, self.weight)
+        cap = self.capacity(x.shape[0])
+        combine, dispatch, aux = topk_gating(
+            logits, k=self.top_k, capacity=cap)
+        self.loss = aux
+        return combine, dispatch
+
+
+class NaiveGate(BaseGate):
+    """Top-k softmax gate, no auxiliary loss used by caller (loss still
+    computed; upstream NaiveGate also skips the balance loss)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2,
+                 num_experts=None, **kw):
+        e = num_experts if num_experts is not None else \
+            (num_expert or 1) * world_size
+        super().__init__(d_model, e, top_k=topk, **kw)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 routing with load-balance loss (Switch Transformer)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=1,
+                 switch_eps=0.1, capacity=None, num_experts=None, **kw):
+        e = num_experts if num_experts is not None else \
+            (num_expert or 1) * world_size
+        kw.setdefault("capacity_factor", 1.25)
+        super().__init__(d_model, e, top_k=1, **kw)
+
+
+class GShardGate(BaseGate):
+    """Top-2 routing with load-balance loss (GShard)."""
+
+    def __init__(self, d_model, num_expert=None, world_size=1, topk=2,
+                 capacity=None, group=None, num_experts=None, **kw):
+        e = num_experts if num_experts is not None else \
+            (num_expert or 1) * world_size
+        super().__init__(d_model, e, top_k=2, **kw)

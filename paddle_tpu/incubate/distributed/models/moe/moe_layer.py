@@ -1,0 +1,169 @@
+"""Mixture-of-Experts layer with expert parallelism (parity:
+python/paddle/incubate/distributed/models/moe/moe_layer.py — MoELayer
+with global_scatter/global_gather all-to-all dispatch; SURVEY.md §2.2
+"EP (expert parallel / MoE)").
+
+TPU-native design: upstream dispatches tokens with index-building CUDA
+kernels (assign_pos, limit_by_capacity) + NCCL all-to-all
+(global_scatter/global_gather ops).  Here dispatch/combine are dense
+einsums against the gate's [tokens, experts, capacity] masks — batched
+matmuls on the MXU — and expert parallelism is a sharding annotation on
+the expert axis: ``dispatched [E, C, d]`` carries a PartitionSpec
+('mp' by default) so under jit the XLA SPMD partitioner inserts the
+all-to-all over ICI exactly where upstream calls global_scatter.  On a
+single chip the same code runs dense (no collective), so loss-parity
+tests vs a serial model hold.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from .....tensor import Tensor
+from .....nn.layer import Layer
+from .....nn.container import LayerList
+from .....nn import initializer as I
+from ..... import ops
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+
+def _constrain(t: Tensor, spec) -> Tensor:
+    from .....distributed.fleet.meta_parallel.mp_layers import _constrain_op
+    return _constrain_op(t, spec=spec)
+
+
+class ExpertLayer(Layer):
+    """One FFN expert (upstream ExpertLayer: fc1-act-fc2)."""
+
+    def __init__(self, d_model: int, d_hidden: int, name=None,
+                 activation="gelu"):
+        super().__init__()
+        from ..... import nn
+        self.htoh4 = nn.Linear(d_model, d_hidden)
+        self.h4toh = nn.Linear(d_hidden, d_model)
+        self._act = activation
+
+    def forward(self, x):
+        h = self.htoh4(x)
+        h = ops.gelu(h) if self._act == "gelu" else ops.relu(h)
+        return self.h4toh(h)
+
+
+class GroupedExpertsFFN(Layer):
+    """All experts' FFN weights stacked on a leading expert axis, sharded
+    on the EP mesh axis — the grouped-GEMM formulation (one batched
+    einsum feeds the MXU instead of E small matmuls)."""
+
+    def __init__(self, num_experts: int, d_model: int, d_hidden: int,
+                 ep_axis: str = "mp", activation="gelu"):
+        super().__init__()
+        self.num_experts = num_experts
+        self.ep_axis = ep_axis
+        self._act = activation
+        self.w1 = self.create_parameter(
+            shape=[num_experts, d_model, d_hidden],
+            default_initializer=I.XavierUniform())
+        self.b1 = self.create_parameter(
+            shape=[num_experts, 1, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter(
+            shape=[num_experts, d_hidden, d_model],
+            default_initializer=I.XavierUniform())
+        self.b2 = self.create_parameter(
+            shape=[num_experts, 1, d_model], is_bias=True)
+        for p in (self.w1, self.b1, self.w2, self.b2):
+            p.dist_spec = (ep_axis,) + (None,) * (len(p.shape) - 1)
+            p.is_distributed = True
+
+    def forward(self, dispatched):
+        """dispatched: [E, C, d_model] → [E, C, d_model]."""
+        h = ops.einsum("ecd,edh->ech", dispatched, self.w1) + self.b1
+        h = ops.gelu(h) if self._act == "gelu" else ops.relu(h)
+        return ops.einsum("ech,ehd->ecd", h, self.w2) + self.b2
+
+
+def _make_gate(gate, d_model, num_experts, top_k):
+    if isinstance(gate, BaseGate):
+        return gate
+    if isinstance(gate, dict):
+        kind = gate.get("type", "gshard")
+        top_k = gate.get("top_k", top_k)
+    else:
+        kind = gate or "gshard"
+    kind = str(kind).lower()
+    if kind in ("gshard",):
+        return GShardGate(d_model, num_experts=num_experts)
+    if kind in ("switch",):
+        return SwitchGate(d_model, num_experts=num_experts)
+    if kind in ("naive", "topk"):
+        return NaiveGate(d_model, num_experts=num_experts, topk=top_k)
+    raise ValueError(f"unknown gate {gate!r}")
+
+
+class MoELayer(Layer):
+    """paddle.incubate.distributed.models.moe.MoELayer parity.
+
+    Args follow upstream: ``experts`` is a list/LayerList of expert
+    Layers (each mapping [*, d_model] → [*, d_model]) OR a
+    GroupedExpertsFFN; ``gate`` a BaseGate, dict, or name.  ``moe_group``
+    selects the EP mesh axis (a communication.Group whose axis_name
+    names a mesh axis); None → single-group dense execution.
+    """
+
+    def __init__(self, d_model: int, experts=None, gate=None,
+                 moe_group=None, mp_group=None, num_experts: int = None,
+                 d_hidden: int = None, top_k: int = 2,
+                 recompute_interval: int = 0, name=None):
+        super().__init__()
+        if experts is None:
+            if num_experts is None or d_hidden is None:
+                raise ValueError(
+                    "give either experts=[...] or num_experts+d_hidden")
+            experts = GroupedExpertsFFN(
+                num_experts, d_model, d_hidden,
+                ep_axis=getattr(moe_group, "axis_name", None) or "mp")
+        if isinstance(experts, (list, tuple)):
+            experts = LayerList(experts)
+        self.experts = experts
+        self.grouped = isinstance(experts, GroupedExpertsFFN)
+        self.num_experts = experts.num_experts if self.grouped \
+            else len(experts)
+        self.d_model = d_model
+        self.gate = _make_gate(gate, d_model, self.num_experts, top_k)
+        self.moe_group = moe_group
+        self._ep_axis = getattr(moe_group, "axis_name", None)
+        self._recompute = recompute_interval
+
+    @property
+    def l_aux(self) -> Optional[Tensor]:
+        """Balance loss of the last forward (add to the train loss)."""
+        return self.gate.loss
+
+    def _run_experts(self, dispatched: Tensor) -> Tensor:
+        if self.grouped:
+            return self.experts(dispatched)
+        outs = [self.experts[i](dispatched[i])
+                for i in range(self.num_experts)]
+        return ops.stack(outs, axis=0)
+
+    def forward(self, x):
+        orig_shape = list(x.shape)
+        x2 = ops.reshape(x, [-1, self.d_model])
+        combine, dispatch = self.gate(x2)
+        # dispatch: [T, E, C] 0/1 — routing is not differentiated
+        dispatch = dispatch.detach() if hasattr(dispatch, "detach") \
+            else dispatch
+        dispatched = ops.einsum("tec,td->ecd", dispatch, x2)
+        if self._ep_axis:
+            # EP boundary: expert axis sharded → XLA emits the
+            # all-to-all here (upstream: global_scatter)
+            dispatched = _constrain(
+                dispatched, (self._ep_axis, None, None))
+        expert_out = self._run_experts(dispatched)
+        if self._ep_axis:
+            expert_out = _constrain(
+                expert_out, (self._ep_axis, None, None))
+        y = ops.einsum("tec,ecd->td", combine, expert_out)
+        return ops.reshape(y, orig_shape[:-1] + [self.d_model])

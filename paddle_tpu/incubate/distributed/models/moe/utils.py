@@ -1,0 +1,57 @@
+"""Functional MoE dispatch ops (parity: paddle/fluid/operators/
+collective/global_scatter_op.* / global_gather_op.* — the NCCL
+all-to-all pair behind upstream MoELayer; SURVEY.md §2.1
+"Collective c_ops").
+
+On TPU these are ``lax.all_to_all`` over a named mesh axis inside a
+traced region (shard_map / jit).  MoELayer itself does not call them —
+its EP boundary is a sharding annotation (see moe_layer.py) — but the
+functional forms are provided for scripts that used the raw ops.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .....tensor import Tensor
+from ..... import ops
+
+
+@ops.primitive(name="global_scatter")
+def global_scatter(x, local_count=None, global_count=None, group=None,
+                   axis_name: str = None):
+    """Exchange per-expert token buffers rank→expert-owner.
+
+    x: [E, C, d] dense per-expert buffers (all experts).  Inside a
+    traced region with ``axis_name`` bound (shard_map over the EP axis)
+    performs the all-to-all; otherwise (single group) it is identity.
+    """
+    name = axis_name or getattr(group, "axis_name", None)
+    if name is not None and isinstance(x, jax.core.Tracer):
+        n = lax.axis_size(name)
+        e = x.shape[0]
+        parts = x.reshape((n, e // n) + x.shape[1:])
+        return lax.all_to_all(parts, name, split_axis=0, concat_axis=1,
+                              tiled=False).reshape(
+            (e // n, n * x.shape[1]) + x.shape[2:])
+    return x
+
+
+@ops.primitive(name="global_gather")
+def global_gather(x, local_count=None, global_count=None, group=None,
+                  axis_name: str = None):
+    """Inverse of global_scatter: return expert outputs to token owners.
+
+    x: [E_local, n·C, d] → [E, C, d]."""
+    name = axis_name or getattr(group, "axis_name", None)
+    if name is not None and isinstance(x, jax.core.Tracer):
+        n = lax.axis_size(name)
+        e_local, nc = x.shape[0], x.shape[1]
+        parts = x.reshape((e_local, n, nc // n) + x.shape[2:])
+        parts = jnp.moveaxis(parts, 1, 0)           # [n, E_local, C, d]
+        out = lax.all_to_all(parts, name, split_axis=0, concat_axis=0,
+                             tiled=False)
+        return out.reshape((n * e_local, nc // n) + x.shape[2:])
+    return x
