@@ -140,13 +140,15 @@ class Model:
         return jax.jit(step)
 
     def train_batch(self, inputs, labels=None, update=True):
-        self.network.train()
-        inputs_v = self._prepare_data(inputs)
-        labels_v = self._prepare_data(labels)
-        self._n_inputs = len(inputs_v)
-        if self._use_jit:
-            return self._train_batch_jit(inputs_v, labels_v, update)
-        return self._train_batch_eager(inputs_v, labels_v, update)
+        from ..profiler import RecordEvent
+        with RecordEvent("train_batch"):
+            self.network.train()
+            inputs_v = self._prepare_data(inputs)
+            labels_v = self._prepare_data(labels)
+            self._n_inputs = len(inputs_v)
+            if self._use_jit:
+                return self._train_batch_jit(inputs_v, labels_v, update)
+            return self._train_batch_eager(inputs_v, labels_v, update)
 
     def _train_batch_jit(self, inputs_v, labels_v, update=True):
         if self._jit_train_step is None:

@@ -58,7 +58,7 @@ class GroupedExpertsFFN(Layer):
     einsum feeds the MXU instead of E small matmuls)."""
 
     def __init__(self, num_experts: int, d_model: int, d_hidden: int,
-                 ep_axis: str = "mp", activation="gelu"):
+                 ep_axis: Optional[str] = None, activation="gelu"):
         super().__init__()
         self.num_experts = num_experts
         self.ep_axis = ep_axis
@@ -73,9 +73,10 @@ class GroupedExpertsFFN(Layer):
             default_initializer=I.XavierUniform())
         self.b2 = self.create_parameter(
             shape=[num_experts, 1, d_model], is_bias=True)
-        for p in (self.w1, self.b1, self.w2, self.b2):
-            p.dist_spec = (ep_axis,) + (None,) * (len(p.shape) - 1)
-            p.is_distributed = True
+        if ep_axis:  # None → dense (no EP): leave weights replicated
+            for p in (self.w1, self.b1, self.w2, self.b2):
+                p.dist_spec = (ep_axis,) + (None,) * (len(p.shape) - 1)
+                p.is_distributed = True
 
     def forward(self, dispatched):
         """dispatched: [E, C, d_model] → [E, C, d_model]."""
@@ -123,7 +124,7 @@ class MoELayer(Layer):
                     "give either experts=[...] or num_experts+d_hidden")
             experts = GroupedExpertsFFN(
                 num_experts, d_model, d_hidden,
-                ep_axis=getattr(moe_group, "axis_name", None) or "mp")
+                ep_axis=getattr(moe_group, "axis_name", None))
         if isinstance(experts, (list, tuple)):
             experts = LayerList(experts)
         self.experts = experts

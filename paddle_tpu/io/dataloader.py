@@ -1,9 +1,14 @@
 """DataLoader (parity: python/paddle/io/dataloader/).
 
-Threaded prefetch instead of upstream's fork-based workers + C++
-BlockingQueue: TPU hosts feed from numpy; the expensive part (H2D) is
-async under jax, so a small thread pool + bounded queue gives the same
-overlap the BufferedReader provides upstream.
+Two reader paths, mirroring upstream's Python-workers + C++
+BlockingQueue split:
+
+- ``num_workers > 0`` (map-style datasets): the **native reader** —
+  N worker threads run indexing + collate and enqueue batches into the
+  C++ blocking queue (``paddle_tpu.native``), which copies arrays into
+  aligned native memory with the GIL released (see io/native_reader.py).
+- otherwise: a single-thread Python prefetch queue, enough to overlap
+  host batching with the async H2D jax already provides.
 """
 
 from __future__ import annotations
@@ -107,6 +112,7 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -143,6 +149,15 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def __iter__(self):
+        if (self.num_workers > 0 and not self._iterable_mode
+                and self.batch_sampler is not None):
+            from .. import native
+            if native.available():
+                from .native_reader import NativeMapIterator
+                return NativeMapIterator(
+                    self.dataset, [list(b) for b in self.batch_sampler],
+                    self.collate_fn, self.num_workers,
+                    self.prefetch_factor, self.worker_init_fn)
         if self.use_buffer_reader:
             return _PrefetchIterator(self._generate, self.prefetch_factor)
         return self._generate()

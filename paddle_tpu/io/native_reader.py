@@ -1,0 +1,196 @@
+"""Multi-worker buffered reader over the native blocking queue.
+
+The reference's DataLoader pairs Python worker processes with a C++
+BlockingQueue/BufferedReader (SURVEY.md §2.1 "DataLoader C++ core");
+here N worker *threads* run dataset indexing + collate and hand each
+batch to ``paddle_tpu.native.NativeQueue``, which copies the arrays
+into one aligned C++ allocation with the GIL released — so the heavy
+memcpys overlap across workers, and the consumer reads sequential
+aligned memory ready for host→HBM transfer.
+
+Order is preserved (paddle semantics): batches carry a sequence number
+and the consumer reorders through a small stash.
+
+Lifecycle: worker threads deliberately hold NO reference to the
+iterator — only to a shared ``_WorkerState`` — so an abandoned iterator
+(e.g. ``break`` mid-epoch) is garbage-collected, its finalizer closes
+the queue, blocked pushes return False, and the workers exit.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import traceback
+import weakref
+from typing import Any, Callable, List, Tuple
+
+import numpy as np
+
+from .. import native
+
+
+def flatten_batch(obj) -> Tuple[List[np.ndarray], Any]:
+    """Split a collated batch pytree into (arrays, skeleton)."""
+    from ..tensor import Tensor
+    arrays: List[np.ndarray] = []
+
+    def rec(o):
+        if isinstance(o, Tensor):
+            arrays.append(np.asarray(o.numpy()))
+            return ("t", len(arrays) - 1)
+        if isinstance(o, np.ndarray):
+            arrays.append(o)
+            return ("a", len(arrays) - 1)
+        if isinstance(o, tuple):
+            return ("u", [rec(x) for x in o])
+        if isinstance(o, list):
+            return ("l", [rec(x) for x in o])
+        if isinstance(o, dict):
+            return ("d", {k: rec(v) for k, v in o.items()})
+        return ("o", o)
+
+    return arrays, rec(obj)
+
+
+def unflatten_batch(arrays: List[np.ndarray], skel) -> Any:
+    from ..tensor import Tensor
+    tag, payload = skel
+    if tag == "t":
+        return Tensor(arrays[payload])
+    if tag == "a":
+        return arrays[payload]
+    if tag == "u":
+        return tuple(unflatten_batch(arrays, s) for s in payload)
+    if tag == "l":
+        return [unflatten_batch(arrays, s) for s in payload]
+    if tag == "d":
+        return {k: unflatten_batch(arrays, s) for k, s in payload.items()}
+    return payload
+
+
+_DONE = "__worker_done__"
+_ERROR = "__error__"
+
+
+class _WorkerState:
+    """Everything the worker threads touch; no back-ref to the iterator."""
+
+    def __init__(self, dataset, batches, collate_fn, queue,
+                 worker_init_fn):
+        self.dataset = dataset
+        self.batches = batches
+        self.collate = collate_fn
+        self.queue = queue
+        self.worker_init_fn = worker_init_fn
+        self.cursor = 0
+        self.lock = threading.Lock()
+
+    def next_index(self):
+        with self.lock:
+            if self.cursor >= len(self.batches):
+                return None
+            i = self.cursor
+            self.cursor += 1
+            return i
+
+
+def _pickle_exc(e: BaseException) -> bytes:
+    """Pickle an exception, degrading to a RuntimeError that carries the
+    formatted traceback when the original object won't pickle."""
+    try:
+        blob = pickle.dumps((_ERROR, e))
+        pickle.loads(blob)  # some objects pickle but fail to unpickle
+        return blob
+    except Exception:
+        return pickle.dumps((_ERROR, RuntimeError(
+            "DataLoader worker raised (original exception not "
+            "picklable):\n" + "".join(traceback.format_exception(e)))))
+
+
+def _worker_main(state: _WorkerState, wid: int):
+    q = state.queue
+    try:
+        if state.worker_init_fn is not None:
+            state.worker_init_fn(wid)
+        while True:
+            seq = state.next_index()
+            if seq is None:
+                break
+            indices = state.batches[seq]
+            batch = state.collate([state.dataset[i] for i in indices])
+            arrays, skel = flatten_batch(batch)
+            if not q.push(arrays, pickle.dumps((seq, skel))):
+                return  # queue closed: consumer abandoned us
+    except BaseException as e:  # propagate to consumer
+        try:
+            q.push([], _pickle_exc(e))
+        except Exception:
+            pass
+    finally:
+        try:
+            q.push([], pickle.dumps((_DONE, wid)))
+        except Exception:
+            pass
+
+
+class NativeMapIterator:
+    """Ordered multi-worker iterator for map-style datasets."""
+
+    def __init__(self, dataset, batch_indices: List[List[int]],
+                 collate_fn: Callable, num_workers: int,
+                 prefetch_factor: int = 2,
+                 worker_init_fn: Callable = None):
+        self._num_workers = max(1, num_workers)
+        queue = native.NativeQueue(
+            self._num_workers * max(1, prefetch_factor))
+        self._queue = queue
+        self._state = _WorkerState(dataset, batch_indices, collate_fn,
+                                   queue, worker_init_fn)
+        self._next_out = 0
+        self._stash = {}
+        self._done_workers = 0
+        # if the iterator is dropped without exhausting/close(), unblock
+        # and terminate the workers
+        self._finalizer = weakref.finalize(self, queue.close)
+        self._threads = [
+            threading.Thread(target=_worker_main,
+                             args=(self._state, w), daemon=True)
+            for w in range(self._num_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._next_out in self._stash:
+                arrays, skel = self._stash.pop(self._next_out)
+                self._next_out += 1
+                return unflatten_batch(arrays, skel)
+            if self._done_workers >= self._num_workers:
+                if self._stash:
+                    # workers exited with gaps — shouldn't happen
+                    raise RuntimeError("native reader lost batches")
+                self.close()
+                raise StopIteration
+            got = self._queue.pop()
+            if got is None:  # closed
+                raise StopIteration
+            arrays, blob = got
+            key, payload = pickle.loads(blob)
+            if key == _ERROR:
+                self.close()
+                raise payload
+            if key == _DONE:
+                self._done_workers += 1
+                continue
+            self._stash[key] = (arrays, payload)
+
+    def close(self):
+        self._queue.close()
+
+    def stats(self):
+        return self._queue.stats()

@@ -1,0 +1,315 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference keeps its reader plumbing and host tracer in C++
+(paddle/fluid/operators/reader/, paddle/fluid/platform/profiler/ —
+SURVEY.md §2.1/§5.1); this package is the TPU-native equivalent:
+
+- ``NativeQueue``     — bounded MPMC blocking queue; batches live in one
+                        64-byte-aligned C++ allocation, filled by
+                        GIL-released memcpys (src/blocking_queue.cc).
+- ``host_tracer``     — RecordEvent span collection + chrome-trace
+                        export (src/host_tracer.cc).
+
+The library is compiled on first import with g++ (cached in
+``_build/``); if no toolchain is available, ``LIB`` is None and callers
+fall back to pure-Python implementations.  Set
+``PADDLE_TPU_DISABLE_NATIVE=1`` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = [os.path.join(_DIR, "src", f)
+        for f in ("blocking_queue.cc", "host_tracer.cc")]
+_SO = os.path.join(_DIR, "_build", "libpaddle_tpu_native.so")
+
+_build_lock = threading.Lock()
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(os.path.getmtime(s) > so_mtime for s in _SRC)
+
+
+def _build() -> Optional[str]:
+    with _build_lock:
+        if not _needs_build():
+            return _SO
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        cmd = [os.environ.get("CXX", "g++"), "-O2", "-std=c++17",
+               "-fPIC", "-pthread", "-shared", *_SRC, "-o", _SO]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        return _SO
+
+
+def _load_impl() -> Optional[ctypes.CDLL]:
+    if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+        return None
+    so = _build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    # blocking queue
+    lib.ptq_create.restype = ctypes.c_void_p
+    lib.ptq_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptq_close.argtypes = [ctypes.c_void_p]
+    lib.ptq_closed.restype = ctypes.c_int
+    lib.ptq_closed.argtypes = [ctypes.c_void_p]
+    lib.ptq_size.restype = ctypes.c_uint64
+    lib.ptq_size.argtypes = [ctypes.c_void_p]
+    lib.ptq_push_parts.restype = ctypes.c_int
+    lib.ptq_push_parts.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_void_p, ctypes.c_uint64]
+    lib.ptq_pop.restype = ctypes.c_void_p
+    lib.ptq_pop.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                            ctypes.POINTER(ctypes.c_int)]
+    lib.ptq_item_nparts.restype = ctypes.c_uint64
+    lib.ptq_item_nparts.argtypes = [ctypes.c_void_p]
+    lib.ptq_item_meta.restype = ctypes.c_void_p
+    lib.ptq_item_meta.argtypes = [ctypes.c_void_p,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.ptq_item_part.restype = ctypes.c_void_p
+    lib.ptq_item_part.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_uint64)]
+    lib.ptq_item_free.argtypes = [ctypes.c_void_p]
+    lib.ptq_stats.argtypes = [ctypes.c_void_p] + \
+        [ctypes.POINTER(ctypes.c_uint64)] * 4
+    # tracer
+    lib.trc_enable.argtypes = [ctypes.c_uint64]
+    lib.trc_enabled.restype = ctypes.c_int
+    lib.trc_begin.argtypes = [ctypes.c_char_p]
+    lib.trc_instant.argtypes = [ctypes.c_char_p]
+    lib.trc_counter.argtypes = [ctypes.c_char_p, ctypes.c_double]
+    lib.trc_count.restype = ctypes.c_uint64
+    lib.trc_dump_json.restype = ctypes.c_int
+    lib.trc_dump_json.argtypes = [ctypes.c_char_p]
+    return lib
+
+
+# Loaded lazily: `import paddle_tpu` must not pay (or fail) a g++
+# compile; the first actual use (available()/NativeQueue/host_tracer
+# .enable()) triggers the cached build.
+_lib: Optional[ctypes.CDLL] = None
+_lib_attempted = False
+_lib_lock = threading.Lock()
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_attempted
+    if _lib is not None or _lib_attempted:
+        return _lib
+    with _lib_lock:
+        if not _lib_attempted:
+            _lib = _load_impl()
+            _lib_attempted = True
+    return _lib
+
+
+def available() -> bool:
+    return _get_lib() is not None
+
+
+# ---------------------------------------------------------------------------
+# Batch (de)serialization: a batch is a list of numpy arrays plus a
+# pytree skeleton; arrays travel as raw part buffers, the skeleton +
+# dtypes/shapes travel in the meta blob.
+# ---------------------------------------------------------------------------
+_META_MAGIC = 0x5054424D  # 'PTBM'
+
+
+def _pack_meta(arrays: Sequence[np.ndarray], skeleton: bytes) -> bytes:
+    out = [struct.pack("<II", _META_MAGIC, len(arrays))]
+    for a in arrays:
+        dt = np.dtype(a.dtype).str.encode()
+        out.append(struct.pack("<B", len(dt)))
+        out.append(dt)
+        out.append(struct.pack("<B", a.ndim))
+        out.append(struct.pack(f"<{a.ndim}q", *a.shape))
+    out.append(skeleton)
+    return b"".join(out)
+
+
+def _unpack_meta(buf: bytes) -> Tuple[List[Tuple[np.dtype, tuple]], bytes]:
+    magic, n = struct.unpack_from("<II", buf, 0)
+    assert magic == _META_MAGIC, "corrupt native queue meta"
+    off = 8
+    specs = []
+    for _ in range(n):
+        (dlen,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dt = np.dtype(buf[off:off + dlen].decode())
+        off += dlen
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        shape = struct.unpack_from(f"<{ndim}q", buf, off)
+        off += 8 * ndim
+        specs.append((dt, tuple(shape)))
+    return specs, bytes(buf[off:])
+
+
+class NativeQueue:
+    """Bounded blocking queue of numpy-array batches (C++-backed)."""
+
+    def __init__(self, capacity: int, capacity_bytes: int = 0):
+        lib = _get_lib()
+        assert lib is not None, "native library unavailable"
+        self._lib = lib
+        self._h = lib.ptq_create(capacity, capacity_bytes)
+        if not self._h:
+            raise MemoryError("ptq_create failed")
+        self._lock = threading.Lock()
+
+    def push(self, arrays: Sequence[np.ndarray],
+             skeleton: bytes = b"") -> bool:
+        """Copy ``arrays`` into native memory and enqueue.
+
+        Returns False if the queue was closed. Blocks (GIL released)
+        while the queue is full — backpressure for workers.
+        """
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        n = len(arrays)
+        ptrs = (ctypes.c_void_p * n)(
+            *[a.ctypes.data_as(ctypes.c_void_p).value for a in arrays])
+        sizes = (ctypes.c_uint64 * n)(*[a.nbytes for a in arrays])
+        meta = _pack_meta(arrays, skeleton)
+        rc = self._lib.ptq_push_parts(self._h, n, ptrs, sizes, meta,
+                                      len(meta))
+        if rc < 0:
+            raise MemoryError("native queue allocation failed")
+        return rc == 1
+
+    def pop(self, timeout_ms: int = -1):
+        """Dequeue one batch.
+
+        Returns (arrays, skeleton) or None when the queue is closed and
+        drained. Raises TimeoutError on timeout. The returned arrays
+        are fresh writable copies (one memmove out of the native buffer,
+        which is freed before returning).
+        """
+        lib = self._lib
+        to = ctypes.c_int(0)
+        item = lib.ptq_pop(self._h, timeout_ms, ctypes.byref(to))
+        if not item:
+            if to.value:
+                raise TimeoutError("native queue pop timed out")
+            return None
+        try:
+            msize = ctypes.c_uint64(0)
+            mptr = lib.ptq_item_meta(item, ctypes.byref(msize))
+            meta = ctypes.string_at(mptr, msize.value)
+            specs, skeleton = _unpack_meta(meta)
+            arrays = []
+            for i, (dt, shape) in enumerate(specs):
+                psize = ctypes.c_uint64(0)
+                pptr = lib.ptq_item_part(item, i, ctypes.byref(psize))
+                a = np.empty(shape, dtype=dt)
+                if psize.value:
+                    ctypes.memmove(a.ctypes.data, pptr, psize.value)
+                arrays.append(a)
+            return arrays, skeleton
+        finally:
+            lib.ptq_item_free(item)
+
+    def close(self):
+        if self._h:
+            self._lib.ptq_close(self._h)
+
+    def closed(self) -> bool:
+        return bool(self._lib.ptq_closed(self._h))
+
+    def __len__(self):
+        return self._lib.ptq_size(self._h)
+
+    def stats(self):
+        vals = [ctypes.c_uint64(0) for _ in range(4)]
+        self._lib.ptq_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {"pushed": vals[0].value, "popped": vals[1].value,
+                "bytes_live": vals[2].value, "bytes_peak": vals[3].value}
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ptq_close(self._h)
+                self._lib.ptq_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+class host_tracer:
+    """Namespace over the C++ host tracer.
+
+    ``enable()`` triggers the (cached) native build; every other call is
+    a no-op until then, so hot-path guards like ``enabled()`` stay cheap
+    and never spawn a compiler."""
+
+    @staticmethod
+    def enable(capacity: int = 1 << 20):
+        lib = _get_lib()
+        if lib is not None:
+            lib.trc_enable(capacity)
+
+    @staticmethod
+    def disable():
+        if _lib is not None:
+            _lib.trc_disable()
+
+    @staticmethod
+    def enabled() -> bool:
+        return _lib is not None and bool(_lib.trc_enabled())
+
+    @staticmethod
+    def begin(name: str):
+        if _lib is not None:
+            _lib.trc_begin(name.encode())
+
+    @staticmethod
+    def end():
+        if _lib is not None:
+            _lib.trc_end()
+
+    @staticmethod
+    def instant(name: str):
+        if _lib is not None:
+            _lib.trc_instant(name.encode())
+
+    @staticmethod
+    def counter(name: str, value: float):
+        if _lib is not None:
+            _lib.trc_counter(name.encode(), float(value))
+
+    @staticmethod
+    def count() -> int:
+        return _lib.trc_count() if _lib is not None else 0
+
+    @staticmethod
+    def clear():
+        if _lib is not None:
+            _lib.trc_clear()
+
+    @staticmethod
+    def dump(path: str) -> bool:
+        if _lib is None:
+            return False
+        return bool(_lib.trc_dump_json(path.encode()))

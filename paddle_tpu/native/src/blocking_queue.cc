@@ -1,0 +1,244 @@
+// Native DataLoader core: a bounded, multi-producer/multi-consumer
+// blocking queue holding batches as single aligned allocations.
+//
+// Parity target: the reference framework's C++ reader plumbing
+// (BlockingQueue + BufferedReader, paddle/fluid/operators/reader/ —
+// SURVEY.md §2.1 "DataLoader C++ core").  TPU-native design notes:
+//  - one contiguous 64-byte-aligned allocation per batch so the later
+//    host→HBM DMA (jax.device_put) reads sequential, aligned memory;
+//  - the memcpy from worker-produced numpy buffers into the batch
+//    allocation happens HERE, with the Python GIL released (ctypes
+//    releases it for the duration of the call), so N worker threads
+//    copy truly in parallel;
+//  - capacity is enforced in items and bytes, with condition-variable
+//    backpressure exactly like the reference's BlockingQueue.
+//
+// C API only (consumed via ctypes; no pybind11 in this image).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <new>
+#include <vector>
+
+namespace {
+
+constexpr std::size_t kAlign = 64;
+
+struct Item {
+  uint8_t* buf = nullptr;          // one aligned allocation: meta + parts
+  uint64_t buf_size = 0;
+  uint64_t meta_off = 0;
+  uint64_t meta_size = 0;
+  std::vector<uint64_t> part_offs;
+  std::vector<uint64_t> part_sizes;
+
+  ~Item() { std::free(buf); }
+};
+
+struct Stats {
+  std::atomic<uint64_t> pushed{0};
+  std::atomic<uint64_t> popped{0};
+  std::atomic<uint64_t> bytes_live{0};
+  std::atomic<uint64_t> bytes_peak{0};
+};
+
+class BlockingQueue {
+ public:
+  BlockingQueue(uint64_t cap_items, uint64_t cap_bytes)
+      : cap_items_(cap_items ? cap_items : 1),
+        cap_bytes_(cap_bytes) {}
+
+  ~BlockingQueue() {
+    Close();
+    std::lock_guard<std::mutex> lk(mu_);
+    for (Item* it : q_) delete it;
+    q_.clear();
+  }
+
+  // Blocks while full unless closed. Returns false if closed.
+  bool Push(Item* item) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] {
+      return closed_ || (q_.size() < cap_items_ &&
+                         (cap_bytes_ == 0 || bytes_in_q_ == 0 ||
+                          bytes_in_q_ + item->buf_size <= cap_bytes_));
+    });
+    if (closed_) return false;
+    bytes_in_q_ += item->buf_size;
+    q_.push_back(item);
+    stats_.pushed.fetch_add(1, std::memory_order_relaxed);
+    not_empty_.notify_one();
+    return true;
+  }
+
+  // Blocks while empty unless closed; timeout_ms<0 means wait forever.
+  // nullptr => closed-and-drained (or timeout).
+  Item* Pop(int64_t timeout_ms, bool* timed_out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto ready = [&] { return closed_ || !q_.empty(); };
+    if (timeout_ms < 0) {
+      not_empty_.wait(lk, ready);
+    } else if (!not_empty_.wait_for(
+                   lk, std::chrono::milliseconds(timeout_ms), ready)) {
+      if (timed_out) *timed_out = true;
+      return nullptr;
+    }
+    if (q_.empty()) return nullptr;  // closed + drained
+    Item* it = q_.front();
+    q_.pop_front();
+    bytes_in_q_ -= it->buf_size;
+    stats_.popped.fetch_add(1, std::memory_order_relaxed);
+    not_full_.notify_one();
+    return it;
+  }
+
+  void Close() {
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  bool Closed() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return closed_;
+  }
+
+  uint64_t Size() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return q_.size();
+  }
+
+  Stats stats_;
+
+ private:
+  const uint64_t cap_items_;
+  const uint64_t cap_bytes_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<Item*> q_;
+  uint64_t bytes_in_q_ = 0;
+  bool closed_ = false;
+};
+
+uint64_t AlignUp(uint64_t v) { return (v + kAlign - 1) & ~(kAlign - 1); }
+
+std::atomic<uint64_t> g_bytes_live{0};
+std::atomic<uint64_t> g_bytes_peak{0};
+
+void TrackAlloc(uint64_t n) {
+  uint64_t live = g_bytes_live.fetch_add(n) + n;
+  uint64_t peak = g_bytes_peak.load();
+  while (live > peak && !g_bytes_peak.compare_exchange_weak(peak, live)) {
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptq_create(uint64_t cap_items, uint64_t cap_bytes) {
+  return new (std::nothrow) BlockingQueue(cap_items, cap_bytes);
+}
+
+void ptq_destroy(void* h) { delete static_cast<BlockingQueue*>(h); }
+
+void ptq_close(void* h) { static_cast<BlockingQueue*>(h)->Close(); }
+
+int ptq_closed(void* h) {
+  return static_cast<BlockingQueue*>(h)->Closed() ? 1 : 0;
+}
+
+uint64_t ptq_size(void* h) {
+  return static_cast<BlockingQueue*>(h)->Size();
+}
+
+// Copy n_parts buffers (+ one metadata blob) into one aligned
+// allocation and enqueue it.  Returns 1 ok, 0 closed, -1 alloc failure.
+int ptq_push_parts(void* h, uint64_t n_parts, const void** ptrs,
+                   const uint64_t* sizes, const void* meta,
+                   uint64_t meta_size) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  auto* it = new (std::nothrow) Item();
+  if (!it) return -1;
+
+  uint64_t total = AlignUp(meta_size);
+  it->meta_off = 0;
+  it->meta_size = meta_size;
+  it->part_offs.reserve(n_parts);
+  it->part_sizes.reserve(n_parts);
+  for (uint64_t i = 0; i < n_parts; ++i) {
+    it->part_offs.push_back(total);
+    it->part_sizes.push_back(sizes[i]);
+    total += AlignUp(sizes[i]);
+  }
+  it->buf_size = total;
+  if (total) {
+    it->buf = static_cast<uint8_t*>(std::aligned_alloc(kAlign, total));
+    if (!it->buf) {
+      delete it;
+      return -1;
+    }
+    TrackAlloc(total);
+  }
+  if (meta_size) std::memcpy(it->buf, meta, meta_size);
+  for (uint64_t i = 0; i < n_parts; ++i) {
+    if (sizes[i]) {
+      std::memcpy(it->buf + it->part_offs[i], ptrs[i], sizes[i]);
+    }
+  }
+  if (!q->Push(it)) {
+    g_bytes_live.fetch_sub(it->buf_size);
+    delete it;
+    return 0;
+  }
+  return 1;
+}
+
+// Pop: returns an Item* handle or nullptr (closed/timeout; check
+// ptq_closed + timed_out to distinguish).
+void* ptq_pop(void* h, int64_t timeout_ms, int* timed_out) {
+  bool to = false;
+  Item* it = static_cast<BlockingQueue*>(h)->Pop(timeout_ms, &to);
+  if (timed_out) *timed_out = to ? 1 : 0;
+  return it;
+}
+
+uint64_t ptq_item_nparts(void* item) {
+  return static_cast<Item*>(item)->part_offs.size();
+}
+
+const void* ptq_item_meta(void* item, uint64_t* size) {
+  auto* it = static_cast<Item*>(item);
+  if (size) *size = it->meta_size;
+  return it->buf + it->meta_off;
+}
+
+const void* ptq_item_part(void* item, uint64_t i, uint64_t* size) {
+  auto* it = static_cast<Item*>(item);
+  if (i >= it->part_offs.size()) return nullptr;
+  if (size) *size = it->part_sizes[i];
+  return it->buf + it->part_offs[i];
+}
+
+void ptq_item_free(void* item) {
+  auto* it = static_cast<Item*>(item);
+  g_bytes_live.fetch_sub(it->buf_size);
+  delete it;
+}
+
+void ptq_stats(void* h, uint64_t* pushed, uint64_t* popped,
+               uint64_t* bytes_live, uint64_t* bytes_peak) {
+  auto* q = static_cast<BlockingQueue*>(h);
+  if (pushed) *pushed = q->stats_.pushed.load();
+  if (popped) *popped = q->stats_.popped.load();
+  if (bytes_live) *bytes_live = g_bytes_live.load();
+  if (bytes_peak) *bytes_peak = g_bytes_peak.load();
+}
+
+}  // extern "C"

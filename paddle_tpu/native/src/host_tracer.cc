@@ -1,0 +1,174 @@
+// Native host tracer: RecordEvent begin/end spans collected into
+// per-thread buffers, merged and exported as a chrome://tracing JSON.
+//
+// Parity target: the reference's C++ host tracer + ChromeTracingLogger
+// (paddle/fluid/platform/profiler/ — SURVEY.md §5.1).  The device side
+// is covered by jax.profiler/XPlane; this tracer supplies the host
+// RecordEvent spans the reference instruments its framework with
+// (op dispatch, dataloader, collective issue), at ~100ns overhead per
+// span when enabled and one branch when disabled.
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Event {
+  std::string name;
+  uint64_t tid;
+  int64_t t0_ns;
+  int64_t t1_ns;  // -1 => instant event
+  double counter;  // only for counter events (t1_ns == -2)
+};
+
+struct OpenSpan {
+  std::string name;
+  int64_t t0_ns;
+};
+
+std::atomic<bool> g_enabled{false};
+std::mutex g_mu;
+std::vector<Event> g_events;
+uint64_t g_capacity = 1 << 20;
+
+thread_local std::vector<OpenSpan> t_stack;
+
+int64_t NowNs() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+uint64_t Tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id()) &
+         0xffffff;
+}
+
+void Append(Event&& e) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_events.size() < g_capacity) g_events.push_back(std::move(e));
+}
+
+// Minimal JSON string escape for event names.
+void EscapeTo(std::string* out, const std::string& s) {
+  for (char c : s) {
+    switch (c) {
+      case '"': *out += "\\\""; break;
+      case '\\': *out += "\\\\"; break;
+      case '\n': *out += "\\n"; break;
+      case '\t': *out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          *out += buf;
+        } else {
+          *out += c;
+        }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void trc_enable(uint64_t capacity) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (capacity) g_capacity = capacity;
+  g_events.clear();
+  g_events.reserve(g_capacity < 65536 ? g_capacity : 65536);
+  g_enabled.store(true, std::memory_order_release);
+}
+
+void trc_disable() { g_enabled.store(false, std::memory_order_release); }
+
+int trc_enabled() { return g_enabled.load(std::memory_order_acquire); }
+
+void trc_begin(const char* name) {
+  if (!g_enabled.load(std::memory_order_acquire)) return;
+  t_stack.push_back(OpenSpan{name ? name : "?", NowNs()});
+}
+
+void trc_end() {
+  if (t_stack.empty()) return;
+  OpenSpan span = std::move(t_stack.back());
+  t_stack.pop_back();
+  if (!g_enabled.load(std::memory_order_acquire)) return;
+  Append(Event{std::move(span.name), Tid(), span.t0_ns, NowNs(), 0.0});
+}
+
+void trc_instant(const char* name) {
+  if (!g_enabled.load(std::memory_order_acquire)) return;
+  Append(Event{name ? name : "?", Tid(), NowNs(), -1, 0.0});
+}
+
+void trc_counter(const char* name, double value) {
+  if (!g_enabled.load(std::memory_order_acquire)) return;
+  Append(Event{name ? name : "?", Tid(), NowNs(), -2, value});
+}
+
+uint64_t trc_count() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  return g_events.size();
+}
+
+void trc_clear() {
+  std::lock_guard<std::mutex> lk(g_mu);
+  g_events.clear();
+}
+
+// Export chrome://tracing "traceEvents" JSON. Returns 1 ok / 0 io error.
+int trc_dump_json(const char* path) {
+  std::vector<Event> events;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    events = g_events;
+  }
+  std::FILE* f = std::fopen(path, "w");
+  if (!f) return 0;
+  std::string out;
+  out.reserve(events.size() * 96 + 64);
+  out += "{\"traceEvents\":[\n";
+  bool first = true;
+  for (const Event& e : events) {
+    if (!first) out += ",\n";
+    first = false;
+    char buf[160];
+    double ts_us = e.t0_ns / 1000.0;
+    out += "{\"name\":\"";
+    EscapeTo(&out, e.name);
+    out += "\",";
+    if (e.t1_ns == -1) {
+      std::snprintf(buf, sizeof(buf),
+                    "\"ph\":\"i\",\"s\":\"t\",\"pid\":0,\"tid\":%llu,"
+                    "\"ts\":%.3f}",
+                    (unsigned long long)e.tid, ts_us);
+    } else if (e.t1_ns == -2) {
+      std::snprintf(buf, sizeof(buf),
+                    "\"ph\":\"C\",\"pid\":0,\"tid\":%llu,\"ts\":%.3f,"
+                    "\"args\":{\"value\":%.6g}}",
+                    (unsigned long long)e.tid, ts_us, e.counter);
+    } else {
+      std::snprintf(buf, sizeof(buf),
+                    "\"ph\":\"X\",\"pid\":0,\"tid\":%llu,\"ts\":%.3f,"
+                    "\"dur\":%.3f}",
+                    (unsigned long long)e.tid, ts_us,
+                    (e.t1_ns - e.t0_ns) / 1000.0);
+    }
+    out += buf;
+  }
+  out += "\n]}\n";
+  std::size_t n = std::fwrite(out.data(), 1, out.size(), f);
+  std::fclose(f);
+  return n == out.size() ? 1 : 0;
+}
+
+}  // extern "C"

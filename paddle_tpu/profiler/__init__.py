@@ -1,6 +1,10 @@
-"""paddle.profiler facade over jax.profiler (parity: python/paddle/
-profiler/ — SURVEY.md §5.1: keep the API shape; traces go to
-XPlane/TensorBoard instead of CUPTI chrome traces)."""
+"""paddle.profiler facade (parity: python/paddle/profiler/ —
+SURVEY.md §5.1).
+
+Device side: jax.profiler → XPlane/TensorBoard (replacing CUPTI).
+Host side: the native C++ tracer (paddle_tpu/native/src/host_tracer.cc,
+replacing the reference's C++ host tracer) collects RecordEvent spans
+and exports a chrome://tracing JSON via ``export_chrome_tracing``."""
 
 from __future__ import annotations
 
@@ -11,6 +15,8 @@ import time
 from typing import Callable, Iterable, Optional
 
 import jax
+
+from ..native import host_tracer as _host_tracer
 
 
 class ProfilerTarget(enum.Enum):
@@ -50,8 +56,13 @@ def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler writing the host-side chrome trace
+    collected by the native tracer."""
     def handler(prof):
         prof._log_dir = dir_name
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"worker_{os.getpid()}"
+        _host_tracer.dump(os.path.join(dir_name, f"{name}.json"))
     return handler
 
 
@@ -72,6 +83,7 @@ class Profiler:
 
     def start(self):
         if not self._timer_only:
+            _host_tracer.enable()
             try:
                 jax.profiler.start_trace(self._log_dir)
                 self._active = True
@@ -88,6 +100,7 @@ class Profiler:
             self._active = False
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
+        _host_tracer.disable()
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
@@ -116,14 +129,19 @@ class Profiler:
 
 
 class RecordEvent:
-    """Host-side trace annotation (upstream RecordEvent → here a
-    jax.profiler.TraceAnnotation)."""
+    """Host-side trace annotation: spans go to BOTH the native host
+    tracer (chrome trace, ~100ns when enabled) and
+    jax.profiler.TraceAnnotation (XPlane correlation)."""
 
     def __init__(self, name: str, event_type=None):
         self._name = name
         self._ctx = None
+        self._native = False
 
     def begin(self):
+        if _host_tracer.enabled():
+            _host_tracer.begin(self._name)
+            self._native = True
         self._ctx = jax.profiler.TraceAnnotation(self._name)
         self._ctx.__enter__()
 
@@ -131,6 +149,9 @@ class RecordEvent:
         if self._ctx is not None:
             self._ctx.__exit__(None, None, None)
             self._ctx = None
+        if self._native:
+            _host_tracer.end()
+            self._native = False
 
     def __enter__(self):
         self.begin()

@@ -1,0 +1,202 @@
+"""Native C++ runtime: blocking queue, multi-worker reader, host tracer."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable")
+
+
+def test_queue_roundtrip_dtypes():
+    q = native.NativeQueue(4)
+    arrays = [
+        np.arange(12, dtype=np.float32).reshape(3, 4),
+        np.arange(6, dtype=np.int64),
+        np.asarray([], dtype=np.float64),
+        (np.random.RandomState(0).rand(2, 3, 4) * 10).astype(np.float16),
+        np.asarray([[True, False], [False, True]]),
+    ]
+    assert q.push(arrays, b"meta-blob")
+    out, skel = q.pop()
+    assert skel == b"meta-blob"
+    assert len(out) == len(arrays)
+    for got, want in zip(out, arrays):
+        assert got.dtype == want.dtype
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(got, want)
+
+
+def test_queue_backpressure_and_order():
+    q = native.NativeQueue(2)
+    order = []
+
+    def producer():
+        for i in range(8):
+            assert q.push([np.full((4,), i, np.int32)])
+
+    t = threading.Thread(target=producer)
+    t.start()
+    for _ in range(8):
+        arrs, _ = q.pop()
+        order.append(int(arrs[0][0]))
+    t.join()
+    assert order == list(range(8))
+
+
+def test_queue_close_unblocks():
+    q = native.NativeQueue(1)
+    q.push([np.zeros(1, np.float32)])
+    q.close()
+    assert q.pop() is not None      # drain existing
+    assert q.pop() is None          # closed + empty
+    assert not q.push([np.zeros(1, np.float32)])  # push after close
+
+
+def test_queue_pop_timeout():
+    q = native.NativeQueue(1)
+    with pytest.raises(TimeoutError):
+        q.pop(timeout_ms=50)
+
+
+def test_queue_stats():
+    q = native.NativeQueue(4)
+    q.push([np.zeros((64,), np.float32)])
+    s = q.stats()
+    assert s["pushed"] == 1 and s["bytes_peak"] >= 256
+    q.pop()
+    assert q.stats()["popped"] == 1
+
+
+def test_dataloader_native_workers_order_and_content():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Square(Dataset):
+        def __len__(self):
+            return 37
+
+        def __getitem__(self, i):
+            return (np.full((3,), i, np.float32),
+                    np.asarray(i * i, np.int64))
+
+    dl = DataLoader(Square(), batch_size=5, num_workers=4,
+                    drop_last=False, shuffle=False)
+    seen_x, seen_y = [], []
+    for x, y in dl:
+        seen_x.append(np.asarray(x.numpy()))
+        seen_y.append(np.asarray(y.numpy()))
+    xs = np.concatenate([a[:, 0] for a in seen_x])
+    ys = np.concatenate(seen_y)
+    np.testing.assert_array_equal(xs, np.arange(37, dtype=np.float32))
+    np.testing.assert_array_equal(ys, np.arange(37) ** 2)
+
+
+def test_dataloader_native_batches_writable():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Arr(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((2,), i, np.float32)
+
+    def collate(batch):
+        return np.stack(batch)  # raw ndarray path
+
+    dl = DataLoader(Arr(), batch_size=4, num_workers=2, shuffle=False,
+                    collate_fn=collate)
+    for b in dl:
+        b += 1.0  # must not raise (read-only arrays would)
+
+
+def test_dataloader_abandoned_iterator_workers_exit():
+    import gc
+    import time
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Slow(Dataset):
+        def __len__(self):
+            return 1000
+
+        def __getitem__(self, i):
+            return np.zeros((1024,), np.float32)
+
+    before = threading.active_count()
+    it = iter(DataLoader(Slow(), batch_size=4, num_workers=3,
+                         shuffle=False))
+    next(it)
+    threads = it._threads
+    del it  # abandon mid-epoch; finalizer must close the queue
+    gc.collect()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and any(t.is_alive() for t in threads):
+        time.sleep(0.05)
+    assert not any(t.is_alive() for t in threads), \
+        "abandoned native reader leaked worker threads"
+    assert threading.active_count() <= before + 1
+
+
+def test_dataloader_native_worker_error_propagates():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Bad(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            if i == 7:
+                raise ValueError("boom at 7")
+            return np.zeros(2, np.float32)
+
+    dl = DataLoader(Bad(), batch_size=2, num_workers=2, shuffle=False)
+    with pytest.raises(ValueError, match="boom at 7"):
+        for _ in dl:
+            pass
+
+
+def test_host_tracer_chrome_export(tmp_path):
+    tr = native.host_tracer
+    tr.enable()
+    try:
+        with_span_names = ["train_step", "forward", "backward"]
+        tr.begin(with_span_names[0])
+        tr.begin(with_span_names[1])
+        tr.end()
+        tr.begin(with_span_names[2])
+        tr.end()
+        tr.end()
+        tr.counter("loss", 0.25)
+        tr.instant("checkpoint")
+        path = str(tmp_path / "trace.json")
+        assert tr.dump(path)
+    finally:
+        tr.disable()
+    events = json.load(open(path))["traceEvents"]
+    names = {e["name"] for e in events}
+    assert set(with_span_names) <= names
+    spans = [e for e in events if e["ph"] == "X"]
+    assert all(e["dur"] >= 0 for e in spans)
+    assert any(e["ph"] == "C" for e in events)
+    assert any(e["ph"] == "i" for e in events)
+
+
+def test_profiler_record_event_uses_native(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    native.host_tracer.enable()
+    try:
+        with profiler.RecordEvent("my_region"):
+            pass
+        assert native.host_tracer.count() >= 1
+        assert native.host_tracer.dump(str(tmp_path / "t.json"))
+    finally:
+        native.host_tracer.disable()
+    events = json.load(open(tmp_path / "t.json"))["traceEvents"]
+    assert any(e["name"] == "my_region" for e in events)
