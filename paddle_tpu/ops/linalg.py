@@ -231,3 +231,18 @@ def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
     h, _ = jnp.histogram(x, bins=bins, range=range_, weights=weight,
                          density=density)
     return h if density else h.astype(jnp.int64)
+
+
+@primitive
+def cdist(x, y, p=2.0):
+    """Pairwise p-norm distance between row sets ([..., M, D], [..., N, D])."""
+    diff = jnp.abs(x[..., :, None, :] - y[..., None, :, :])
+    if p == 2.0:
+        # sqrt of squared sums, stabilised for grad at 0
+        sq = jnp.sum(diff * diff, axis=-1)
+        return jnp.sqrt(sq + 1e-30)
+    if p == float("inf"):
+        return jnp.max(diff, axis=-1)
+    if p == 0.0:
+        return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
+    return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)

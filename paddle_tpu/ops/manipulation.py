@@ -486,3 +486,167 @@ def bucketize(x, sorted_sequence, out_int32=False, right=False):
 
 def tolist(x):
     return unwrap(x).tolist()
+
+
+@primitive
+def unflatten(x, axis, shape):
+    axis = int(axis) % x.ndim
+    shape = tuple(int(s) for s in shape)
+    if -1 in shape:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape = tuple(x.shape[axis] // known if s == -1 else s
+                      for s in shape)
+    new_shape = x.shape[:axis] + shape + x.shape[axis + 1:]
+    return jnp.reshape(x, new_shape)
+
+
+def _stack_like(jnp_fn, name):
+    def op(x, **kwargs):
+        wrapped = [v if isinstance(v, Tensor) else Tensor(v) for v in x]
+
+        def _f(*vals):
+            return jnp_fn(vals)
+
+        return apply_closure(_f, wrapped, name=name)
+
+    op.__name__ = name
+    return op
+
+
+hstack = _stack_like(jnp.hstack, "hstack")
+vstack = _stack_like(jnp.vstack, "vstack")
+dstack = _stack_like(jnp.dstack, "dstack")
+row_stack = _stack_like(jnp.vstack, "row_stack")
+column_stack = _stack_like(jnp.column_stack, "column_stack")
+
+
+def atleast_1d(*xs):
+    from .creation import assign
+    outs = [reshape(x, [1]) if unwrap(x).ndim == 0 else assign(x)
+            for x in xs]
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_2d(*xs):
+    outs = []
+    for x in xs:
+        nd = unwrap(x).ndim
+        if nd == 0:
+            outs.append(reshape(x, [1, 1]))
+        elif nd == 1:
+            outs.append(unsqueeze(x, 0))
+        else:
+            from .creation import assign
+            outs.append(assign(x))
+    return outs if len(outs) > 1 else outs[0]
+
+
+def atleast_3d(*xs):
+    outs = []
+    for x in xs:
+        y = atleast_2d(x)
+        if unwrap(y).ndim == 2:
+            y = unsqueeze(y, -1)
+        outs.append(y)
+    return outs if len(outs) > 1 else outs[0]
+
+
+@primitive
+def masked_scatter(x, mask, value):
+    """Fill True positions of `mask` with consecutive values from
+    `value` (row-major), paddle.masked_scatter semantics."""
+    mask_b = jnp.broadcast_to(mask, x.shape)
+    flat_mask = jnp.ravel(mask_b)
+    flat_x = jnp.ravel(x)
+    flat_v = jnp.ravel(value)
+    # position of each True among Trues → index into value
+    order = jnp.cumsum(flat_mask.astype(jnp.int32)) - 1
+    take = jnp.clip(order, 0, flat_v.shape[0] - 1)
+    out = jnp.where(flat_mask, flat_v[take], flat_x)
+    return out.reshape(x.shape)
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    arr = unwrap(x)
+    axis = int(axis)
+    if isinstance(num_or_indices, int):
+        pieces = jnp.array_split(arr, num_or_indices, axis=axis)
+        idx = np.cumsum([p.shape[axis] for p in pieces])[:-1].tolist()
+    else:
+        idx = [int(i) for i in num_or_indices]
+    n = len(idx) + 1
+    sizes = []
+    prev = 0
+    for i in idx + [arr.shape[axis]]:
+        sizes.append(i - prev)
+        prev = i
+    return list(split_p(x, sizes, axis))
+
+
+def block_diag(inputs):
+    wrapped = [v if isinstance(v, Tensor) else Tensor(v) for v in inputs]
+
+    def _f(*mats):
+        mats = [jnp.atleast_2d(m) for m in mats]
+        rows = sum(m.shape[0] for m in mats)
+        cols = sum(m.shape[1] for m in mats)
+        out = jnp.zeros((rows, cols), dtype=mats[0].dtype)
+        r = c = 0
+        for m in mats:
+            out = jax.lax.dynamic_update_slice(out, m.astype(out.dtype),
+                                               (r, c))
+            r += m.shape[0]
+            c += m.shape[1]
+        return out
+
+    return apply_closure(_f, wrapped, name="block_diag")
+
+
+@primitive
+def take(x, index, mode="raise"):
+    flat = jnp.ravel(x)
+    idx = index
+    if mode == "wrap":
+        idx = jnp.mod(idx, flat.shape[0])
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    else:  # jax clamps OOB; paddle 'raise' can't raise under jit
+        idx = jnp.where(idx < 0, idx + flat.shape[0], idx)
+    return flat[idx]
+
+
+@primitive
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value along axis (ties → smallest), with index of
+    its LAST occurrence (paddle semantics)."""
+    axis = int(axis) % x.ndim
+    xm = jnp.moveaxis(x, axis, -1)
+    n = xm.shape[-1]
+    s = jnp.sort(xm, axis=-1)
+    # run lengths in sorted order: count equal elements per position
+    eq = (s[..., :, None] == s[..., None, :])
+    counts = jnp.sum(eq, axis=-1)
+    best = jnp.argmax(counts, axis=-1)  # first max → smallest value tie
+    values = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+    # index of last occurrence in the ORIGINAL (pre-sort) layout
+    is_val = xm == values[..., None]
+    pos = jnp.arange(n)
+    last = jnp.max(jnp.where(is_val, pos, -1), axis=-1)
+    if keepdim:
+        values = jnp.expand_dims(values, axis)
+        last = jnp.expand_dims(last, axis)
+    return values, last
+
+
+@primitive
+def index_fill(x, index, axis, value):
+    axis = int(axis) % x.ndim
+    mask_1d = jnp.zeros(x.shape[axis], dtype=bool).at[index].set(True)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return jnp.where(mask_1d.reshape(shape),
+                     jnp.asarray(value, x.dtype), x)

@@ -607,3 +607,77 @@ def inner(x, y):
 @primitive
 def outer(x, y):
     return jnp.outer(x, y)
+
+
+@primitive
+def heaviside(x, y):
+    return jnp.where(x < 0, jnp.zeros_like(x),
+                     jnp.where(x > 0, jnp.ones_like(x),
+                               y.astype(x.dtype) if hasattr(y, "astype")
+                               else jnp.asarray(y, x.dtype)))
+
+
+@primitive
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@primitive
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@primitive
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@primitive
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+@primitive
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e
+
+
+@primitive
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    # numerically-stable running log-sum-exp via cumulative logaddexp
+    return jax.lax.associative_scan(jnp.logaddexp, x, axis=axis)
+
+
+@primitive
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+@primitive
+def polar(abs_v, angle):
+    return jax.lax.complex(abs_v * jnp.cos(angle),
+                           abs_v * jnp.sin(angle))
+
+
+@primitive
+def renorm(x, p, axis, max_norm):
+    """Renormalize slices along `axis` to have p-norm <= max_norm."""
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=reduce_axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7),
+                       jnp.ones_like(norms))
+    return x * factor
+
+
+@primitive
+def vander(x, n=None, increasing=False):
+    n = x.shape[-1] if n is None else int(n)
+    pows = jnp.arange(n, dtype=x.dtype)
+    if not increasing:
+        pows = pows[::-1]
+    return x[..., :, None] ** pows
