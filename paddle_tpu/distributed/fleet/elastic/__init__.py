@@ -1,0 +1,2 @@
+from .manager import (ElasticManager, ElasticStatus, KVServer,  # noqa
+                      KVClient)

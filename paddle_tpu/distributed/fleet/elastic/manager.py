@@ -1,0 +1,302 @@
+"""Elastic training manager (parity: python/paddle/distributed/fleet/
+elastic/manager.py — SURVEY.md §5.3).
+
+Upstream registers ranks in etcd under a job prefix, heartbeats, and on
+membership change signals trainers to exit so the controller relaunches
+with the new world — checkpoint-restart elasticity within
+[np_min, np_max].  Here the registry is a built-in threaded HTTP KV
+server (the launch master runs it; ``--elastic_server http://...`` or
+``PADDLE_ELASTIC_SERVER`` points at it), so the semantics survive
+without an external etcd.  On TPU pods the driver-level analog is slice
+membership: a lost host drops out of the registry exactly like a lost
+GPU node does.
+
+Env contract (upstream names): PADDLE_ELASTIC_SERVER,
+PADDLE_ELASTIC_TIMEOUT, PADDLE_ELASTIC_NP (``min`` or ``min:max``),
+PADDLE_ELASTIC_JOB_ID.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+import urllib.request
+import urllib.error
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+class ElasticStatus(enum.Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"        # waiting for members
+    RESTART = "restart"  # membership changed → relaunch
+    EXIT = "exit"
+
+
+# ---------------------------------------------------------------------------
+# KV + heartbeat server (the etcd stand-in; runs inside the launch master)
+# ---------------------------------------------------------------------------
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "PaddleTPUElastic/1"
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def _send(self, code: int, body: bytes = b""):
+        self.send_response(code)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        if body:
+            self.wfile.write(body)
+
+    def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        val = self.rfile.read(n).decode() if n else ""
+        with self.server.lock:
+            if self.path.startswith("/hb/"):
+                self.server.heartbeats[self.path[4:]] = (time.time(), val)
+            else:
+                self.server.kv[self.path] = val
+        self._send(200)
+
+    def do_DELETE(self):
+        with self.server.lock:
+            self.server.kv.pop(self.path, None)
+            if self.path.startswith("/hb/"):
+                self.server.heartbeats.pop(self.path[4:], None)
+        self._send(200)
+
+    def do_GET(self):
+        with self.server.lock:
+            if self.path.startswith("/members/"):
+                prefix = self.path[len("/members/"):]
+                ttl = self.server.ttl
+                now = time.time()
+                alive = {k: v for k, (t, v) in
+                         self.server.heartbeats.items()
+                         if k.startswith(prefix) and now - t <= ttl}
+                self._send(200, json.dumps(alive).encode())
+                return
+            if self.path in self.server.kv:
+                self._send(200, self.server.kv[self.path].encode())
+                return
+        self._send(404)
+
+
+class KVServer:
+    """Threaded HTTP KV + heartbeat registry."""
+
+    def __init__(self, port: int = 0, ttl: float = 6.0):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _Handler)
+        self._httpd.kv = {}
+        self._httpd.heartbeats = {}
+        self._httpd.lock = threading.Lock()
+        self._httpd.ttl = ttl
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    @property
+    def endpoint(self) -> str:
+        """Routable URL other nodes can dial (loopback only when the
+        host has no external interface)."""
+        return f"http://{host_ip()}:{self.port}"
+
+
+class KVClient:
+    def __init__(self, server: str, timeout: float = 3.0):
+        self._base = server.rstrip("/")
+        self._timeout = timeout
+
+    def _req(self, method: str, path: str, data: Optional[bytes] = None):
+        req = urllib.request.Request(self._base + path, data=data,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self._timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def put(self, key: str, value: str):
+        self._req("PUT", key, value.encode())
+
+    def get(self, key: str) -> Optional[str]:
+        return self._req("GET", key)
+
+    def delete(self, key: str):
+        self._req("DELETE", key)
+
+    def heartbeat(self, node_id: str, payload: str = ""):
+        self._req("PUT", f"/hb/{node_id}", payload.encode())
+
+    def members(self, prefix: str) -> Dict[str, str]:
+        out = self._req("GET", f"/members/{prefix}")
+        return json.loads(out) if out else {}
+
+
+# ---------------------------------------------------------------------------
+# Manager
+# ---------------------------------------------------------------------------
+def _parse_np(np_str: str) -> Tuple[int, int]:
+    if ":" in np_str:
+        lo, hi = np_str.split(":")
+        return int(lo), int(hi)
+    n = int(np_str)
+    return n, n
+
+
+def host_ip() -> str:
+    """This host's routable IP (the address other nodes must dial).
+    UDP-connect trick: no packet is sent, the kernel just picks the
+    outbound interface.  Falls back to loopback on isolated hosts."""
+    import socket
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("8.8.8.8", 80))
+            return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"
+
+
+class ElasticManager:
+    """Per-node membership agent used by the launch controller.
+
+    register() → heartbeat thread; watch() → poll membership and
+    classify into HOLD (below np_min), RESTART (set changed while
+    runnable), or steady state (None).
+    """
+
+    def __init__(self, server: Optional[str] = None,
+                 job_id: Optional[str] = None,
+                 np: Optional[str] = None,
+                 node_id: Optional[str] = None,
+                 heartbeat_interval: float = 2.0,
+                 elastic_timeout: Optional[float] = None):
+        server = server or os.environ.get("PADDLE_ELASTIC_SERVER")
+        self.enabled = bool(server)
+        if not self.enabled:
+            return
+        self.client = KVClient(server)
+        self.job_id = job_id or os.environ.get(
+            "PADDLE_ELASTIC_JOB_ID", "default")
+        self.np_min, self.np_max = _parse_np(
+            np or os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        self.node_id = node_id or os.environ.get(
+            "PADDLE_CURRENT_ENDPOINT",
+            f"{os.uname().nodename}-{os.getpid()}")
+        self.heartbeat_interval = heartbeat_interval
+        self.elastic_timeout = elastic_timeout or float(
+            os.environ.get("PADDLE_ELASTIC_TIMEOUT", "30"))
+        self._stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._last_members: Optional[List[str]] = None
+
+    # -- membership ---------------------------------------------------------
+    def _prefix(self) -> str:
+        return f"{self.job_id}/"
+
+    def register(self, payload: str = ""):
+        """Idempotent: re-registering after a lapse reuses the existing
+        heartbeat thread."""
+        if not self.enabled:
+            return
+        self.client.heartbeat(self._prefix() + self.node_id, payload)
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._stop.clear()
+        self._hb_thread = threading.Thread(target=self._hb_loop,
+                                           args=(payload,), daemon=True)
+        self._hb_thread.start()
+
+    def _hb_loop(self, payload: str):
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self.client.heartbeat(self._prefix() + self.node_id,
+                                      payload)
+            except Exception:
+                pass  # transient server loss; next beat retries
+
+    def exit(self):
+        if not self.enabled:
+            return
+        self._stop.set()
+        try:
+            self.client.delete(f"/hb/{self._prefix()}{self.node_id}")
+        except Exception:
+            pass
+
+    def members(self) -> List[str]:
+        if not self.enabled:
+            return []
+        pfx = self._prefix()
+        return sorted(k[len(pfx):] for k in
+                      self.client.members(pfx).keys())
+
+    # -- elastic policy -----------------------------------------------------
+    def runnable(self, members: Optional[List[str]] = None) -> bool:
+        m = self.members() if members is None else members
+        return len(m) >= self.np_min
+
+    def active_members(self, members: Optional[List[str]] = None
+                       ) -> List[str]:
+        """The member set the pod actually runs with: sorted, capped at
+        np_max (later joiners beyond np_max are spares)."""
+        m = self.members() if members is None else members
+        return sorted(m)[:self.np_max]
+
+    def wait_for_members(self, timeout: Optional[float] = None
+                         ) -> List[str]:
+        """Block until >= np_min members are registered (or timeout
+        expires), then return the active set (capped at np_max)."""
+        deadline = time.time() + (timeout or self.elastic_timeout)
+        while time.time() < deadline:
+            m = self.members()
+            if self.runnable(m):
+                # settle: wait one beat for stragglers up to np_max
+                time.sleep(self.heartbeat_interval)
+                m2 = self.members()
+                if len(m2) >= len(m):
+                    return self.active_members(m2)
+                # membership shrank while settling: re-evaluate
+                continue
+            time.sleep(0.5)
+        return self.active_members()
+
+    def seed(self, members: List[str]) -> None:
+        """Pin the membership the pod was spawned with as the watch
+        baseline, so changes during pod spawn still trigger a
+        relaunch."""
+        self._last_members = list(members)
+
+    def watch(self) -> Optional[ElasticStatus]:
+        """One poll step for the controller loop."""
+        if not self.enabled:
+            return None
+        m = self.active_members()
+        if self._last_members is None:
+            self._last_members = m
+            return None
+        if m == self._last_members:
+            return None
+        self._last_members = m
+        if len(m) < self.np_min:
+            return ElasticStatus.HOLD
+        return ElasticStatus.RESTART

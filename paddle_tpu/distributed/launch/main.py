@@ -49,9 +49,46 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _spawn_pod(args, nproc: int, world: int, endpoints: List[str],
+               master: str, node_rank: int) -> List[subprocess.Popen]:
+    procs = []
+    for local_rank in range(nproc):
+        rank = node_rank * nproc + local_rank
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER": master,
+            "PADDLE_JOB_ID": args.job_id,
+            "FLAGS_selected_tpus": str(local_rank),
+        })
+        log_path = os.path.join(args.log_dir, f"workerlog.{local_rank}")
+        log_f = open(log_path, "a")
+        cmd = [sys.executable, args.training_script] + \
+            args.training_script_args
+        procs.append(subprocess.Popen(cmd, env=env, stdout=log_f,
+                                      stderr=subprocess.STDOUT))
+    return procs
+
+
+def _kill_pod(procs: List[subprocess.Popen]):
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.time() + 10
+    for p in procs:
+        while p.poll() is None and time.time() < deadline:
+            time.sleep(0.2)
+        if p.poll() is None:
+            p.kill()
+
+
 def main(argv=None):
     args = parse_args(argv)
-    nnodes = int(str(args.nnodes).split(":")[0])
+    np_parts = str(args.nnodes).split(":")
+    nnodes = int(np_parts[0])
     nproc = args.nproc_per_node or 1
     os.makedirs(args.log_dir, exist_ok=True)
 
@@ -59,62 +96,111 @@ def main(argv=None):
     if master is None:
         master = f"127.0.0.1:{_free_port()}"
 
-    world = nnodes * nproc
-    endpoints = []
-    base_port = _free_port()
-    for i in range(world):
-        endpoints.append(f"127.0.0.1:{base_port + i}")
+    # ---- elastic mode (SURVEY.md §5.3): membership via the KV registry;
+    # world size is discovered, membership changes trigger
+    # checkpoint-restart relaunches within [np_min, np_max].
+    elastic = None
+    elastic_server = None
+    if args.elastic_server or os.environ.get("PADDLE_ELASTIC_SERVER"):
+        from ..fleet.elastic import ElasticManager, ElasticStatus, \
+            KVServer
+        from ..fleet.elastic.manager import host_ip
+        server = args.elastic_server or \
+            os.environ["PADDLE_ELASTIC_SERVER"]
+        if server == "auto":  # master embeds the registry
+            elastic_server = KVServer().start()
+            server = elastic_server.endpoint
+        my_endpoint = f"{host_ip()}:{_free_port()}"
+        elastic = ElasticManager(server=server, job_id=args.job_id,
+                                 np=str(args.nnodes),
+                                 node_id=my_endpoint)
+        elastic.register(payload=my_endpoint)
 
     procs: List[subprocess.Popen] = []
     restarts = 0
-    while True:
-        procs.clear()
-        for local_rank in range(nproc):
-            rank = (max(args.rank, 0)) * nproc + local_rank
-            env = dict(os.environ)
-            env.update({
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(world),
-                "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-                "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
-                "PADDLE_MASTER": master,
-                "PADDLE_JOB_ID": args.job_id,
-                "FLAGS_selected_tpus": str(local_rank),
-            })
-            log_path = os.path.join(args.log_dir,
-                                    f"workerlog.{local_rank}")
-            log_f = open(log_path, "a")
-            cmd = [sys.executable, args.training_script] + \
-                args.training_script_args
-            procs.append(subprocess.Popen(cmd, env=env, stdout=log_f,
-                                          stderr=subprocess.STDOUT))
-        # watchdog
-        failed = False
+    try:
         while True:
-            alive = [p.poll() is None for p in procs]
-            codes = [p.poll() for p in procs]
-            if not any(alive):
-                failed = any(c not in (0, None) for c in codes)
-                break
-            if any(c not in (0, None) for c in codes):
-                # a rank died: kill the pod (upstream non-elastic policy)
-                for p in procs:
-                    if p.poll() is None:
-                        p.send_signal(signal.SIGTERM)
-                failed = True
-                time.sleep(2)
-                break
-            time.sleep(1)
-        if not failed:
-            print(f"launch: job {args.job_id} finished OK")
-            return 0
-        restarts += 1
-        if restarts > args.max_restart:
-            print(f"launch: job failed after {restarts - 1} restarts",
-                  file=sys.stderr)
-            return 1
-        print(f"launch: restarting ({restarts}/{args.max_restart}) — "
-              "trainers resume from their last checkpoint")
+            if elastic is not None:
+                members = elastic.wait_for_members()
+                if len(members) < elastic.np_min:
+                    print("launch: not enough nodes "
+                          f"({len(members)}/{elastic.np_min}); waiting",
+                          file=sys.stderr)
+                    time.sleep(2)
+                    continue
+                if elastic.node_id not in members:
+                    # our heartbeat lapsed (partition) or we're a spare
+                    # beyond np_max: re-register and wait for the next
+                    # membership window instead of crashing
+                    print("launch: this node not in active membership; "
+                          "re-registering", file=sys.stderr)
+                    elastic.register(payload=elastic.node_id)
+                    time.sleep(elastic.heartbeat_interval)
+                    continue
+                node_endpoints = members
+                node_rank = node_endpoints.index(elastic.node_id)
+                world = len(node_endpoints) * nproc
+                # one endpoint per proc: node registers host:base_port,
+                # local proc i gets host:(base_port + i)
+                endpoints = []
+                for ep in node_endpoints:
+                    host, port = ep.rsplit(":", 1)
+                    endpoints.extend(f"{host}:{int(port) + i}"
+                                     for i in range(nproc))
+                master = node_endpoints[0]
+            else:
+                node_rank = max(args.rank, 0)
+                world = nnodes * nproc
+                base_port = _free_port()
+                endpoints = [f"127.0.0.1:{base_port + i}"
+                             for i in range(world)]
+
+            procs = _spawn_pod(args, nproc, world, endpoints, master,
+                               node_rank)
+            if elastic is not None:
+                # baseline = membership the pod was SPAWNED with, so a
+                # join/leave during spawn still triggers a relaunch
+                elastic.seed(node_endpoints)
+            # watchdog: rank death kills the pod; elastic membership
+            # change triggers relaunch with the new world
+            failed = False
+            relaunch = False
+            while True:
+                alive = [p.poll() is None for p in procs]
+                codes = [p.poll() for p in procs]
+                if not any(alive):
+                    failed = any(c not in (0, None) for c in codes)
+                    break
+                if any(c not in (0, None) for c in codes):
+                    _kill_pod(procs)
+                    failed = True
+                    break
+                if elastic is not None:
+                    ev = elastic.watch()
+                    if ev is not None:
+                        print(f"launch: elastic event {ev.value}; "
+                              "restarting pod with new membership")
+                        _kill_pod(procs)
+                        relaunch = True
+                        break
+                time.sleep(1)
+            if not failed and not relaunch:
+                print(f"launch: job {args.job_id} finished OK")
+                return 0
+            if relaunch:
+                continue  # membership change doesn't count as a failure
+            restarts += 1
+            if restarts > args.max_restart:
+                print(f"launch: job failed after {restarts - 1} restarts",
+                      file=sys.stderr)
+                return 1
+            print(f"launch: restarting ({restarts}/{args.max_restart}) — "
+                  "trainers resume from their last checkpoint")
+    finally:
+        if elastic is not None:
+            elastic.exit()
+        if elastic_server is not None:
+            elastic_server.stop()
 
 
 if __name__ == "__main__":

@@ -559,6 +559,12 @@ def masked_scatter(x, mask, value):
     flat_mask = jnp.ravel(mask_b)
     flat_x = jnp.ravel(x)
     flat_v = jnp.ravel(value)
+    if not isinstance(mask_b, jax.core.Tracer):  # eager: enforce size
+        n_true = int(jnp.sum(flat_mask))
+        if n_true > flat_v.shape[0]:
+            raise ValueError(
+                f"masked_scatter: mask selects {n_true} elements but "
+                f"value has only {flat_v.shape[0]}")
     # position of each True among Trues → index into value
     order = jnp.cumsum(flat_mask.astype(jnp.int32)) - 1
     take = jnp.clip(order, 0, flat_v.shape[0] - 1)

@@ -611,10 +611,13 @@ def outer(x, y):
 
 @primitive
 def heaviside(x, y):
-    return jnp.where(x < 0, jnp.zeros_like(x),
-                     jnp.where(x > 0, jnp.ones_like(x),
-                               y.astype(x.dtype) if hasattr(y, "astype")
-                               else jnp.asarray(y, x.dtype)))
+    yv = y.astype(x.dtype) if hasattr(y, "astype") \
+        else jnp.asarray(y, x.dtype)
+    out = jnp.where(x < 0, jnp.zeros_like(x),
+                    jnp.where(x > 0, jnp.ones_like(x), yv))
+    if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+        out = jnp.where(jnp.isnan(x), x, out)  # NaN propagates
+    return out
 
 
 @primitive

@@ -1,0 +1,12 @@
+"""paddle.quantization parity (SURVEY.md §2.2 "Quantization": QAT/PTQ,
+observers, quanter) — TPU-native fake-quant via STE custom-vjp ops that
+XLA fuses into the surrounding computation."""
+
+from .config import QuantConfig  # noqa
+from .observers import (  # noqa
+    AbsmaxObserver, MovingAverageAbsmaxObserver, PerChannelAbsmaxObserver,
+    FakeQuanterWithAbsMaxObserver, FakeQuanterChannelWiseAbsMaxObserver,
+    BaseObserver)
+from .qat import QAT, PTQ, QuantedLinear, QuantedConv2D  # noqa
+from .fake_quant import (  # noqa
+    fake_quant_dequant, quantize_linear, dequantize_linear)

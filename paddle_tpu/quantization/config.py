@@ -1,0 +1,58 @@
+"""QuantConfig (parity: python/paddle/quantization/config.py)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional, Type
+
+from ..nn.layer import Layer
+
+
+class QuantConfig:
+    """Selects which layers get quantized and with what quanters.
+
+    ``activation``/``weight`` are *factories* (classes or callables
+    returning an observer/quanter layer), applied by default to every
+    quantizable layer; per-layer / per-type / per-name overrides follow
+    upstream's add_layer_config / add_type_config / add_name_config.
+    """
+
+    def __init__(self, activation=None, weight=None):
+        self._default = dict(activation=activation, weight=weight)
+        self._layer_cfg: Dict[int, dict] = {}     # id(layer) -> cfg
+        self._type_cfg: Dict[type, dict] = {}
+        self._name_cfg: Dict[str, dict] = {}
+        self.qat_layer_mappings: Dict[type, type] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = dict(activation=activation,
+                                          weight=weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg[t] = dict(activation=activation, weight=weight)
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, (list, tuple)) \
+            else [layer_name]
+        for n in names:
+            self._name_cfg[n] = dict(activation=activation, weight=weight)
+
+    def add_qat_layer_mapping(self, source: type, target: type):
+        self.qat_layer_mappings[source] = target
+
+    def _config_for(self, name: str, layer: Layer) -> Optional[dict]:
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        if name in self._name_cfg:
+            return self._name_cfg[name]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        if self._default["activation"] or self._default["weight"]:
+            return self._default
+        return None

@@ -1,0 +1,61 @@
+"""Fake quant-dequant ops with straight-through-estimator gradients.
+
+Parity target: paddle.quantization's fake quanters
+(python/paddle/quantization/ + the fake_quantize_* CUDA kernels —
+SURVEY.md §2.2 "Quantization").  TPU-native: one jax function with a
+``jax.custom_vjp`` STE; the tape autograd honours the custom vjp when it
+replays the op, and under jit XLA fuses the whole quant-dequant chain
+into neighbouring elementwise work.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops._primitive import primitive
+
+
+@jax.custom_vjp
+def _qdq_ste(x, scale, qmin, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s), qmin, qmax)
+    return q * s
+
+
+def _qdq_fwd(x, scale, qmin, qmax):
+    s = jnp.maximum(scale, 1e-9)
+    inside = (x / s >= qmin) & (x / s <= qmax)
+    return _qdq_ste(x, scale, qmin, qmax), inside
+
+
+def _qdq_bwd(res, g):
+    inside = res
+    # STE: pass gradient through where the value wasn't clipped
+    return (jnp.where(inside, g, jnp.zeros_like(g)), None, None, None)
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+@primitive
+def fake_quant_dequant(x, scale, bit_length=8):
+    """Per-tensor (scalar scale) or per-channel (scale broadcastable to
+    x) symmetric fake quantization with STE gradient."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    qmin = -qmax
+    return _qdq_ste(x, jnp.asarray(scale, x.dtype), qmin, qmax)
+
+
+@primitive
+def quantize_linear(x, scale, zero_point=0, bit_length=8):
+    """x -> int8-domain values (kept in the input float dtype so XLA can
+    fuse; a trailing cast materialises int8 when exporting)."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(jnp.asarray(scale, x.dtype), 1e-9)
+    return jnp.clip(jnp.round(x / s) + zero_point, -qmax, qmax)
+
+
+@primitive
+def dequantize_linear(x, scale, zero_point=0, bit_length=8):
+    return (x - zero_point) * jnp.asarray(scale, x.dtype)

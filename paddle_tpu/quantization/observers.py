@@ -1,0 +1,116 @@
+"""Observers / quanters (parity: python/paddle/quantization/observers/
+and quanters/ — SURVEY.md §2.2 "Quantization").
+
+An observer is a Layer that watches tensors flowing through it and
+maintains the quantization scale; in QAT mode it also fake-quantizes
+its input (with STE), in PTQ mode it only records statistics.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..tensor import Tensor
+from .. import ops
+from .fake_quant import fake_quant_dequant
+
+
+class BaseObserver(Layer):
+    """Base: tracks a scale; subclasses update it per forward."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None  # python float or np array (per-channel)
+
+    def scale(self):
+        return self._scale
+
+    def bit_length(self):
+        return self.quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def observe(self, x: Tensor) -> None:
+        raise NotImplementedError
+
+    def forward(self, x):
+        self.observe(x)
+        return x
+
+
+class AbsmaxObserver(BaseObserver):
+    """Running max of |x| (PTQ calibration observer)."""
+
+    def observe(self, x):
+        m = float(np.asarray(ops.abs(x).max().numpy()))
+        self._scale = m if self._scale is None else max(self._scale, m)
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA of per-batch absmax (upstream moving_average_abs_max)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x):
+        m = float(np.asarray(ops.abs(x).max().numpy()))
+        if self._scale is None:
+            self._scale = m
+        else:
+            r = self.moving_rate
+            self._scale = r * self._scale + (1 - r) * m
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax (weights; channel axis 0 or last)."""
+
+    def __init__(self, quant_bits: int = 8, quant_axis: int = 0):
+        super().__init__(quant_bits)
+        self._axis = quant_axis
+
+    def quant_axis(self):
+        return self._axis
+
+    def observe(self, x):
+        arr = np.abs(np.asarray(x.numpy(), dtype=np.float32))
+        axes = tuple(i for i in range(arr.ndim) if i != self._axis)
+        m = arr.max(axis=axes) if axes else arr
+        self._scale = m if self._scale is None \
+            else np.maximum(self._scale, m)
+
+
+class FakeQuanterWithAbsMaxObserver(MovingAverageAbsmaxObserver):
+    """QAT quanter: observe (EMA absmax) then fake-quant with STE —
+    upstream FakeQuanterWithAbsMaxObserverLayer."""
+
+    def forward(self, x):
+        if self.training:
+            self.observe(x)
+        if self._scale is None:
+            return x
+        qmax = float(2 ** (self.quant_bits - 1) - 1)
+        return fake_quant_dequant(x, self._scale / qmax,
+                                  bit_length=self.quant_bits)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(PerChannelAbsmaxObserver):
+    """QAT per-channel weight quanter."""
+
+    def forward(self, x):
+        if self.training:
+            self.observe(x)
+        if self._scale is None:
+            return x
+        qmax = float(2 ** (self.quant_bits - 1) - 1)
+        scale = self._scale / qmax
+        shape = [1] * len(x.shape)
+        shape[self._axis] = -1
+        scale = np.reshape(scale, shape)
+        return fake_quant_dequant(x, scale, bit_length=self.quant_bits)

@@ -1,0 +1,157 @@
+"""QAT / PTQ drivers (parity: python/paddle/quantization/qat.py,
+ptq.py — SURVEY.md §2.2 "Quantization").
+
+``QAT(config).quantize(model)`` swaps quantizable layers for Quanted*
+wrappers that fake-quant weights + activations with STE — training then
+adapts to int8 noise.  ``PTQ(config).quantize(model)`` inserts pure
+observers; after calibration batches, ``convert`` freezes the scales
+into Q/DQ-simulating layers.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import numpy as np
+
+from ..nn.layer import Layer
+from ..nn.common import Linear
+from ..nn.conv import Conv2D
+from .. import ops
+from .config import QuantConfig
+from .observers import (BaseObserver, FakeQuanterWithAbsMaxObserver,
+                        MovingAverageAbsmaxObserver)
+
+
+def _make(factory, default_cls):
+    if factory is None:
+        return default_cls()
+    if isinstance(factory, type):
+        return factory()
+    if isinstance(factory, Layer):
+        return copy.deepcopy(factory)
+    return factory()  # callable factory
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized activation + weight."""
+
+    def __init__(self, source: Linear, cfg: dict,
+                 qat: bool = True):
+        super().__init__()
+        default = FakeQuanterWithAbsMaxObserver if qat \
+            else MovingAverageAbsmaxObserver
+        self.source = source
+        self.activation_quanter = _make(cfg.get("activation"), default)
+        self.weight_quanter = _make(cfg.get("weight"), default)
+
+    def forward(self, x):
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(self.source.weight)
+        return ops.linear(x, w, self.source.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, source: Conv2D, cfg: dict, qat: bool = True):
+        super().__init__()
+        default = FakeQuanterWithAbsMaxObserver if qat \
+            else MovingAverageAbsmaxObserver
+        self.source = source
+        self.activation_quanter = _make(cfg.get("activation"), default)
+        self.weight_quanter = _make(cfg.get("weight"), default)
+
+    def forward(self, x):
+        s = self.source
+        x = self.activation_quanter(x)
+        w = self.weight_quanter(s.weight)
+        return ops.conv2d(x, w, s.bias, s._stride, s._padding,
+                          s._dilation, s._groups, s._data_format)
+
+
+_QUANTABLE = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _swap_layers(model: Layer, config: QuantConfig, qat: bool) -> int:
+    """Replace quantizable sublayers in place; returns #swapped."""
+    n = 0
+    for name, parent in [("", model)] + \
+            list(model.named_sublayers(include_self=False)):
+        for child_name, child in list(parent._sub_layers.items()):
+            cls = type(child)
+            target = config.qat_layer_mappings.get(cls) or \
+                _QUANTABLE.get(cls)
+            if target is None:
+                continue
+            full = f"{name}.{child_name}" if name else child_name
+            cfg = config._config_for(full, child)
+            if cfg is None:
+                continue
+            parent._sub_layers[child_name] = target(child, cfg, qat=qat)
+            n += 1
+    return n
+
+
+class QAT:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        _swap_layers(model, self._config, qat=True)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Freeze: stop observing (eval mode keeps scales fixed)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, BaseObserver):
+                layer.eval()
+        return model
+
+
+class PTQ:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        _swap_layers(model, self._config, qat=False)
+        return model
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """After calibration: swap observers for fixed fake-quanters."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        from .fake_quant import fake_quant_dequant
+
+        class _Frozen(Layer):
+            def __init__(self, scale, bits, quant_axis):
+                super().__init__()
+                self._s = scale
+                self._b = bits
+                self._axis = quant_axis
+
+            def forward(self, x):
+                if self._s is None:
+                    return x
+                qmax = float(2 ** (self._b - 1) - 1)
+                scale = self._s / qmax
+                if np.ndim(scale) > 0:  # per-channel: align to axis
+                    shape = [1] * len(x.shape)
+                    shape[self._axis] = -1
+                    scale = np.reshape(scale, shape)
+                return fake_quant_dequant(x, scale, bit_length=self._b)
+
+        for layer in model.sublayers(include_self=True):
+            for attr in ("activation_quanter", "weight_quanter"):
+                ob = getattr(layer, attr, None)
+                if isinstance(ob, BaseObserver):
+                    frozen = _Frozen(ob.scale(), ob.bit_length(),
+                                     ob.quant_axis())
+                    layer._sub_layers[attr] = frozen
+                    setattr(layer, attr, frozen)
+        return model

@@ -1,0 +1,142 @@
+"""Elastic manager: KV registry, heartbeats, membership transitions,
+and the launch controller's elastic relaunch path."""
+
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, KVClient, KVServer)
+
+
+@pytest.fixture
+def server():
+    s = KVServer(ttl=1.5).start()
+    yield s
+    s.stop()
+
+
+def test_kv_roundtrip(server):
+    c = KVClient(server.endpoint)
+    c.put("/foo", "bar")
+    assert c.get("/foo") == "bar"
+    assert c.get("/missing") is None
+    c.delete("/foo")
+    assert c.get("/foo") is None
+
+
+def test_heartbeat_membership_and_ttl(server):
+    c = KVClient(server.endpoint)
+    c.heartbeat("job1/node-a", "a")
+    c.heartbeat("job1/node-b", "b")
+    c.heartbeat("job2/node-z", "z")
+    m = c.members("job1/")
+    assert sorted(m) == ["job1/node-a", "job1/node-b"]
+    time.sleep(2.0)  # past ttl with no beats
+    assert c.members("job1/") == {}
+
+
+def test_manager_scale_down_detected(server):
+    a = ElasticManager(server=server.endpoint, job_id="j", np="1:3",
+                       node_id="node-a", heartbeat_interval=0.3)
+    b = ElasticManager(server=server.endpoint, job_id="j", np="1:3",
+                       node_id="node-b", heartbeat_interval=0.3)
+    a.register()
+    b.register()
+    time.sleep(0.5)
+    assert a.members() == ["node-a", "node-b"]
+    assert a.watch() is None          # establishes baseline
+    b.exit()                          # node leaves
+    deadline = time.time() + 5
+    ev = None
+    while time.time() < deadline and ev is None:
+        ev = a.watch()
+        time.sleep(0.2)
+    assert ev == ElasticStatus.RESTART  # still >= np_min=1
+    a.exit()
+
+
+def test_manager_hold_below_min(server):
+    a = ElasticManager(server=server.endpoint, job_id="k", np="2:3",
+                       node_id="node-a", heartbeat_interval=0.3)
+    b = ElasticManager(server=server.endpoint, job_id="k", np="2:3",
+                       node_id="node-b", heartbeat_interval=0.3)
+    a.register()
+    b.register()
+    time.sleep(0.5)
+    assert a.watch() is None
+    b.exit()
+    deadline = time.time() + 5
+    ev = None
+    while time.time() < deadline and ev is None:
+        ev = a.watch()
+        time.sleep(0.2)
+    assert ev == ElasticStatus.HOLD   # dropped below np_min=2
+    a.exit()
+
+
+def test_manager_disabled_without_server(monkeypatch):
+    monkeypatch.delenv("PADDLE_ELASTIC_SERVER", raising=False)
+    m = ElasticManager(server=None)
+    assert not m.enabled
+    m.register()      # all no-ops
+    assert m.members() == []
+    assert m.watch() is None
+    m.exit()
+
+
+def test_launch_elastic_single_node_end_to_end(tmp_path):
+    """launch --elastic_server auto runs a 1-node job to completion."""
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+        "assert 'PADDLE_MASTER' in os.environ\n"
+        "print('trainer ok', os.environ['PADDLE_TRAINER_ID'])\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "1", "--elastic_server", "auto",
+         "--log_dir", str(tmp_path / "log"), str(script)],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd=str(tmp_path))
+    assert out.returncode == 0, out.stderr
+    log = (tmp_path / "log" / "workerlog.0").read_text()
+    assert "trainer ok 0" in log
+
+
+def test_np_max_caps_active_members(server):
+    ms = [ElasticManager(server=server.endpoint, job_id="m", np="1:2",
+                         node_id=f"node-{c}", heartbeat_interval=0.3)
+          for c in "abc"]
+    for m in ms:
+        m.register()
+    time.sleep(0.5)
+    active = ms[0].wait_for_members(timeout=3)
+    assert len(active) == 2                # capped at np_max
+    assert active == ["node-a", "node-b"]  # deterministic (sorted)
+    # node-c is a spare: not in active set
+    assert "node-c" not in active
+    for m in ms:
+        m.exit()
+
+
+def test_seeded_watch_detects_spawn_window_change(server):
+    a = ElasticManager(server=server.endpoint, job_id="s", np="1:3",
+                       node_id="node-a", heartbeat_interval=0.3)
+    a.register()
+    time.sleep(0.4)
+    a.seed(["node-a", "node-ghost"])  # pod spawned believing 2 members
+    deadline = time.time() + 5
+    ev = None
+    while time.time() < deadline and ev is None:
+        ev = a.watch()
+        time.sleep(0.2)
+    assert ev == ElasticStatus.RESTART  # ghost never appeared → restart
+    a.exit()

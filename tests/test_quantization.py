@@ -1,0 +1,139 @@
+"""Quantization: fake-quant STE, observers, QAT swap+train, PTQ flow."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, AbsmaxObserver, FakeQuanterWithAbsMaxObserver,
+    MovingAverageAbsmaxObserver, QuantedLinear, fake_quant_dequant)
+
+
+def test_fake_quant_dequant_roundtrip():
+    x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+    out = fake_quant_dequant(x, 1.0 / 127.0)
+    got = out.numpy()
+    # values snap to multiples of scale; max error <= scale/2
+    assert np.max(np.abs(got - x.numpy())) <= 0.5 / 127 + 1e-7
+    q = np.round(got * 127)
+    np.testing.assert_allclose(q, np.round(q))
+
+
+def test_fake_quant_ste_gradient():
+    x = paddle.to_tensor(np.array([-2.0, -0.5, 0.3, 2.0], np.float32),
+                         stop_gradient=False)
+    # scale chosen so +-2.0 clip (qmax*scale = 1.27)
+    out = fake_quant_dequant(x, 0.01)
+    out.sum().backward()
+    g = x.grad.numpy()
+    np.testing.assert_allclose(g, [0.0, 1.0, 1.0, 0.0], atol=1e-6)
+
+
+def test_observers():
+    ob = AbsmaxObserver()
+    ob(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+    ob(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert abs(ob.scale() - 3.0) < 1e-6
+    ema = MovingAverageAbsmaxObserver(moving_rate=0.5)
+    ema(paddle.to_tensor(np.array([4.0], np.float32)))
+    ema(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert abs(ema.scale() - 3.0) < 1e-6
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.relu(self.fc1(x)))
+
+
+def test_qat_swaps_and_trains():
+    net = MLP()
+    q = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                        weight=FakeQuanterWithAbsMaxObserver))
+    qnet = q.quantize(net)
+    assert isinstance(qnet.fc1, QuantedLinear)
+    assert isinstance(qnet.fc2, QuantedLinear)
+
+    opt = optimizer.Adam(1e-2, parameters=qnet.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randn(16, 4).astype(np.float32)
+    losses = []
+    for _ in range(20):
+        out = qnet(paddle.to_tensor(x))
+        loss = paddle.mse_loss(out, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0], losses
+    # scales were learned/observed
+    assert qnet.fc1.activation_quanter.scale() is not None
+    assert qnet.fc1.weight_quanter.scale() is not None
+
+
+def test_qat_selective_by_name():
+    net = MLP()
+    cfg = QuantConfig()
+    cfg.add_name_config("fc1",
+                        activation=FakeQuanterWithAbsMaxObserver,
+                        weight=FakeQuanterWithAbsMaxObserver)
+    qnet = QAT(cfg).quantize(net)
+    assert isinstance(qnet.fc1, QuantedLinear)
+    assert isinstance(qnet.fc2, nn.Linear)
+
+
+def test_ptq_calibrate_convert_close_to_fp():
+    paddle.seed(0)
+    net = MLP()
+    rng = np.random.RandomState(1)
+    x = rng.randn(32, 8).astype(np.float32)
+    fp_out = net(paddle.to_tensor(x)).numpy()
+
+    ptq = PTQ(QuantConfig(activation=MovingAverageAbsmaxObserver,
+                          weight=AbsmaxObserver))
+    qnet = ptq.quantize(net, inplace=False)
+    for i in range(4):  # calibration
+        qnet(paddle.to_tensor(x[i * 8:(i + 1) * 8]))
+    converted = ptq.convert(qnet)
+    q_out = converted(paddle.to_tensor(x)).numpy()
+    # int8 sim should track fp closely on this scale of values
+    err = np.abs(q_out - fp_out).mean() / (np.abs(fp_out).mean() + 1e-9)
+    assert err < 0.1, err
+
+
+def test_ptq_per_channel_weight_convert():
+    from paddle_tpu.quantization import PerChannelAbsmaxObserver
+    paddle.seed(0)
+    net = MLP()
+    rng = np.random.RandomState(2)
+    x = rng.randn(16, 8).astype(np.float32)
+    fp_out = net(paddle.to_tensor(x)).numpy()
+    ptq = PTQ(QuantConfig(activation=MovingAverageAbsmaxObserver,
+                          weight=PerChannelAbsmaxObserver))
+    qnet = ptq.quantize(net, inplace=False)
+    qnet(paddle.to_tensor(x))  # calibrate (non-square weights 8x16)
+    converted = ptq.convert(qnet)
+    q_out = converted(paddle.to_tensor(x)).numpy()
+    err = np.abs(q_out - fp_out).mean() / (np.abs(fp_out).mean() + 1e-9)
+    assert err < 0.1, err
+
+
+def test_masked_scatter_size_check():
+    with pytest.raises(ValueError, match="masked_scatter"):
+        paddle.masked_scatter(
+            paddle.to_tensor(np.zeros((2, 2), np.float32)),
+            paddle.to_tensor(np.ones((2, 2), bool)),
+            paddle.to_tensor(np.array([1.0], np.float32)))
+
+
+def test_heaviside_nan_propagates():
+    out = paddle.heaviside(
+        paddle.to_tensor(np.array([np.nan, 1.0], np.float32)),
+        paddle.to_tensor(np.float32(0.5)))
+    assert np.isnan(out.numpy()[0]) and out.numpy()[1] == 1.0
