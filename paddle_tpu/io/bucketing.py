@@ -1,0 +1,141 @@
+"""Dynamic-shape handling for XLA: bucketing + padding.
+
+SURVEY.md §7.3 hard part 3: XLA compiles one program per input shape,
+so variable-length/size data (detection images, ragged text) must be
+bucketed and padded to a small set of canonical shapes.  Upstream has
+no equivalent (CUDA kernels take any shape); this is a TPU-native
+component, used by the ViT/PP-YOLOE-class configs.
+
+- ``shape_bucket(n, buckets)``: smallest bucket >= n.
+- ``BucketBatchSampler``: groups sample indices so each batch comes
+  from one length bucket (minimises padding waste) — same interface as
+  io.BatchSampler.
+- ``pad_batch(arrays, buckets, axis, pad_value)``: pad each array (and
+  return the mask) to its bucket boundary.
+- ``PadToBuckets``: collate_fn wrapper applying pad_batch to a field.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .sampler import Sampler
+
+
+def shape_bucket(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (last bucket if n exceeds them all)."""
+    buckets = sorted(buckets)
+    i = bisect.bisect_left(buckets, n)
+    return buckets[min(i, len(buckets) - 1)]
+
+
+def pad_batch(arrays: Sequence[np.ndarray], buckets: Sequence[int],
+              axis: int = 0, pad_value=0):
+    """Pad every array along ``axis`` to the common bucket boundary of
+    the longest one.  Returns (stacked [B, ...], mask [B, L])."""
+    longest = max(a.shape[axis] for a in arrays)
+    target = shape_bucket(longest, buckets)
+    out, mask = [], []
+    for a in arrays:
+        n = a.shape[axis]
+        pad = [(0, 0)] * a.ndim
+        pad[axis] = (0, max(target - n, 0))
+        if n > target:  # exceeds the largest bucket: truncate
+            sl = [slice(None)] * a.ndim
+            sl[axis] = slice(0, target)
+            a = a[tuple(sl)]
+            n = target
+        out.append(np.pad(a, pad, constant_values=pad_value))
+        m = np.zeros(target, dtype=bool)
+        m[:n] = True
+        mask.append(m)
+    return np.stack(out), np.stack(mask)
+
+
+class BucketBatchSampler(Sampler):
+    """Batch sampler grouping samples into size buckets.
+
+    ``size_fn(idx) -> int`` gives each sample's size (e.g. seq length);
+    batches are drawn within one bucket so the padded shape is shared —
+    one XLA program per bucket instead of per unique length.
+    """
+
+    def __init__(self, dataset, batch_size: int,
+                 buckets: Sequence[int],
+                 size_fn: Optional[Callable[[int], int]] = None,
+                 shuffle: bool = False, drop_last: bool = False,
+                 seed: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.buckets = sorted(buckets)
+        self.size_fn = size_fn or \
+            (lambda i: int(np.asarray(dataset[i][0]).shape[0]))
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._seed = seed
+        self._assign = None
+
+    def _assignments(self) -> dict:
+        if self._assign is None:
+            self._assign = {}
+            for i in range(len(self.dataset)):
+                b = shape_bucket(self.size_fn(i), self.buckets)
+                self._assign.setdefault(b, []).append(i)
+        return self._assign
+
+    def set_epoch(self, epoch: int):
+        self._epoch = epoch
+
+    def __iter__(self):
+        groups = self._assignments()
+        batches = []
+        rng = np.random.RandomState(self._seed + self._epoch)
+        for b, idxs in sorted(groups.items()):
+            idxs = list(idxs)
+            if self.shuffle:
+                rng.shuffle(idxs)
+            for k in range(0, len(idxs), self.batch_size):
+                chunk = idxs[k:k + self.batch_size]
+                if len(chunk) < self.batch_size and self.drop_last:
+                    continue
+                batches.append(chunk)
+        if self.shuffle:
+            rng.shuffle(batches)
+        return iter(batches)
+
+    def __len__(self):
+        groups = self._assignments()
+        n = 0
+        for idxs in groups.values():
+            if self.drop_last:
+                n += len(idxs) // self.batch_size
+            else:
+                n += (len(idxs) + self.batch_size - 1) // self.batch_size
+        return n
+
+
+class PadToBuckets:
+    """collate_fn: pads field 0 (or ``field``) of each sample to its
+    bucket along ``axis`` and appends the validity mask."""
+
+    def __init__(self, buckets: Sequence[int], axis: int = 0,
+                 pad_value=0, field: int = 0):
+        self.buckets = sorted(buckets)
+        self.axis = axis
+        self.pad_value = pad_value
+        self.field = field
+
+    def __call__(self, batch):
+        from .dataloader import default_collate_fn
+        from ..tensor import Tensor
+        seqs = [np.asarray(s[self.field]) for s in batch]
+        padded, mask = pad_batch(seqs, self.buckets, self.axis,
+                                 self.pad_value)
+        rest = [[v for j, v in enumerate(s) if j != self.field]
+                for s in batch]
+        collated = default_collate_fn(rest) if rest[0] else []
+        return (Tensor(padded), *collated, Tensor(mask))

@@ -3,4 +3,5 @@
 from . import models  # noqa
 from . import datasets  # noqa
 from . import transforms  # noqa
+from . import ops  # noqa
 from .models import LeNet, ResNet, resnet18, resnet50  # noqa

@@ -1,0 +1,488 @@
+"""Detection operators (parity: python/paddle/vision/ops.py —
+SURVEY.md §2.2 `paddle.vision`; the PP-YOLOE/detection slice of
+BASELINE.json config 5).
+
+TPU-first design notes:
+- ``nms`` runs a **fixed-iteration masked suppression loop** (no
+  data-dependent shapes): under jit it returns a padded index vector +
+  valid count; the eager wrapper trims to the dynamic result paddle
+  returns.
+- ``roi_align`` is pure gather + bilinear arithmetic — differentiable
+  and fusable by XLA (upstream needs a handwritten CUDA kernel pair).
+- ``yolo_box``/``box_coder`` are elementwise decodes — free on the VPU.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._primitive import primitive, unwrap
+from .. import ops as _ops
+from ..nn.layer import Layer
+
+
+# ---------------------------------------------------------------------------
+# box utilities
+# ---------------------------------------------------------------------------
+def _box_area(b):
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+
+
+def _pairwise_iou(a, b):
+    """a: [N,4], b: [M,4] (x1,y1,x2,y2) → [N,M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _box_area(a)[:, None] + _box_area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+@primitive
+def box_iou(boxes1, boxes2):
+    return _pairwise_iou(boxes1, boxes2)
+
+
+def _nms_mask(boxes, scores, iou_threshold: float):
+    """Fixed-shape greedy NMS: returns keep mask [N] (bool), computed
+    with a lax.fori_loop over N iterations — jit-safe."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    sorted_boxes = boxes[order]
+    iou = _pairwise_iou(sorted_boxes, sorted_boxes)
+
+    def body(i, alive):
+        # if candidate i still alive, kill all later boxes with IoU>thr
+        kill = (iou[i] > iou_threshold) & \
+            (jnp.arange(n) > i) & alive[i]
+        return alive & ~kill
+
+    alive = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # un-sort the mask back to input order
+    keep = jnp.zeros((n,), bool).at[order].set(alive)
+    return keep, order, alive
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None,
+        category_idxs=None, categories=None, top_k: Optional[int] = None):
+    """paddle.vision.ops.nms parity: returns kept indices sorted by
+    descending score. Batched-per-category when category_idxs given."""
+    b = unwrap(boxes)
+    s = unwrap(scores) if scores is not None else None
+    if s is None:
+        s = jnp.arange(b.shape[0], 0, -1, dtype=b.dtype)  # keep order
+    if category_idxs is not None:
+        # offset trick: shift boxes per category so they never overlap
+        c = unwrap(category_idxs).astype(b.dtype)
+        offset = (c * (jnp.max(b) + 1.0))[:, None]
+        b = b + offset
+    keep, order, alive = _nms_mask(b, s, iou_threshold)
+    # eager path: trim to the dynamic result
+    alive_np = np.asarray(alive)
+    order_np = np.asarray(order)
+    kept = order_np[alive_np]          # already score-descending
+    if top_k is not None:
+        kept = kept[:top_k]
+    return Tensor(jnp.asarray(kept, dtype=jnp.int64))
+
+
+def nms_padded(boxes, scores, iou_threshold: float, max_out: int):
+    """jit-safe NMS: (indices[max_out] padded with -1, valid_count).
+    This is the form detection heads compile into a TPU program."""
+    b, s = unwrap(boxes), unwrap(scores)
+    keep, order, alive = _nms_mask(b, s, iou_threshold)
+    # stable-select the first max_out alive entries of `order`;
+    # suppressed/overflow entries scatter to a dummy slot [max_out]
+    alive_rank = jnp.cumsum(alive.astype(jnp.int32)) - 1
+    valid = alive & (alive_rank < max_out)
+    buf = jnp.full((max_out + 1,), -1, dtype=jnp.int64)
+    tgt = jnp.where(valid, alive_rank, max_out)
+    buf = buf.at[tgt].set(jnp.where(valid, order, -1))
+    count = jnp.minimum(jnp.sum(alive.astype(jnp.int32)), max_out)
+    return Tensor(buf[:max_out]), Tensor(count)
+
+
+def multiclass_nms(bboxes, scores, score_threshold: float = 0.05,
+                   nms_threshold: float = 0.45, keep_top_k: int = 100,
+                   nms_top_k: int = 400):
+    """Per-class NMS + global top-k (detection postprocess).
+    bboxes: [N,4]; scores: [C,N]. Returns [M,6] (label, score, box)."""
+    b = np.asarray(unwrap(bboxes))
+    s = np.asarray(unwrap(scores))
+    results = []
+    for c in range(s.shape[0]):
+        mask = s[c] > score_threshold
+        if not mask.any():
+            continue
+        cb, cs = b[mask], s[c][mask]
+        if nms_top_k > 0 and cb.shape[0] > nms_top_k:
+            top = np.argsort(-cs)[:nms_top_k]
+            cb, cs = cb[top], cs[top]
+        kept = np.asarray(
+            nms(Tensor(cb), nms_threshold, Tensor(cs)).numpy())
+        for i in kept:
+            results.append([float(c), float(cs[i]), *cb[i].tolist()])
+    if not results:
+        return Tensor(np.zeros((0, 6), np.float32))
+    out = np.asarray(results, np.float32)
+    out = out[np.argsort(-out[:, 1])][:keep_top_k]
+    return Tensor(out)
+
+
+# ---------------------------------------------------------------------------
+# RoI ops
+# ---------------------------------------------------------------------------
+@primitive
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """x: [N,C,H,W]; boxes: [R,4] (x1,y1,x2,y2 in image coords);
+    boxes_num: [N] rois per image. Differentiable bilinear pooling."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    # image index per roi from boxes_num
+    img_idx = jnp.repeat(jnp.arange(N), boxes_num,
+                         total_repeat_length=R)
+
+    off = 0.5 if aligned else 0.0
+    bx = boxes * spatial_scale
+    x1, y1, x2, y2 = bx[:, 0] - off, bx[:, 1] - off, \
+        bx[:, 2] - off, bx[:, 3] - off
+    rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+    bin_h, bin_w = rh / ph, rw / pw
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, ph, sr] y coords, [R, pw, sr] x coords
+    sy = (y1[:, None, None] + (jnp.arange(ph)[None, :, None]) *
+          bin_h[:, None, None] +
+          (jnp.arange(sr)[None, None, :] + 0.5) / sr *
+          bin_h[:, None, None])
+    sx = (x1[:, None, None] + (jnp.arange(pw)[None, :, None]) *
+          bin_w[:, None, None] +
+          (jnp.arange(sr)[None, None, :] + 0.5) / sr *
+          bin_w[:, None, None])
+
+    def bilinear(img, yy, xx):
+        """img: [C,H,W]; yy,xx: [...]→ [C, ...]"""
+        yy = jnp.clip(yy, 0, H - 1)
+        xx = jnp.clip(xx, 0, W - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1_ = jnp.minimum(y0 + 1, H - 1)
+        x1_ = jnp.minimum(x0 + 1, W - 1)
+        wy = yy - y0
+        wx = xx - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1_]
+        v10 = img[:, y1_, x0]
+        v11 = img[:, y1_, x1_]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def per_roi(r):
+        img = x[img_idx[r]]                       # [C,H,W]
+        yy = sy[r][:, None, :, None]              # [ph,1,sr,1]
+        xx = sx[r][None, :, None, :]              # [1,pw,1,sr]
+        yy = jnp.broadcast_to(yy, (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(xx, (ph, pw, sr, sr))
+        vals = bilinear(img, yy, xx)              # [C,ph,pw,sr,sr]
+        return jnp.mean(vals, axis=(-1, -2))      # [C,ph,pw]
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+@primitive
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max pooling over roi bins (quantized boundaries)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    img_idx = jnp.repeat(jnp.arange(N), boxes_num,
+                         total_repeat_length=R)
+    bx = jnp.round(boxes * spatial_scale).astype(jnp.int32)
+
+    # fixed sample lattice (jit-safe): sample a dense grid per bin and
+    # max-reduce; grid of 4 samples per bin side approximates the
+    # dynamic quantized pooling
+    sr = 4
+
+    def per_roi(r):
+        img = x[img_idx[r]]
+        x1, y1, x2, y2 = bx[r, 0], bx[r, 1], bx[r, 2], bx[r, 3]
+        rw = jnp.maximum(x2 - x1 + 1, 1).astype(jnp.float32)
+        rh = jnp.maximum(y2 - y1 + 1, 1).astype(jnp.float32)
+        yy = y1 + (jnp.arange(ph * sr) + 0.5) / (ph * sr) * rh
+        xx = x1 + (jnp.arange(pw * sr) + 0.5) / (pw * sr) * rw
+        yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+        xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+        patch = img[:, yi][:, :, xi]              # [C, ph*sr, pw*sr]
+        patch = patch.reshape(C, ph, sr, pw, sr)
+        return jnp.max(patch, axis=(2, 4))
+
+    return jax.vmap(per_roi)(jnp.arange(R))
+
+
+class RoIAlign(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+class RoIPool(Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# YOLO decode + box coder
+# ---------------------------------------------------------------------------
+@primitive
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """Decode a YOLO head: x [N, na*(5+nc), H, W], img_size [N,2] (h,w)
+    → (boxes [N, na*H*W, 4], scores [N, na*H*W, nc])."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    na = anchors.shape[0]
+    N, _, H, W = x.shape
+    nc = class_num
+    feat = x.reshape(N, na, 5 + nc, H, W)
+    gx = jnp.arange(W, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(H, dtype=x.dtype)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(feat[:, :, 0]) * scale_x_y -
+          (scale_x_y - 1) / 2 + gx) / W
+    by = (sig(feat[:, :, 1]) * scale_x_y -
+          (scale_x_y - 1) / 2 + gy) / H
+    in_w = W * downsample_ratio
+    in_h = H * downsample_ratio
+    bw = jnp.exp(feat[:, :, 2]) * anchors[None, :, 0, None, None] / in_w
+    bh = jnp.exp(feat[:, :, 3]) * anchors[None, :, 1, None, None] / in_h
+    obj = sig(feat[:, :, 4])
+    cls = sig(feat[:, :, 5:])
+    scores = obj[:, :, None] * cls                # [N,na,nc,H,W]
+    # conf threshold zeroes scores (fixed shape; no dynamic filtering)
+    scores = jnp.where(scores > conf_thresh, scores, 0.0)
+    imh = img_size[:, 0].astype(x.dtype)[:, None, None, None]
+    imw = img_size[:, 1].astype(x.dtype)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N,na,H,W,4]
+    boxes = boxes.reshape(N, na * H * W, 4)
+    scores = jnp.moveaxis(scores, 2, -1).reshape(N, na * H * W, nc)
+    return boxes, scores
+
+
+@primitive
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """SSD-style box encode/decode (upstream box_coder op)."""
+    pb = prior_box
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if prior_box_var is None:
+        var = jnp.ones((4,), pb.dtype)
+        vx, vy, vw, vh = var
+    elif prior_box_var.ndim == 1:
+        vx, vy, vw, vh = (prior_box_var[i] for i in range(4))
+    else:
+        vx, vy = prior_box_var[:, 0], prior_box_var[:, 1]
+        vw, vh = prior_box_var[:, 2], prior_box_var[:, 3]
+    if code_type == "encode_center_size":
+        tb = target_box
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None]) / pw[None] / vx
+        oy = (tcy[:, None] - pcy[None]) / ph[None] / vy
+        ow = jnp.log(tw[:, None] / pw[None]) / vw
+        oh = jnp.log(th[:, None] / ph[None]) / vh
+        return jnp.stack([ox, oy, ow, oh], axis=-1)
+    # decode
+    tb = target_box  # [R,4] deltas
+    dcx = vx * tb[..., 0] * pw + pcx
+    dcy = vy * tb[..., 1] * ph + pcy
+    dw = jnp.exp(vw * tb[..., 2]) * pw
+    dh = jnp.exp(vh * tb[..., 3]) * ph
+    return jnp.stack([dcx - dw / 2 + norm * 0.5,
+                      dcy - dh / 2 + norm * 0.5,
+                      dcx + dw / 2 - norm * 0.5,
+                      dcy + dh / 2 - norm * 0.5], axis=-1)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level,
+                             refer_level, refer_scale,
+                             rois_num=None):
+    """Assign RoIs to FPN levels by scale (eager, dynamic output —
+    detection postprocess runs on host)."""
+    rois = np.asarray(unwrap(fpn_rois))
+    w = np.maximum(rois[:, 2] - rois[:, 0], 0)
+    h = np.maximum(rois[:, 3] - rois[:, 1], 0)
+    scale = np.sqrt(w * h)
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois = []
+    restore = np.argsort(
+        np.concatenate([np.where(lvl == l)[0]
+                        for l in range(min_level, max_level + 1)]))
+    nums = []
+    for l in range(min_level, max_level + 1):
+        sel = lvl == l
+        multi_rois.append(Tensor(rois[sel]))
+        nums.append(int(sel.sum()))
+    return multi_rois, Tensor(restore.astype(np.int64)), \
+        Tensor(np.asarray(nums, np.int32))
+
+
+@primitive
+def deform_conv2d_op(x, offset, weight, mask=None, stride=1, padding=0,
+                     dilation=1, deformable_groups=1, groups=1):
+    """Deformable conv v2 via bilinear sampling + matmul (DCNv2 when
+    mask given).  x [N,C,H,W], offset [N, 2*dg*kh*kw, Ho, Wo]."""
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    N, C, H, W = x.shape
+    O, Cg, kh, kw = weight.shape
+    Ho = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) \
+        // stride[0] + 1
+    Wo = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) \
+        // stride[1] + 1
+    K = kh * kw
+    # base sampling locations per output pixel/kernel tap
+    oy = jnp.arange(Ho) * stride[0] - padding[0]
+    ox = jnp.arange(Wo) * stride[1] - padding[1]
+    ky = jnp.arange(kh) * dilation[0]
+    kx = jnp.arange(kw) * dilation[1]
+    base_y = oy[:, None, None, None] + ky[None, None, :, None]
+    base_x = ox[None, :, None, None] + kx[None, None, None, :]
+    base_y = jnp.broadcast_to(base_y, (Ho, Wo, kh, kw)).astype(x.dtype)
+    base_x = jnp.broadcast_to(base_x, (Ho, Wo, kh, kw)).astype(x.dtype)
+    off = offset.reshape(N, deformable_groups, K, 2, Ho, Wo)
+    m = None if mask is None else \
+        mask.reshape(N, deformable_groups, K, Ho, Wo)
+
+    def sample_img(img, yy, xx):
+        """img [C,H,W]; yy/xx [...]: bilinear with zero padding OOB."""
+        valid = (yy > -1) & (yy < H) & (xx > -1) & (xx < W)
+        yy = jnp.clip(yy, 0, H - 1)
+        xx = jnp.clip(xx, 0, W - 1)
+        y0 = jnp.floor(yy).astype(jnp.int32)
+        x0 = jnp.floor(xx).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy, wx = yy - y0, xx - x0
+        v = (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+             img[:, y0, x1] * (1 - wy) * wx +
+             img[:, y1, x0] * wy * (1 - wx) +
+             img[:, y1, x1] * wy * wx)
+        return v * valid.astype(img.dtype)
+
+    cpg = C // deformable_groups  # channels per deformable group
+
+    def per_image(n):
+        cols = []
+        for g in range(deformable_groups):
+            dy = off[n, g, :, 0].transpose(1, 2, 0).reshape(Ho, Wo,
+                                                            kh, kw)
+            dx = off[n, g, :, 1].transpose(1, 2, 0).reshape(Ho, Wo,
+                                                            kh, kw)
+            yy = base_y + dy
+            xx = base_x + dx
+            img = x[n, g * cpg:(g + 1) * cpg]
+            v = sample_img(img, yy, xx)  # [cpg,Ho,Wo,kh,kw]
+            if m is not None:
+                mm = m[n, g].transpose(1, 2, 0).reshape(Ho, Wo, kh, kw)
+                v = v * mm[None]
+            cols.append(v)
+        col = jnp.concatenate(cols, axis=0)      # [C,Ho,Wo,kh,kw]
+        col = col.transpose(1, 2, 0, 3, 4).reshape(Ho * Wo, C * K)
+        wmat = weight.reshape(O, Cg * K)
+        if groups == 1:
+            out = col @ wmat.T                    # [Ho*Wo, O]
+        else:
+            og = O // groups
+            outs = []
+            for g in range(groups):
+                cg = col.reshape(Ho * Wo, C, K)[
+                    :, g * Cg:(g + 1) * Cg].reshape(Ho * Wo, Cg * K)
+                outs.append(cg @ wmat[g * og:(g + 1) * og].T)
+            out = jnp.concatenate(outs, axis=-1)
+        return out.T.reshape(O, Ho, Wo)
+
+    return jax.vmap(per_image)(jnp.arange(N))
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None):
+    out = deform_conv2d_op(x, offset, weight, mask, stride=stride,
+                           padding=padding, dilation=dilation,
+                           deformable_groups=deformable_groups,
+                           groups=groups)
+    if bias is not None:
+        out = _ops.add(out, _ops.reshape(bias, [1, -1, 1, 1]))
+    return out
+
+
+class DeformConv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size, kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            shape=[out_channels, in_channels // groups, *kernel_size],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)

@@ -1,0 +1,94 @@
+"""Dynamic-shape bucketing: bucket assignment, padding, sampler, and
+the one-program-per-bucket property under jit."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.io.bucketing import (BucketBatchSampler, PadToBuckets,
+                                     pad_batch, shape_bucket)
+
+
+def test_shape_bucket():
+    assert shape_bucket(5, [8, 16, 32]) == 8
+    assert shape_bucket(8, [8, 16, 32]) == 8
+    assert shape_bucket(9, [8, 16, 32]) == 16
+    assert shape_bucket(100, [8, 16, 32]) == 32  # clamps to largest
+
+
+def test_pad_batch_and_mask():
+    arrays = [np.ones((3, 2), np.float32), np.ones((7, 2), np.float32)]
+    out, mask = pad_batch(arrays, [4, 8], axis=0)
+    assert out.shape == (2, 8, 2)
+    assert mask.shape == (2, 8)
+    assert mask[0].sum() == 3 and mask[1].sum() == 7
+    assert out[0, 3:].sum() == 0
+
+
+class Ragged(Dataset):
+    def __init__(self, lengths):
+        self.lengths = lengths
+
+    def __len__(self):
+        return len(self.lengths)
+
+    def __getitem__(self, i):
+        n = self.lengths[i]
+        return np.full((n, 4), i, np.float32), np.int64(i)
+
+
+def test_bucket_batch_sampler_groups_by_bucket():
+    lengths = [3, 5, 9, 15, 4, 12, 7, 8]
+    ds = Ragged(lengths)
+    bs = BucketBatchSampler(ds, batch_size=2, buckets=[8, 16],
+                            size_fn=lambda i: lengths[i])
+    batches = list(bs)
+    assert len(bs) == len(batches)
+    for batch in batches:
+        buckets = {shape_bucket(lengths[i], [8, 16]) for i in batch}
+        assert len(buckets) == 1, "batch mixes buckets"
+    all_idx = sorted(i for b in batches for i in b)
+    assert all_idx == list(range(8))
+
+
+def test_bucketed_dataloader_limits_shapes():
+    lengths = [3, 5, 9, 15, 4, 12, 7, 8] * 2
+    ds = Ragged(lengths)
+    bs = BucketBatchSampler(ds, batch_size=2, buckets=[8, 16],
+                            size_fn=lambda i: lengths[i])
+    dl = DataLoader(ds, batch_sampler=bs,
+                    collate_fn=PadToBuckets([8, 16], axis=0))
+    seen_shapes = set()
+    total = 0
+    for x, y, mask in dl:
+        seen_shapes.add(tuple(x.shape[1:]))
+        total += x.shape[0]
+        assert tuple(mask.shape[:2]) == tuple(x.shape[:2])
+    assert total == 16
+    # padded feature shapes collapse to the two buckets only
+    assert seen_shapes <= {(8, 4), (16, 4)}
+
+
+def test_bucketing_compiles_once_per_bucket():
+    import jax
+
+    traces = []
+
+    @jax.jit
+    def step(x):
+        traces.append(x.shape)
+        return x.sum()
+
+    lengths = [3, 5, 9, 15, 4, 12, 7, 8]
+    ds = Ragged(lengths)
+    # drop_last keeps the batch dim constant too: with bucketing this
+    # bounds the number of XLA programs at #buckets
+    bs = BucketBatchSampler(ds, batch_size=2, buckets=[8, 16],
+                            size_fn=lambda i: lengths[i],
+                            drop_last=True)
+    dl = DataLoader(ds, batch_sampler=bs,
+                    collate_fn=PadToBuckets([8, 16], axis=0))
+    for x, y, mask in dl:
+        step(x._value)
+    assert len(traces) <= 2, f"recompiled per shape: {traces}"

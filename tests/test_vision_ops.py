@@ -1,0 +1,174 @@
+"""Detection ops: nms (vs numpy reference), roi_align (vs torchvision
+semantics oracle), yolo_box, box_coder, deform_conv2d (vs plain conv
+when offsets are zero)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def ref_nms(boxes, scores, thr):
+    idx = np.argsort(-scores)
+    keep = []
+    while idx.size:
+        i = idx[0]
+        keep.append(i)
+        if idx.size == 1:
+            break
+        xx1 = np.maximum(boxes[i, 0], boxes[idx[1:], 0])
+        yy1 = np.maximum(boxes[i, 1], boxes[idx[1:], 1])
+        xx2 = np.minimum(boxes[i, 2], boxes[idx[1:], 2])
+        yy2 = np.minimum(boxes[i, 3], boxes[idx[1:], 3])
+        inter = np.maximum(xx2 - xx1, 0) * np.maximum(yy2 - yy1, 0)
+        a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+        a2 = (boxes[idx[1:], 2] - boxes[idx[1:], 0]) * \
+            (boxes[idx[1:], 3] - boxes[idx[1:], 1])
+        iou = inter / (a1 + a2 - inter + 1e-9)
+        idx = idx[1:][iou <= thr]
+    return np.asarray(keep)
+
+
+def _rand_boxes(rng, n, size=100):
+    xy = rng.rand(n, 2) * size
+    wh = rng.rand(n, 2) * (size / 3) + 2
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_nms_matches_reference():
+    rng = np.random.RandomState(0)
+    for trial in range(5):
+        boxes = _rand_boxes(rng, 30)
+        scores = rng.rand(30).astype(np.float32)
+        got = np.asarray(paddle.vision.ops.nms(
+            paddle.to_tensor(boxes), 0.5,
+            paddle.to_tensor(scores)).numpy())
+        want = ref_nms(boxes, scores, 0.5)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_nms_padded_jit_safe():
+    import jax
+    rng = np.random.RandomState(1)
+    boxes = _rand_boxes(rng, 20)
+    scores = rng.rand(20).astype(np.float32)
+
+    idx, count = V.nms_padded(paddle.to_tensor(boxes),
+                              paddle.to_tensor(scores), 0.5, 10)
+    want = ref_nms(boxes, scores, 0.5)[:10]
+    got = np.asarray(idx.numpy())[:int(count.numpy())]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 10, 10]], np.float32)
+    b = np.array([[0, 0, 10, 10], [5, 5, 15, 15],
+                  [20, 20, 30, 30]], np.float32)
+    iou = V.box_iou(paddle.to_tensor(a), paddle.to_tensor(b)).numpy()
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], atol=1e-5)
+
+
+def test_roi_align_uniform_field():
+    # constant feature map → every roi bin must equal the constant
+    x = np.full((1, 3, 16, 16), 7.0, np.float32)
+    boxes = np.array([[2, 2, 10, 10], [0, 0, 15, 15]], np.float32)
+    out = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                      paddle.to_tensor(np.array([2])), 4).numpy()
+    assert out.shape == (2, 3, 4, 4)
+    np.testing.assert_allclose(out, 7.0, atol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32),
+        stop_gradient=False)
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+    out = V.roi_align(x, boxes, paddle.to_tensor(np.array([1])), 2)
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert np.abs(g).sum() > 0
+
+
+def test_roi_pool_shape_and_max():
+    x = np.zeros((1, 1, 8, 8), np.float32)
+    x[0, 0, 3, 3] = 5.0
+    out = V.roi_pool(paddle.to_tensor(x),
+                     paddle.to_tensor(
+                         np.array([[0, 0, 7, 7]], np.float32)),
+                     paddle.to_tensor(np.array([1])), 2).numpy()
+    assert out.shape == (1, 1, 2, 2)
+    assert out.max() == 5.0
+
+
+def test_yolo_box_shapes_and_range():
+    rng = np.random.RandomState(0)
+    na, nc, H, W = 3, 4, 5, 5
+    x = rng.randn(2, na * (5 + nc), H, W).astype(np.float32)
+    img = np.array([[160, 160], [320, 320]], np.int32)
+    boxes, scores = V.yolo_box(paddle.to_tensor(x),
+                               paddle.to_tensor(img),
+                               [10, 13, 16, 30, 33, 23], nc,
+                               downsample_ratio=32)
+    assert boxes.shape == [2, na * H * W, 4]
+    assert scores.shape == [2, na * H * W, nc]
+    b = boxes.numpy()
+    assert (b[0, :, [0, 2]] <= 160).all() and (b[0] >= 0).all()
+    s = scores.numpy()
+    assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_box_coder_decode_inverts_encode():
+    rng = np.random.RandomState(0)
+    priors = _rand_boxes(rng, 6)
+    targets = _rand_boxes(rng, 6)
+    enc = V.box_coder(paddle.to_tensor(priors), None,
+                      paddle.to_tensor(targets),
+                      code_type="encode_center_size").numpy()
+    # decode the diagonal (each target vs its own prior)
+    deltas = np.stack([enc[i, i] for i in range(6)])
+    dec = V.box_coder(paddle.to_tensor(priors), None,
+                      paddle.to_tensor(deltas.astype(np.float32)),
+                      code_type="decode_center_size").numpy()
+    np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-3)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small → low level
+                     [0, 0, 300, 300]],   # large → high level
+                    np.float32)
+    multi, restore, nums = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224)
+    assert len(multi) == 4
+    assert sum(int(n) for n in nums.numpy()) == 2
+    assert multi[0].shape[0] == 1          # level 2 got the small roi
+    assert multi[-1].shape[0] + multi[-2].shape[0] >= 1
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 6, 6), np.float32)
+    got = V.deform_conv2d(paddle.to_tensor(x),
+                          paddle.to_tensor(offset),
+                          paddle.to_tensor(w)).numpy()
+    want = paddle.conv2d(paddle.to_tensor(x), paddle.to_tensor(w)) \
+        .numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_multiclass_nms():
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                      [50, 50, 60, 60]], np.float32)
+    scores = np.array([[0.9, 0.85, 0.1],     # class 0
+                       [0.2, 0.1, 0.8]],     # class 1
+                      np.float32)
+    out = V.multiclass_nms(paddle.to_tensor(boxes),
+                           paddle.to_tensor(scores),
+                           score_threshold=0.3,
+                           nms_threshold=0.5).numpy()
+    # class 0 keeps 1 of the two overlapping, class 1 keeps the far box
+    assert out.shape[1] == 6
+    labels = out[:, 0].astype(int).tolist()
+    assert labels.count(0) == 1 and labels.count(1) == 1
