@@ -65,6 +65,7 @@ from . import profiler  # noqa
 from . import incubate  # noqa
 from . import device  # noqa
 from . import quantization  # noqa
+from . import sparse  # noqa
 from . import linalg as _linalg_ns  # noqa
 
 from .framework.io import save, load  # noqa

@@ -2,7 +2,8 @@ from .mp_layers import (  # noqa
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy, mark_as_sequence_parallel_parameter)
 from .pp_layers import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa
-from .pipeline_parallel import PipelineParallel, pipeline_spmd  # noqa
+from .pipeline_parallel import (PipelineParallel, pipeline_spmd,  # noqa
+                                pipeline_spmd_interleaved)
 from .parallel_wrappers import (  # noqa
     TensorParallel, PipelineParallelWrapper)
 from .sharding_parallel import (  # noqa

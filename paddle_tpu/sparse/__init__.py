@@ -1,0 +1,290 @@
+"""paddle.sparse parity (SURVEY.md §2.1 "DenseTensor & friends":
+SelectedRows/SparseCooTensor) over jax.experimental.sparse.
+
+TPU-native: COO → ``sparse.BCOO`` and CSR → ``sparse.BCSR``; sparse
+matmul lowers to ``bcoo_dot_general``, which XLA implements as
+gather+dot — dense MXU work on the gathered blocks, so moderate
+sparsity keeps full matmul throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+from ..ops._primitive import unwrap
+
+__all__ = ["SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_same_shape", "add", "subtract",
+           "multiply", "divide", "matmul", "masked_matmul", "relu",
+           "transpose", "nn"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (wraps jax BCOO). Mirrors the dense Tensor
+    surface where it makes sense (.shape, .dtype, .to_dense())."""
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient: bool = True):
+        self._m = bcoo
+        self.stop_gradient = stop_gradient
+
+    # -- paddle api ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    def nnz(self) -> int:
+        return int(self._m.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._m.indices.T)  # paddle: [sparse_dim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._m.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._m.todense())
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._m.sum_duplicates(),
+                               self.stop_gradient)
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        assert len(self._m.shape) == 2, "CSR needs a 2-D tensor"
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(
+            self._m.sum_duplicates()))
+
+    def numpy(self):
+        return np.asarray(self._m.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+    # convenience arithmetic
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def T(self):
+        return transpose(self, list(range(len(self.shape)))[::-1])
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (wraps jax BCSR)."""
+
+    def __init__(self, bcsr: jsparse.BCSR, stop_gradient: bool = True):
+        self._m = bcsr
+        self.stop_gradient = stop_gradient
+
+    @property
+    def shape(self):
+        return list(self._m.shape)
+
+    @property
+    def dtype(self):
+        return self._m.dtype
+
+    def nnz(self) -> int:
+        return int(self._m.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._m.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._m.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._m.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._m.todense())
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None):
+        return SparseCooTensor(self._m.to_bcoo())
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def numpy(self):
+        return np.asarray(self._m.todense())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, "
+                f"nnz={self.nnz()}, dtype={self.dtype})")
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """indices: [sparse_dim, nnz] (paddle layout); values: [nnz, ...]."""
+    idx = np.asarray(unwrap(indices))
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1)) + \
+            tuple(vals.shape[1:])
+    m = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(m, stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    vals = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        from ..framework.dtype import to_jax_dtype
+        vals = vals.astype(to_jax_dtype(dtype))
+    m = jsparse.BCSR((vals, jnp.asarray(unwrap(cols)),
+                      jnp.asarray(unwrap(crows))), shape=tuple(shape))
+    return SparseCsrTensor(m, stop_gradient)
+
+
+def _to_bcoo(x):
+    if isinstance(x, SparseCooTensor):
+        return x._m
+    if isinstance(x, SparseCsrTensor):
+        return x._m.to_bcoo()
+    raise TypeError(f"expected sparse tensor, got {type(x)}")
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+def _coo_add(a: jsparse.BCOO, b: jsparse.BCOO) -> jsparse.BCOO:
+    """Union of the two sparsity patterns via concat + coalesce."""
+    data = jnp.concatenate([a.data, b.data])
+    idx = jnp.concatenate([a.indices, b.indices])
+    return jsparse.BCOO((data, idx), shape=a.shape).sum_duplicates()
+
+
+def _neg(m: jsparse.BCOO) -> jsparse.BCOO:
+    return jsparse.BCOO((-m.data, m.indices), shape=m.shape)
+
+
+def _binary(x, y, fn):
+    if isinstance(y, (Tensor, jnp.ndarray, np.ndarray)):
+        # sparse ∘ dense → dense
+        return Tensor(fn(_to_bcoo(x).todense(), unwrap(y)))
+    a, b = _to_bcoo(x), _to_bcoo(y)
+    if fn is jnp.add:
+        return SparseCooTensor(_coo_add(a, b))
+    # general elementwise on the union pattern: fall back through dense
+    return SparseCooTensor(
+        jsparse.BCOO.fromdense(fn(a.todense(), b.todense())))
+
+
+def add(x, y):
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y):
+    if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return SparseCooTensor(_coo_add(_to_bcoo(x), _neg(_to_bcoo(y))))
+    return Tensor(jnp.subtract(_to_bcoo(x).todense(), unwrap(y)))
+
+
+def multiply(x, y):
+    if isinstance(y, (int, float)):
+        m = _to_bcoo(x)
+        return SparseCooTensor(jsparse.BCOO(
+            (m.data * y, m.indices), shape=m.shape))
+    return _binary(x, y, jnp.multiply)
+
+
+def divide(x, y):
+    if isinstance(y, (int, float)):
+        return multiply(x, 1.0 / y)
+    return _binary(x, y, jnp.divide)
+
+
+def matmul(x, y):
+    """sparse @ dense → dense (the TPU-profitable case); sparse @
+    sparse → sparse."""
+    if isinstance(y, (Tensor, jnp.ndarray, np.ndarray)):
+        out = _to_bcoo(x) @ unwrap(y)
+        return Tensor(out)
+    out = jsparse.bcoo_dot_general(
+        _to_bcoo(x), _to_bcoo(y).todense(),
+        dimension_numbers=(((1,), (0,)), ((), ())))
+    return Tensor(out)
+
+
+def masked_matmul(x, y, mask: "SparseCooTensor"):
+    """(x @ y) sampled at mask's sparsity pattern (SDDMM)."""
+    m = _to_bcoo(mask)
+    out_data = jsparse.bcoo_dot_general_sampled(
+        unwrap(x), unwrap(y), m.indices,
+        dimension_numbers=(((1,), (0,)), ((), ())))
+    return SparseCooTensor(jsparse.BCOO((out_data, m.indices),
+                                        shape=m.shape))
+
+
+def relu(x):
+    m = _to_bcoo(x)
+    return SparseCooTensor(jsparse.BCOO(
+        (jnp.maximum(m.data, 0), m.indices), shape=m.shape))
+
+
+def transpose(x, perm):
+    m = _to_bcoo(x)
+    return SparseCooTensor(
+        jsparse.bcoo_transpose(m, permutation=tuple(perm)))
+
+
+# dense Tensor → sparse converters (paddle patches these onto Tensor)
+def _tensor_to_sparse_coo(self, sparse_dim=None):
+    nd = len(self.shape)
+    sparse_dim = sparse_dim or nd
+    m = jsparse.BCOO.fromdense(self._value, n_batch=0,
+                               n_dense=nd - sparse_dim)
+    return SparseCooTensor(m, self.stop_gradient)
+
+
+def _tensor_to_sparse_csr(self):
+    return _tensor_to_sparse_coo(self).to_sparse_csr()
+
+
+Tensor.to_sparse_coo = _tensor_to_sparse_coo
+Tensor.to_sparse_csr = _tensor_to_sparse_csr
+
+from . import nn  # noqa: E402  (needs SparseCooTensor defined above)

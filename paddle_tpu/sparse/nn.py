@@ -1,0 +1,40 @@
+"""paddle.sparse.nn: layer wrappers over sparse functional ops."""
+
+from __future__ import annotations
+
+from ..nn.layer import Layer
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+        return relu(x)
+
+
+class Softmax(Layer):
+    """Softmax over the last dense axis of each sparse row (CSR/COO):
+    computed on values grouped per row."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+        from . import SparseCooTensor, _to_bcoo
+        m = _to_bcoo(x).sum_duplicates()
+        assert len(m.shape) == 2, "sparse softmax: 2-D only"
+        rows = m.indices[:, 0]
+        # segment softmax over rows
+        from jax import ops as _  # noqa
+        import jax
+        n_rows = m.shape[0]
+        row_max = jax.ops.segment_max(m.data, rows, n_rows) \
+            if hasattr(jax.ops, "segment_max") else \
+            jnp.full((n_rows,), -jnp.inf).at[rows].max(m.data)
+        e = jnp.exp(m.data - row_max[rows])
+        denom = jnp.zeros((n_rows,), m.dtype).at[rows].add(e)
+        out = e / denom[rows]
+        return SparseCooTensor(
+            jsparse.BCOO((out, m.indices), shape=m.shape))

@@ -1,0 +1,117 @@
+"""paddle.sparse: constructors, conversions, ops, sparse nn."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _dense():
+    d = np.zeros((3, 4), np.float32)
+    d[0, 1] = 2.0
+    d[1, 3] = -1.5
+    d[2, 0] = 4.0
+    return d
+
+
+def test_coo_roundtrip():
+    d = _dense()
+    idx = np.asarray(np.nonzero(d))
+    vals = d[tuple(idx)]
+    s = sparse.sparse_coo_tensor(idx, vals, d.shape)
+    assert s.is_sparse() and s.is_sparse_coo()
+    assert s.shape == [3, 4] and s.nnz() == 3
+    np.testing.assert_allclose(s.to_dense().numpy(), d)
+    np.testing.assert_array_equal(s.indices().numpy(), idx)
+    np.testing.assert_allclose(s.values().numpy(), vals)
+
+
+def test_csr_roundtrip_and_convert():
+    d = _dense()
+    s = paddle.to_tensor(d).to_sparse_csr()
+    assert s.is_sparse_csr()
+    np.testing.assert_allclose(s.to_dense().numpy(), d)
+    coo = s.to_sparse_coo()
+    np.testing.assert_allclose(coo.to_dense().numpy(), d)
+    back = coo.to_sparse_csr()
+    np.testing.assert_allclose(back.to_dense().numpy(), d)
+
+
+def test_dense_to_sparse_and_back():
+    d = _dense()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    assert s.nnz() == 3
+    np.testing.assert_allclose(s.to_dense().numpy(), d)
+
+
+def test_sparse_add_subtract():
+    d1, d2 = _dense(), _dense() * 2
+    d2[0, 0] = 9.0  # different pattern
+    s1 = paddle.to_tensor(d1).to_sparse_coo()
+    s2 = paddle.to_tensor(np.asarray(d2)).to_sparse_coo()
+    np.testing.assert_allclose(sparse.add(s1, s2).to_dense().numpy(),
+                               d1 + d2)
+    np.testing.assert_allclose(
+        sparse.subtract(s1, s2).to_dense().numpy(), d1 - d2)
+
+
+def test_sparse_scalar_multiply_divide():
+    d = _dense()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    np.testing.assert_allclose(sparse.multiply(s, 3.0)
+                               .to_dense().numpy(), d * 3)
+    np.testing.assert_allclose(sparse.divide(s, 2.0)
+                               .to_dense().numpy(), d / 2)
+
+
+def test_sparse_dense_matmul():
+    d = _dense()
+    w = np.random.RandomState(0).rand(4, 5).astype(np.float32)
+    s = paddle.to_tensor(d).to_sparse_coo()
+    out = sparse.matmul(s, paddle.to_tensor(w))
+    np.testing.assert_allclose(out.numpy(), d @ w, rtol=1e-5)
+    # csr path
+    sc = paddle.to_tensor(d).to_sparse_csr()
+    out2 = sparse.matmul(sc, paddle.to_tensor(w))
+    np.testing.assert_allclose(out2.numpy(), d @ w, rtol=1e-5)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(0)
+    x = rng.rand(3, 4).astype(np.float32)
+    y = rng.rand(4, 3).astype(np.float32)
+    mask_d = np.zeros((3, 3), np.float32)
+    mask_d[0, 1] = 1
+    mask_d[2, 2] = 1
+    mask = paddle.to_tensor(mask_d).to_sparse_coo()
+    out = sparse.masked_matmul(paddle.to_tensor(x),
+                               paddle.to_tensor(y), mask)
+    full = x @ y
+    want = np.zeros_like(full)
+    want[0, 1] = full[0, 1]
+    want[2, 2] = full[2, 2]
+    np.testing.assert_allclose(out.to_dense().numpy(), want, rtol=1e-5)
+
+
+def test_sparse_relu_and_transpose():
+    d = _dense()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    np.testing.assert_allclose(sparse.relu(s).to_dense().numpy(),
+                               np.maximum(d, 0))
+    np.testing.assert_allclose(
+        sparse.transpose(s, [1, 0]).to_dense().numpy(), d.T)
+
+
+def test_sparse_nn_relu_softmax():
+    d = _dense()
+    s = paddle.to_tensor(d).to_sparse_coo()
+    out = sparse.nn.ReLU()(s)
+    np.testing.assert_allclose(out.to_dense().numpy(), np.maximum(d, 0))
+    sm = sparse.nn.Softmax()(s)
+    got = sm.to_dense().numpy()
+    # softmax over nonzeros of each row
+    for r in range(3):
+        nz = d[r] != 0
+        e = np.exp(d[r][nz] - d[r][nz].max())
+        np.testing.assert_allclose(got[r][nz], e / e.sum(), rtol=1e-5)
