@@ -38,7 +38,8 @@ def _on_tpu() -> bool:
 # ---------------------------------------------------------------------------
 # Pallas kernel (TPU)
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   seq_len: int):
     from jax.experimental import pallas as pl
@@ -95,12 +96,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         o_ref[0] = (acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
             o_ref.dtype)
+        # log-sum-exp per query row, saved for the backward kernels
+        lse_ref[0] = (m_scr[...] +
+                      jnp.log(jnp.maximum(l_scr[...], 1e-30)))[:, 0]
 
 
 def _pallas_flash_bh(q, k, v, *, causal: bool, block_q: int = 512,
                      block_k: int = 512):
-    """q,k,v: [BH, S, D] → [BH, S, D].  S must divide by blocks (caller
-    pads)."""
+    """q,k,v: [BH, S, D] → (out [BH, S, D], lse [BH, S]).  S must divide
+    by blocks (caller guards)."""
     from jax.experimental import pallas as pl
 
     bh, s, d = q.shape
@@ -119,14 +123,185 @@ def _pallas_flash_bh(q, k, v, *, causal: bool, block_q: int = 512,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
         scratch_shapes=[
             pl.pltpu.VMEM((block_q, 1), jnp.float32),
             pl.pltpu.VMEM((block_q, 1), jnp.float32),
             pl.pltpu.VMEM((block_q, d), jnp.float32),
         ],
     )(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas backward kernels — standard flash-attention backward: recompute
+# P per block from the saved lse; never materialise [S, S] in HBM.
+# dQ kernel streams K/V blocks per Q block; dK/dV kernel streams Q
+# blocks per K/V block.
+# ---------------------------------------------------------------------------
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, scale: float, causal: bool,
+                         block_q: int, block_k: int, seq_len: int):
+    from jax.experimental import pallas as pl
+
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)          # [bq, d]
+        k = k_ref[0].astype(jnp.float32)          # [bk, d]
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)        # [bq, d]
+        lse = lse_ref[0][:, None]                 # [bq, 1]
+        delta = delta_ref[0][:, None]             # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse)                      # normalised probs
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(kv_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_kv = seq_len // block_k
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          scale: float, causal: bool, block_q: int,
+                          block_k: int, seq_len: int):
+    from jax.experimental import pallas as pl
+
+    kv_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    def body():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        p = jnp.exp(s - lse)                      # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bk, d]
+
+    if causal:
+        @pl.when(q_idx * block_q + block_q - 1 >= kv_idx * block_k)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_q = seq_len // block_q
+
+    @pl.when(q_idx == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pallas_flash_bwd(q, k, v, out, lse, do, *, causal: bool,
+                      block_q: int = 512, block_k: int = 512):
+    """Flash backward on [BH, S, D]; returns (dq, dk, dv)."""
+    from jax.experimental import pallas as pl
+
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    scale = 1.0 / math.sqrt(d)
+    # delta_i = rowsum(dO_i * O_i) — cheap elementwise+reduce in XLA
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                      # [bh, s]
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    rowq = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, seq_len=s),
+        grid=(bh, s // block_q, s // block_k),
+        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        scratch_shapes=[pl.pltpu.VMEM((block_q, d), jnp.float32)],
+    )(q, k, v, do, lse, delta)
+
+    # dkv grid: (bh, kv, q) — q is the minor (sequential) axis
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    rowq2 = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale,
+                          causal=causal, block_q=block_q,
+                          block_k=block_k, seq_len=s),
+        grid=(bh, s // block_k, s // block_q),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ],
+        scratch_shapes=[pl.pltpu.VMEM((block_k, d), jnp.float32),
+                        pl.pltpu.VMEM((block_k, d), jnp.float32)],
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
 def _flash_reference(q, k, v, causal):
@@ -143,30 +318,46 @@ def _flash_reference(q, k, v, causal):
         q.dtype)
 
 
+def _pallas_eligible(q, k):
+    import os
+    return (_on_tpu() and q.shape[1] >= 256 and q.shape[1] % 128 == 0
+            and q.shape == k.shape
+            and not os.environ.get("PADDLE_TPU_DISABLE_PALLAS"))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def _flash_core(q, k, v, causal):
     return _flash_fwd_impl(q, k, v, causal)
 
 
 def _flash_fwd_impl(q, k, v, causal):
-    if _on_tpu() and q.shape[1] >= 256 and q.shape[1] % 128 == 0 \
-            and q.shape == k.shape:
+    if _pallas_eligible(q, k):
         try:
-            return _pallas_flash_bh(q, k, v, causal=causal)
+            out, _ = _pallas_flash_bh(q, k, v, causal=causal)
+            return out
         except Exception:
             pass
     return _flash_reference(q, k, v, causal)
 
 
 def _flash_fwd(q, k, v, causal):
-    out = _flash_fwd_impl(q, k, v, causal)
-    return out, (q, k, v)
+    if _pallas_eligible(q, k):
+        try:
+            out, lse = _pallas_flash_bh(q, k, v, causal=causal)
+            return out, (q, k, v, out, lse)
+        except Exception:
+            pass
+    out = _flash_reference(q, k, v, causal)
+    # empty lse marks the reference path for the backward dispatch
+    lse = jnp.zeros((0,), jnp.float32)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, res, g):
-    q, k, v = res
-    # Recompute-based backward through the reference form (XLA fuses);
-    # a Pallas backward kernel is a follow-up optimization.
+    q, k, v, out, lse = res
+    if lse.size:  # pallas path: block-streaming backward, no [S,S] in HBM
+        return _pallas_flash_bwd(q, k, v, out, lse, g, causal=causal)
+    # fallback: recompute-based backward through the reference form
     _, vjp = jax.vjp(lambda q_, k_, v_: _flash_reference(q_, k_, v_, causal),
                      q, k, v)
     return vjp(g)
