@@ -25,7 +25,9 @@ from . import checkpoint  # noqa
 
 # auto-parallel style API
 from .auto_parallel.api import (  # noqa
-    ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard)
+    ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,
+    Placement, Replicate, Shard, Partial)
+from .auto_parallel.engine import Engine, DistModel, to_static  # noqa
 
 
 def launch():

@@ -84,16 +84,20 @@ def _placements_to_spec(placements: Sequence[Placement], mesh: ProcessMesh,
 
 def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
                  dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Annotate + place a tensor on the mesh.  A Tensor/Parameter input
+    is annotated IN PLACE (and returned), so module-registered
+    parameters keep their registration — the natural way to annotate a
+    model before handing it to auto_parallel.Engine."""
     t = data if isinstance(data, Tensor) else Tensor(data, dtype=dtype)
     jmesh = mesh.get_jax_mesh()
     spec = _placements_to_spec(placements, mesh, t.ndim)
-    sharded = jax.device_put(t._value, NamedSharding(jmesh, spec))
-    out = Tensor(sharded, stop_gradient=t.stop_gradient
-                 if stop_gradient is None else stop_gradient)
-    out.dist_spec = tuple(spec)
-    out.process_mesh = mesh
-    out.placements = list(placements)
-    return out
+    t._value = jax.device_put(t._value, NamedSharding(jmesh, spec))
+    if stop_gradient is not None:
+        t.stop_gradient = stop_gradient
+    t.dist_spec = tuple(spec)
+    t.process_mesh = mesh
+    t.placements = list(placements)
+    return t
 
 
 def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):

@@ -43,3 +43,6 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
                 tgt._value = jax.numpy.asarray(
                     restored[k], dtype=tgt._value.dtype)
     return state_dict
+
+
+from .manager import CheckpointManager  # noqa

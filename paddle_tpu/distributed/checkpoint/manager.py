@@ -1,0 +1,156 @@
+"""Checkpoint manager: periodic, async, retention-managed training
+checkpoints with preemption-safe resume.
+
+Parity: the operational side of SURVEY.md §5.3/5.4 — upstream covers
+this with hapi ModelCheckpoint + fleet sharded-save utilities + the
+elastic manager's checkpoint-restart contract.  TPU-native build:
+orbax ``CheckpointManager`` (already in the image) does atomic-rename
+commits, async array gathering, and per-host sharded writes; we wrap it
+with the paddle state_dict conventions so ``save(step, model,
+optimizer)`` / ``restore(model, optimizer)`` round-trip Layer and
+optimizer state including LR schedulers.
+
+Preemption: ``save_on_preemption()`` installs a SIGTERM handler that
+writes a final checkpoint before the process dies (TPU maintenance
+events surface as SIGTERM from the launch watchdog).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+
+from ...tensor import Tensor
+
+
+def _to_arrays(tree):
+    if isinstance(tree, Tensor):
+        return tree._value
+    if isinstance(tree, dict):
+        return {k: _to_arrays(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return [_to_arrays(v) for v in tree]
+    return tree
+
+
+def _assign_back(target, restored):
+    """Write restored arrays into an existing (Tensor-bearing) tree."""
+    if isinstance(target, Tensor):
+        import jax.numpy as jnp
+        target._value = jnp.asarray(restored, dtype=target._value.dtype)
+        return target
+    if isinstance(target, dict):
+        for k in target:
+            if k in restored:
+                target[k] = _assign_back(target[k], restored[k])
+        return target
+    if isinstance(target, (list, tuple)):
+        out = [_assign_back(t, r) for t, r in zip(target, restored)]
+        return type(target)(out) if isinstance(target, tuple) else out
+    return restored
+
+
+class CheckpointManager:
+    """Step-indexed training checkpoints.
+
+    >>> mgr = CheckpointManager(dir, save_interval_steps=100,
+    ...                         max_to_keep=3)
+    >>> for step in ...:
+    ...     mgr.save(step, model, optimizer)      # no-op off-interval
+    >>> start = mgr.restore(model, optimizer)     # latest, or 0
+    """
+
+    def __init__(self, directory: str, save_interval_steps: int = 1,
+                 max_to_keep: int = 5, async_save: bool = True):
+        import orbax.checkpoint as ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.save_interval_steps = max(1, int(save_interval_steps))
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=self.save_interval_steps,
+            enable_async_checkpointing=async_save)
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        self._lock = threading.Lock()
+        self._last_payload = None
+
+    # -- save ---------------------------------------------------------------
+    def _payload(self, model=None, optimizer=None,
+                 extra: Optional[Dict[str, Any]] = None):
+        tree: Dict[str, Any] = {}
+        if model is not None:
+            tree["model"] = _to_arrays(model.state_dict())
+        if optimizer is not None:
+            tree["optimizer"] = _to_arrays(optimizer.state_dict())
+        if extra:
+            tree["extra"] = _to_arrays(extra)
+        return tree
+
+    def save(self, step: int, model=None, optimizer=None,
+             extra: Optional[Dict[str, Any]] = None,
+             force: bool = False) -> bool:
+        """Save if the step hits the interval (or force). Async-safe."""
+        import orbax.checkpoint as ocp
+        with self._lock:
+            self._last_payload = (model, optimizer, extra)
+            saved = self._mgr.save(
+                step, args=ocp.args.StandardSave(
+                    self._payload(model, optimizer, extra)),
+                force=force)
+            return bool(saved)
+
+    def wait_until_finished(self):
+        self._mgr.wait_until_finished()
+
+    # -- restore ------------------------------------------------------------
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def restore(self, model=None, optimizer=None,
+                step: Optional[int] = None) -> int:
+        """Load the given (or latest) step into model/optimizer in
+        place; returns the restored step (0 if no checkpoint)."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            return 0
+        restored = self._mgr.restore(step)
+        if model is not None and "model" in restored:
+            sd = model.state_dict()
+            _assign_back(sd, restored["model"])
+            model.set_state_dict(sd)
+        if optimizer is not None and "optimizer" in restored:
+            optimizer.set_state_dict(restored["optimizer"])
+        return int(step)
+
+    # -- preemption ---------------------------------------------------------
+    def save_on_preemption(self, get_step, model=None, optimizer=None):
+        """Install a SIGTERM handler that force-saves before exit.
+        ``get_step``: callable returning the current step."""
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            try:
+                self.save(int(get_step()), model, optimizer, force=True)
+                self.wait_until_finished()
+            finally:
+                if callable(prev):
+                    prev(signum, frame)
+                else:
+                    raise SystemExit(143)
+
+        signal.signal(signal.SIGTERM, handler)
+
+    def close(self):
+        try:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+        except Exception:
+            pass

@@ -285,3 +285,81 @@ class DistributedRunner:
                 b._value = v
                 bufs[n] = v
         return loss
+
+    # -- eval / predict ------------------------------------------------------
+    def _eval_build(self, with_loss: bool):
+        net = self.network
+        loss_layer = self.loss_fn
+
+        def run(params, frozen, buffers, *data):
+            n_in = self._n_inputs if with_loss else len(data)
+            inputs = [Tensor(v) for v in data[:n_in]]
+            labels = [Tensor(v) for v in data[n_in:]]
+            with F.bind(net, params, buffers, frozen):
+                from ..autograd import tape as _tape
+                with _tape.no_grad_ctx():
+                    out = net(*inputs)
+                    if with_loss and loss_layer is not None:
+                        outs = out if isinstance(out, (list, tuple)) \
+                            else [out]
+                        loss = loss_layer(*outs, *labels)
+                        return loss._value.astype(jnp.float32)
+            if isinstance(out, (list, tuple)):
+                return [o._value for o in out]
+            return out._value
+
+        return jax.jit(run)
+
+    def _eval_values(self):
+        if not self._placed:
+            self.place()
+        if getattr(self, "_val_cache", None) is None:
+            self._val_cache = (
+                {n: p._value for n, p in self._name_to_param.items()
+                 if not p.stop_gradient},
+                {n: p._value for n, p in self._name_to_param.items()
+                 if p.stop_gradient},
+                {n: b._value for n, b in self._name_to_buf.items()
+                 if b is not None})
+        return self._val_cache
+
+    def eval_step(self, inputs, labels):
+        """Compiled forward + loss (no grad, no update)."""
+        prev_mesh = coll.get_mesh()
+        coll.set_mesh(self.mesh)
+        try:
+            params, frozen, bufs = self._eval_values()
+            if getattr(self, "_eval_fn", None) is None:
+                self._eval_fn = self._eval_build(with_loss=True)
+            iv = [i._value if isinstance(i, Tensor)
+                  else jax.device_put(np.asarray(i)) for i in
+                  (inputs if isinstance(inputs, (list, tuple))
+                   else [inputs])]
+            lv = [l._value if isinstance(l, Tensor)
+                  else jax.device_put(np.asarray(l)) for l in
+                  (labels if isinstance(labels, (list, tuple))
+                   else [labels])]
+            if getattr(self, "_n_inputs", None) is None:
+                self._n_inputs = len(iv)
+            return self._eval_fn(params, frozen, bufs, *iv, *lv)
+        finally:
+            coll.set_mesh(prev_mesh)
+
+    def predict_step(self, inputs):
+        """Compiled forward; returns raw outputs."""
+        prev_mesh = coll.get_mesh()
+        coll.set_mesh(self.mesh)
+        try:
+            params, frozen, bufs = self._eval_values()
+            if getattr(self, "_predict_fn", None) is None:
+                self._predict_fn = self._eval_build(with_loss=False)
+            iv = [i._value if isinstance(i, Tensor)
+                  else jax.device_put(np.asarray(i)) for i in
+                  (inputs if isinstance(inputs, (list, tuple))
+                   else [inputs])]
+            out = self._predict_fn(params, frozen, bufs, *iv)
+            if isinstance(out, list):
+                return [Tensor(o) for o in out]
+            return Tensor(out)
+        finally:
+            coll.set_mesh(prev_mesh)

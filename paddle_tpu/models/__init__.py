@@ -10,4 +10,6 @@ from .gpt import (  # noqa
     GPTForCausalLMPipe, gpt_tiny, gpt2_small, gpt3_1p3b)
 from .bert import (  # noqa
     BertConfig, BertModel, BertForPretraining, BertPretrainingCriterion,
-    bert_tiny, bert_base, ernie_3_base)
+    BertForSequenceClassification, ErnieConfig, ErnieModel,
+    ErnieForPretraining, ErniePretrainingCriterion,
+    ErnieForSequenceClassification, bert_tiny, bert_base, ernie_3_base)

@@ -221,3 +221,32 @@ class BertPretrainingCriterion(nn.Layer):
             loss = loss + self.nsp_loss(
                 nsp_logits, ops.reshape(next_sentence_labels, [-1]))
         return loss
+
+
+class BertForSequenceClassification(nn.Layer):
+    """Pooled-output classification head (fine-tuning surface of the
+    BERT/ERNIE family)."""
+
+    def __init__(self, config: BertConfig, num_classes: int = 2,
+                 dropout: Optional[float] = None):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+# ERNIE-3.0 aliases: same architecture, ERNIE naming (the differences —
+# knowledge-enhanced pretraining tasks — live in data/objectives, which
+# BertPretrainingCriterion's MLM(+NSP) form covers here).
+ErnieConfig = BertConfig
+ErnieModel = BertModel
+ErnieForPretraining = BertForPretraining
+ErniePretrainingCriterion = BertPretrainingCriterion
+ErnieForSequenceClassification = BertForSequenceClassification

@@ -214,6 +214,8 @@ class Optimizer:
         if "LR_Scheduler" in state_dict and isinstance(
                 self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        # group slots per saved param name, preserving save order
+        groups: Dict[str, Dict[str, Any]] = {}
         for key, v in state_dict.items():
             if key in ("LR_Scheduler", "global_step"):
                 continue
@@ -221,7 +223,25 @@ class Optimizer:
             if not name:
                 continue
             arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
-            self._state.setdefault(name, {})[slot] = jnp.asarray(arr)
+            groups.setdefault(name, {})[slot] = jnp.asarray(arr)
+        # auto-generated param names (param_N) are process-global
+        # counters: a checkpoint written by another process (or another
+        # net instance) carries different numbers for the same params.
+        # When the saved names don't match this optimizer's params,
+        # remap by position — parameter ORDER is the stable identity.
+        current = [p.name for p in (self._parameter_list or [])]
+        if current and groups and \
+                not set(groups).issubset(set(current)) and \
+                len(groups) <= len(current):
+            def ordinal(n):  # numeric suffix; robust to dict reordering
+                tail = n.rsplit("_", 1)[-1]
+                return (0, int(tail)) if tail.isdigit() else (1, n)
+
+            ordered = sorted(groups, key=ordinal)
+            groups = {current[i]: groups[k]
+                      for i, k in enumerate(ordered)}
+        for name, st in groups.items():
+            self._state.setdefault(name, {}).update(st)
 
 
 class SGD(Optimizer):

@@ -117,7 +117,22 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
+        """Print step timing + host-span table aggregated from the
+        native tracer (upstream: op/kernel summary tables)."""
         print(self.step_info())
+        stats = host_span_stats()
+        if not stats:
+            return
+        name_w = max(len(n) for n in stats) + 2
+        print(f"{'Name':<{name_w}}{'Calls':>8}{'Total(ms)':>12}"
+              f"{'Avg(ms)':>10}{'Max(ms)':>10}{'Ratio%':>8}")
+        total_all = sum(s['total'] for s in stats.values()) or 1.0
+        order = sorted(stats.items(), key=lambda kv: -kv[1]["total"])
+        for name, s in order:
+            print(f"{name:<{name_w}}{s['count']:>8}"
+                  f"{s['total']:>12.3f}{s['avg']:>10.3f}"
+                  f"{s['max']:>10.3f}"
+                  f"{100.0 * s['total'] / total_all:>8.1f}")
 
     def __enter__(self):
         self.start()
@@ -126,6 +141,40 @@ class Profiler:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+def host_span_stats():
+    """Aggregate the native tracer's span buffer into per-name stats
+    (count/total/avg/max in ms)."""
+    import json
+    import tempfile
+    if _host_tracer.count() == 0:
+        return {}
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        path = f.name
+    try:
+        if not _host_tracer.dump(path):
+            return {}
+        with open(path) as fh:
+            events = json.load(fh)["traceEvents"]
+    finally:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    stats = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        s = stats.setdefault(e["name"],
+                             {"count": 0, "total": 0.0, "max": 0.0})
+        dur_ms = e["dur"] / 1000.0
+        s["count"] += 1
+        s["total"] += dur_ms
+        s["max"] = max(s["max"], dur_ms)
+    for s in stats.values():
+        s["avg"] = s["total"] / s["count"]
+    return stats
 
 
 class RecordEvent:

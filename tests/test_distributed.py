@@ -436,3 +436,99 @@ def test_pipeline_interleaved_grad():
     g_ref = jax.grad(loss_seq)(ws)
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_auto_parallel_engine_fit_eval():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.auto_parallel.engine import (
+        Engine, to_static)
+    from paddle_tpu.io.dataset import Dataset
+
+    collective.set_mesh(None)
+    paddle.seed(0)
+
+    class DS(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            x = rng.rand(8).astype(np.float32)
+            return x, np.float32(x.sum())
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 1)
+
+        def forward(self, x):
+            return paddle.squeeze(self.fc(x), -1)
+
+    net = Net()
+    eng = Engine(net, loss=nn.MSELoss(),
+                 optimizer=optimizer.Adam(
+                     1e-1, parameters=net.parameters()))
+    hist = eng.fit(DS(), epochs=3, batch_size=8, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+    ev = eng.evaluate(DS(), batch_size=8)
+    assert ev["loss"] < 1.0
+    preds = eng.predict(DS(), batch_size=8)
+    assert len(preds) == 4
+
+    # dist.to_static step-call API
+    paddle.seed(0)
+    net2 = Net()
+    dm = to_static(net2, None, nn.MSELoss(),
+                   optimizer.Adam(1e-1, parameters=net2.parameters()))
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 8).astype(np.float32)
+    y = x.sum(1).astype(np.float32)
+    l1 = float(dm(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+    for _ in range(5):
+        l2 = float(dm(paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+    assert l2 < l1
+
+
+def test_auto_parallel_shard_tensor_engine_mesh():
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import ProcessMesh, shard_tensor
+    from paddle_tpu.distributed.auto_parallel.api import Shard, Replicate
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.distributed import collective
+
+    collective.set_mesh(None)
+    paddle.seed(0)
+    mesh = ProcessMesh(np.arange(8).reshape(2, 4).tolist(),
+                       dim_names=["dp", "mp"])
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 16)
+            # column-parallel annotation on the mp axis
+            self.fc.weight = shard_tensor(
+                self.fc.weight, mesh, [Replicate(), Shard(1)],
+                stop_gradient=False)
+            self.fc2 = nn.Linear(16, 1)
+
+        def forward(self, x):
+            return paddle.squeeze(self.fc2(paddle.relu(self.fc(x))), -1)
+
+    net = Net()
+    eng = Engine(net, loss=nn.MSELoss(),
+                 optimizer=optimizer.Adam(1e-2,
+                                          parameters=net.parameters()))
+    eng._ensure_runner()
+    assert eng._mesh is not None and dict(eng._mesh.shape) == \
+        {"dp": 2, "mp": 4}
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 8).astype(np.float32)
+    y = x.sum(1).astype(np.float32)
+    l1 = float(np.asarray(eng._runner.train_step([x], [y])))
+    l2 = float(np.asarray(eng._runner.train_step([x], [y])))
+    assert np.isfinite(l1) and np.isfinite(l2)
