@@ -1,79 +1,247 @@
 """Benchmark: GPT-2-small causal-LM training throughput on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Workload: the ERNIE/GPT class of baseline configs (BASELINE.json:9-10)
 reduced to one chip — bf16 train step (fwd+bwd+AdamW) of a 124M-param
 GPT-2-small at batch 8 × seq 1024, compiled to a single XLA program.
+A ResNet-50 images/s figure (BASELINE.json:8) is reported as an extra
+field when time allows.
 
 vs_baseline: BASELINE.md records no published reference numbers
 ("published": {} — empty reference mount), so the denominator is the
 community-typical per-A100 figure for GPT-2-small-class training used
 as the provisional bar: 25k tokens/s/GPU.  Replace when real reference
 numbers exist.
+
+Robustness (round-1 failure mode, VERDICT.md weak #2): the TPU backend
+can fail or hang during init (`jax.devices()` never returns).  The
+parent process therefore runs each workload in a child with a
+backend-init watchdog and an overall deadline, retries once when the
+failure was early (init-class), and always emits a parseable JSON line.
 """
 
 import json
+import os
+import subprocess
+import sys
+import threading
 import time
 
-import numpy as np
-
 BASELINE_TOKENS_PER_SEC = 25_000.0
+BASELINE_RESNET50_IMG_PER_SEC = 400.0   # community per-A100 fp16 figure
+
+INIT_DEADLINE_S = 150     # child must report `devices-ok` within this
+GPT_DEADLINE_S = 480      # full GPT bench wall-clock cap
+GLOBAL_DEADLINE_S = 900   # parent never runs longer than this
+RETRY_ONLY_BEFORE_S = 240  # retry only if attempt 1 failed early
 
 
-def main():
+def _maybe_force_cpu():
+    # Testing hook: exercise the bench mechanics without TPU hardware.
+    # Must run before any backend init; the axon plugin ignores the
+    # JAX_PLATFORMS env var, so use the config switch.
+    if os.environ.get("GRAFT_BENCH_FORCE_CPU"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _timed_bench(build, steps):
+    """Shared scaffold: build (model, opt, loss, data) then time steps.
+
+    `build` returns (net, opt, loss_fn, inputs, labels, units_per_step).
+    Returns units/sec over `steps` timed steps after compile + warmup.
+    """
+    _maybe_force_cpu()
     import jax
-    import jax.numpy as jnp
     import paddle_tpu as paddle
-    from paddle_tpu import nn, optimizer, amp
     from paddle_tpu.distributed import collective
     from paddle_tpu.distributed.runner import DistributedRunner
-    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
-                                   GPTPretrainingCriterion)
 
+    print("devices-ok", jax.devices(), flush=True)
     paddle.seed(0)
-    cfg = GPTConfig(vocab_size=50304, hidden_size=768,
-                    num_hidden_layers=12, num_attention_heads=12,
-                    intermediate_size=3072,
-                    max_position_embeddings=1024,
-                    hidden_dropout_prob=0.0,
-                    attention_probs_dropout_prob=0.0,
-                    use_flash_attention=True)
-    batch, seq = 8, 1024
-    net = GPTForCausalLM(cfg)
-    opt = optimizer.AdamW(learning_rate=1e-4,
-                          parameters=net.parameters(),
-                          multi_precision=True)
-    # O2: bf16 params + fp32 master weights in the optimizer
-    amp.decorate(net, opt, level="O2", dtype="bfloat16")
+    net, opt, loss_fn, inputs, labels, units = build()
     mesh = collective.build_mesh({})
     collective.set_mesh(mesh)
-    runner = DistributedRunner(net, opt, GPTPretrainingCriterion(),
-                               mesh=mesh)
+    runner = DistributedRunner(net, opt, loss_fn, mesh=mesh)
 
-    rng = np.random.RandomState(0)
-    x = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-    y = np.roll(x, -1, axis=1)
+    float(runner.train_step(inputs, labels))   # compile
+    print("compiled", flush=True)
+    float(runner.train_step(inputs, labels))   # warmup
 
-    # compile + warmup (float() forces a full device sync)
-    float(runner.train_step([x], [y]))
-    float(runner.train_step([x], [y]))
-
-    steps = 10
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = runner.train_step([x], [y])
+        loss = runner.train_step(inputs, labels)
     jax.block_until_ready(runner._opt_state)
     float(loss)
     dt = time.perf_counter() - t0
+    return units * steps / dt
 
-    tokens_per_sec = batch * seq * steps / dt
-    print(json.dumps({
-        "metric": "gpt2_small_bf16_train_tokens_per_sec_1chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/s",
-        "vs_baseline": round(tokens_per_sec / BASELINE_TOKENS_PER_SEC, 3),
-    }))
+
+def bench_gpt():
+    import numpy as np
+    from paddle_tpu import amp, optimizer
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    tiny = bool(os.environ.get("GRAFT_BENCH_TINY"))  # mechanics smoke
+
+    def build():
+        if tiny:
+            cfg = GPTConfig(vocab_size=1024, hidden_size=64,
+                            num_hidden_layers=2, num_attention_heads=4,
+                            intermediate_size=128,
+                            max_position_embeddings=128,
+                            hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0,
+                            use_flash_attention=False)
+            batch, seq = 2, 64
+        else:
+            cfg = GPTConfig(vocab_size=50304, hidden_size=768,
+                            num_hidden_layers=12, num_attention_heads=12,
+                            intermediate_size=3072,
+                            max_position_embeddings=1024,
+                            hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0,
+                            use_flash_attention=True)
+            batch, seq = 8, 1024
+        net = GPTForCausalLM(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=net.parameters(),
+                              multi_precision=True)
+        # O2: bf16 params + fp32 master weights in the optimizer
+        amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        rng = np.random.RandomState(0)
+        x = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+        y = np.roll(x, -1, axis=1)
+        return (net, opt, GPTPretrainingCriterion(), [x], [y], batch * seq)
+
+    tps = _timed_bench(build, steps=2 if tiny else 20)
+    print("RESULT " + json.dumps({"tokens_per_sec": tps}), flush=True)
+
+
+def bench_resnet():
+    import numpy as np
+    from paddle_tpu import amp, nn, optimizer
+    from paddle_tpu.vision import models as vmodels
+
+    batch = 64
+
+    def build():
+        net = vmodels.resnet50()
+        opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=net.parameters(),
+                                 multi_precision=True)
+        amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        rng = np.random.RandomState(0)
+        x = rng.rand(batch, 3, 224, 224).astype(np.float32)
+        y = rng.randint(0, 1000, (batch,)).astype(np.int64)
+        return (net, opt, nn.CrossEntropyLoss(), [x], [y], batch)
+
+    ips = _timed_bench(build, steps=10)
+    print("RESULT " + json.dumps({"images_per_sec": ips}), flush=True)
+
+
+def _parse_result(line):
+    try:
+        return json.loads(line[len("RESULT "):])
+    except (ValueError, KeyError):   # truncated write mid-kill
+        return None
+
+
+def _run_child(mode: str, overall_deadline: float):
+    """Run one workload in a child; return (result_dict|None, err_str)."""
+    env = dict(os.environ)
+    env["_GRAFT_BENCH_CHILD"] = mode
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)], env=env, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    lines = []
+    lock = threading.Lock()
+
+    def reader():
+        for line in proc.stdout:
+            with lock:
+                lines.append(line.rstrip("\n"))
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t0 = time.time()
+    err = ""
+    done_at = None
+    while True:
+        now = time.time()
+        with lock:
+            init_seen = any(ln.startswith("devices-ok") for ln in lines)
+            done = any(ln.startswith("RESULT ") for ln in lines)
+        if done and done_at is None:
+            done_at = now
+        if done and proc.poll() is not None:
+            break
+        if done_at is not None and now - done_at > 15:
+            proc.kill()   # result is in hand; don't wait out a hung teardown
+            break
+        if not init_seen and now - t0 > INIT_DEADLINE_S:
+            err = f"backend init exceeded {INIT_DEADLINE_S}s"
+            proc.kill()
+            break
+        if now - t0 > overall_deadline:
+            err = f"bench exceeded {overall_deadline:.0f}s"
+            proc.kill()
+            break
+        if proc.poll() is not None:
+            break
+        time.sleep(1.0)
+    proc.wait()
+    t.join(timeout=5)
+    result = None
+    with lock:
+        tail = "\n".join(lines[-15:])
+        for ln in lines:
+            if ln.startswith("RESULT "):
+                result = _parse_result(ln)
+    if result is None and not err:
+        err = f"child rc={proc.returncode}; tail:\n{tail}"
+    return result, err
+
+
+def main():
+    mode = os.environ.get("_GRAFT_BENCH_CHILD")
+    if mode == "gpt":
+        return bench_gpt()
+    if mode == "resnet":
+        return bench_resnet()
+
+    t_start = time.time()
+
+    def remaining():
+        return GLOBAL_DEADLINE_S - (time.time() - t_start)
+
+    out = {"metric": "gpt2_small_bf16_train_tokens_per_sec_1chip",
+           "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0}
+    gpt, err = _run_child("gpt", min(GPT_DEADLINE_S, remaining()))
+    if gpt is None and time.time() - t_start < RETRY_ONLY_BEFORE_S:
+        # early failure (init-class) — one retry within the global budget
+        gpt, err2 = _run_child("gpt", min(GPT_DEADLINE_S, remaining()))
+        if gpt is None:
+            err = f"attempt1: {err}; attempt2: {err2}"
+    if gpt is not None:
+        tps = gpt.get("tokens_per_sec", 0.0)
+        out["value"] = round(tps, 1)
+        out["vs_baseline"] = round(tps / BASELINE_TOKENS_PER_SEC, 3)
+    else:
+        out["error"] = err[-2000:]
+
+    if (gpt is not None and remaining() > 120
+            and not os.environ.get("GRAFT_BENCH_GPT_ONLY")):
+        resnet, _rerr = _run_child("resnet", remaining())
+        if resnet is not None:
+            ips = resnet.get("images_per_sec", 0.0)
+            out["resnet50_images_per_sec"] = round(ips, 1)
+            out["resnet50_vs_baseline"] = round(
+                ips / BASELINE_RESNET50_IMG_PER_SEC, 3)
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
