@@ -80,6 +80,7 @@ class Engine:
             DataLoader(train_data, batch_size=batch_size, shuffle=True)
         history = {"loss": []}
         for epoch in range(epochs):
+            loss = None
             for step, batch in enumerate(loader):
                 if steps_per_epoch and step >= steps_per_epoch:
                     break
@@ -88,6 +89,9 @@ class Engine:
                 if verbose and step % log_freq == 0:
                     print(f"epoch {epoch} step {step} "
                           f"loss {float(np.asarray(loss)):.4f}")
+            if loss is None:
+                raise ValueError("Engine.fit consumed no batches "
+                                 "(empty DataLoader)")
             history["loss"].append(float(np.asarray(loss)))
         return history
 

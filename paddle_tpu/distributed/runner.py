@@ -260,18 +260,7 @@ class DistributedRunner:
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         self._step_ctr = getattr(self, "_step_ctr", 0) + 1
         ctr = jnp.uint32(self._step_ctr)
-        # name→wrapper maps are invariant after place(); the VALUE dicts
-        # are cached and updated in place after each step — no per-step
-        # dict rebuild over hundreds of params
-        if getattr(self, "_val_cache", None) is None:
-            self._val_cache = (
-                {n: p._value for n, p in self._name_to_param.items()
-                 if not p.stop_gradient},
-                {n: p._value for n, p in self._name_to_param.items()
-                 if p.stop_gradient},
-                {n: b._value for n, b in self._name_to_buf.items()
-                 if b is not None})
-        params, frozen, bufs = self._val_cache
+        params, frozen, bufs = self._sync_val_cache()
         loss, new_p, new_s, new_buf = self._step_fn(
             params, frozen, bufs,
             self._opt_state, lr, ctr, *inputs_v, *labels_v)
@@ -285,6 +274,42 @@ class DistributedRunner:
                 b._value = v
                 bufs[n] = v
         return loss
+
+    def _sync_val_cache(self):
+        """Return (params, frozen, buffers) value dicts, kept coherent.
+
+        The dicts are cached and updated in place after each step — no
+        per-step rebuild over hundreds of params.  To stay correct under
+        external in-place weight updates (``set_state_dict``,
+        ``CheckpointManager.restore`` writing ``p._value``), every call
+        id-compares each wrapper's current ``_value`` against the cache
+        and re-places any externally replaced leaf with its canonical
+        sharding before the compiled step consumes it.
+        """
+        if getattr(self, "_val_cache", None) is None:
+            self._val_cache = (
+                {n: p._value for n, p in self._name_to_param.items()
+                 if not p.stop_gradient},
+                {n: p._value for n, p in self._name_to_param.items()
+                 if p.stop_gradient},
+                {n: b._value for n, b in self._name_to_buf.items()
+                 if b is not None})
+            return self._val_cache
+        params, frozen, bufs = self._val_cache
+        for n, p in self._name_to_param.items():
+            tgt = frozen if p.stop_gradient else params
+            if tgt.get(n) is not p._value:
+                v = self._shard(p._value, self._pspecs.get(n, P()))
+                p._value = v
+                tgt[n] = v
+        for n, b in self._name_to_buf.items():
+            if b is not None and bufs.get(n) is not b._value:
+                bufs[n] = b._value
+        return self._val_cache
+
+    def invalidate_cache(self):
+        """Drop cached value dicts (call after bulk external updates)."""
+        self._val_cache = None
 
     # -- eval / predict ------------------------------------------------------
     def _eval_build(self, with_loss: bool):
@@ -313,15 +338,7 @@ class DistributedRunner:
     def _eval_values(self):
         if not self._placed:
             self.place()
-        if getattr(self, "_val_cache", None) is None:
-            self._val_cache = (
-                {n: p._value for n, p in self._name_to_param.items()
-                 if not p.stop_gradient},
-                {n: p._value for n, p in self._name_to_param.items()
-                 if p.stop_gradient},
-                {n: b._value for n, b in self._name_to_buf.items()
-                 if b is not None})
-        return self._val_cache
+        return self._sync_val_cache()
 
     def eval_step(self, inputs, labels):
         """Compiled forward + loss (no grad, no update)."""

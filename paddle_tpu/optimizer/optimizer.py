@@ -227,20 +227,53 @@ class Optimizer:
         # auto-generated param names (param_N) are process-global
         # counters: a checkpoint written by another process (or another
         # net instance) carries different numbers for the same params.
-        # When the saved names don't match this optimizer's params,
-        # remap by position — parameter ORDER is the stable identity.
-        current = [p.name for p in (self._parameter_list or [])]
-        if current and groups and \
-                not set(groups).issubset(set(current)) and \
-                len(groups) <= len(current):
+        # Restore BY NAME whenever names+shapes line up (so a subset
+        # checkpoint — e.g. frozen params excluded — is never
+        # positionally scrambled); fall back to positional remap
+        # (parameter ORDER is the stable identity) only when no saved
+        # group matches by name+shape and the counts agree.  Unmatched
+        # groups are dropped with a warning, never filed under dead
+        # names that would propagate into future checkpoints.
+        import warnings
+        params_by_name = {p.name: p for p in (self._parameter_list or [])}
+
+        def shapes_ok(param, st):
+            # moments / master_weight share the param's shape; scalar
+            # slots (e.g. beta-pow counters) are shape-free
+            return all(np.ndim(v) == 0 or
+                       tuple(np.shape(v)) == tuple(param.shape)
+                       for v in st.values())
+
+        matched = {n: st for n, st in groups.items()
+                   if n in params_by_name and
+                   shapes_ok(params_by_name[n], st)}
+        if params_by_name and groups and not matched and \
+                len(groups) == len(params_by_name):
+            warnings.warn(
+                "optimizer.set_state_dict: no saved slot group matches "
+                "this optimizer's parameters by name+shape; remapping "
+                "all groups by position (cross-process checkpoint).")
+
             def ordinal(n):  # numeric suffix; robust to dict reordering
                 tail = n.rsplit("_", 1)[-1]
                 return (0, int(tail)) if tail.isdigit() else (1, n)
 
-            ordered = sorted(groups, key=ordinal)
-            groups = {current[i]: groups[k]
-                      for i, k in enumerate(ordered)}
-        for name, st in groups.items():
+            current = [p.name for p in (self._parameter_list or [])]
+            remapped = {current[i]: groups[k]
+                        for i, k in enumerate(sorted(groups, key=ordinal))}
+            matched = {n: st for n, st in remapped.items()
+                       if shapes_ok(params_by_name[n], st)}
+            if len(matched) != len(remapped):
+                warnings.warn(
+                    "optimizer.set_state_dict: positional remap dropped "
+                    f"{len(remapped) - len(matched)} slot group(s) whose "
+                    "shapes do not fit the target parameters.")
+        elif len(matched) != len(groups):
+            dropped = sorted(set(groups) - set(matched))
+            warnings.warn(
+                "optimizer.set_state_dict: ignoring slot groups that "
+                f"match no current parameter by name+shape: {dropped}")
+        for name, st in matched.items():
             self._state.setdefault(name, {}).update(st)
 
 

@@ -239,14 +239,14 @@ def divide(x, y):
 
 def matmul(x, y):
     """sparse @ dense → dense (the TPU-profitable case); sparse @
-    sparse → sparse."""
+    sparse → sparse (upstream COO@COO parity)."""
     if isinstance(y, (Tensor, jnp.ndarray, np.ndarray)):
         out = _to_bcoo(x) @ unwrap(y)
         return Tensor(out)
     out = jsparse.bcoo_dot_general(
-        _to_bcoo(x), _to_bcoo(y).todense(),
+        _to_bcoo(x), _to_bcoo(y),
         dimension_numbers=(((1,), (0,)), ((), ())))
-    return Tensor(out)
+    return SparseCooTensor(out)
 
 
 def masked_matmul(x, y, mask: "SparseCooTensor"):

@@ -160,6 +160,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
     rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
     bin_h, bin_w = rh / ph, rw / pw
+    # sampling_ratio=-1: upstream adapts the lattice per RoI
+    # (ceil(roi_size/output_size)), which is data-dependent and
+    # incompatible with XLA static shapes.  We use a fixed 2x2 lattice —
+    # the detectron2/torchvision default — so outputs diverge from the
+    # adaptive reference for RoIs much larger than the output grid.
+    # Pass an explicit sampling_ratio for exact parity at a known scale.
     sr = sampling_ratio if sampling_ratio > 0 else 2
     # sample grid: [R, ph, sr] y coords, [R, pw, sr] x coords
     sy = (y1[:, None, None] + (jnp.arange(ph)[None, :, None]) *

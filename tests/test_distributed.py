@@ -532,3 +532,61 @@ def test_auto_parallel_shard_tensor_engine_mesh():
     l1 = float(np.asarray(eng._runner.train_step([x], [y])))
     l2 = float(np.asarray(eng._runner.train_step([x], [y])))
     assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_runner_uses_externally_restored_weights():
+    """ADVICE r1: the runner's value cache must not serve stale weights
+    after an external in-place restore (set_state_dict writing _value)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.runner import DistributedRunner
+
+    collective.set_mesh(None)
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    sd0 = {k: np.asarray(v.numpy()).copy()
+           for k, v in net.state_dict().items()}
+    opt = optimizer.SGD(learning_rate=0.5, parameters=net.parameters())
+    mesh = collective.build_mesh({})
+    runner = DistributedRunner(net, opt, nn.MSELoss(), mesh=mesh)
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 4).astype(np.float32)
+    y = rng.rand(2, 4).astype(np.float32)
+    loss_fresh = float(runner.eval_step([x], [y]))
+    runner.train_step([x], [y])          # mutates weights + caches values
+    moved = float(runner.eval_step([x], [y]))
+    assert moved != loss_fresh
+    net.set_state_dict({k: paddle.to_tensor(v) for k, v in sd0.items()})
+    restored = float(runner.eval_step([x], [y]))
+    np.testing.assert_allclose(restored, loss_fresh, rtol=1e-5)
+    # and a train step after restore starts from the restored weights:
+    l1 = float(runner.train_step([x], [y]))
+    np.testing.assert_allclose(
+        l1, loss_fresh, rtol=1e-5,
+        err_msg="train step after restore used stale cached weights")
+
+
+def test_engine_fit_empty_loader_raises():
+    import pytest as _pytest
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.io.dataset import Dataset
+
+    collective.set_mesh(None)
+
+    class Empty(Dataset):
+        def __len__(self):
+            return 0
+
+        def __getitem__(self, i):
+            raise IndexError(i)
+
+    net = nn.Linear(2, 1)
+    eng = Engine(net, loss=nn.MSELoss(),
+                 optimizer=optimizer.Adam(1e-2,
+                                          parameters=net.parameters()))
+    with _pytest.raises(ValueError, match="no batches"):
+        eng.fit(Empty(), epochs=1, batch_size=4, verbose=0)

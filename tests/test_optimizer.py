@@ -152,3 +152,47 @@ def test_multi_precision_master_weights():
     assert "master_weight" in st
     assert str(st["master_weight"].dtype) == "float32"
     assert p.dtype == paddle.bfloat16
+
+
+def test_set_state_dict_subset_not_remapped():
+    """ADVICE r1: a checkpoint holding state for a SUBSET of params with
+    matching names must be restored by name, never positionally."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    net = nn.Linear(3, 3)
+    opt = optimizer.Adam(1e-3, parameters=net.parameters())
+    names = [p.name for p in net.parameters()]
+    # checkpoint contains moment state for only the SECOND param
+    m = np.full((3,), 7.0, np.float32)
+    opt.set_state_dict({f"{names[1]}.moment1": paddle.to_tensor(m)})
+    assert names[1] in opt._state
+    np.testing.assert_allclose(
+        np.asarray(opt._state[names[1]]["moment1"]), m)
+    assert names[0] not in opt._state or \
+        "moment1" not in opt._state.get(names[0], {})
+
+
+def test_set_state_dict_cross_process_remap_warns():
+    """Pure cross-process case: NO name matches and counts agree →
+    positional remap, with a warning."""
+    import warnings as _warnings
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+
+    paddle.seed(0)
+    net = nn.Linear(3, 3)
+    opt = optimizer.Adam(1e-3, parameters=net.parameters())
+    names = [p.name for p in net.parameters()]
+    sd = {}
+    for i in range(len(names)):
+        sd[f"other_{i}.moment1"] = paddle.to_tensor(
+            np.full((3, 3) if i == 0 else (3,), float(i + 1), np.float32))
+    with _warnings.catch_warnings(record=True) as w:
+        _warnings.simplefilter("always")
+        opt.set_state_dict(sd)
+    assert any("remapping" in str(x.message) for x in w)
+    np.testing.assert_allclose(
+        np.asarray(opt._state[names[0]]["moment1"]),
+        np.full((3, 3), 1.0, np.float32))

@@ -115,3 +115,20 @@ def test_sparse_nn_relu_softmax():
         nz = d[r] != 0
         e = np.exp(d[r][nz] - d[r][nz].max())
         np.testing.assert_allclose(got[r][nz], e / e.sum(), rtol=1e-5)
+
+
+def test_sparse_sparse_matmul_returns_sparse():
+    """ADVICE r1: COO @ COO must return a sparse result (upstream
+    paddle.sparse.matmul parity), not a silently densified Tensor."""
+    from paddle_tpu import sparse
+    from paddle_tpu.sparse import SparseCooTensor
+
+    rng = np.random.RandomState(0)
+    a = rng.rand(4, 5).astype(np.float32) * (rng.rand(4, 5) > 0.5)
+    b = rng.rand(5, 3).astype(np.float32) * (rng.rand(5, 3) > 0.5)
+    sa = paddle.to_tensor(a).to_sparse_coo(2)
+    sb = paddle.to_tensor(b).to_sparse_coo(2)
+    out = sparse.matmul(sa, sb)
+    assert isinstance(out, SparseCooTensor)
+    np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                               a @ b, rtol=1e-5, atol=1e-6)

@@ -172,3 +172,60 @@ def test_multiclass_nms():
     assert out.shape[1] == 6
     labels = out[:, 0].astype(int).tolist()
     assert labels.count(0) == 1 and labels.count(1) == 1
+
+
+def ref_roi_align(x, boxes, img_idx, output_size, spatial_scale=1.0,
+                  sampling_ratio=2, aligned=True):
+    """Exact numpy roi_align oracle (fixed sampling lattice, bilinear
+    with coordinate clamping — the documented TPU semantics)."""
+    ph, pw = output_size
+    N, C, H, W = x.shape
+    R = boxes.shape[0]
+    out = np.zeros((R, C, ph, pw), np.float32)
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio
+    for r in range(R):
+        img = x[img_idx[r]]
+        x1, y1, x2, y2 = boxes[r] * spatial_scale - off
+        rw = max(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = max(y2 - y1, 1e-3 if aligned else 1.0)
+        bh, bw = rh / ph, rw / pw
+        for i in range(ph):
+            for j in range(pw):
+                acc = np.zeros(C, np.float32)
+                for ky in range(sr):
+                    for kx in range(sr):
+                        yy = min(max(y1 + i * bh + (ky + .5) / sr * bh,
+                                     0), H - 1)
+                        xx = min(max(x1 + j * bw + (kx + .5) / sr * bw,
+                                     0), W - 1)
+                        y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+                        y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                        wy, wx = yy - y0, xx - x0
+                        acc += (img[:, y0, x0] * (1 - wy) * (1 - wx) +
+                                img[:, y0, x1_] * (1 - wy) * wx +
+                                img[:, y1_, x0] * wy * (1 - wx) +
+                                img[:, y1_, x1_] * wy * wx)
+                out[r, :, i, j] = acc / (sr * sr)
+    return out
+
+
+def test_roi_align_matches_numpy_oracle():
+    """ADVICE r1: verify on non-constant input against an exact oracle
+    (previous tests only used constant feature maps)."""
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 16, 16).astype(np.float32)
+    boxes = np.array([[1.0, 1.0, 9.0, 13.0],
+                      [0.5, 2.0, 14.0, 8.0],
+                      [3.0, 3.0, 12.0, 12.0]], np.float32)
+    boxes_num = np.array([2, 1])
+    img_idx = np.array([0, 0, 1])
+    for sr in (1, 2, 4):
+        got = V.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                          paddle.to_tensor(boxes_num), (4, 4),
+                          spatial_scale=1.0, sampling_ratio=sr,
+                          aligned=True).numpy()
+        want = ref_roi_align(x, boxes, img_idx, (4, 4),
+                             sampling_ratio=sr, aligned=True)
+        np.testing.assert_allclose(np.asarray(got), want,
+                                   rtol=1e-4, atol=1e-5)
