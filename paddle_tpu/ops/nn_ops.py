@@ -10,6 +10,7 @@ kept at the API level; XLA:TPU internally re-lays out as needed.
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Sequence, Union
 
 import numpy as np
@@ -183,7 +184,7 @@ def _pool(x, kernel, stride, padding, init, op, data_format="NCHW",
         if count_include_pad or (isinstance(pad, str) and pad == "VALID") \
                 or (not isinstance(pad, str)
                     and all(p == (0, 0) for p in pad)):
-            out = out / np.prod(k)
+            out = out / float(np.prod(k))  # weak float: no f64 promotion
         else:
             ones = jnp.ones_like(x)
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, dims,
@@ -241,7 +242,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                 ceil_mode=ceil_mode)
     if divisor_override:
         k = _pair(kernel_size)
-        out = out * (np.prod(k) / divisor_override)
+        out = out * (float(np.prod(k)) / divisor_override)
     return out
 
 
@@ -706,7 +707,10 @@ def _sdpa(query, key, value, attn_mask, dropout_key, dropout_p=0.0,
     q = jnp.swapaxes(query, 1, 2)  # → B,H,S,D
     k = jnp.swapaxes(key, 1, 2)
     v = jnp.swapaxes(value, 1, 2)
-    scale = 1.0 / np.sqrt(q.shape[-1])
+    # math.sqrt (weak Python float), NOT np.sqrt: a strong np.float64
+    # scalar would silently promote the whole attention to f64 under
+    # the global jax_enable_x64 — catastrophic on the MXU
+    scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     if is_causal:
         sq, sk = logits.shape[-2], logits.shape[-1]
