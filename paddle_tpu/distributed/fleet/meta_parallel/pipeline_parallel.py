@@ -30,6 +30,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from jax.sharding import PartitionSpec as P
+
 from ....tensor import Tensor
 from ....nn import functional_call as F
 from ... import collective as coll
@@ -47,7 +49,7 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x_micro: Any,
     """
     mesh = mesh or coll.ensure_mesh()
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     num_micro = x_micro.shape[0]
     T = num_micro + num_stages - 1
@@ -94,7 +96,7 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x_micro: Any,
         per_stage, mesh=mesh,
         in_specs=(spec_params, P()),
         out_specs=P(),
-        check_rep=False)(stacked_params, x_micro)
+        check_vma=False)(stacked_params, x_micro)
     return out
 
 
@@ -120,7 +122,7 @@ def pipeline_spmd_interleaved(stage_fn: Callable, stacked_params: Any,
     """
     mesh = mesh or coll.ensure_mesh()
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     V, Pdeg = vpp_degree, num_stages
     S = Pdeg * V
@@ -175,12 +177,89 @@ def pipeline_spmd_interleaved(stage_fn: Callable, stacked_params: Any,
         per_device, mesh=mesh,
         in_specs=(spec_params, P()),
         out_specs=P(),
-        check_rep=False)(params_vp, x_micro)
+        check_vma=False)(params_vp, x_micro)
+
+
+def _type_key(layer):
+    """Structural identity of a layer: type + param tree structure."""
+    shapes = tuple((n, tuple(np.shape(p._value)))
+                   for n, p in sorted(layer.named_parameters()))
+    return (type(layer).__name__, shapes)
+
+
+def split_pipeline_sections(net, pattern: Optional[str] = None):
+    """Split a PipelineLayer's item list into (pre, body, post).
+
+    ``body`` is the contiguous run of structurally identical Layers that
+    gets pipelined over the 'pp' mesh axis (the GPT decoder stack);
+    ``pre``/``post`` (embedding / final-norm+head and any plain
+    callables) run replicated outside the shard loop.  This is the
+    TPU-native answer to upstream's per-rank LayerDesc segmentation
+    (SURVEY.md §3.4): non-uniform edges become replicated closures, the
+    uniform middle becomes one stacked, stage-sharded tensor program.
+    """
+    items = list(zip(net.run_function, net._funcs))
+    if pattern:
+        idx = [i for i, (l, _) in enumerate(items)
+               if l is not None and pattern in type(l).__name__]
+    else:
+        # maximal contiguous run of structurally identical layers
+        best = (0, 0)  # (length, start)
+        i = 0
+        n = len(items)
+        while i < n:
+            l = items[i][0]
+            if l is None:
+                i += 1
+                continue
+            k = _type_key(l)
+            j = i
+            while j < n and items[j][0] is not None and \
+                    _type_key(items[j][0]) == k:
+                j += 1
+            if j - i > best[0]:
+                best = (j - i, i)
+            i = j
+        idx = list(range(best[1], best[1] + best[0])) if best[0] else []
+    if not idx:
+        raise ValueError(
+            "pipeline body not found: no contiguous run of identical "
+            "layers to shard over 'pp' (seg_method pattern matched "
+            "nothing)")
+    lo, hi = idx[0], idx[-1] + 1
+    if idx != list(range(lo, hi)):
+        raise ValueError(
+            "pipeline body must be contiguous; matched layer indices "
+            f"{idx} have gaps")
+    body = [items[i][0] for i in range(lo, hi)]
+    keys = {_type_key(l) for l in body}
+    if len(keys) != 1:
+        raise ValueError(
+            "pipeline body layers are not structurally identical: "
+            f"{sorted(k[0] for k in keys)}")
+    return items[:lo], body, items[hi:]
 
 
 class PipelineParallel:
-    """Stateful train driver (upstream API: train_batch).  Wraps a
-    PipelineLayer + optimizer; compiles the full microbatch loop."""
+    """Stateful train driver (upstream API parity:
+    fleet/meta_parallel/pipeline_parallel.py — PipelineParallel
+    .train_batch, SURVEY.md §3.4).
+
+    TPU-native engine: the whole microbatch schedule is ONE compiled
+    program.  Body weights live STACKED [P, ...] and sharded on the
+    'pp' mesh axis (stage-resident storage, like upstream's per-rank
+    ownership); the GPipe loop is a ``lax.scan`` whose carried buffer
+    [P, micro, ...] rotates stage→stage via ``jnp.roll`` on the
+    pp-sharded axis — XLA lowers the roll to collective-permute over
+    the ICI ring, and ``jax.grad`` differentiates straight through
+    (reverse permute = backward sends).  Embedding/head (non-uniform
+    edges) run replicated outside the loop; tied weights flow through
+    shared traced values so their grads accumulate exactly once.
+
+    Composes with dp / mp / sharding axes of the same mesh purely via
+    sharding constraints — the decoder's mp layers keep their Megatron
+    specs inside the vmapped stage body.
+    """
 
     def __init__(self, layers, hcg, strategy):
         self._layers = layers
@@ -189,16 +268,346 @@ class PipelineParallel:
         cfg = strategy.pipeline_configs if strategy else {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
-        self._train_fn = None
+        self._train_fn = None          # pipelined (pp>1) compiled step
+        self._inline_fn = None         # pp=1 compiled step (distinct sig)
+        self._plan = None
+        self._opt_tree = None
+
+    # -- planning ------------------------------------------------------------
+    def _build_plan(self, mesh):
+        from jax.sharding import NamedSharding
+        net = self._layers
+        P_deg = int(mesh.shape.get("pp", 1))
+        pat = None
+        seg = getattr(net, "_seg_method", "uniform") or "uniform"
+        if seg.startswith("layer:"):
+            pat = seg.split("layer:", 1)[1]
+        pre, body, post = split_pipeline_sections(net, pat)
+        if len(body) % P_deg != 0:
+            raise ValueError(
+                f"pipeline body has {len(body)} layers, not divisible by "
+                f"pp degree {P_deg}")
+        per = len(body) // P_deg
+        if any(b is not None for _, b in
+               ((n, v) for l in body for n, v in l.named_buffers())):
+            raise NotImplementedError(
+                "pipelined body layers with buffers (e.g. BatchNorm "
+                "running stats) are not supported; keep stateful layers "
+                "in the pre/post sections")
+
+        named = list(net.named_parameters())
+        id2g = {id(p): n for n, p in named}
+        gname_to_param = dict(named)
+        body_ids = set()
+        # stacked leaf bookkeeping: (pos j, local name) → [gname per stage]
+        stack_index: Dict[tuple, List[str]] = {}
+        rep_layers = body[:per]          # stage-0 chunk traces all stages
+        for s in range(P_deg):
+            for j in range(per):
+                layer = body[s * per + j]
+                for local, p in layer.named_parameters():
+                    g = id2g[id(p)]
+                    stack_index.setdefault((j, local), []).append(g)
+                    body_ids.add(id(p))
+        for (j, local), gs in stack_index.items():
+            if len(gs) != P_deg:
+                raise ValueError(
+                    f"body param {local!r} at position {j} appears in "
+                    f"{len(gs)} stages, expected {P_deg} (shared weights "
+                    "inside the body are not supported)")
+
+        def stack_name(j, local):
+            return f"pp_stack.{j}.{local}"
+
+        plan = {
+            "mesh": mesh, "P": P_deg, "per": per,
+            "pre": pre, "post": post, "rep_layers": rep_layers,
+            "stack_index": stack_index, "stack_name": stack_name,
+            "id2g": id2g, "gname_to_param": gname_to_param,
+            "body_ids": body_ids,
+            "bid2g": {id(b): n for n, b in net.named_buffers()
+                      if b is not None},
+        }
+        return plan
+
+    def _place(self, optimizer):
+        """Build + device_put the flat value dicts: pre/post params under
+        their global names, body params stacked [P, ...] on 'pp'."""
+        from jax.sharding import NamedSharding
+        plan = self._plan
+        mesh = plan["mesh"]
+        net = self._layers
+
+        def put(v, spec):
+            return jax.device_put(v, NamedSharding(mesh, spec))
+
+        params, frozen = {}, {}
+        decay, lrs = {}, {}
+        opt = optimizer if hasattr(optimizer, "apply_gradients_tree") \
+            else optimizer._inner_opt
+        for g, p in plan["gname_to_param"].items():
+            if id(p) in plan["body_ids"]:
+                continue
+            spec = P(*p.dist_spec) if getattr(p, "dist_spec", None) \
+                else P()
+            tgt = frozen if p.stop_gradient else params
+            p._value = put(p._value, spec)
+            tgt[g] = p._value
+            if not p.stop_gradient:
+                decay[g] = float(opt._param_decay(p))
+                lrs[g] = float(p.optimize_attr.get("learning_rate", 1.0))
+        for (j, local), gs in plan["stack_index"].items():
+            ps = [plan["gname_to_param"][g] for g in gs]
+            rep = ps[0]
+            spec = (("pp",) + tuple(rep.dist_spec)
+                    if getattr(rep, "dist_spec", None)
+                    else ("pp",) + (None,) * rep._value.ndim)
+            leaf = put(jnp.stack([p._value for p in ps]), P(*spec))
+            name = plan["stack_name"](j, local)
+            tgt = frozen if rep.stop_gradient else params
+            tgt[name] = leaf
+            if not rep.stop_gradient:
+                decay[name] = float(opt._param_decay(rep))
+                lrs[name] = float(
+                    rep.optimize_attr.get("learning_rate", 1.0))
+        self._params, self._frozen = params, frozen
+        self._decay, self._lrs = decay, lrs
+        self._buffers = {n: b._value for n, b in net.named_buffers()
+                         if b is not None}
+        if self._opt_tree is None:
+            existing = getattr(optimizer, "_opt_state_tree", None)
+            if existing is not None:
+                if set(existing) != set(params):
+                    raise ValueError(
+                        "optimizer already carries state keyed for a "
+                        "non-pipelined run; pipelined training keys body "
+                        "state per stacked stage — use a fresh optimizer "
+                        "or restore a pipelined checkpoint")
+                self._opt_tree = existing
+            else:
+                self._opt_tree = opt.init_state_tree(params)
+        self._opt = opt
+
+    # -- the compiled step ---------------------------------------------------
+    def _build_step(self):
+        plan = self._plan
+        mesh = plan["mesh"]
+        P_deg, per = plan["P"], plan["per"]
+        net = self._layers
+        daxes = tuple(a for a in ("dp", "sharding")
+                      if a in mesh.axis_names and mesh.shape[a] > 1)
+        dspec = daxes if daxes else None
+        rep_layers = plan["rep_layers"]
+        stack_name, stack_index = plan["stack_name"], plan["stack_index"]
+        id2g = plan["id2g"]
+        from jax.sharding import NamedSharding
+        from ....autograd import tape as _tape
+
+        def cons(v, *spec):
+            return jax.lax.with_sharding_constraint(
+                v, NamedSharding(mesh, P(*spec)))
+
+        def bind_map(layer, p_all):
+            """Local-name → traced value for a pre/post layer, following
+            tied params into their canonical global entry."""
+            out = {}
+            for local, pobj in layer.named_parameters():
+                g = id2g[id(pobj)]
+                out[local] = p_all[g]
+            return out
+
+        def buf_map(layer, b_all):
+            return {local: b_all[g]
+                    for local, g in
+                    ((ln, bid2g.get(id(bobj)))
+                     for ln, bobj in layer.named_buffers()
+                     if bobj is not None)
+                    if g is not None and g in b_all}
+
+        bid2g = plan["bid2g"]
+
+        def run_section(items, p_all, b_all, x, new_bufs):
+            """new_bufs: dict collecting buffer updates (global names)."""
+            for layer, fn in items:
+                if layer is None:
+                    x = fn(*x) if isinstance(x, tuple) else fn(x)
+                    continue
+                bm = bind_map(layer, p_all)
+                bufm = buf_map(layer, b_all)
+                with F.bind(layer, bm, bufm or None) as holder:
+                    with _tape.no_grad_ctx():
+                        t = x if isinstance(x, Tensor) else Tensor(x)
+                        out = fn(layer, t) if fn is not None else layer(t)
+                for local, v in holder.get("buffers", {}).items():
+                    g = None
+                    for ln, bobj in layer.named_buffers():
+                        if ln == local and bobj is not None:
+                            g = bid2g.get(id(bobj))
+                    if g is not None:
+                        new_bufs[g] = v
+                x = out
+            return x if isinstance(x, Tensor) else Tensor(x)
+
+        from ....framework import random as _random
+
+        def stage_fn(stage_params, x, tick_key):
+            """One pipeline stage = `per` body layers, traced on the
+            stage-0 chunk, bound with this stage's param slices.  The
+            dropout key is distinct per (tick, stage): tick keys come
+            through the scan, the stage index through the vmap axis."""
+            sidx = jax.lax.axis_index("pp_stage")
+            key_s = jax.random.fold_in(tick_key, sidx)
+            t = Tensor(x)
+            with _random.key_provider(_random.make_split_provider(key_s)):
+                for j, layer in enumerate(rep_layers):
+                    bm = {local: stage_params[(j, local)]
+                          for (jj, local) in stack_index if jj == j}
+                    with F.bind(layer, bm):
+                        with _tape.no_grad_ctx():
+                            t = layer(t)
+            return t._value
+
+        def step(params, frozen, buffers, opt_state, lr, key, xs, ys):
+            # xs/ys: [M, Bm, ...] microbatched; batch dim on dp axes
+            M = xs.shape[0]
+            if dspec:
+                xs = cons(xs, None, dspec)
+                ys = cons(ys, None, dspec)
+
+            def loss_fn(p):
+                pa = {**p, **frozen}
+                new_bufs = {}
+                with _random.key_provider(
+                        _random.make_split_provider(key)):
+                    # pre (embedding): merge microbatches, run replicated
+                    flat_in = xs.reshape((-1,) + xs.shape[2:])
+                    h = run_section(plan["pre"], pa, buffers, flat_in,
+                                    new_bufs)._value
+                    h = h.reshape((M,) + (xs.shape[1],) + h.shape[1:])
+                    if dspec:
+                        h = cons(h, None, dspec)
+
+                    # stacked stage params for vmap: leading axis P
+                    sp = {(j, local): pa[stack_name(j, local)]
+                          for (j, local) in stack_index}
+
+                    fn = jax.checkpoint(stage_fn)
+                    T = M + P_deg - 1
+                    pad = jnp.zeros((P_deg - 1,) + h.shape[1:], h.dtype)
+                    h_pad = jnp.concatenate([h, pad], 0)
+                    buf0 = jnp.zeros((P_deg,) + h.shape[1:], h.dtype)
+                    tick_keys = jax.random.split(key, T)
+
+                    def tick(buf, x_key):
+                        x_t, k_t = x_key
+                        buf = buf.at[0].set(x_t)
+                        buf = cons(buf, "pp", dspec)
+                        y = jax.vmap(fn, in_axes=(0, 0, None),
+                                     axis_name="pp_stage")(sp, buf, k_t)
+                        y = cons(y, "pp", dspec)
+                        out_t = y[P_deg - 1]
+                        return jnp.roll(y, 1, axis=0), out_t
+
+                    _, outs = jax.lax.scan(tick, buf0, (h_pad, tick_keys))
+                    outs = outs[P_deg - 1:]           # [M, Bm, ...]
+                    flat = outs.reshape((-1,) + outs.shape[2:])
+                    if dspec:
+                        flat = cons(flat, dspec)
+                    logits = run_section(plan["post"], pa, buffers, flat,
+                                         new_bufs)
+                    flat_y = ys.reshape((-1,) + ys.shape[2:])
+                    if net._loss_fn is not None:
+                        loss = net._loss_fn(logits, Tensor(flat_y))
+                    else:
+                        loss = logits
+                    return (loss._value.mean().astype(jnp.float32),
+                            new_bufs)
+
+            (loss, new_bufs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_p, new_s = self._opt.apply_gradients_tree(
+                params, grads, opt_state, lr,
+                decay_coeffs=self._decay, lr_scales=self._lrs)
+            return loss, new_p, new_s, new_bufs
+
+        return jax.jit(step, donate_argnums=(0, 3))
+
+    def _commit(self, new_p, new_s, new_bufs=None):
+        """Write step results back into the engine store and the layer
+        tree (body Parameters get lazy on-device slices of the stacks)."""
+        plan = self._plan
+        self._params = new_p
+        self._opt_tree = new_s
+        if new_bufs:
+            for g, v in new_bufs.items():
+                self._buffers[g] = v
+            for n, b in self._layers.named_buffers():
+                if b is not None and n in new_bufs:
+                    b._value = new_bufs[n]
+        for g, p in plan["gname_to_param"].items():
+            if id(p) in plan["body_ids"] or g not in new_p:
+                continue
+            p._value = new_p[g]
+        for (j, local), gs in plan["stack_index"].items():
+            leaf = new_p.get(plan["stack_name"](j, local))
+            if leaf is None:
+                continue
+            for s, g in enumerate(gs):
+                plan["gname_to_param"][g]._value = leaf[s]
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """data: (inputs, labels) full batch; splits into microbatches,
-        runs the compiled pipeline fwd+bwd+update, returns mean loss."""
+        """data: (inputs, labels) full batch; splits into
+        ``accumulate_steps`` microbatches and runs the compiled pipeline
+        fwd+bwd+update over the 'pp' mesh axis; returns the mean loss."""
         inputs, labels = data
         inputs_v = inputs._value if isinstance(inputs, Tensor) else \
             jnp.asarray(np.asarray(inputs))
         labels_v = labels._value if isinstance(labels, Tensor) else \
             jnp.asarray(np.asarray(labels))
+        mesh = coll.get_mesh() or coll.ensure_mesh()
+        if int(mesh.shape.get("pp", 1)) <= 1:
+            # pp=1: no pipeline axis — run the microbatch loop inline
+            # (plain compiled gradient accumulation, same semantics)
+            return self._train_batch_inline(inputs_v, labels_v, optimizer,
+                                            lr_scheduler)
+        if self._plan is None:
+            self._plan = self._build_plan(mesh)
+            self._place(optimizer)
+        M = max(int(self.accumulate_steps), 1)
+        if inputs_v.shape[0] % M != 0:
+            raise ValueError(
+                f"batch {inputs_v.shape[0]} not divisible by "
+                f"accumulate_steps {M}")
+        xs = inputs_v.reshape((M, -1) + tuple(inputs_v.shape[1:]))
+        ys = labels_v.reshape((M, -1) + tuple(labels_v.shape[1:]))
+        lr = jnp.asarray(
+            optimizer.get_lr() if hasattr(optimizer, "get_lr") else 1e-3,
+            dtype=jnp.float32)
+        from ....framework import random as _random
+        key = _random.default_generator().draw_key()
+        prev = coll.get_mesh()
+        coll.set_mesh(mesh)
+        try:
+            if self._train_fn is None:
+                self._train_fn = self._build_step()
+            loss, new_p, new_s, new_bufs = self._train_fn(
+                self._params, self._frozen, self._buffers,
+                self._opt_tree, lr, key, xs, ys)
+        finally:
+            coll.set_mesh(prev)
+        self._commit(new_p, new_s, new_bufs)
+        # keep the optimizer's canonical state slot in sync so
+        # checkpointing and later (pipelined) runs see the moments
+        optimizer._opt_state_tree = self._opt_tree
+        if hasattr(optimizer, "_global_step"):
+            optimizer._global_step += 1
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss)
+
+    def _train_batch_inline(self, inputs_v, labels_v, optimizer,
+                            lr_scheduler=None):
+        """pp=1 path: compiled microbatch accumulation on one replica."""
         net = self._layers
         params = F.param_dict(net)
         frozen = F.frozen_dict(net)
@@ -210,9 +619,16 @@ class PipelineParallel:
                 else optimizer._inner_opt.init_state_tree(params))
         opt = optimizer if hasattr(optimizer, "apply_gradients_tree") \
             else optimizer._inner_opt
+        name_to_param = dict(net.named_parameters())
+        # per-param weight-decay / lr multipliers — SAME contract as the
+        # pipelined path (ParamAttr regularizer / learning_rate parity)
+        decay = {n: float(opt._param_decay(p))
+                 for n, p in name_to_param.items() if not p.stop_gradient}
+        lrs = {n: float(p.optimize_attr.get("learning_rate", 1.0))
+               for n, p in name_to_param.items() if not p.stop_gradient}
 
-        if self._train_fn is None:
-            M = self.accumulate_steps
+        if self._inline_fn is None:
+            M = max(int(self.accumulate_steps), 1)
 
             def step(params, frozen, buffers, opt_state, lr, xs, ys):
                 def loss_fn(p):
@@ -221,8 +637,8 @@ class PipelineParallel:
                             from ....autograd import tape as _tape
                             with _tape.no_grad_ctx():
                                 out = net(Tensor(x))
-                                loss = self._layers._loss_fn(out, Tensor(y)) \
-                                    if self._layers._loss_fn else out
+                                loss = net._loss_fn(out, Tensor(y)) \
+                                    if net._loss_fn else out
                         return loss._value.mean().astype(jnp.float32)
 
                     losses = [micro_loss(xs[i], ys[i]) for i in range(M)]
@@ -230,24 +646,25 @@ class PipelineParallel:
 
                 loss, grads = jax.value_and_grad(loss_fn)(params)
                 new_p, new_s = opt.apply_gradients_tree(
-                    params, grads, opt_state, lr)
+                    params, grads, opt_state, lr,
+                    decay_coeffs=decay, lr_scales=lrs)
                 return loss, new_p, new_s
 
-            self._train_fn = jax.jit(step)
+            self._inline_fn = jax.jit(step)
 
-        xs = inputs_v.reshape((self.accumulate_steps, -1)
-                              + tuple(inputs_v.shape[1:]))
-        ys = labels_v.reshape((self.accumulate_steps, -1)
-                              + tuple(labels_v.shape[1:]))
+        M = max(int(self.accumulate_steps), 1)
+        xs = inputs_v.reshape((M, -1) + tuple(inputs_v.shape[1:]))
+        ys = labels_v.reshape((M, -1) + tuple(labels_v.shape[1:]))
         lr = jnp.asarray(
             optimizer.get_lr() if hasattr(optimizer, "get_lr") else 1e-3,
             dtype=jnp.float32)
-        loss, new_p, new_s = self._train_fn(
+        loss, new_p, new_s = self._inline_fn(
             params, frozen, buffers, optimizer._opt_state_tree, lr, xs, ys)
-        name_to_param = dict(net.named_parameters())
         for n, v in new_p.items():
             name_to_param[n]._value = v
         optimizer._opt_state_tree = new_s
+        if hasattr(optimizer, "_global_step"):
+            optimizer._global_step += 1
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor(loss)

@@ -51,7 +51,7 @@ def test_topology_groups():
 
 def test_collectives_inside_shard_map():
     _need_devices(8)
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed.communication import Group
     mesh = collective.build_mesh({"dp": 8})
@@ -590,3 +590,293 @@ def test_engine_fit_empty_loader_raises():
                                           parameters=net.parameters()))
     with _pytest.raises(ValueError, match="no batches"):
         eng.fit(Empty(), epochs=1, batch_size=4, verbose=0)
+
+
+# ---------------------------------------------------------------------------
+# Real-model pipeline parallelism (upstream PipelineParallel.train_batch,
+# SURVEY.md §3.4): GPT with embedding/head edges + uniform decoder body,
+# pipelined over the 'pp' mesh axis, loss parity vs serial.
+# ---------------------------------------------------------------------------
+def _serial_gpt_losses(cfg, x, y, steps=3):
+    from paddle_tpu.models import GPTForCausalLM, GPTPretrainingCriterion
+
+    paddle.seed(0)
+    net = GPTForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    mesh1 = collective.build_mesh({}, devices=jax.devices()[:1])
+    collective.set_mesh(mesh1)
+    runner = DistributedRunner(net, opt, GPTPretrainingCriterion(),
+                               mesh=mesh1)
+    return [float(runner.train_step([x], [y])) for _ in range(steps)]
+
+
+def _pipe_gpt_losses(cfg, x, y, mesh_degrees, steps=3,
+                     accumulate_steps=4):
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallel
+    from paddle_tpu.models import GPTForCausalLMPipe
+
+    paddle.seed(0)
+    net = GPTForCausalLMPipe(cfg, num_stages=mesh_degrees.get("pp", 1))
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    mesh = collective.build_mesh(mesh_degrees)
+    collective.set_mesh(mesh)
+
+    class _Strat:
+        pipeline_configs = {"accumulate_steps": accumulate_steps,
+                            "micro_batch_size": 2}
+
+    eng = PipelineParallel(net, None, _Strat())
+    return [float(eng.train_batch((x, y), opt)) for _ in range(steps)], net
+
+
+def test_pipeline_real_gpt_pp2_matches_serial():
+    _need_devices(2)
+    from paddle_tpu.models import gpt_tiny
+
+    cfg = gpt_tiny(use_flash_attention=False)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    serial = _serial_gpt_losses(cfg, x, y)
+    pp, net = _pipe_gpt_losses(cfg, x, y, {"pp": 2})
+    np.testing.assert_allclose(pp, serial, rtol=1e-4)
+    # losses actually decrease (the optimizer update went through)
+    assert pp[2] < pp[0]
+    # committed body weights are readable from the layer tree (slices of
+    # the stage-resident stacks)
+    p0 = list(net.named_parameters())[5][1]
+    assert np.isfinite(np.asarray(p0._value)).all()
+
+
+def test_pipeline_real_gpt_hybrid_dp2_mp2_pp2():
+    _need_devices(8)
+    from paddle_tpu.models import gpt_tiny
+
+    cfg = gpt_tiny(use_flash_attention=False)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    serial = _serial_gpt_losses(cfg, x, y)
+    hyb, _ = _pipe_gpt_losses(cfg, x, y, {"pp": 2, "dp": 2, "mp": 2})
+    np.testing.assert_allclose(hyb, serial, rtol=1e-3)
+
+
+def test_pipeline_fleet_wrapper_routes_to_engine():
+    _need_devices(2)
+    from paddle_tpu.models import gpt_tiny, GPTForCausalLMPipe
+
+    cfg = gpt_tiny(use_flash_attention=False)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    mesh = collective.build_mesh({"pp": 2}, devices=jax.devices()[:2])
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = GPTForCausalLMPipe(cfg, num_stages=2)
+    model = fleet.distributed_model(net)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    l1 = float(model.train_batch((x, y), opt))
+    l2 = float(model.train_batch((x, y), opt))
+    assert np.isfinite(l1) and np.isfinite(l2) and l2 < l1
+
+
+def test_pipeline_body_split_validation():
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import split_pipeline_sections
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+
+    class Body(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    net = PipelineLayer([nn.Linear(4, 4), Body(), Body(), Body(),
+                         nn.Linear(4, 8)], num_stages=3)
+    pre, body, post = split_pipeline_sections(net, None)
+    # maximal uniform run = the three Body layers; Linear(4,4) and
+    # Linear(4,8) differ structurally from Body so they land on the edges
+    assert len(body) == 3 and len(pre) == 1 and len(post) == 1
+
+
+def test_hybrid_step_compiles_without_involuntary_remat(capfd):
+    """Round-2 weak #2: activation constraints pinning batch dims to
+    replicated forced XLA's replicate-then-repartition path on every
+    decoder add.  The mp layers now leave non-mp dims UNCONSTRAINED;
+    this guards the fix by failing on the XLA SPMD warning."""
+    _need_devices(8)
+    from paddle_tpu.models import (gpt_tiny, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    mesh = collective.build_mesh({"dp": 2, "mp": 2, "sharding": 2})
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    cfg = gpt_tiny(use_flash_attention=False)
+    net = GPTForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    runner = DistributedRunner(net, opt, GPTPretrainingCriterion(),
+                               mesh=mesh, sharding_stage=2)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (8, 48)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    loss = float(runner.train_step([x], [y]))
+    assert np.isfinite(loss)
+    captured = capfd.readouterr()
+    assert "Involuntary full rematerialization" not in captured.err, \
+        "XLA SPMD replicate-then-repartition reshard is back: " + \
+        captured.err[-2000:]
+
+
+def test_model_fit_on_mesh_matches_single_replica():
+    """hapi.Model delegates to DistributedRunner when a mesh is active
+    (round-2 weak #3: unified train-step engines): loss parity between
+    the sharded fit and the plain single-replica fit."""
+    _need_devices(2)
+    import paddle_tpu.hapi as hapi
+    from paddle_tpu import metric as M
+    from paddle_tpu.io.dataset import Dataset
+
+    class Synth(Dataset):
+        def __init__(self, n=32):
+            rng = np.random.RandomState(7)
+            self.x = rng.rand(n, 1, 28, 28).astype(np.float32)
+            self.y = rng.randint(0, 10, (n, 1)).astype(np.int64)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    def run(mesh):
+        collective.set_mesh(mesh)
+        paddle.seed(0)
+        from paddle_tpu.vision.models import LeNet
+        net = LeNet()
+        model = hapi.Model(net)
+        opt = optimizer.Adam(learning_rate=1e-3,
+                             parameters=net.parameters())
+        model.prepare(opt, nn.CrossEntropyLoss(), M.Accuracy())
+        losses = []
+        for _ in range(3):
+            loss, _ = model.train_batch(
+                [Synth().x[:8]], [Synth().y[:8]])
+            losses.append(float(loss[0]))
+        if mesh is not None:
+            assert model._runner is not None, \
+                "mesh active but Model did not delegate to the runner"
+        return losses
+
+    base = run(None)
+    mesh = collective.build_mesh({"dp": 2}, devices=jax.devices()[:2])
+    sharded = run(mesh)
+    np.testing.assert_allclose(sharded, base, rtol=2e-4)
+
+
+def test_model_fit_mesh_accumulation_smoke():
+    _need_devices(2)
+    import paddle_tpu.hapi as hapi
+    from paddle_tpu.io.dataset import Dataset
+
+    class Synth(Dataset):
+        def __init__(self, n=16):
+            rng = np.random.RandomState(3)
+            self.x = rng.rand(n, 4).astype(np.float32)
+            self.y = rng.rand(n, 2).astype(np.float32)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    mesh = collective.build_mesh({"dp": 2}, devices=jax.devices()[:2])
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    model = hapi.Model(net)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    model.prepare(opt, nn.MSELoss())
+    model.fit(Synth(), batch_size=8, epochs=2, verbose=0,
+              accumulate_grad_batches=2)
+    assert model._runner is not None
+    assert model._runner.accumulate_steps == 2
+
+
+def test_model_fit_accumulate_is_cross_batch():
+    """Review finding: accumulate_grad_batches must mean ONE optimizer
+    step per k loader batches (paddle semantics), not within-batch
+    splitting."""
+    _need_devices(2)
+    import paddle_tpu.hapi as hapi
+    from paddle_tpu.io.dataset import Dataset
+
+    class Synth(Dataset):
+        def __init__(self, n=16):
+            rng = np.random.RandomState(3)
+            self.x = rng.rand(n, 4).astype(np.float32)
+            self.y = rng.rand(n, 2).astype(np.float32)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    mesh = collective.build_mesh({"dp": 2}, devices=jax.devices()[:2])
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    model = hapi.Model(net)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    model.prepare(opt, nn.MSELoss())
+    # 16 samples / batch 4 = 4 loader batches; k=2 → 2 steps per epoch
+    model.fit(Synth(), batch_size=4, epochs=1, verbose=0,
+              accumulate_grad_batches=2)
+    assert opt._global_step == 2, opt._global_step
+
+
+def test_pipeline_engine_syncs_optimizer_state():
+    """Review finding: pipelined steps must surface optimizer moments on
+    the optimizer object (checkpointing), and a state tree keyed for a
+    different layout must be refused, not silently re-initialized."""
+    _need_devices(2)
+    import pytest as _pytest
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallel
+    from paddle_tpu.models import gpt_tiny, GPTForCausalLMPipe
+
+    cfg = gpt_tiny(use_flash_attention=False)
+    rng = np.random.RandomState(0)
+    x = rng.randint(0, cfg.vocab_size, (4, 16)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    paddle.seed(0)
+    net = GPTForCausalLMPipe(cfg, num_stages=2)
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=net.parameters())
+    collective.set_mesh(collective.build_mesh(
+        {"pp": 2}, devices=jax.devices()[:2]))
+
+    class _Strat:
+        pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2}
+
+    eng = PipelineParallel(net, None, _Strat())
+    eng.train_batch((x, y), opt)
+    assert opt._opt_state_tree is not None
+    assert any(k.startswith("pp_stack.") for k in opt._opt_state_tree)
+
+    # a foreign (non-pipelined) state tree is refused
+    opt2 = optimizer.AdamW(learning_rate=1e-3,
+                           parameters=net.parameters())
+    opt2._opt_state_tree = {"bogus.weight": {}}
+    eng2 = PipelineParallel(net, None, _Strat())
+    with _pytest.raises(ValueError, match="fresh optimizer"):
+        eng2.train_batch((x, y), opt2)

@@ -106,7 +106,7 @@ def test_moe_expert_parallel_parity_on_mesh():
 
 def test_global_scatter_gather_roundtrip_on_mesh():
     _need_devices(8)
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed import collective
     mesh = collective.build_mesh({"mp": 8})
@@ -117,7 +117,7 @@ def test_global_scatter_gather_roundtrip_on_mesh():
         return global_gather.raw(s, axis_name="mp")
 
     out = shard_map(f, mesh=mesh, in_specs=P(), out_specs=P(),
-                    check_rep=False)(jnp.asarray(x))
+                    check_vma=False)(jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(out), x, rtol=1e-6, atol=1e-6)
 
 
