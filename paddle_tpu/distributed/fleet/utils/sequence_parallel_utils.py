@@ -14,19 +14,19 @@ import jax
 from ....tensor import Tensor
 from .... import ops
 from ....nn.layer import Layer
-from ..meta_parallel.mp_layers import (_constrain_op,
+from ..meta_parallel.mp_layers import (_constrain_op, U,
                                        ColumnParallelLinear,
                                        RowParallelLinear)
 
 
 def scatter(x):
     """Mark seq dim (axis 1 of [b, s, h]) sharded on 'mp'."""
-    return _constrain_op(x, spec=(None, "mp") + (None,) * (x.ndim - 2))
+    return _constrain_op(x, spec=(U, "mp") + (U,) * (x.ndim - 2))
 
 
 def all_gather(x):
-    """Back to replicated seq."""
-    return _constrain_op(x, spec=(None,) * x.ndim)
+    """Back to replicated seq (batch/hidden stay unconstrained)."""
+    return _constrain_op(x, spec=(U, None) + (U,) * (x.ndim - 2))
 
 
 class ScatterOp:
