@@ -41,7 +41,11 @@ _data_axes = coll.data_axes
 class DistributedRunner:
     def __init__(self, network, optimizer, loss_fn=None,
                  mesh: Optional[Mesh] = None, sharding_stage: int = 0,
-                 accumulate_steps: int = 1, input_specs=None):
+                 accumulate_steps: int = 1, input_specs=None,
+                 amp_level: Optional[str] = None,
+                 amp_dtype: str = "bfloat16",
+                 capture_outputs: bool = False,
+                 remat: bool = False):
         self.network = network
         self.optimizer = optimizer
         self.loss_fn = loss_fn
@@ -51,6 +55,16 @@ class DistributedRunner:
         # per-input PartitionSpec overrides (position → PartitionSpec or
         # None to keep the tensor out of the dspec heuristic below)
         self.input_specs = input_specs
+        # amp_level "O1": auto_cast around the forward inside the
+        # compiled step (O2 is param-level — use amp.decorate up front)
+        self.amp_level = amp_level
+        self.amp_dtype = amp_dtype
+        # capture_outputs: step also returns the network outputs
+        # (hapi.Model needs them for metrics)
+        self.capture_outputs = capture_outputs
+        # remat: jax.checkpoint around the per-microbatch loss —
+        # DistributedStrategy.recompute wiring (trade FLOPs for HBM)
+        self.remat = remat
         self._step_fn = None
         self._opt_state = None
         self._placed = False
@@ -154,25 +168,37 @@ class DistributedRunner:
                 data = tuple(place(i, d) for i, d in enumerate(data))
 
             def loss_of(p, bufs_in, micro_data, micro_key):
+                import contextlib
                 inputs = [Tensor(v) for v in micro_data[:n_in]]
                 labels = [Tensor(v) for v in micro_data[n_in:]]
+                amp_ctx = contextlib.nullcontext()
+                if runner.amp_level:
+                    from ..amp import auto_cast
+                    amp_ctx = auto_cast(level=runner.amp_level,
+                                        dtype=runner.amp_dtype)
                 with F.bind(net, p, bufs_in, frozen) as holder:
                     from ..autograd import tape as _tape
                     with _tape.no_grad_ctx():
                         with _random.key_provider(
                                 _random.make_split_provider(micro_key)):
-                            out = net(*inputs)
+                            with amp_ctx:
+                                out = net(*inputs)
+                            outs = out if isinstance(out, (list, tuple)) \
+                                else [out]
                             if loss_layer is not None:
-                                outs = out if isinstance(out, (list, tuple)) \
-                                    else [out]
                                 loss = loss_layer(*outs, *labels)
                             else:
-                                loss = out
-                return loss._value.astype(jnp.float32), holder.get(
-                    "buffers", {})
+                                loss = outs[0]
+                out_vals = ([o._value for o in outs]
+                            if runner.capture_outputs else [])
+                return loss._value.astype(jnp.float32), (
+                    holder.get("buffers", {}), out_vals)
+
+            if runner.remat:
+                loss_of = jax.checkpoint(loss_of)
 
             if acc == 1:
-                (loss_val, new_buf), grads = jax.value_and_grad(
+                (loss_val, (new_buf, out_vals)), grads = jax.value_and_grad(
                     loss_of, has_aux=True)(params, buffers, data, key)
             else:
                 # gradient accumulation (paddle gradient_merge parity):
@@ -186,21 +212,24 @@ class DistributedRunner:
                 def body(carry, xs):
                     g_acc, l_acc, bufs_c = carry
                     md, mk = xs
-                    (l, nb), g = jax.value_and_grad(
+                    (l, (nb, ov)), g = jax.value_and_grad(
                         loss_of, has_aux=True)(params, bufs_c, md, mk)
                     bufs_c = {**bufs_c, **nb}
                     g_acc = jax.tree_util.tree_map(
                         lambda a, b: a + b, g_acc, g)
-                    return (g_acc, l_acc + l, bufs_c), None
+                    return (g_acc, l_acc + l, bufs_c), ov
 
                 g0 = jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.result_type(p)),
                     params)
                 keys = jax.random.split(key, acc)
-                (grads, loss_sum, new_buf), _ = jax.lax.scan(
+                (grads, loss_sum, new_buf), out_stack = jax.lax.scan(
                     body,
                     (g0, jnp.asarray(0.0, jnp.float32), dict(buffers)),
                     (micro, keys))
+                # [acc, bm, ...] per output → full-batch [B, ...]
+                out_vals = [o.reshape((-1,) + o.shape[2:])
+                            for o in out_stack]
                 grads = jax.tree_util.tree_map(lambda g: g / acc, grads)
                 loss_val = loss_sum / acc
             if stage >= 2:
@@ -221,7 +250,7 @@ class DistributedRunner:
                 n: jax.lax.with_sharding_constraint(
                     v, NamedSharding(mesh, runner._pspecs.get(n, P())))
                 for n, v in new_params.items()}
-            return loss_val, new_params, new_state, new_buf
+            return loss_val, new_params, new_state, new_buf, out_vals
 
         return jax.jit(step, donate_argnums=(0, 3))
 
@@ -261,7 +290,7 @@ class DistributedRunner:
         self._step_ctr = getattr(self, "_step_ctr", 0) + 1
         ctr = jnp.uint32(self._step_ctr)
         params, frozen, bufs = self._sync_val_cache()
-        loss, new_p, new_s, new_buf = self._step_fn(
+        loss, new_p, new_s, new_buf, out_vals = self._step_fn(
             params, frozen, bufs,
             self._opt_state, lr, ctr, *inputs_v, *labels_v)
         for n, v in new_p.items():
@@ -273,6 +302,8 @@ class DistributedRunner:
             if b is not None:
                 b._value = v
                 bufs[n] = v
+        if self.capture_outputs:
+            return loss, out_vals
         return loss
 
     def _sync_val_cache(self):
@@ -316,6 +347,8 @@ class DistributedRunner:
         net = self.network
         loss_layer = self.loss_fn
 
+        capture = self.capture_outputs
+
         def run(params, frozen, buffers, *data):
             n_in = self._n_inputs if with_loss else len(data)
             inputs = [Tensor(v) for v in data[:n_in]]
@@ -328,7 +361,10 @@ class DistributedRunner:
                         outs = out if isinstance(out, (list, tuple)) \
                             else [out]
                         loss = loss_layer(*outs, *labels)
-                        return loss._value.astype(jnp.float32)
+                        lv = loss._value.astype(jnp.float32)
+                        if capture:
+                            return lv, [o._value for o in outs]
+                        return lv
             if isinstance(out, (list, tuple)):
                 return [o._value for o in out]
             return out._value

@@ -47,6 +47,8 @@ class Model:
         self._jit_train_step = None
         self._jit_eval_step = None
         self._opt_state = None
+        self._runner = None
+        self._accumulate = 1
         self.stop_training = False
 
     # -- preparation --------------------------------------------------------
@@ -69,6 +71,27 @@ class Model:
                 self._amp_dtype = amp_configs.get("dtype", "bfloat16")
         self._jit_train_step = None
         self._jit_eval_step = None
+        self._runner = None
+
+    def _mesh_runner(self):
+        """When a device mesh is active, train/eval delegate to THE
+        distributed engine (DistributedRunner) instead of the mesh-blind
+        single-replica step — one engine, one sharding story (upstream
+        hapi on fleet contract, SURVEY.md §3.1; round-2 weak #3)."""
+        from ..distributed import collective
+        mesh = collective.get_mesh()
+        if mesh is None or not self._use_jit or self._optimizer is None:
+            return None
+        if self._runner is not None and self._runner.mesh is mesh and \
+                self._runner.accumulate_steps == self._accumulate:
+            return self._runner
+        from ..distributed.runner import DistributedRunner
+        self._runner = DistributedRunner(
+            self.network, self._optimizer, self._loss, mesh=mesh,
+            accumulate_steps=self._accumulate,
+            amp_level=self._amp_level, amp_dtype=self._amp_dtype,
+            capture_outputs=True)
+        return self._runner
 
     # -- single-batch APIs --------------------------------------------------
     def _prepare_data(self, data):
@@ -146,6 +169,12 @@ class Model:
             inputs_v = self._prepare_data(inputs)
             labels_v = self._prepare_data(labels)
             self._n_inputs = len(inputs_v)
+            runner = self._mesh_runner() if update else None
+            if runner is not None:
+                loss_val, out_vals = runner.train_step(inputs_v, labels_v)
+                self._optimizer._global_step += 1
+                metrics = self._update_metrics(out_vals, labels_v)
+                return self._format_loss(loss_val), metrics
             if self._use_jit:
                 return self._train_batch_jit(inputs_v, labels_v, update)
             return self._train_batch_eager(inputs_v, labels_v, update)
@@ -193,6 +222,11 @@ class Model:
         inputs_v = self._prepare_data(inputs)
         labels_v = self._prepare_data(labels)
         self._n_inputs = len(inputs_v)
+        runner = self._mesh_runner()
+        if runner is not None and self._loss is not None:
+            loss_val, out_vals = runner.eval_step(inputs_v, labels_v)
+            metrics = self._update_metrics(out_vals, labels_v)
+            return self._format_loss(loss_val), metrics
         if self._jit_eval_step is None:
             self._jit_eval_step = self._build_jit_eval_step()
         net = self.network
@@ -229,6 +263,7 @@ class Model:
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, accumulate_grad_batches=1, num_iters=None):
         from ..io import DataLoader, Dataset
+        self._accumulate = max(int(accumulate_grad_batches), 1)
         if isinstance(train_data, Dataset):
             train_loader = DataLoader(train_data, batch_size=batch_size,
                                       shuffle=shuffle, drop_last=drop_last,
@@ -271,6 +306,21 @@ class Model:
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
         self._reset_metrics()
         logs: Dict[str, Any] = {}
+        # accumulate_grad_batches=k (paddle semantics): ONE optimizer
+        # step per k loader batches, gradient averaged over all k.  The
+        # k batches are concatenated and the compiled step consumes them
+        # as k microbatches (runner accumulate_steps) — same math, one
+        # XLA program.  A trailing partial group is dropped with a
+        # warning (same effect as drop_last for the last step).
+        k = self._accumulate if mode == "train" else 1
+        pending: List[Any] = []
+
+        def _cat(parts):
+            arrs = [[np.asarray(p[i].numpy() if isinstance(p[i], Tensor)
+                                else p[i]) for p in parts]
+                    for i in range(len(parts[0]))]
+            return [np.concatenate(a, axis=0) for a in arrs]
+
         for step, data in enumerate(loader):
             if num_iters is not None and step >= num_iters:
                 break
@@ -285,6 +335,15 @@ class Model:
             labels = data[len(data) - n_label:] if n_label else []
             cbks.on_batch_begin(mode, step, logs)
             if mode == "train":
+                if k > 1:
+                    pending.append((inputs, labels))
+                    if len(pending) < k:
+                        logs["step"] = step
+                        cbks.on_batch_end(mode, step, logs)
+                        continue
+                    inputs = _cat([p[0] for p in pending])
+                    labels = _cat([p[1] for p in pending])
+                    pending = []
                 loss, metrics = self.train_batch(inputs, labels)
             else:
                 loss, metrics = self.eval_batch(inputs, labels)
@@ -294,6 +353,11 @@ class Model:
             logs["batch_size"] = (inputs[0].shape[0] if inputs else 0)
             logs["step"] = step
             cbks.on_batch_end(mode, step, logs)
+        if pending:
+            import warnings
+            warnings.warn(
+                f"fit(accumulate_grad_batches={k}): dropping trailing "
+                f"group of {len(pending)} batch(es) smaller than k")
         self._merge_metric_logs(logs)
         return logs
 
