@@ -76,7 +76,9 @@ from .distributed.parallel import DataParallel  # noqa
 
 
 def disable_static(place=None):
-    """Dygraph is the default and only-eager mode; kept for parity."""
+    """Back to dygraph (the default mode): stops Program recording."""
+    from .static import _disable_static_mode
+    _disable_static_mode()
     return None
 
 

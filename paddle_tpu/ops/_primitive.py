@@ -34,6 +34,15 @@ OP_TABLE: Dict[str, Callable] = {}
 # AMP hook: amp.auto_cast installs a callable (opname, vals) -> vals.
 _amp_hook: Optional[Callable] = None
 
+# Static-graph recording hook: paddle.enable_static() installs
+# static.record_op so every op call is captured into the current
+# Program (SURVEY.md §3.5 — the trace-recorder static world).
+_static_hook: list = [None]
+
+
+def set_static_hook(hook: Optional[Callable]) -> None:
+    _static_hook[0] = hook
+
 
 def set_amp_hook(hook: Optional[Callable]) -> None:
     global _amp_hook
@@ -89,6 +98,8 @@ def primitive(fn=None, *, name: Optional[str] = None,
                     if jnp.issubdtype(o._value.dtype, jnp.inexact):
                         o.stop_gradient = False
                 _tape.record(f, args, vals, kwargs, diff_idx, outs, opname)
+            if _static_hook[0] is not None:
+                _static_hook[0](f, args, vals, kwargs, outs)
             if _flags.flag("FLAGS_check_nan_inf"):
                 _check_nan_inf(opname, outs)
             return outs if multi else outs[0]
@@ -120,6 +131,8 @@ def apply_closure(f: Callable, diff_tensors: Sequence[Tensor],
             if jnp.issubdtype(o._value.dtype, jnp.inexact):
                 o.stop_gradient = False
         _tape.record(f, diff_tensors, vals, {}, diff_idx, outs, name)
+    if _static_hook[0] is not None:
+        _static_hook[0](f, diff_tensors, vals, {}, outs)
     return outs if multi else outs[0]
 
 

@@ -1,28 +1,50 @@
-"""paddle.static shim (parity: python/paddle/static/).
+"""paddle.static — an EXECUTING static-graph shim (parity:
+python/paddle/static/ — Program / Executor / data / program_guard;
+upstream StandaloneExecutor::Run, SURVEY.md §3.5).
 
-The static world here is a *trace recorder* over the same op table: a
-``Program`` captures a jaxpr-backed callable; ``Executor.run`` invokes
-the compiled function.  This is intentionally thin — the real static
-path on TPU is ``@to_static``/jit (SURVEY.md §3.5: "trace-once/
-compile-once is native").
+TPU-native design: the static world is a *trace recorder* over the same
+op table the eager world uses.  Under ``paddle.enable_static()`` every
+``@primitive`` op call appends a node (raw jax fn, arg refs, kwargs,
+output ids) to the current ``Program``; ``static.data`` declares feed
+sources; layer Parameters are read live at run time.  ``Executor.run``
+topologically replays the recorded graph with the fed values — compiled
+with ``jax.jit`` and cached per feed signature — and returns the fetch
+values.  This IS trace-once/compile-once, which is why upstream's whole
+Program/IR/Pass/Executor stack collapses to ~200 lines here.
+
+Execute-or-refuse contract (VERDICT.md r2 weak #5): a fetch without a
+recorded lineage raises instead of returning a stale placeholder value.
+Static *training* programs (optimizer.minimize inside the Program) are
+out of scope — use the dygraph path, which compiles the whole step
+anyway.
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Dict, List, Optional
+import itertools
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
 
-from ..tensor import Tensor
+from ..tensor import Tensor, Parameter
 from ..framework import dtype as dtypes
 
 _static_mode = [False]
+_sym_counter = itertools.count(1)
 
 
 def _enable_static_mode():
     _static_mode[0] = True
+    from ..ops._primitive import set_static_hook
+    set_static_hook(record_op)
+
+
+def _disable_static_mode():
+    _static_mode[0] = False
+    from ..ops._primitive import set_static_hook
+    set_static_hook(None)
 
 
 def _static_mode_enabled():
@@ -46,18 +68,46 @@ class InputSpec:
 
 
 class Program:
-    """Records (feed names → fetch builders). A paddle Program analog
-    good enough for Executor.run-style scripts."""
+    """Records the op graph built while this program is current."""
 
     def __init__(self):
         self._feed_specs: Dict[str, InputSpec] = {}
-        self._builders = []  # list of (name, callable(feed_dict)->Tensor)
+        self._feed_ids: Dict[str, int] = {}      # feed name → sym id
+        self._nodes: List[tuple] = []            # (f, arg_specs, kw, outs)
+        self._sym_ids: set = set()               # ids produced here
+        self._compiled: Dict[Any, Any] = {}
+        self._version = 0
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
         return self
+
+    # -- recording -----------------------------------------------------------
+    def _record(self, f, args, vals, kwargs, outs):
+        arg_specs = []
+        for a, v in zip(args, vals):
+            if isinstance(a, Tensor):
+                sid = getattr(a, "_sym_id", None)
+                if sid is not None and sid in self._sym_ids:
+                    arg_specs.append(("sym", sid))
+                elif isinstance(a, Parameter):
+                    arg_specs.append(("param", a))
+                else:
+                    arg_specs.append(("const", v))
+            else:
+                arg_specs.append(("raw", a))
+        out_ids = []
+        for o in outs:
+            sid = next(_sym_counter)
+            o._sym_id = sid
+            self._sym_ids.add(sid)
+            out_ids.append(sid)
+        self._nodes.append((f, tuple(arg_specs), dict(kwargs),
+                            tuple(out_ids)))
+        self._compiled.clear()
+        self._version += 1
 
 
 _default_main = [Program()]
@@ -84,15 +134,26 @@ def program_guard(main_program, startup_program=None):
         _default_main[0], _default_startup[0] = prev_m, prev_s
 
 
+def record_op(f, args, vals, kwargs, outs):
+    """Hook called by the primitive dispatcher under static mode."""
+    default_main_program()._record(f, args, vals, kwargs, outs)
+
+
 def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
-    """Declare a feed placeholder: returns a zero Tensor carrying the
-    name; Executor.run substitutes the fed value."""
+    """Declare a feed placeholder.  The returned Tensor carries a sym id
+    that Executor.run substitutes with the fed value."""
+    prog = default_main_program()
     spec = InputSpec(shape, dtype, name)
-    default_main_program()._feed_specs[name] = spec
+    prog._feed_specs[name] = spec
     shp = [1 if s in (-1, None) else s for s in shape]
     t = Tensor(np.zeros(shp, dtype=spec.dtype.np_dtype))
     t.name = name
     t._is_feed = True
+    sid = next(_sym_counter)
+    t._sym_id = sid
+    prog._feed_ids[name] = sid
+    prog._sym_ids.add(sid)
+    prog._compiled.clear()
     return t
 
 
@@ -101,16 +162,106 @@ class Executor:
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None,
-            return_numpy=True):
-        # Static scripts in eager-first frameworks re-execute eagerly:
-        # feed values are bound to the placeholder tensors and the
-        # fetches (built eagerly against them) are recomputed by the
-        # user's callables if provided, else returned as-is.
-        results = []
-        for fetch in fetch_list or []:
-            val = fetch.numpy() if return_numpy else fetch
-            results.append(val)
-        return results
+            return_numpy=True, scope=None):
+        program = program if isinstance(program, Program) else \
+            (program or default_main_program())
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not fetch_list:
+            return []   # e.g. exe.run(startup_program): params init eagerly
+
+        fetch_ids = []
+        for t in fetch_list:
+            sid = getattr(t, "_sym_id", None)
+            if sid is not None and sid in program._sym_ids:
+                fetch_ids.append(("sym", sid))
+            elif isinstance(t, Parameter):
+                fetch_ids.append(("param", t))
+            else:
+                raise RuntimeError(
+                    "Executor.run: fetch target was not recorded in this "
+                    "Program (no sym id). Only outputs of ops executed "
+                    "under paddle.enable_static() with the program "
+                    "current can be fetched; static training graphs "
+                    "(optimizer.minimize inside a Program) are not "
+                    "supported on the TPU build — use dygraph, which "
+                    "compiles the whole step anyway (SURVEY.md §3.5).")
+
+        missing = [n for n in program._feed_ids if n not in feed]
+        # only feeds the fetch subgraph needs are strictly required;
+        # requiring all declared feeds is the upstream behavior and is
+        # simpler + more predictable:
+        if missing:
+            raise KeyError(
+                f"Executor.run: missing feed values for {missing}")
+
+        feed_names = sorted(program._feed_ids)
+        # cast to the declared InputSpec dtype: a Python-float feed would
+        # otherwise arrive as float64 and promote the whole replayed
+        # graph under the global jax_enable_x64
+        feed_vals = [
+            np.asarray(feed[n],
+                       dtype=program._feed_specs[n].dtype.np_dtype
+                       if n in program._feed_specs else None)
+            for n in feed_names]
+        sig = (program._version,
+               tuple((v.shape, str(v.dtype)) for v in feed_vals),
+               tuple(sid for kind, sid in
+                     ((k, s if k == "sym" else id(s))
+                      for k, s in fetch_ids)))
+
+        # collect the live params the graph references (read at call
+        # time so set_state_dict/updates are visible) — including params
+        # that are fetched directly without any op consuming them
+        param_objs = []
+        seen = set()
+        for _, arg_specs, _, _ in program._nodes:
+            for kind, ref in arg_specs:
+                if kind == "param" and id(ref) not in seen:
+                    seen.add(id(ref))
+                    param_objs.append(ref)
+        for kind, ref in fetch_ids:
+            if kind == "param" and id(ref) not in seen:
+                seen.add(id(ref))
+                param_objs.append(ref)
+
+        fn = program._compiled.get(sig)
+        if fn is None:
+            nodes = list(program._nodes)
+            feed_id_list = [program._feed_ids[n] for n in feed_names]
+
+            def replay(fvals, pvals):
+                env = dict(zip(feed_id_list, fvals))
+                pmap = {id(p): v for p, v in zip(param_objs, pvals)}
+
+                def resolve(spec):
+                    kind, ref = spec
+                    if kind == "sym":
+                        return env[ref]
+                    if kind == "param":
+                        return pmap[id(ref)]
+                    return ref    # "raw" and "const" both pass through
+
+                for f, arg_specs, kw, out_ids in nodes:
+                    vals = [resolve(s) for s in arg_specs]
+                    out = f(*vals, **kw)
+                    outs = out if isinstance(out, tuple) else (out,)
+                    for sid, v in zip(out_ids, outs):
+                        env[sid] = v
+                results = []
+                for kind, ref in fetch_ids:
+                    results.append(env[ref] if kind == "sym"
+                                   else pmap[id(ref)])
+                return results
+
+            fn = jax.jit(replay)
+            program._compiled[sig] = fn
+
+        pvals = [p._value for p in param_objs]
+        results = fn(feed_vals, pvals)
+        if return_numpy:
+            return [np.asarray(jax.device_get(r)) for r in results]
+        return [Tensor(r) for r in results]
 
 
 def name_scope(prefix=None):

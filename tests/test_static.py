@@ -1,0 +1,124 @@
+"""paddle.static executing-graph tests (upstream StandaloneExecutor::Run
+contract — SURVEY.md §3.5; VERDICT.md r2 missing #3: the Executor must
+execute the Program or refuse, never return stale placeholders)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    yield
+    paddle.disable_static()
+
+
+def test_static_linear_forward_executes():
+    paddle.seed(0)
+    paddle.enable_static()
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        lin = nn.Linear(4, 3)
+        y = lin(x)
+        loss = y.sum()
+    exe = static.Executor()
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    xv = rng.rand(5, 4).astype(np.float32)
+    out, lv = exe.run(main, feed={"x": xv}, fetch_list=[y, loss])
+    w = np.asarray(lin.weight.numpy())
+    b = np.asarray(lin.bias.numpy())
+    expect = xv @ w + b
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    np.testing.assert_allclose(lv, expect.sum(), rtol=1e-5)
+
+
+def test_static_feed_changes_output():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+        y = x * 3.0
+    exe = static.Executor()
+    r1, = exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                  fetch_list=[y])
+    r2, = exe.run(main, feed={"x": np.full((2, 2), 2.0, np.float32)},
+                  fetch_list=[y])
+    np.testing.assert_allclose(r1, 3.0 * np.ones((2, 2)))
+    np.testing.assert_allclose(r2, 6.0 * np.ones((2, 2)))
+
+
+def test_static_param_update_visible():
+    """Params are read live: set_state_dict between runs changes the
+    executed result (no stale compiled constants)."""
+    paddle.seed(0)
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1, 2], "float32")
+        lin = nn.Linear(2, 1)
+        y = lin(x)
+    exe = static.Executor()
+    xv = np.ones((1, 2), np.float32)
+    r1, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    sd = lin.state_dict()
+    sd["weight"] = Tensor(np.zeros((2, 1), np.float32))
+    sd["bias"] = Tensor(np.asarray([5.0], np.float32))
+    lin.set_state_dict(sd)
+    r2, = exe.run(main, feed={"x": xv}, fetch_list=[y])
+    assert not np.allclose(r1, r2)
+    np.testing.assert_allclose(r2, [[5.0]], rtol=1e-6)
+
+
+def test_static_missing_feed_raises():
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        y = x + 1.0
+    exe = static.Executor()
+    with pytest.raises(KeyError, match="missing feed"):
+        exe.run(main, feed={}, fetch_list=[y])
+
+
+def test_static_unrecorded_fetch_refuses():
+    """Execute-or-refuse: a tensor that was never recorded in the
+    Program cannot be silently 'fetched'."""
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1], "float32")
+        _ = x + 1.0
+    paddle.disable_static()
+    stray = Tensor(np.zeros(3, np.float32)) * 2.0   # built OUTSIDE
+    exe = static.Executor()
+    with pytest.raises(RuntimeError, match="not recorded"):
+        exe.run(main, feed={"x": np.ones(1, np.float32)},
+                fetch_list=[stray])
+
+
+def test_static_startup_run_is_noop_and_empty():
+    paddle.enable_static()
+    exe = static.Executor()
+    assert exe.run(static.default_startup_program()) == []
+
+
+def test_static_fetch_unconsumed_param():
+    """Review finding: fetching a Parameter no op consumed must return
+    its live value, not KeyError inside jit."""
+    paddle.seed(0)
+    paddle.enable_static()
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [1, 2], "float32")
+        lin = nn.Linear(2, 2)
+        _ = x + 1.0     # program never reads lin's params
+    exe = static.Executor()
+    out, w = exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
+                     fetch_list=[_, lin.weight])
+    np.testing.assert_allclose(w, np.asarray(lin.weight.numpy()))
