@@ -22,6 +22,7 @@ from .collective import split, get_mesh, set_mesh  # noqa
 from .runner import DistributedRunner  # noqa
 from .fleet.recompute import recompute  # noqa
 from . import checkpoint  # noqa
+from . import passes  # noqa
 
 # auto-parallel style API
 from .auto_parallel.api import (  # noqa

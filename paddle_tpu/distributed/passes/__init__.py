@@ -1,0 +1,98 @@
+"""paddle.distributed.passes (parity: python/paddle/distributed/passes/
+— new_pass / apply_pass / PassManager over static Programs;
+SURVEY.md §2.2 "distributed.passes" row).
+
+TPU-native shape: upstream passes rewrite Program IR; here the same
+optimizations are *flags on the compiled step* (XLA does the rewriting),
+so a Pass mutates a DistributedStrategy or a DistributedRunner.  Known
+passes map onto real features; unknown names refuse loudly (never a
+silent no-op).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+
+class PassContext:
+    def __init__(self):
+        self.attrs: Dict[str, Any] = {}
+
+
+_KNOWN = {
+    # upstream pass name → (strategy flag, configs attr)
+    "auto_parallel_amp": ("amp", "amp_configs"),
+    "amp": ("amp", "amp_configs"),
+    "auto_parallel_fp16": ("amp", "amp_configs"),
+    "auto_parallel_recompute": ("recompute", "recompute_configs"),
+    "recompute": ("recompute", "recompute_configs"),
+    "auto_parallel_sharding": ("sharding", "sharding_configs"),
+    "sharding": ("sharding", "sharding_configs"),
+    "auto_parallel_gradient_merge_pass": ("gradient_merge",
+                                          "gradient_merge_configs"),
+    "gradient_merge": ("gradient_merge", "gradient_merge_configs"),
+    "auto_parallel_pipeline": ("pipeline", "pipeline_configs"),
+    "pipeline": ("pipeline", "pipeline_configs"),
+}
+
+
+class Pass:
+    def __init__(self, name: str, attrs: Optional[Dict[str, Any]] = None):
+        if name not in _KNOWN:
+            raise NotImplementedError(
+                f"pass {name!r} has no TPU-native equivalent; known "
+                f"passes: {sorted(set(_KNOWN))}")
+        self.name = name
+        self.attrs = dict(attrs or {})
+
+    def apply(self, target, context: Optional[PassContext] = None):
+        """target: DistributedStrategy (sets the knob + configs) or
+        DistributedRunner (applies the feature directly)."""
+        flag, cfg_attr = _KNOWN[self.name]
+        from ..fleet.base.distributed_strategy import DistributedStrategy
+        from ..runner import DistributedRunner
+        if isinstance(target, DistributedStrategy):
+            setattr(target, flag, True)
+            if self.attrs:
+                setattr(target, cfg_attr, self.attrs)
+            return target
+        if isinstance(target, DistributedRunner):
+            if target._step_fn is not None:
+                raise RuntimeError(
+                    f"pass {self.name!r} applied after the step was "
+                    "compiled; apply passes before the first train_step")
+            if flag == "amp":
+                target.amp_level = ("O2" if self.attrs.get("use_pure_fp16")
+                                    else self.attrs.get("level", "O1"))
+                target.amp_dtype = self.attrs.get("dtype", "bfloat16")
+            elif flag == "recompute":
+                target.remat = True
+            elif flag == "sharding":
+                target.sharding_stage = int(self.attrs.get("stage", 1))
+            elif flag in ("gradient_merge", "pipeline"):
+                target.accumulate_steps = int(
+                    self.attrs.get("k_steps",
+                                   self.attrs.get("accumulate_steps", 1)))
+            return target
+        raise TypeError(
+            f"apply_pass target must be DistributedStrategy or "
+            f"DistributedRunner, got {type(target).__name__}")
+
+
+def new_pass(name: str, attrs: Optional[Dict[str, Any]] = None) -> Pass:
+    return Pass(name, attrs)
+
+
+def apply_pass(target, name: str, attrs: Optional[Dict[str, Any]] = None,
+               context: Optional[PassContext] = None):
+    return Pass(name, attrs).apply(target, context)
+
+
+class PassManager:
+    def __init__(self, passes: List[Pass]):
+        self._passes = list(passes)
+
+    def apply(self, target, context: Optional[PassContext] = None):
+        for p in self._passes:
+            target = p.apply(target, context)
+        return target
