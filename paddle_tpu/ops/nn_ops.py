@@ -332,26 +332,36 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
-    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    # mixed-precision contract: statistics in f32, output in the input
+    # dtype.  Under amp-O2 the norm weights stay f32 (decorate excludes
+    # norms); without the cast-back, `out * weight` would promote the
+    # activation to f32 and every downstream matmul would run off the
+    # bf16 MXU path (measured 3x step-time on the GPT bench).
+    orig = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
     if weight is not None:
-        out = out * weight
+        out = out * weight.astype(jnp.float32)
     if bias is not None:
-        out = out + bias
-    return out
+        out = out + bias.astype(jnp.float32)
+    return out.astype(orig)
 
 
 @primitive
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
     axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
-    ms = jnp.mean(jnp.square(x), axis=axes, keepdims=True)
-    out = x * jax.lax.rsqrt(ms + epsilon)
+    # f32 statistics, input-dtype output (see layer_norm)
+    orig = x.dtype
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axes, keepdims=True)
+    out = xf * jax.lax.rsqrt(ms + epsilon)
     if weight is not None:
-        out = out * weight
+        out = out * weight.astype(jnp.float32)
     if bias is not None:
-        out = out + bias
-    return out
+        out = out + bias.astype(jnp.float32)
+    return out.astype(orig)
 
 
 @primitive
@@ -362,16 +372,21 @@ def batch_norm_train(x, running_mean, running_var, weight, bias,
     running = momentum*running + (1-momentum)*batch)."""
     ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
     axes = tuple(i for i in range(x.ndim) if i != ch_axis)
-    mean = jnp.mean(x, axis=axes)
-    var = jnp.var(x, axis=axes)
+    # f32 statistics, input-dtype output (see layer_norm: keeps amp-O2
+    # activations in bf16 past the f32 norm params)
+    orig = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
     shape = [1] * x.ndim
     shape[ch_axis] = x.shape[ch_axis]
-    out = (x - mean.reshape(shape)) * jax.lax.rsqrt(
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(
         var.reshape(shape) + epsilon)
     if weight is not None:
-        out = out * weight.reshape(shape)
+        out = out * weight.astype(jnp.float32).reshape(shape)
     if bias is not None:
-        out = out + bias.reshape(shape)
+        out = out + bias.astype(jnp.float32).reshape(shape)
+    out = out.astype(orig)
     n = x.size / x.shape[ch_axis]
     unbiased_var = var * (n / max(n - 1.0, 1.0))
     new_mean = momentum * running_mean + (1.0 - momentum) * mean
@@ -385,13 +400,15 @@ def batch_norm_eval(x, running_mean, running_var, weight, bias,
     ch_axis = 1 if data_format == "NCHW" else x.ndim - 1
     shape = [1] * x.ndim
     shape[ch_axis] = x.shape[ch_axis]
-    out = (x - running_mean.reshape(shape)) * jax.lax.rsqrt(
+    orig = x.dtype
+    xf = x.astype(jnp.float32)
+    out = (xf - running_mean.reshape(shape)) * jax.lax.rsqrt(
         running_var.reshape(shape) + epsilon)
     if weight is not None:
-        out = out * weight.reshape(shape)
+        out = out * weight.astype(jnp.float32).reshape(shape)
     if bias is not None:
-        out = out + bias.reshape(shape)
-    return out
+        out = out + bias.astype(jnp.float32).reshape(shape)
+    return out.astype(orig)
 
 
 @primitive
@@ -510,6 +527,36 @@ def embedding(x, weight, padding_idx=None, sparse=False):
     return out
 
 
+def _embedding_sparse_raw(x, weight, padding_idx=None):
+    return embedding.raw(x, weight, padding_idx=padding_idx)
+
+
+def _embedding_sparse_vjp(node, out_cts):
+    """Eager backward: the weight grad is a SelectedRows (rows = the
+    looked-up ids, values = the output cotangents) — upstream
+    embedding_sparse_grad (SURVEY.md §2.1 SelectedRows row)."""
+    from ..framework.selected_rows import SelectedRows
+    x_val, w_val = node.arg_vals[0], node.arg_vals[1]
+    padding_idx = node.kwargs.get("padding_idx")
+    ct = out_cts[0]
+    dim = w_val.shape[1]
+    rows = jnp.reshape(x_val, (-1,))
+    vals = jnp.reshape(ct, (-1, dim)).astype(w_val.dtype)
+    if padding_idx is not None:
+        keep = (rows != padding_idx)[:, None]
+        vals = jnp.where(keep, vals, jnp.zeros_like(vals))
+    sr = SelectedRows(rows, vals, w_val.shape[0])
+    # cotangents aligned with node.diff_idx (only the weight is
+    # differentiable; x is integer)
+    return [sr for _ in node.diff_idx]
+
+
+_embedding_sparse_raw._eager_vjp = _embedding_sparse_vjp
+
+embedding_sparse = primitive(name="embedding_sparse")(
+    _embedding_sparse_raw)
+
+
 # ---------------------------------------------------------------------------
 # Losses
 # ---------------------------------------------------------------------------
@@ -526,13 +573,15 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0):
     logits = input
-    if use_softmax:
-        logp = jax.nn.log_softmax(logits, axis=axis)
-    else:
-        logp = jnp.log(jnp.maximum(logits, 1e-30))
-    if soft_label or (label.ndim == logits.ndim
-                      and label.shape[axis] == logits.shape[axis]
-                      and jnp.issubdtype(label.dtype, jnp.floating)):
+    hard_label = not (soft_label or (
+        label.ndim == logits.ndim
+        and label.shape[axis] == logits.shape[axis]
+        and jnp.issubdtype(label.dtype, jnp.floating)))
+    if not hard_label:
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
         soft = label
         if label_smoothing > 0:
             n = logits.shape[axis]
@@ -543,17 +592,33 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         if lbl.ndim == logits.ndim and lbl.shape[axis] == 1:
             lbl = jnp.squeeze(lbl, axis=axis)
         n = logits.shape[axis]
-        # gather formulation: loss = lse - logits[label].  Avoids the
-        # one-hot [.., V] fp32 materialisation (1.6 GB at GPT-2 bench
-        # shapes); the vjp is a scatter-add, which XLA fuses.
-        ax = axis % logp.ndim
-        picked = jnp.take_along_axis(
-            logp, jnp.expand_dims(jnp.clip(lbl, 0, n - 1), ax), axis=ax)
-        loss = -jnp.squeeze(picked, axis=ax)
-        if label_smoothing > 0:
-            # -sum(soft*logp) with soft=(1-e)*onehot + e/n
-            loss = (1 - label_smoothing) * loss + \
-                label_smoothing * (-jnp.mean(logp, axis=ax))
+        ax = axis % logits.ndim
+        if use_softmax:
+            # lse − logits[label] formulation: never materialises the
+            # [.., V] log-probs (f32 log_softmax over a 50k vocab is
+            # 1.6 GB at GPT-2 bench shapes and dominated the loss cost);
+            # the lse reduction fuses, its vjp recomputes softmax from
+            # the (bf16) logits, and the gather's vjp is a scatter-add.
+            lf = logits.astype(jnp.float32)
+            lse = jax.scipy.special.logsumexp(lf, axis=ax, keepdims=True)
+            picked = jnp.take_along_axis(
+                lf, jnp.expand_dims(jnp.clip(lbl, 0, n - 1), ax), axis=ax)
+            loss = jnp.squeeze(lse - picked, axis=ax)
+            if label_smoothing > 0:
+                # -mean(logp) = lse - mean(logits)
+                mean_logp = (jnp.mean(lf, axis=ax)
+                             - jnp.squeeze(lse, axis=ax))
+                loss = (1 - label_smoothing) * loss + \
+                    label_smoothing * (-mean_logp)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(jnp.clip(lbl, 0, n - 1), ax),
+                axis=ax)
+            loss = -jnp.squeeze(picked, axis=ax)
+            if label_smoothing > 0:
+                loss = (1 - label_smoothing) * loss + \
+                    label_smoothing * (-jnp.mean(logp, axis=ax))
         # weight and ignore_index compose: per-sample w, zeroed where
         # ignored; mean divides by the sum of effective weights
         # (paddle softmax_with_cross_entropy semantics)
