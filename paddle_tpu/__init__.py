@@ -44,6 +44,7 @@ from .tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa
 # op surface: everything in ops is also a paddle.* function
 from .ops import *  # noqa
 from .ops import OP_TABLE  # noqa
+from .framework.selected_rows import SelectedRows  # noqa
 from .ops.manipulation import concat, stack, split, where  # noqa
 
 from .autograd import no_grad, enable_grad, grad  # noqa

@@ -52,8 +52,15 @@ class GradScaler:
         self._unscaled = True
         inv = 1.0 / self._scale
         found = False
+        from ..framework.selected_rows import SelectedRows
         for p in optimizer._parameter_list:
             if p.grad is not None:
+                if isinstance(p.grad, SelectedRows):
+                    vals = p.grad.values * inv
+                    found = found or bool(jnp.any(~jnp.isfinite(vals)))
+                    p.grad = SelectedRows(p.grad.rows, vals,
+                                          p.grad.height)
+                    continue
                 g = p.grad._value * inv
                 found = found or bool(jnp.any(~jnp.isfinite(g)))
                 p.grad = Tensor(g)

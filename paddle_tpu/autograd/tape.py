@@ -144,6 +144,16 @@ def _node_vjp(node, cts):
         full = [jnp.zeros_like(o._value) if c is None else c
                 for o, c in zip(node.outputs, full)]
         return _pylayer_vjp(node, full)
+    eager_vjp = getattr(node.fn, "_eager_vjp", None)
+    if eager_vjp is not None:
+        # op supplies its own eager backward (may return SelectedRows
+        # cotangents — e.g. sparse embedding grads)
+        out_cts = [cts.get(id(o)) for o in node.outputs]
+        if all(c is None for c in out_cts):
+            return None
+        out_cts = [jnp.zeros_like(o._value) if c is None else c
+                   for o, c in zip(node.outputs, out_cts)]
+        return eager_vjp(node, out_cts)
     out_idx = [j for j, o in enumerate(node.outputs)
                if jnp.issubdtype(o._value.dtype, jnp.inexact)]
     if not out_idx:
@@ -216,6 +226,21 @@ def _accum(cts: Dict[int, Any], key: int, val) -> None:
 
 def _add_grad(t, ct) -> None:
     from ..tensor import Tensor
+    from ..framework.selected_rows import SelectedRows
+    if isinstance(ct, SelectedRows):
+        # sparse grad stays sparse (paddle dygraph sparse semantics);
+        # accumulation with an existing dense grad densifies
+        if t.grad is None:
+            t.grad = ct
+        elif isinstance(t.grad, SelectedRows):
+            t.grad = t.grad + ct
+        else:
+            t.grad = Tensor(ct + t.grad._value, stop_gradient=True)
+        return
+    if isinstance(t.grad, SelectedRows):
+        t.grad = Tensor(t.grad + jnp.asarray(ct, dtype=t._value.dtype),
+                        stop_gradient=True)
+        return
     ct = jnp.asarray(ct, dtype=t._value.dtype)
     if t.grad is None:
         t.grad = Tensor(ct, stop_gradient=True)
@@ -263,6 +288,11 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "pass allow_unused=True to return None for it.")
             results.append(None)
         else:
+            from ..framework.selected_rows import SelectedRows
+            if isinstance(c, SelectedRows):
+                # paddle.grad returns dense tensors; sparse stays on the
+                # .grad attribute path only
+                c = c.to_dense()
             results.append(Tensor(c, stop_gradient=not create_graph))
     if retain_graph is False or retain_graph is None and not create_graph:
         pass  # keep tape: paddle.grad defaults to retaining for repeat calls

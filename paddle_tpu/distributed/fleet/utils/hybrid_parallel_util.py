@@ -17,9 +17,14 @@ def fused_allreduce_gradients(parameter_list, hcg):
     group = hcg.get_data_parallel_group() if hcg else None
     if group is None or group.nranks <= 1:
         return
+    from ....framework.selected_rows import SelectedRows
     for p in parameter_list:
         if p.grad is None:
             continue
+        if isinstance(p.grad, SelectedRows):
+            # collectives need dense layout; upstream allgathers rows —
+            # here the psum of the dense equivalent is the SPMD form
+            p.grad = Tensor(p.grad.to_dense())
         g = p.grad._value
         if isinstance(g, jax.core.Tracer) and group.axis_name:
             p.grad = Tensor(lax.psum(g, group.axis_name) / group.nranks)

@@ -1,0 +1,83 @@
+"""SelectedRows — sparse row-wise gradients (parity: upstream
+``phi::SelectedRows``, paddle/phi/core/selected_rows.h; SURVEY.md §2.1
+DenseTensor/SelectedRows row).
+
+Upstream represents an embedding gradient as (rows, values) so the
+optimizer touches only the looked-up rows of a big vocab table.  The
+TPU-native story: inside a jit step XLA already fuses the scatter-add,
+so SelectedRows here serves the EAGER path (``loss.backward()`` +
+``optimizer.step()``) exactly like upstream dygraph sparse gradients:
+``nn.Embedding(sparse=True)`` produces a SelectedRows ``.grad`` and
+SGD / Adam(lazy_mode=True) apply row-wise updates.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class SelectedRows:
+    """rows: [n] int indices into dim 0; values: [n, ...] grads for
+    those rows; height: dim-0 extent of the dense equivalent."""
+
+    def __init__(self, rows, values, height: int, _merged: bool = False):
+        self.rows = jnp.asarray(rows)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+        self._merged = _merged
+
+    # paddle Tensor API surface
+    def is_selected_rows(self) -> bool:
+        return True
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def numpy(self):
+        """Dense numpy view — boundary for Tensor.gradient etc."""
+        import numpy as np
+        return np.asarray(self.to_dense())
+
+    def to_dense(self):
+        out = jnp.zeros((self.height,) + tuple(self.values.shape[1:]),
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    def merged(self) -> "SelectedRows":
+        """Deduplicate rows, summing their values (upstream
+        merge_sparse_grad / MergeAdd).  Idempotent: already-merged
+        results pass through (grad-clip merges before the optimizer)."""
+        if self._merged:
+            return self
+        rows, inv = jnp.unique(self.rows, return_inverse=True)
+        vals = jax.ops.segment_sum(self.values, inv.reshape(-1),
+                                   num_segments=int(rows.shape[0]))
+        return SelectedRows(rows, vals, self.height, _merged=True)
+
+    def scale(self, s) -> "SelectedRows":
+        return SelectedRows(self.rows, self.values * s, self.height,
+                            _merged=self._merged)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        # dense + sparse → dense
+        return jnp.asarray(other).at[self.rows].add(
+            self.values.astype(jnp.asarray(other).dtype))
+
+    __radd__ = __add__
+
+    def __repr__(self):
+        return (f"SelectedRows(rows={self.rows.shape[0]}, "
+                f"height={self.height}, value_shape="
+                f"{tuple(self.values.shape)})")

@@ -22,10 +22,17 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -self.max
 
     def _dygraph_clip(self, params_grads):
+        from ..framework.selected_rows import SelectedRows
         out = []
         for p, g in params_grads:
             if g is None:
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                m = g.merged()
+                out.append((p, SelectedRows(
+                    m.rows, jnp.clip(m.values, self.min, self.max),
+                    m.height)))
                 continue
             out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
         return out
@@ -36,10 +43,18 @@ class ClipGradByNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def _dygraph_clip(self, params_grads):
+        from ..framework.selected_rows import SelectedRows
         out = []
         for p, g in params_grads:
             if g is None:
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                m = g.merged()
+                norm = jnp.sqrt(jnp.sum(jnp.square(m.values)))
+                scale = jnp.minimum(
+                    self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+                out.append((p, m.scale(scale.astype(m.values.dtype))))
                 continue
             norm = jnp.sqrt(jnp.sum(jnp.square(g._value)))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
@@ -60,11 +75,19 @@ class ClipGradByGlobalNorm(ClipGradBase):
         self._comm_hook = None  # set by HybridParallelOptimizer
 
     def _dygraph_clip(self, params_grads):
+        from ..framework.selected_rows import SelectedRows
+
+        # merge SelectedRows FIRST: duplicate ids must contribute
+        # (g1+g2)^2 to the global norm, not g1^2+g2^2 (upstream merges
+        # before the norm)
+        merged = [(p, g.merged() if isinstance(g, SelectedRows) else g)
+                  for p, g in params_grads]
         sq = None
-        for _, g in params_grads:
+        for _, g in merged:
             if g is None:
                 continue
-            s = jnp.sum(jnp.square(g._value.astype(jnp.float32)))
+            v = g.values if isinstance(g, SelectedRows) else g._value
+            s = jnp.sum(jnp.square(v.astype(jnp.float32)))
             sq = s if sq is None else sq + s
         if sq is None:
             return params_grads
@@ -73,9 +96,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
         global_norm = jnp.sqrt(sq)
         scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
         out = []
-        for p, g in params_grads:
+        for p, g in merged:
             if g is None:
                 out.append((p, g))
+                continue
+            if isinstance(g, SelectedRows):
+                out.append((p, g.scale(scale.astype(g.values.dtype))))
                 continue
             out.append((p, Tensor((g._value.astype(jnp.float32) * scale
                                    ).astype(g._value.dtype))))

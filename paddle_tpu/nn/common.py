@@ -90,7 +90,12 @@ class Embedding(Layer):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
+        # normalize a negative padding_idx (paddle semantics) so the
+        # id comparisons in the kernels/backward actually match
+        if padding_idx is not None and padding_idx < 0:
+            padding_idx = padding_idx + num_embeddings
         self._padding_idx = padding_idx
+        self._sparse = sparse
         self.weight = self.create_parameter(
             shape=[num_embeddings, embedding_dim], attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
@@ -98,6 +103,14 @@ class Embedding(Layer):
             self.weight._value = self.weight._value.at[padding_idx].set(0.0)
 
     def forward(self, x):
+        import jax as _jax
+        if self._sparse and not isinstance(self.weight._value,
+                                           _jax.core.Tracer):
+            # eager path: SelectedRows gradient for the big table
+            # (upstream sparse=True).  Under jit the scatter-add is
+            # fused by XLA, so the dense op is used when tracing.
+            return ops.embedding_sparse(x, self.weight,
+                                        padding_idx=self._padding_idx)
         return ops.embedding(x, self.weight, padding_idx=self._padding_idx)
 
     def extra_repr(self):

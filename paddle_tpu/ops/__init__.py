@@ -19,6 +19,8 @@ from .linalg import *  # noqa
 from .logic import *  # noqa
 from .activation import *  # noqa
 from .nn_ops import *  # noqa
+from .array_ops import (  # noqa
+    TensorArray, create_array, array_write, array_read, array_length)
 
 from ..tensor import Tensor as _Tensor
 

@@ -1,0 +1,82 @@
+"""TensorArray + array ops (parity: python/paddle/tensor/array.py —
+create_array / array_write / array_read / array_length over upstream's
+LoDTensorArray; SURVEY.md §2.1 DenseTensor/TensorArray row).
+
+TPU-native shape: eagerly a TensorArray is a growable Python list of
+Tensors (upstream's C++ vector<LoDTensor> is exactly that); inside a
+``@to_static``/jit trace the writes/reads become pytree operations —
+for compiler-friendly fixed-length loops prefer ``lax.scan``/``stack``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..tensor import Tensor
+
+
+class TensorArray:
+    """Growable array of Tensors (LoDTensorArray parity)."""
+
+    def __init__(self, items: Optional[List[Tensor]] = None):
+        self._items: List[Tensor] = list(items or [])
+
+    def append(self, t) -> "TensorArray":
+        self._items.append(t if isinstance(t, Tensor) else Tensor(t))
+        return self
+
+    def write(self, i: int, t) -> "TensorArray":
+        i = int(i)
+        if i == len(self._items):
+            self.append(t)
+        elif i < len(self._items):
+            self._items[i] = t if isinstance(t, Tensor) else Tensor(t)
+        else:
+            raise IndexError(
+                f"array_write index {i} out of range (length "
+                f"{len(self._items)}; paddle requires i <= length)")
+        return self
+
+    def read(self, i: int) -> Tensor:
+        return self._items[int(i)]
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from . import stack as _stack
+        return _stack(self._items, axis=axis)
+
+    def pop(self, i: int = -1) -> Tensor:
+        return self._items.pop(int(i))
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __repr__(self):
+        return f"TensorArray(len={len(self._items)})"
+
+
+def create_array(dtype: str = "float32", initialized_list=None):
+    return TensorArray(list(initialized_list) if initialized_list
+                       else None)
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    if array is None:
+        array = TensorArray()
+    idx = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    return array.write(idx, x)
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    idx = int(i.numpy()) if isinstance(i, Tensor) else int(i)
+    return array.read(idx)
+
+
+def array_length(array: TensorArray) -> Tensor:
+    import numpy as np
+    return Tensor(np.asarray(len(array), dtype=np.int64))

@@ -111,6 +111,23 @@ class Optimizer:
                 st["master_weight"] = value.astype(jnp.float32)
             self._state[name] = st
 
+    def _sparse_step(self, p, sr, st, lr, decay):
+        """Apply a SelectedRows gradient.  Base: densify (correct for
+        any update rule); SGD / Adam(lazy_mode) override with row-wise
+        updates (upstream sparse kernels, SURVEY.md §2.1 SelectedRows
+        row)."""
+        gd = sr.to_dense()
+        if "master_weight" in st:
+            mw = st["master_weight"]
+            new_mw, new_st = self._update(mw, gd.astype(jnp.float32), st,
+                                          lr, decay)
+            new_st["master_weight"] = new_mw
+            p._value = new_mw.astype(p._value.dtype)
+            return new_st
+        new_v, new_st = self._update(p._value, gd, st, lr, decay)
+        p._value = new_v
+        return new_st
+
     def step(self):
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
@@ -118,13 +135,18 @@ class Optimizer:
             params_grads = self._grad_clip(params_grads)
         lr = self.get_lr()
         self._global_step += 1
+        from ..framework.selected_rows import SelectedRows
         for p, g in params_grads:
             name = p.name
             self._ensure_state(name, p._value)
             st = self._state[name]
-            gval = g._value
             decay = self._param_decay(p)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
+            if isinstance(g, SelectedRows):
+                self._state[name] = self._sparse_step(
+                    p, g.merged(), st, plr, decay)
+                continue
+            gval = g._value
             if "master_weight" in st:
                 mw = st["master_weight"]
                 new_mw, new_st = self._update(
@@ -290,6 +312,21 @@ class SGD(Optimizer):
         return value - lr * grad, {k: v for k, v in state.items()
                                    if k == "master_weight"}
 
+    def _sparse_step(self, p, sr, st, lr, decay):
+        v = st.get("master_weight", p._value)
+        rows = sr.rows
+        vals = sr.values.astype(v.dtype)
+        if decay:
+            vals = vals + decay * v[rows]
+        new_v = v.at[rows].add(-lr * vals)
+        if "master_weight" in st:
+            st = dict(st)
+            st["master_weight"] = new_v
+            p._value = new_v.astype(p._value.dtype)
+            return st
+        p._value = new_v
+        return st
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -328,6 +365,7 @@ class Adam(Optimizer):
                          name, multi_precision)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
         self._amsgrad = amsgrad
+        self._lazy_mode = bool(lazy_mode)
 
     def _init_state(self, value):
         acc_dtype = jnp.float32 if value.dtype in (
@@ -366,6 +404,41 @@ class Adam(Optimizer):
         if "master_weight" in state:
             out["master_weight"] = state["master_weight"]
         return new_value.astype(value.dtype), out
+
+    def _sparse_step(self, p, sr, st, lr, decay):
+        """lazy_mode (upstream adam lazy_mode=True): moments and weights
+        update ONLY on the looked-up rows; beta powers still advance
+        globally.  Without lazy_mode, fall back to the dense rule."""
+        if not self._lazy_mode or self._amsgrad:
+            return super()._sparse_step(p, sr, st, lr, decay)
+        rows = sr.rows
+        v = st.get("master_weight", p._value)
+        vr = v[rows]
+        g = sr.values.astype(jnp.float32)
+        if decay and not self._decoupled:
+            g = g + decay * vr.astype(jnp.float32)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        b1p = st["beta1_pow"] * b1
+        b2p = st["beta2_pow"] * b2
+        m1r = b1 * st["moment1"][rows] + (1 - b1) * g
+        m2r = b2 * st["moment2"][rows] + (1 - b2) * jnp.square(g)
+        lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+        upd = lr_t * (m1r / (jnp.sqrt(m2r) + eps * jnp.sqrt(1 - b2p)))
+        new_vr = vr - upd.astype(v.dtype)
+        if decay and self._decoupled:
+            new_vr = new_vr - (lr * decay * vr).astype(v.dtype)
+        new_v = v.at[rows].set(new_vr)
+        new_st = dict(st)
+        new_st["moment1"] = st["moment1"].at[rows].set(m1r)
+        new_st["moment2"] = st["moment2"].at[rows].set(m2r)
+        new_st["beta1_pow"] = b1p
+        new_st["beta2_pow"] = b2p
+        if "master_weight" in st:
+            new_st["master_weight"] = new_v
+            p._value = new_v.astype(p._value.dtype)
+        else:
+            p._value = new_v
+        return new_st
 
 
 class AdamW(Adam):

@@ -132,3 +132,106 @@ def test_sparse_sparse_matmul_returns_sparse():
     assert isinstance(out, SparseCooTensor)
     np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
                                a @ b, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# SelectedRows sparse gradients (upstream phi::SelectedRows +
+# embedding_sparse_grad + sgd/adam sparse kernels)
+# ---------------------------------------------------------------------------
+def test_selected_rows_embedding_grad():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.framework.selected_rows import SelectedRows
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    emb = nn.Embedding(100, 8, sparse=True)
+    ids = Tensor(np.asarray([[1, 5, 5], [7, 1, 99]], dtype=np.int64))
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert g.height == 100 and g.values.shape[1] == 8
+    dense = np.asarray(g.to_dense())
+    # rows 1 and 5 looked up twice -> grad 2.0 everywhere in the row
+    np.testing.assert_allclose(dense[1], 2.0 * np.ones(8))
+    np.testing.assert_allclose(dense[5], 2.0 * np.ones(8))
+    np.testing.assert_allclose(dense[7], np.ones(8))
+    np.testing.assert_allclose(dense[0], np.zeros(8))
+
+
+def test_selected_rows_sgd_matches_dense():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+
+    ids = np.asarray([[3, 4, 3]], dtype=np.int64)
+
+    def run(sparse):
+        paddle.seed(0)
+        emb = nn.Embedding(20, 4, sparse=sparse)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=emb.parameters())
+        for _ in range(3):
+            loss = (emb(Tensor(ids)) ** 2.0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight.numpy())
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_selected_rows_adam_lazy_touches_only_rows():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+
+    ids = np.asarray([[2, 9]], dtype=np.int64)
+    paddle.seed(0)
+    emb = nn.Embedding(16, 4, sparse=True)
+    w0 = np.asarray(emb.weight.numpy()).copy()
+    opt = optimizer.Adam(learning_rate=0.05, lazy_mode=True,
+                         parameters=emb.parameters())
+    loss = (emb(Tensor(ids)) ** 2.0).sum()
+    loss.backward()
+    opt.step()
+    w1 = np.asarray(emb.weight.numpy())
+    changed = np.any(w1 != w0, axis=1)
+    assert changed[2] and changed[9]
+    untouched = [i for i in range(16) if i not in (2, 9)]
+    np.testing.assert_allclose(w1[untouched], w0[untouched])
+
+
+def test_selected_rows_with_global_norm_clip():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    emb = nn.Embedding(10, 4, sparse=True)
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=emb.parameters(),
+                        grad_clip=nn.ClipGradByGlobalNorm(0.01))
+    loss = (emb(Tensor(np.asarray([[1, 2]], np.int64))) ** 2.0).sum()
+    loss.backward()
+    opt.step()   # must not raise; update magnitude bounded by the clip
+    assert np.isfinite(np.asarray(emb.weight.numpy())).all()
+
+
+def test_tensor_array_shim():
+    import paddle_tpu as paddle
+    from paddle_tpu import ops
+    from paddle_tpu.tensor import Tensor
+
+    arr = ops.create_array("float32")
+    ops.array_write(Tensor(np.ones(3, np.float32)), 0, arr)
+    ops.array_write(Tensor(2 * np.ones(3, np.float32)), 1, arr)
+    assert int(ops.array_length(arr)) == 2
+    back = ops.array_read(arr, 1)
+    np.testing.assert_allclose(np.asarray(back.numpy()), 2 * np.ones(3))
+    stacked = arr.stack()
+    assert tuple(stacked.shape) == (2, 3)
+    import pytest as _pytest
+    with _pytest.raises(IndexError):
+        ops.array_write(Tensor(np.ones(3, np.float32)), 5, arr)
