@@ -1,0 +1,815 @@
+"""Single-source op specification registry (the L0 idea of upstream's
+ops.yaml/backward.yaml codegen, SURVEY.md §2.1 "PHI YAML codegen",
+rebuilt TPU-side as data, not codegen).
+
+ONE table describes each op: the paddle-level callable, a numpy oracle,
+deterministic sample inputs, dtype coverage, and gradient-check policy.
+Consumers:
+
+* ``tests/test_op_suite.py`` parameterizes forward/grad/dtype tests
+  straight from ``build_specs()`` — adding an op test is one line HERE;
+* ``audit_coverage()`` is the drift guard: every op in ``OP_TABLE``
+  must be spec'd or carry an explicit exemption with a reason, and
+  every spec must resolve against the live API.
+
+The module imports paddle_tpu lazily so it can live inside the package
+without an import cycle.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+@dataclass
+class OpSpec:
+    name: str                       # display/id
+    fn: Callable                    # paddle-level op over Tensors
+    ref: Callable                   # numpy oracle over np arrays
+    inputs: Sequence[Callable]      # each: rng -> np.ndarray
+    kwargs: Dict = field(default_factory=dict)
+    dtypes: Tuple[str, ...] = ("float32", "bfloat16")
+    check_grad: bool = True
+    covers: Optional[str] = None    # OP_TABLE op this spec exercises
+                                    # when fn is a lambda over it
+    grad_inputs: Optional[Sequence[int]] = None  # default: all float
+    fw_rtol: Dict[str, float] = field(default_factory=lambda: {
+        "float32": 1e-5, "bfloat16": 2e-2, "float16": 1e-2})
+    fw_atol: Dict[str, float] = field(default_factory=lambda: {
+        "float32": 1e-5, "bfloat16": 2e-2, "float16": 1e-2})
+    grad_atol: float = 1e-2
+    grad_rtol: float = 1e-2
+    grad_eps: float = 1e-3
+
+    def __repr__(self):
+        return self.name
+
+
+def _cast_in(a: np.ndarray, dtype: str):
+    if not np.issubdtype(a.dtype, np.floating):
+        return a  # int/bool inputs keep their dtype
+    if dtype == "bfloat16":
+        import ml_dtypes
+        return a.astype(ml_dtypes.bfloat16)
+    return a.astype(dtype)
+
+
+def _is_numeric(a: np.ndarray) -> bool:
+    # ml_dtypes types (bfloat16 etc.) are not np.number subdtypes;
+    # treat anything float-kind-ish ("f", "i", "u", or custom "V"-coded
+    # float like bfloat16) as numeric
+    try:
+        np.asarray(a).astype(np.float64)
+        return a.dtype != np.bool_
+    except (TypeError, ValueError):
+        return False
+
+
+def _to_f64(a) -> np.ndarray:
+    a = np.asarray(a)
+    return a.astype(np.float64) if _is_numeric(a) else a
+
+
+def check_forward(spec: OpSpec, dtype: str, seed: int = 0):
+    import paddle_tpu as paddle
+    rng = np.random.RandomState(seed)
+    raw = [g(rng) for g in spec.inputs]
+    args = [paddle.to_tensor(_cast_in(a, dtype)) for a in raw]
+    out = spec.fn(*args, **spec.kwargs)
+    ref = spec.ref(*[a.astype(np.float64)
+                     if np.issubdtype(a.dtype, np.floating) else a
+                     for a in raw], **spec.kwargs)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    refs = ref if isinstance(ref, (tuple, list)) else (ref,)
+    assert len(outs) == len(refs), \
+        f"{spec.name}: {len(outs)} outputs vs {len(refs)} oracle outputs"
+    for o, r in zip(outs, refs):
+        raw_got = np.asarray(o.numpy())
+        got = _to_f64(raw_got)
+        want = _to_f64(r)
+        assert got.shape == want.shape, \
+            f"{spec.name}[{dtype}]: shape {got.shape} != {want.shape}"
+        if _is_numeric(raw_got) and got.dtype == np.float64:
+            np.testing.assert_allclose(
+                got, want, rtol=spec.fw_rtol[dtype],
+                atol=spec.fw_atol[dtype],
+                err_msg=f"{spec.name} forward mismatch [{dtype}]")
+        else:
+            np.testing.assert_array_equal(
+                got, want, err_msg=f"{spec.name} forward mismatch")
+
+
+def check_grad(spec: OpSpec, seed: int = 0):
+    import paddle_tpu as paddle
+    """Tape-autograd gradients vs central finite differences, fp32
+    inputs / fp64 oracle arithmetic, scalar loss = sum(op(x))."""
+    rng = np.random.RandomState(seed)
+    raw = [g(rng) for g in spec.inputs]
+    grad_idx = spec.grad_inputs
+    if grad_idx is None:
+        grad_idx = [i for i, a in enumerate(raw)
+                    if np.issubdtype(a.dtype, np.floating)]
+    assert grad_idx, f"{spec.name}: no differentiable inputs"
+
+    def run(np_args) -> float:
+        ts = [paddle.to_tensor(a.astype(np.float32)
+                               if np.issubdtype(a.dtype, np.floating)
+                               else a)
+              for a in np_args]
+        out = spec.fn(*ts, **spec.kwargs)
+        out0 = out[0] if isinstance(out, (tuple, list)) else out
+        return float(out0.sum().numpy())
+
+    # analytic
+    ts = []
+    for i, a in enumerate(raw):
+        st = i not in grad_idx
+        ts.append(paddle.to_tensor(
+            a.astype(np.float32)
+            if np.issubdtype(a.dtype, np.floating) else a,
+            stop_gradient=st))
+    out = spec.fn(*ts, **spec.kwargs)
+    out0 = out[0] if isinstance(out, (tuple, list)) else out
+    out0.sum().backward()
+
+    for i in grad_idx:
+        analytic = np.asarray(ts[i].grad.numpy(), dtype=np.float64)
+        numeric = np.zeros_like(raw[i], dtype=np.float64)
+        it = np.nditer(raw[i], flags=["multi_index"])
+        eps = spec.grad_eps
+        while not it.finished:
+            idx = it.multi_index
+            plus = [a.copy() for a in raw]
+            minus = [a.copy() for a in raw]
+            plus[i][idx] += eps
+            minus[i][idx] -= eps
+            numeric[idx] = (run(plus) - run(minus)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=spec.grad_rtol, atol=spec.grad_atol,
+            err_msg=f"{spec.name} grad mismatch on input {i}")
+
+
+def rand(*shape, lo=0.0, hi=1.0):
+    def gen(rng):
+        return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+    return gen
+
+
+def randn(*shape, scale=1.0):
+    def gen(rng):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+    return gen
+
+
+def randint(*shape, lo=0, hi=10, dtype=np.int64):
+    def gen(rng):
+        return rng.randint(lo, hi, size=shape).astype(dtype)
+    return gen
+
+
+def randbool(*shape):
+    def gen(rng):
+        return rng.rand(*shape) > 0.5
+    return gen
+
+
+# --- oracle helpers -------------------------------------------------------
+def np_sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def np_softmax(x, axis=-1):
+    e = np.exp(x - np.max(x, axis=axis, keepdims=True))
+    return e / np.sum(e, axis=axis, keepdims=True)
+
+
+def np_erf(x):
+    # Abramowitz–Stegun 7.1.26, enough for 1e-5
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    y = 1.0 - (((((1.061405429 * t - 1.453152027) * t) + 1.421413741)
+                * t - 0.284496736) * t + 0.254829592) * t * np.exp(-x * x)
+    return sign * y
+
+
+def _spd(rng, n):
+    a = rng.randn(n, n)
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def _renorm_ref(a, p, axis, maxn):
+    reduce_axes = tuple(i for i in range(a.ndim) if i != axis)
+    norms = np.sum(np.abs(a) ** p, axis=reduce_axes,
+                   keepdims=True) ** (1.0 / p)
+    factor = np.where(norms > maxn, maxn / (norms + 1e-7),
+                      np.ones_like(norms))
+    return a * factor
+
+
+def _index_fill_ref(a, i, v):
+    out = a.copy()
+    out[i] = v
+    return out
+
+
+
+
+def build_specs():
+    """The op table: name, paddle fn, numpy oracle, inputs, tolerances."""
+    import paddle_tpu as paddle
+    P = paddle
+    FP32 = ("float32",)
+
+    specs = [
+        # ---- binary elementwise ----
+        OpSpec("add", P.add, lambda a, b: a + b, [randn(3, 4), randn(3, 4)]),
+        OpSpec("add_bcast", P.add, lambda a, b: a + b,
+               [randn(3, 4), randn(4)]),
+        OpSpec("subtract", P.subtract, lambda a, b: a - b,
+               [randn(3, 4), randn(3, 4)]),
+        OpSpec("multiply", P.multiply, lambda a, b: a * b,
+               [randn(3, 4), randn(3, 4)]),
+        OpSpec("divide", P.divide, lambda a, b: a / b,
+               [randn(3, 4), rand(3, 4, lo=0.5, hi=1.5)]),
+        OpSpec("maximum", P.maximum, np.maximum, [randn(3, 4), randn(3, 4)],
+               grad_atol=5e-2),
+        OpSpec("minimum", P.minimum, np.minimum, [randn(3, 4), randn(3, 4)],
+               grad_atol=5e-2),
+        OpSpec("fmax", P.fmax, np.fmax, [randn(3, 4), randn(3, 4)],
+               check_grad=False),
+        OpSpec("fmin", P.fmin, np.fmin, [randn(3, 4), randn(3, 4)],
+               check_grad=False),
+        OpSpec("pow", lambda x: P.pow(x, 3.0), lambda a: a ** 3.0,
+               [rand(3, 4, lo=0.5, hi=1.5)]),
+        OpSpec("elementwise_pow", P.elementwise_pow, lambda a, b: a ** b,
+               [rand(3, 4, lo=0.5, hi=2.0), rand(3, 4, lo=0.5, hi=2.0)]),
+        OpSpec("atan2", P.atan2, np.arctan2,
+               [rand(3, 4, lo=0.2, hi=1.0), rand(3, 4, lo=0.2, hi=1.0)]),
+        OpSpec("hypot", P.hypot, np.hypot,
+               [rand(3, lo=0.5), rand(3, lo=0.5)]),
+        OpSpec("copysign", P.copysign, np.copysign,
+               [randn(3, 4), randn(3, 4)], check_grad=False),
+        OpSpec("logaddexp", P.logaddexp, np.logaddexp,
+               [randn(3, 4), randn(3, 4)]),
+        OpSpec("heaviside", P.heaviside,
+               lambda a, b: np.heaviside(a, b),
+               [randn(3, 4), rand(3, 4)], check_grad=False),
+        OpSpec("remainder", P.remainder, np.mod,
+               [rand(3, 4, lo=1.0, hi=5.0), rand(3, 4, lo=1.0, hi=2.0)],
+               check_grad=False),
+        OpSpec("floor_divide", P.floor_divide, np.floor_divide,
+               [rand(3, 4, lo=1.0, hi=9.0), rand(3, 4, lo=1.0, hi=3.0)],
+               check_grad=False),
+        OpSpec("ldexp", P.ldexp, np.ldexp,
+               [randn(3), randint(3, lo=-2, hi=3, dtype=np.int32)],
+               check_grad=False),
+        OpSpec("nextafter", P.nextafter, np.nextafter,
+               [rand(3), rand(3)], dtypes=FP32, check_grad=False,
+               fw_rtol={"float32": 1e-3}, fw_atol={"float32": 1e-3}),
+        # ---- unary elementwise ----
+        OpSpec("abs", P.abs, np.abs, [rand(3, 4, lo=0.2, hi=1.0)]),
+        OpSpec("neg", P.neg, np.negative, [randn(3, 4)]),
+        OpSpec("sign", P.sign, np.sign, [randn(3, 4)], check_grad=False),
+        OpSpec("signbit", P.signbit, np.signbit, [randn(3, 4)],
+               check_grad=False),
+        OpSpec("exp", P.exp, np.exp, [randn(3, 4)]),
+        OpSpec("expm1", P.expm1, np.expm1, [randn(3, 4)]),
+        OpSpec("log", P.log, np.log, [rand(3, 4, lo=0.5, hi=2.0)]),
+        OpSpec("log2", P.log2, np.log2, [rand(3, 4, lo=0.5, hi=2.0)]),
+        OpSpec("log10", P.log10, np.log10, [rand(3, 4, lo=0.5, hi=2.0)]),
+        OpSpec("log1p", P.log1p, np.log1p, [rand(3, 4)]),
+        OpSpec("sqrt", P.sqrt, np.sqrt, [rand(3, 4, lo=0.3)]),
+        OpSpec("rsqrt", P.rsqrt, lambda a: 1 / np.sqrt(a),
+               [rand(3, 4, lo=0.3)]),
+        OpSpec("square", P.square, np.square, [randn(3, 4)]),
+        OpSpec("reciprocal", P.reciprocal, np.reciprocal,
+               [rand(3, 4, lo=0.5, hi=1.5)]),
+        OpSpec("floor", P.floor, np.floor, [randn(3, 4)], check_grad=False),
+        OpSpec("ceil", P.ceil, np.ceil, [randn(3, 4)], check_grad=False),
+        OpSpec("round", P.round, np.round, [randn(3, 4)], check_grad=False),
+        OpSpec("trunc", P.trunc, np.trunc, [randn(3, 4)], check_grad=False),
+        OpSpec("frac", P.frac, lambda a: a - np.trunc(a), [randn(3, 4)],
+               check_grad=False),
+        OpSpec("sin", P.sin, np.sin, [randn(3, 4)]),
+        OpSpec("cos", P.cos, np.cos, [randn(3, 4)]),
+        OpSpec("tan", P.tan, np.tan, [rand(3, 4, lo=-1.0, hi=1.0)]),
+        OpSpec("asin", P.asin, np.arcsin, [rand(3, 4, lo=-0.8, hi=0.8)]),
+        OpSpec("acos", P.acos, np.arccos, [rand(3, 4, lo=-0.8, hi=0.8)]),
+        OpSpec("atan", P.atan, np.arctan, [randn(3, 4)]),
+        OpSpec("sinh", P.sinh, np.sinh, [randn(3, 4)]),
+        OpSpec("cosh", P.cosh, np.cosh, [randn(3, 4)]),
+        OpSpec("tanh", P.tanh, np.tanh, [randn(3, 4)]),
+        OpSpec("asinh", P.asinh, np.arcsinh, [randn(3, 4)]),
+        OpSpec("acosh", P.acosh, np.arccosh, [rand(3, 4, lo=1.5, hi=3.0)]),
+        OpSpec("atanh", P.atanh, np.arctanh, [rand(3, 4, lo=-0.7, hi=0.7)]),
+        OpSpec("erf", P.erf, np_erf, [randn(3, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 2e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 2e-2}),
+        OpSpec("deg2rad", P.deg2rad, np.deg2rad, [randn(3, 4, scale=90)]),
+        OpSpec("rad2deg", P.rad2deg, np.rad2deg, [randn(3, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 2e-2},
+               fw_atol={"float32": 1e-3, "bfloat16": 2e-1}),
+        OpSpec("clip", lambda x: P.clip(x, -0.5, 0.5),
+               lambda a: np.clip(a, -0.5, 0.5), [randn(3, 4)],
+               grad_atol=5e-2),
+        OpSpec("lerp", P.lerp,
+               lambda a, b, w: a + w * (b - a),
+               [randn(3, 4), randn(3, 4), rand(3, 4)]),
+        OpSpec("scale", lambda x: P.scale(x, 2.0, 1.0),
+               lambda a: a * 2.0 + 1.0, [randn(3, 4)]),
+        # ---- activations ----
+        OpSpec("relu", P.relu, lambda a: np.maximum(a, 0),
+               [rand(3, 4, lo=-1, hi=1)], grad_atol=5e-2),
+        OpSpec("relu6", P.relu6, lambda a: np.clip(a, 0, 6),
+               [randn(3, 4, scale=3)], grad_atol=5e-2),
+        OpSpec("sigmoid", P.sigmoid, np_sigmoid, [randn(3, 4)]),
+        OpSpec("silu", P.silu, lambda a: a * np_sigmoid(a), [randn(3, 4)]),
+        OpSpec("gelu_tanh", lambda x: P.gelu(x, approximate=True),
+               lambda a: 0.5 * a * (1 + np.tanh(
+                   np.sqrt(2 / np.pi) * (a + 0.044715 * a ** 3))),
+               [randn(3, 4)], covers="gelu"),
+        OpSpec("softplus", P.softplus, lambda a: np.log1p(np.exp(a)),
+               [randn(3, 4)]),
+        OpSpec("softsign", P.softsign, lambda a: a / (1 + np.abs(a)),
+               [randn(3, 4)]),
+        OpSpec("mish", P.mish,
+               lambda a: a * np.tanh(np.log1p(np.exp(a))), [randn(3, 4)]),
+        OpSpec("hardtanh", P.hardtanh, lambda a: np.clip(a, -1, 1),
+               [randn(3, 4, scale=2)], grad_atol=5e-2),
+        OpSpec("hardsigmoid", P.hardsigmoid,
+               lambda a: np.clip(a / 6.0 + 0.5, 0, 1),
+               [randn(3, 4, scale=4)],
+               fw_rtol={"float32": 2e-3, "bfloat16": 3e-2},
+               fw_atol={"float32": 2e-3, "bfloat16": 3e-2},
+               check_grad=False),
+        OpSpec("hardswish", P.hardswish,
+               lambda a: a * np.clip(a + 3, 0, 6) / 6, [randn(3, 4, scale=4)],
+               grad_atol=5e-2),
+        OpSpec("elu", P.elu,
+               lambda a: np.where(a > 0, a, np.exp(a) - 1), [randn(3, 4)]),
+        OpSpec("leaky_relu", P.leaky_relu,
+               lambda a: np.where(a > 0, a, 0.01 * a), [randn(3, 4)],
+               grad_atol=5e-2),
+        OpSpec("log_sigmoid", P.log_sigmoid,
+               lambda a: -np.log1p(np.exp(-a)), [randn(3, 4)]),
+        OpSpec("tanhshrink", P.tanhshrink, lambda a: a - np.tanh(a),
+               [randn(3, 4)]),
+        OpSpec("hardshrink", P.hardshrink,
+               lambda a: np.where(np.abs(a) > 0.5, a, 0.0),
+               [randn(3, 4)], check_grad=False),
+        OpSpec("softshrink", P.softshrink,
+               lambda a: np.where(a > 0.5, a - 0.5,
+                                  np.where(a < -0.5, a + 0.5, 0.0)),
+               [randn(3, 4)], check_grad=False),
+        OpSpec("logit", P.logit, lambda a: np.log(a / (1 - a)),
+               [rand(3, 4, lo=0.2, hi=0.8)]),
+        OpSpec("softmax", lambda x: P.softmax(x, axis=-1), np_softmax,
+               [randn(3, 4)]),
+        OpSpec("log_softmax", lambda x: P.log_softmax(x, axis=-1),
+               lambda a: np.log(np_softmax(a)), [randn(3, 4)]),
+        # ---- reductions ----
+        OpSpec("sum", lambda x: x.sum(), np.sum, [randn(3, 4)]),
+        OpSpec("sum_axis", lambda x: P.sum(x, axis=1),
+               lambda a: np.sum(a, axis=1), [randn(3, 4)]),
+        OpSpec("mean", lambda x: P.mean(x, axis=0),
+               lambda a: np.mean(a, axis=0), [randn(3, 4)]),
+        OpSpec("max_red", lambda x: P.max(x, axis=1),
+               lambda a: np.max(a, axis=1), [randn(3, 4)],
+               covers="max", grad_atol=5e-2),
+        OpSpec("min_red", lambda x: P.min(x, axis=1),
+               lambda a: np.min(a, axis=1), [randn(3, 4)],
+               covers="min", grad_atol=5e-2),
+        OpSpec("prod", lambda x: P.prod(x, axis=1),
+               lambda a: np.prod(a, axis=1), [rand(3, 4, lo=0.5, hi=1.5)]),
+        OpSpec("std", lambda x: P.std(x, axis=1),
+               lambda a: np.std(a, axis=1, ddof=1), [randn(3, 4)]),
+        OpSpec("var", lambda x: P.var(x, axis=1),
+               lambda a: np.var(a, axis=1, ddof=1), [randn(3, 4)]),
+        OpSpec("logsumexp", lambda x: P.logsumexp(x, axis=1),
+               lambda a: np.log(np.sum(np.exp(a), axis=1)), [randn(3, 4)]),
+        OpSpec("amax", lambda x: P.amax(x, axis=1),
+               lambda a: np.max(a, axis=1), [randn(3, 4)], check_grad=False),
+        OpSpec("amin", lambda x: P.amin(x, axis=1),
+               lambda a: np.min(a, axis=1), [randn(3, 4)], check_grad=False),
+        OpSpec("nansum", lambda x: P.nansum(x, axis=1),
+               lambda a: np.nansum(a, axis=1), [randn(3, 4)],
+               check_grad=False),
+        OpSpec("cumsum", lambda x: P.cumsum(x, axis=1),
+               lambda a: np.cumsum(a, axis=1), [randn(3, 4)]),
+        OpSpec("cumprod", lambda x: P.cumprod(x, dim=1),
+               lambda a: np.cumprod(a, axis=1),
+               [rand(3, 4, lo=0.5, hi=1.5)]),
+        OpSpec("logcumsumexp", lambda x: P.logcumsumexp(x, axis=1),
+               lambda a: np.log(np.cumsum(np.exp(a), axis=1)),
+               [randn(3, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 2e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 2e-2}),
+        OpSpec("diff", lambda x: P.diff(x, axis=1),
+               lambda a: np.diff(a, axis=1), [randn(3, 4)]),
+        OpSpec("trapezoid", P.trapezoid,
+               lambda a: np.trapezoid(a) if hasattr(np, "trapezoid")
+               else np.trapz(a), [randn(4)]),
+        OpSpec("median", lambda x: P.median(x, axis=1),
+               lambda a: np.median(a, axis=1), [randn(3, 5)],
+               check_grad=False),
+        OpSpec("quantile", lambda x: P.quantile(x, 0.5, axis=1),
+               lambda a: np.quantile(a, 0.5, axis=1), [randn(3, 5)],
+               dtypes=FP32, check_grad=False),
+        OpSpec("nanquantile", lambda x: P.nanquantile(x, 0.5, axis=1),
+               lambda a: np.nanquantile(a, 0.5, axis=1), [randn(3, 5)],
+               dtypes=FP32, check_grad=False),
+        # ---- manipulation ----
+        OpSpec("reshape", lambda x: P.reshape(x, [4, 3]),
+               lambda a: np.reshape(a, (4, 3)), [randn(3, 4)]),
+        OpSpec("transpose", lambda x: P.transpose(x, [1, 0]),
+               lambda a: a.T, [randn(3, 4)]),
+        OpSpec("flatten_op", lambda x: P.flatten(x),
+               lambda a: a.reshape(-1), [randn(2, 3, 2)], covers="flatten"),
+        OpSpec("squeeze", lambda x: P.squeeze(x, 1),
+               lambda a: np.squeeze(a, 1), [randn(3, 1, 4)]),
+        OpSpec("unsqueeze", lambda x: P.unsqueeze(x, 0),
+               lambda a: a[None], [randn(3, 4)]),
+        OpSpec("tile", lambda x: P.tile(x, [2, 3]),
+               lambda a: np.tile(a, (2, 3)), [randn(2, 3)]),
+        OpSpec("broadcast_to", lambda x: P.broadcast_to(x, [3, 4]),
+               lambda a: np.broadcast_to(a, (3, 4)).copy(), [randn(4)]),
+        OpSpec("flip", lambda x: P.flip(x, [0]),
+               lambda a: np.flip(a, 0).copy(), [randn(3, 4)]),
+        OpSpec("roll", lambda x: P.roll(x, 2, 1),
+               lambda a: np.roll(a, 2, 1), [randn(3, 4)]),
+        OpSpec("rot90", lambda x: P.rot90(x),
+               lambda a: np.rot90(a).copy(), [randn(3, 4)]),
+        OpSpec("tril", P.tril, np.tril, [randn(4, 4)]),
+        OpSpec("triu", P.triu, np.triu, [randn(4, 4)]),
+        OpSpec("diag", P.diag, np.diag, [randn(4)]),
+        OpSpec("diagonal", lambda x: P.diagonal(x),
+               lambda a: np.diagonal(a).copy(), [randn(3, 3)]),
+        OpSpec("kron", P.kron, np.kron, [randn(2, 2), randn(2, 3)]),
+        OpSpec("unflatten", lambda x: P.unflatten(x, 1, [2, 3]),
+               lambda a: a.reshape(2, 2, 3), [randn(2, 6)]),
+        OpSpec("gather", lambda x, i: P.gather(x, i, axis=0),
+               lambda a, i: a[i], [randn(5, 3), randint(4, lo=0, hi=5)]),
+        OpSpec("index_select", lambda x, i: P.index_select(x, i, axis=1),
+               lambda a, i: a[:, i], [randn(3, 5), randint(2, lo=0, hi=5)]),
+        OpSpec("take_along_axis",
+               lambda x, i: P.take_along_axis(x, i, 1),
+               lambda a, i: np.take_along_axis(a, i, 1),
+               [randn(3, 5), randint(3, 2, lo=0, hi=5)]),
+        OpSpec("take", lambda x, i: P.take(x, i),
+               lambda a, i: np.take(a, i),
+               [randn(3, 4), randint(5, lo=0, hi=12)], check_grad=False),
+        OpSpec("masked_fill", lambda x, m: P.masked_fill(x, m, 0.0),
+               lambda a, m: np.where(m, 0.0, a),
+               [randn(3, 4), randbool(3, 4)]),
+        OpSpec("index_fill",
+               lambda x, i: P.index_fill(x, i, 0, 7.0),
+               lambda a, i: _index_fill_ref(a, i, 7.0),
+               [randn(4, 3), lambda rng: np.array([1, 3])],
+               check_grad=False),
+        OpSpec("where", lambda c, x, y: P.where(c, x, y), np.where,
+               [randbool(3, 4), randn(3, 4), randn(3, 4)]),
+        OpSpec("pad", lambda x: P.pad(x, [1, 2], value=0.5),
+               lambda a: np.pad(a, ((0, 0), (1, 2)),
+                                constant_values=0.5), [randn(2, 3)]),
+        OpSpec("one_hot", lambda x: P.one_hot(x, 5),
+               lambda a: np.eye(5)[a],
+               [randint(4, lo=0, hi=5)], check_grad=False),
+        # ---- linalg ----
+        OpSpec("matmul", P.matmul, lambda a, b: a @ b,
+               [randn(3, 4), randn(4, 2)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("matmul_tt",
+               lambda x, y: P.matmul(x, y, transpose_x=True,
+                                     transpose_y=True),
+               lambda a, b: a.T @ b.T, [randn(4, 3), randn(2, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("bmm", P.bmm, lambda a, b: a @ b,
+               [randn(2, 3, 4), randn(2, 4, 2)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("mv", P.mv, lambda a, b: a @ b, [randn(3, 4), randn(4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("dot", P.dot, np.dot, [randn(5), randn(5)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("outer", P.outer, np.outer, [randn(3), randn(4)]),
+        OpSpec("inner", P.inner, np.inner, [randn(3, 4), randn(2, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("addmm", P.addmm,
+               lambda i, a, b: i + a @ b,
+               [randn(3, 2), randn(3, 4), randn(4, 2)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("trace", P.trace, np.trace, [randn(4, 4)]),
+        OpSpec("norm_fro", lambda x: P.norm(x),
+               lambda a: np.linalg.norm(a), [randn(3, 4)], covers="norm"),
+        OpSpec("norm_1", lambda x: P.norm(x, p=1, axis=1),
+               lambda a: np.sum(np.abs(a), axis=1),
+               [rand(3, 4, lo=0.2, hi=1.0)]),
+        OpSpec("dist", P.dist, lambda a, b: np.linalg.norm(a - b),
+               [randn(3, 4), randn(3, 4)]),
+        OpSpec("cdist", P.cdist,
+               lambda a, b: np.sqrt(
+                   np.sum((a[:, None] - b[None]) ** 2, -1) + 1e-30),
+               [randn(3, 4), randn(2, 4)], dtypes=FP32),
+        OpSpec("cross", lambda x, y: P.cross(x, y, axis=1),
+               lambda a, b: np.cross(a, b, axis=1),
+               [randn(2, 3), randn(2, 3)]),
+        OpSpec("det", P.det, np.linalg.det,
+               [lambda rng: (rng.randn(3, 3) +
+                             3 * np.eye(3)).astype(np.float32)],
+               dtypes=FP32),
+        OpSpec("inverse", P.inverse, np.linalg.inv,
+               [lambda rng: (rng.randn(3, 3) +
+                             3 * np.eye(3)).astype(np.float32)],
+               dtypes=FP32,
+               fw_rtol={"float32": 1e-3}, fw_atol={"float32": 1e-3}),
+        OpSpec("cholesky", P.cholesky,
+               lambda a: np.linalg.cholesky(a),
+               [lambda rng: _spd(rng, 3)], dtypes=FP32,
+               fw_rtol={"float32": 1e-3}, fw_atol={"float32": 1e-3},
+               check_grad=False),
+        OpSpec("matrix_power", lambda x: P.matrix_power(x, 3),
+               lambda a: np.linalg.matrix_power(a, 3),
+               [lambda rng: (0.3 * rng.randn(3, 3)).astype(np.float32)],
+               dtypes=FP32,
+               fw_rtol={"float32": 1e-3}, fw_atol={"float32": 1e-3}),
+        OpSpec("vander", lambda x: P.vander(x, 4),
+               lambda a: np.vander(a, 4), [rand(4, lo=0.5, hi=1.5)],
+               dtypes=FP32),
+        OpSpec("renorm", lambda x: P.renorm(x, 2.0, 0, 1.0),
+               lambda a: _renorm_ref(a, 2.0, 0, 1.0), [randn(3, 4)],
+               dtypes=FP32,
+               fw_rtol={"float32": 1e-4}, fw_atol={"float32": 1e-4}),
+        # ---- losses ----
+        OpSpec("mse_loss", P.mse_loss,
+               lambda i, t: np.mean((i - t) ** 2),
+               [randn(3, 4), randn(3, 4)]),
+        OpSpec("l1_loss", P.l1_loss,
+               lambda i, t: np.mean(np.abs(i - t)),
+               [randn(3, 4), randn(3, 4)], grad_atol=5e-2),
+        OpSpec("smooth_l1", P.smooth_l1_loss,
+               lambda i, t: np.mean(np.where(
+                   np.abs(i - t) < 1.0, 0.5 * (i - t) ** 2,
+                   np.abs(i - t) - 0.5)),
+               [randn(3, 4), randn(3, 4)]),
+        OpSpec("kl_div", P.kl_div,
+               lambda i, t: np.mean(t * (np.log(t) - i)),
+               [randn(3, 4), rand(3, 4, lo=0.2, hi=1.0)],
+               grad_inputs=[0]),
+        OpSpec("bce", P.binary_cross_entropy,
+               lambda i, t: -np.mean(t * np.log(i) +
+                                     (1 - t) * np.log(1 - i)),
+               [rand(3, 4, lo=0.1, hi=0.9), randbool(3, 4)],
+               grad_inputs=[0],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("bce_logits", P.binary_cross_entropy_with_logits,
+               lambda i, t: np.mean(
+                   np.maximum(i, 0) - i * t + np.log1p(np.exp(-np.abs(i)))),
+               [randn(3, 4), randbool(3, 4)], grad_inputs=[0]),
+        OpSpec("nll_loss", P.nll_loss,
+               lambda i, t: -np.mean(i[np.arange(len(t)), t]),
+               [randn(4, 5), randint(4, lo=0, hi=5)], grad_inputs=[0]),
+        OpSpec("cross_entropy",
+               lambda x, t: P.cross_entropy(x, t),
+               lambda a, t: -np.mean(np.log(
+                   np_softmax(a)[np.arange(len(t)), t])),
+               [randn(4, 5), randint(4, lo=0, hi=5)], grad_inputs=[0]),
+        # ---- nn functional ----
+        OpSpec("linear", P.linear,
+               lambda x, w, b: x @ w + b,
+               [randn(3, 4), randn(4, 2), randn(2)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 5e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 5e-2}),
+        OpSpec("embedding", lambda i, w: P.embedding(i, w),
+               lambda i, w: w[i],
+               [randint(3, 4, lo=0, hi=6), randn(6, 5)], grad_inputs=[1]),
+        OpSpec("layer_norm",
+               lambda x: P.layer_norm(x, [4]),
+               lambda a: (a - a.mean(-1, keepdims=True)) /
+               np.sqrt(a.var(-1, keepdims=True) + 1e-5),
+               [randn(3, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 3e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 3e-2}),
+        OpSpec("rms_norm_f",
+               lambda x, w: P.rms_norm(x, w),
+               lambda a, w: a / np.sqrt(
+                   np.mean(a * a, -1, keepdims=True) + 1e-6) * w,
+               [randn(3, 4), rand(4, lo=0.5, hi=1.5)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 3e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 3e-2}),
+        OpSpec("cosine_similarity", P.cosine_similarity,
+               lambda a, b: np.sum(a * b, 1) /
+               (np.linalg.norm(a, axis=1) * np.linalg.norm(b, axis=1)),
+               [randn(3, 4), randn(3, 4)],
+               fw_rtol={"float32": 1e-4, "bfloat16": 3e-2},
+               fw_atol={"float32": 1e-4, "bfloat16": 3e-2}),
+    ]
+    return specs
+
+
+# Ops in OP_TABLE intentionally NOT covered by a forward/grad spec —
+# each carries the reason (multi-output/structural tests, stateful RNG,
+# IO/distributed/framework plumbing). audit_coverage() enforces that
+# everything else is spec'd.
+EXEMPTIONS = {
+    "all": "structural",
+    "angle": "structural",
+    "any": "structural",
+    "argmax": "structural",
+    "argmin": "structural",
+    "argsort": "structural",
+    "as_strided": "structural",
+    "assign": "structural",
+    "bincount": "structural",
+    "bitwise_and": "structural",
+    "bitwise_left_shift": "structural",
+    "bitwise_not": "structural",
+    "bitwise_or": "structural",
+    "bitwise_right_shift": "structural",
+    "bitwise_xor": "structural",
+    "bucketize": "structural",
+    "cast": "structural",
+    "complex": "structural",
+    "cond": "structural",
+    "conj": "structural",
+    "count_nonzero": "structural",
+    "crop": "structural",
+    "cummax": "structural",
+    "cummin": "structural",
+    "diag_embed": "structural",
+    "diagflat": "structural",
+    "digamma": "structural",
+    "equal": "structural",
+    "erfinv": "structural",
+    "expand": "structural",
+    "frexp": "structural",
+    "full_like": "structural",
+    "gather_nd": "structural",
+    "gcd": "structural",
+    "greater_equal": "structural",
+    "greater_than": "structural",
+    "histogram": "structural",
+    "imag": "structural",
+    "increment": "structural",
+    "index_add": "structural",
+    "index_put": "structural",
+    "index_sample": "structural",
+    "isfinite": "structural",
+    "isinf": "structural",
+    "isnan": "structural",
+    "kthvalue": "structural",
+    "lcm": "structural",
+    "less_equal": "structural",
+    "less_than": "structural",
+    "lgamma": "structural",
+    "logical_and": "structural",
+    "logical_not": "structural",
+    "logical_or": "structural",
+    "logical_xor": "structural",
+    "masked_scatter": "structural",
+    "mode": "structural",
+    "moveaxis": "structural",
+    "multiplex": "structural",
+    "nanmean": "structural",
+    "not_equal": "structural",
+    "ones_like": "structural",
+    "polar": "structural",
+    "put_along_axis": "structural",
+    "real": "structural",
+    "repeat_interleave": "structural",
+    "scatter": "structural",
+    "scatter_nd_add": "structural",
+    "searchsorted": "structural",
+    "slice_op": "structural",
+    "sort": "structural",
+    "split_p": "structural",
+    "strided_slice": "structural",
+    "swapaxes": "structural",
+    "topk": "structural",
+    "unbind_p": "structural",
+    "unfold": "structural",
+    "view": "structural",
+    "zeros_like": "structural",
+    "cholesky_solve": "linalg",
+    "corrcoef": "linalg",
+    "cov": "linalg",
+    "eig": "linalg",
+    "eigh": "linalg",
+    "eigvals": "linalg",
+    "eigvalsh": "linalg",
+    "householder_product": "linalg",
+    "lstsq": "linalg",
+    "lu": "linalg",
+    "matrix_rank": "linalg",
+    "multi_dot": "linalg",
+    "pinv": "linalg",
+    "qr": "linalg",
+    "slogdet": "linalg",
+    "solve": "linalg",
+    "svd": "linalg",
+    "tensordot": "linalg",
+    "triangular_solve": "linalg",
+    "adaptive_avg_pool1d": "composite",
+    "adaptive_avg_pool2d": "composite",
+    "adaptive_max_pool2d": "composite",
+    "avg_pool1d": "composite",
+    "avg_pool2d": "composite",
+    "batch_norm_eval": "composite",
+    "batch_norm_train": "composite",
+    "celu": "composite",
+    "channel_shuffle": "composite",
+    "conv1d": "composite",
+    "conv2d": "composite",
+    "conv2d_transpose": "composite",
+    "conv3d": "composite",
+    "glu": "composite",
+    "group_norm": "composite",
+    "hinge_embedding_loss": "composite",
+    "instance_norm": "composite",
+    "interpolate": "composite",
+    "local_response_norm": "composite",
+    "margin_ranking_loss": "composite",
+    "max_pool1d": "composite",
+    "max_pool2d": "composite",
+    "maxout": "composite",
+    "pixel_shuffle": "composite",
+    "pixel_unshuffle": "composite",
+    "prelu": "composite",
+    "rms_norm": "composite",
+    "scaled_dot_product_attention": "composite",
+    "selu": "composite",
+    "stanh": "composite",
+    "swish": "composite",
+    "temporal_shift": "composite",
+    "thresholded_relu": "composite",
+    "gumbel_softmax": "random",
+    "rrelu": "random",
+    "box_coder": "vision",
+    "box_iou": "vision",
+    "deform_conv2d_op": "vision",
+    "roi_align": "vision",
+    "roi_pool": "vision",
+    "yolo_box": "vision",
+    "embedding_sparse": "sparse",
+    "flash_attention": "composite",
+    "global_gather": "distributed",
+    "global_scatter": "distributed",
+    "mp_constraint": "distributed",
+    "ring_flash_attention": "distributed",
+    "topk_gating": "distributed",
+    "ulysses_attention": "distributed",
+    "dequantize_linear": "quant",
+    "fake_quant_dequant": "quant",
+    "quantize_linear": "quant",
+}
+
+EXEMPT_REASONS = {
+    "structural": (
+        "multi-output or ordering ops checked by dedicated structural "
+        "tests in test_op_suite/test_ops"),
+    "random": "stochastic output; statistical tests live in test_ops",
+    "framework": (
+        "framework plumbing (casting/copy/printing/device), exercised "
+        "across the whole suite"),
+    "composite": (
+        "thin composition of spec'd ops (e.g. losses/norm wrappers) "
+        "covered by test_nn oracle tests"),
+    "linalg": "decomposition/solver ops oracle-tested in test_ops",
+    "quant": "fake-quant ops tested in test_quantization",
+    "vision": "vision/detection ops oracle-tested in test_vision_ops",
+    "sparse": "SelectedRows/sparse ops tested in test_sparse",
+    "distributed": "collective ops need a mesh; tested in distributed suites",
+}
+
+
+def audit_coverage():
+    """Return (unspecced, stale): OP_TABLE ops with neither spec nor
+    exemption, and exempt names that no longer exist."""
+    import paddle_tpu as paddle
+    from . import _primitive
+    from . import pallas_ops  # noqa: F401 — registers flash_attention
+    spec_names = set()
+    for s in build_specs():
+        # exact identities only — a prefix alias would let deleting a
+        # spec silently uncover an op (the drift this audit exists for)
+        spec_names.add(getattr(s.fn, "__name__", s.name))
+        spec_names.add(s.name)
+        if s.covers:
+            spec_names.add(s.covers)
+    exempt = set(EXEMPTIONS)
+    unspecced = sorted(
+        op for op in _primitive.OP_TABLE
+        if op not in spec_names and op not in exempt)
+    stale = sorted(e for e in EXEMPTIONS
+                   if e not in _primitive.OP_TABLE)
+    return unspecced, stale

@@ -1,169 +1,6 @@
-"""OpTest harness — the upstream test/legacy_test/op_test.py pattern
-(SURVEY.md §4 lesson (a)) rebuilt for the TPU framework:
+"""OpTest harness — now a thin re-export of the package's single-source
+op spec registry (paddle_tpu/ops/op_spec.py, the L0 idea of upstream's
+ops.yaml codegen).  Kept for import compatibility."""
 
-- forward check against a numpy oracle,
-- numeric gradient check (central finite differences) against the tape
-  autograd,
-- dtype sweep (fp32 exact-ish, bf16 loose) per op.
-
-Specs are declarative (`OpSpec`); suites parameterize over them so
-adding an op test is one line.
-"""
-
-from __future__ import annotations
-
-from dataclasses import dataclass, field
-from typing import Callable, Dict, Optional, Sequence, Tuple
-
-import numpy as np
-
-import paddle_tpu as paddle
-
-
-@dataclass
-class OpSpec:
-    name: str                       # display/id
-    fn: Callable                    # paddle-level op over Tensors
-    ref: Callable                   # numpy oracle over np arrays
-    inputs: Sequence[Callable]      # each: rng -> np.ndarray
-    kwargs: Dict = field(default_factory=dict)
-    dtypes: Tuple[str, ...] = ("float32", "bfloat16")
-    check_grad: bool = True
-    grad_inputs: Optional[Sequence[int]] = None  # default: all float
-    fw_rtol: Dict[str, float] = field(default_factory=lambda: {
-        "float32": 1e-5, "bfloat16": 2e-2, "float16": 1e-2})
-    fw_atol: Dict[str, float] = field(default_factory=lambda: {
-        "float32": 1e-5, "bfloat16": 2e-2, "float16": 1e-2})
-    grad_atol: float = 1e-2
-    grad_rtol: float = 1e-2
-    grad_eps: float = 1e-3
-
-    def __repr__(self):
-        return self.name
-
-
-def _cast_in(a: np.ndarray, dtype: str):
-    if not np.issubdtype(a.dtype, np.floating):
-        return a  # int/bool inputs keep their dtype
-    if dtype == "bfloat16":
-        import ml_dtypes
-        return a.astype(ml_dtypes.bfloat16)
-    return a.astype(dtype)
-
-
-def _is_numeric(a: np.ndarray) -> bool:
-    # ml_dtypes types (bfloat16 etc.) are not np.number subdtypes;
-    # treat anything float-kind-ish ("f", "i", "u", or custom "V"-coded
-    # float like bfloat16) as numeric
-    try:
-        np.asarray(a).astype(np.float64)
-        return a.dtype != np.bool_
-    except (TypeError, ValueError):
-        return False
-
-
-def _to_f64(a) -> np.ndarray:
-    a = np.asarray(a)
-    return a.astype(np.float64) if _is_numeric(a) else a
-
-
-def check_forward(spec: OpSpec, dtype: str, seed: int = 0):
-    rng = np.random.RandomState(seed)
-    raw = [g(rng) for g in spec.inputs]
-    args = [paddle.to_tensor(_cast_in(a, dtype)) for a in raw]
-    out = spec.fn(*args, **spec.kwargs)
-    ref = spec.ref(*[a.astype(np.float64)
-                     if np.issubdtype(a.dtype, np.floating) else a
-                     for a in raw], **spec.kwargs)
-    outs = out if isinstance(out, (tuple, list)) else (out,)
-    refs = ref if isinstance(ref, (tuple, list)) else (ref,)
-    assert len(outs) == len(refs), \
-        f"{spec.name}: {len(outs)} outputs vs {len(refs)} oracle outputs"
-    for o, r in zip(outs, refs):
-        raw_got = np.asarray(o.numpy())
-        got = _to_f64(raw_got)
-        want = _to_f64(r)
-        assert got.shape == want.shape, \
-            f"{spec.name}[{dtype}]: shape {got.shape} != {want.shape}"
-        if _is_numeric(raw_got) and got.dtype == np.float64:
-            np.testing.assert_allclose(
-                got, want, rtol=spec.fw_rtol[dtype],
-                atol=spec.fw_atol[dtype],
-                err_msg=f"{spec.name} forward mismatch [{dtype}]")
-        else:
-            np.testing.assert_array_equal(
-                got, want, err_msg=f"{spec.name} forward mismatch")
-
-
-def check_grad(spec: OpSpec, seed: int = 0):
-    """Tape-autograd gradients vs central finite differences, fp32
-    inputs / fp64 oracle arithmetic, scalar loss = sum(op(x))."""
-    rng = np.random.RandomState(seed)
-    raw = [g(rng) for g in spec.inputs]
-    grad_idx = spec.grad_inputs
-    if grad_idx is None:
-        grad_idx = [i for i, a in enumerate(raw)
-                    if np.issubdtype(a.dtype, np.floating)]
-    assert grad_idx, f"{spec.name}: no differentiable inputs"
-
-    def run(np_args) -> float:
-        ts = [paddle.to_tensor(a.astype(np.float32)
-                               if np.issubdtype(a.dtype, np.floating)
-                               else a)
-              for a in np_args]
-        out = spec.fn(*ts, **spec.kwargs)
-        out0 = out[0] if isinstance(out, (tuple, list)) else out
-        return float(out0.sum().numpy())
-
-    # analytic
-    ts = []
-    for i, a in enumerate(raw):
-        st = i not in grad_idx
-        ts.append(paddle.to_tensor(
-            a.astype(np.float32)
-            if np.issubdtype(a.dtype, np.floating) else a,
-            stop_gradient=st))
-    out = spec.fn(*ts, **spec.kwargs)
-    out0 = out[0] if isinstance(out, (tuple, list)) else out
-    out0.sum().backward()
-
-    for i in grad_idx:
-        analytic = np.asarray(ts[i].grad.numpy(), dtype=np.float64)
-        numeric = np.zeros_like(raw[i], dtype=np.float64)
-        it = np.nditer(raw[i], flags=["multi_index"])
-        eps = spec.grad_eps
-        while not it.finished:
-            idx = it.multi_index
-            plus = [a.copy() for a in raw]
-            minus = [a.copy() for a in raw]
-            plus[i][idx] += eps
-            minus[i][idx] -= eps
-            numeric[idx] = (run(plus) - run(minus)) / (2 * eps)
-            it.iternext()
-        np.testing.assert_allclose(
-            analytic, numeric, rtol=spec.grad_rtol, atol=spec.grad_atol,
-            err_msg=f"{spec.name} grad mismatch on input {i}")
-
-
-def rand(*shape, lo=0.0, hi=1.0):
-    def gen(rng):
-        return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
-    return gen
-
-
-def randn(*shape, scale=1.0):
-    def gen(rng):
-        return (rng.randn(*shape) * scale).astype(np.float32)
-    return gen
-
-
-def randint(*shape, lo=0, hi=10, dtype=np.int64):
-    def gen(rng):
-        return rng.randint(lo, hi, size=shape).astype(dtype)
-    return gen
-
-
-def randbool(*shape):
-    def gen(rng):
-        return rng.rand(*shape) > 0.5
-    return gen
+from paddle_tpu.ops.op_spec import (  # noqa
+    OpSpec, check_forward, check_grad, rand, randn, randint, randbool)
