@@ -1,0 +1,151 @@
+"""Automatic SParsity (parity: python/paddle/incubate/asp/ — ASPHelper,
+prune_model, decorate, 2:4 semi-structured sparsity; SURVEY.md §2.2
+"Incubate" row).
+
+Upstream prunes FC/conv weights to the 2:4 pattern the A100 sparse
+tensor cores execute.  TPU MXUs have no 2:4 hardware mode, so the
+TPU-native value of ASP is the *algorithm*: train-time structured
+pruning with mask preservation (prune → mask-respecting optimizer) so
+models exported elsewhere (or simply sparsified for quality/size
+research) match upstream behavior bit-for-bit.  Masks are applied as
+elementwise multiplies, which XLA fuses into the consuming matmul.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+# pruned models tracked weakly: a deleted model drops out of the set,
+# releasing its masks (and immune to id() reuse)
+_PRUNED_MODELS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _mask_1d_2to4(flat: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest-|w| of every 4 consecutive weights."""
+    n = flat.shape[0]
+    pad = (-n) % 4
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat.reshape(-1, 4))
+    order = np.argsort(groups, axis=1)          # ascending
+    mask = np.ones_like(groups, dtype=bool)
+    rows = np.arange(groups.shape[0])[:, None]
+    mask[rows, order[:, :2]] = False            # drop the 2 smallest
+    mask = mask.reshape(-1)
+    return mask[:n] if pad else mask
+
+
+def create_mask(weight: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m sparsity mask along the input dimension (paddle masks along
+    the reduced dim of FC weights)."""
+    if (n, m) != (2, 4):
+        raise NotImplementedError("only 2:4 sparsity is supported")
+    w = np.asarray(weight)
+    if w.ndim < 2:
+        return np.ones_like(w, dtype=bool)
+    flat = w.reshape(-1)
+    return _mask_1d_2to4(flat).reshape(w.shape)
+
+
+def check_mask_2_4(weight: np.ndarray) -> bool:
+    """True if every aligned group of 4 has ≤2 nonzeros."""
+    flat = np.asarray(weight).reshape(-1)
+    pad = (-flat.shape[0]) % 4
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    nz = (flat.reshape(-1, 4) != 0).sum(axis=1)
+    return bool((nz <= 2).all())
+
+
+def set_excluded_layers(model, layer_names: List[str]):
+    if not hasattr(model, "_asp_excluded"):
+        model._asp_excluded = set()
+    model._asp_excluded.update(layer_names)
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        for m in list(_PRUNED_MODELS):
+            if hasattr(m, "_asp_excluded"):
+                m._asp_excluded = set()
+        return
+    if hasattr(model, "_asp_excluded"):
+        model._asp_excluded = set()
+
+
+def _prunable(model):
+    """(name, param) pairs ASP prunes: ≥2-D weights of Linear/Conv-like
+    layers, excluding user-excluded layer names."""
+    excluded = getattr(model, "_asp_excluded", set())
+    out = []
+    for lname, layer in [("", model)] + [
+            (n, l) for n, l in getattr(model, "named_sublayers",
+                                       lambda: [])()]:
+        if lname in excluded:
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or w._value.ndim < 2:
+            continue
+        if type(layer).__name__ not in ("Linear", "Conv2D", "Conv1D",
+                                        "Conv3D", "ColumnParallelLinear",
+                                        "RowParallelLinear"):
+            continue
+        pname = f"{lname}.weight" if lname else "weight"
+        out.append((pname, w))
+    return out
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune every supported weight to n:m sparsity and remember the
+    masks (on the model, tracked weakly) so ``decorate``-wrapped
+    optimizers keep them zero."""
+    masks = getattr(model, "_asp_masks", None)
+    if masks is None:
+        masks = model._asp_masks = {}
+    for name, p in _prunable(model):
+        mask = create_mask(np.asarray(p._value), n, m)
+        jmask = jnp.asarray(mask, dtype=p._value.dtype)
+        p._value = p._value * jmask
+        if with_mask:
+            masks[name] = (p, jmask)
+    if with_mask:
+        _PRUNED_MODELS.add(model)
+    return masks
+
+
+def decorate(optimizer):
+    """Wrap an optimizer so every ``step()`` re-applies the pruning
+    masks (upstream OptimizerWithSparsityGuarantee)."""
+    return OptimizerWithSparsityGuarantee(optimizer)
+
+
+class OptimizerWithSparsityGuarantee:
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner"], item)
+
+    def step(self):
+        self._inner.step()
+        # re-zero pruned weights (momentum/adam updates revive them);
+        # only live pruned models are touched (WeakSet)
+        for model in list(_PRUNED_MODELS):
+            for p, jmask in getattr(model, "_asp_masks", {}).values():
+                p._value = p._value * jmask
+
+    def clear_grad(self, *a, **k):
+        return self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        loss.backward()
+        self.step()
+        self._inner.clear_grad()
+        return None, None

@@ -8,16 +8,126 @@ from ....ops.nn_ops import scaled_dot_product_attention  # noqa
 from ....ops.nn_ops import linear as fused_linear  # noqa
 
 
-def fused_multi_head_attention(x, qkv_weight, linear_weight, *args,
-                               **kwargs):
-    raise NotImplementedError(
-        "fused_multi_head_attention: use nn.MultiHeadAttention — XLA "
-        "fuses the composed form on TPU")
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5,
+                               qkv_bias=None, linear_bias=None,
+                               cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True,
+                               mode="upscale_in_train", ring_id=-1,
+                               add_residual=True, num_heads=-1,
+                               transpose_qkv_wb=False, name=None):
+    """Upstream fused_multi_head_attention (fused_attention CUDA op)
+    semantics, composed for XLA fusion: optional pre-LN → fused QKV
+    projection → scaled-dot-product attention (+mask, +attn dropout) →
+    output projection → dropout → residual add → optional post-LN.
+
+    ``qkv_weight``: [3, num_heads, head_dim, embed_dim] (paddle layout;
+    [embed_dim, 3*embed_dim] with ``transpose_qkv_wb=True``)."""
+    import jax.numpy as jnp
+    from ....ops import _primitive
+    from ....ops.nn_ops import (layer_norm, dropout,
+                                scaled_dot_product_attention)
+    from ....ops import matmul, reshape, transpose
+    from ....tensor import Tensor
+
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention(cache_kv=...) decode caching is "
+            "not implemented; use nn.MultiHeadAttention with explicit "
+            "cache handling")
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "fused_multi_head_attention(ring_id>=0): tensor parallelism "
+            "on TPU is expressed via fleet.meta_parallel mp layers "
+            "(SPMD), not NCCL ring ids")
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = layer_norm(out, out.shape[-1:], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    b, s, e = out.shape
+    if transpose_qkv_wb:
+        nh = int(num_heads)
+        if nh <= 0:
+            raise ValueError(
+                "num_heads must be given with transpose_qkv_wb=True")
+        qkv = matmul(out, qkv_weight)                # [b, s, 3e]
+        if qkv_bias is not None:
+            qkv = qkv + qkv_bias
+        qkv = reshape(qkv, [b, s, 3, nh, e // nh])
+    else:
+        w = qkv_weight  # [3, H, hd, E]
+        nh = w.shape[1]
+        hd = w.shape[2]
+        flat_w = reshape(w, [3 * nh * hd, e])
+        qkv = matmul(out, flat_w, transpose_y=True)  # [b, s, 3*H*hd]
+        if qkv_bias is not None:
+            qkv = qkv + reshape(qkv_bias, [3 * nh * hd])
+        qkv = reshape(qkv, [b, s, 3, nh, hd])
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    ctx = scaled_dot_product_attention(
+        q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
+        training=training)
+    ctx = reshape(ctx, [b, s, e])
+    proj = matmul(ctx, linear_weight)
+    if linear_bias is not None:
+        proj = proj + linear_bias
+    proj = dropout(proj, p=dropout_rate, training=training,
+                   mode=mode)
+    if add_residual:
+        proj = residual + proj
+    if not pre_layer_norm:
+        proj = layer_norm(proj, proj.shape[-1:], ln_scale, ln_bias,
+                          ln_epsilon)
+    return proj
 
 
-def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
-    raise NotImplementedError(
-        "fused_feedforward: use Linear+activation — XLA fuses on TPU")
+def fused_feedforward(x, linear1_weight, linear2_weight,
+                      linear1_bias=None, linear2_bias=None,
+                      ln1_scale=None, ln1_bias=None, ln2_scale=None,
+                      ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True,
+                      mode="upscale_in_train", ring_id=-1, name=None):
+    """Upstream fused_feedforward semantics:
+    ``residual + dropout2(linear2(dropout1(act(linear1(ln?(x))))))``
+    with pre- or post-LN."""
+    from ....ops.nn_ops import layer_norm, dropout
+    from ....ops import matmul
+    from .... import ops as _ops
+
+    if ring_id not in (-1, None):
+        raise NotImplementedError(
+            "fused_feedforward(ring_id>=0): use fleet.meta_parallel mp "
+            "layers for tensor parallelism on TPU")
+    residual = x
+    out = x
+    if pre_layer_norm:
+        out = layer_norm(out, out.shape[-1:], ln1_scale, ln1_bias,
+                         ln1_epsilon)
+    out = matmul(out, linear1_weight)
+    if linear1_bias is not None:
+        out = out + linear1_bias
+    act = getattr(_ops, activation)
+    out = act(out)
+    out = dropout(out, p=dropout1_rate, training=training,
+                  mode=mode)
+    out = matmul(out, linear2_weight)
+    if linear2_bias is not None:
+        out = out + linear2_bias
+    out = dropout(out, p=dropout2_rate, training=training,
+                  mode=mode)
+    out = residual + out
+    if not pre_layer_norm:
+        out = layer_norm(out, out.shape[-1:], ln2_scale, ln2_bias,
+                         ln2_epsilon)
+    return out
 
 
 def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5, *args,

@@ -1,0 +1,54 @@
+"""incubate.asp 2:4 sparsity tests (upstream python/paddle/incubate/asp
+ASPHelper / prune_model / decorate)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+from paddle_tpu.tensor import Tensor
+
+
+def test_prune_model_2_4_pattern():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 8), nn.ReLU(), nn.Linear(8, 4))
+    asp.prune_model(net)
+    for _, l in net.named_sublayers():
+        w = getattr(l, "weight", None)
+        if w is None:
+            continue
+        flat = np.asarray(w.numpy()).reshape(-1)
+        assert asp.check_mask_2_4(flat)
+        # exactly half the weights per group survive
+        assert (flat != 0).mean() <= 0.5 + 1e-6
+
+
+def test_decorated_optimizer_preserves_mask():
+    paddle.seed(0)
+    net = nn.Linear(16, 8)
+    asp.prune_model(net)
+    mask0 = np.asarray(net.weight.numpy()) != 0
+    opt = asp.decorate(optimizer.Momentum(
+        learning_rate=0.1, momentum=0.9, parameters=net.parameters()))
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        x = Tensor(rng.rand(4, 16).astype(np.float32))
+        loss = (net(x) ** 2.0).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    w = np.asarray(net.weight.numpy())
+    assert (w[~mask0] == 0).all(), "pruned weights were revived"
+    assert np.abs(w[mask0]).sum() > 0
+
+
+def test_excluded_layers_not_pruned():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 8), nn.Linear(8, 4))
+    names = [n for n, _ in net.named_sublayers()]
+    asp.set_excluded_layers(net, [names[0]])
+    asp.prune_model(net)
+    w0 = np.asarray(net[0].weight.numpy())
+    assert (w0 != 0).all()
+    assert asp.check_mask_2_4(np.asarray(net[1].weight.numpy()))
+    asp.reset_excluded_layers(net)
