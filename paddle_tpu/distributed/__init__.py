@@ -27,7 +27,7 @@ from . import passes  # noqa
 # auto-parallel style API
 from .auto_parallel.api import (  # noqa
     ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,
-    Placement, Replicate, Shard, Partial)
+    shard_dataloader, Placement, Replicate, Shard, Partial)
 from .auto_parallel.engine import Engine, DistModel, to_static  # noqa
 
 

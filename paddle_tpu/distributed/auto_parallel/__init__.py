@@ -1,4 +1,4 @@
 from .api import (  # noqa
     ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,
-    Placement, Replicate, Shard, Partial)
+    shard_dataloader, Placement, Replicate, Shard, Partial)
 from .engine import Engine, DistModel, to_static  # noqa

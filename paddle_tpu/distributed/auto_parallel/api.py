@@ -105,11 +105,109 @@ def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
 
 
 def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
-    return shard_tensor(x, mesh, placements)
+    """Move a tensor to a new placement (upstream dist.reshard).
+
+    Eagerly this is a device_put (XLA emits the collective/resharding
+    transfer); under a jit trace it lowers to a sharding constraint so
+    the SPMD partitioner plans the reshard inside the step.  ``Partial``
+    placements are accepted for annotation parity but have no eager
+    value representation — resharding Partial→Replicate is the SPMD
+    partitioner's psum and only meaningful inside a traced program.
+    """
+    val = x._value if isinstance(x, Tensor) else x
+    jmesh = mesh.get_jax_mesh()
+    ndim = getattr(val, "ndim", Tensor(val).ndim)
+    spec = _placements_to_spec(placements, mesh, ndim)
+    if isinstance(val, jax.core.Tracer):
+        new_val = jax.lax.with_sharding_constraint(
+            val, NamedSharding(jmesh, spec))
+    else:
+        new_val = jax.device_put(val, NamedSharding(jmesh, spec))
+    # a NEW tensor (upstream dist.reshard semantics): the input keeps
+    # its placement — reshard-for-a-read must not re-place the caller's
+    # parameter in place
+    out = Tensor(new_val)
+    if isinstance(x, Tensor):
+        out.stop_gradient = x.stop_gradient
+    out.dist_spec = tuple(spec)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    return out
 
 
 def shard_op(op, mesh: ProcessMesh = None, in_placements=None,
              out_placements=None):
+    """Wrap an op so its inputs/outputs carry the given placements —
+    the manual escape hatch of upstream's semi-auto SPMD rules."""
     def wrapper(*args, **kwargs):
-        return op(*args, **kwargs)
+        if mesh is not None and in_placements:
+            ip = list(in_placements)
+            if ip and isinstance(ip[0], Placement):
+                ip = [ip]          # flat form = placements for arg 0
+            args = tuple(
+                reshard(a, mesh, pl) if isinstance(a, Tensor) and pl
+                else a
+                for a, pl in zip(args, ip + [None] * (len(args)
+                                                      - len(ip))))
+        out = op(*args, **kwargs)
+        if mesh is not None and out_placements:
+            if isinstance(out, (list, tuple)):
+                outs = [reshard(o, mesh, pl) if pl else o
+                        for o, pl in zip(out, out_placements)]
+                return type(out)(outs)
+            return reshard(out, mesh, out_placements[0]
+                           if isinstance(out_placements[0],
+                                         (list, tuple))
+                           else out_placements)
+        return out
     return wrapper
+
+
+def shard_dataloader(dataloader, meshes, input_keys=None,
+                     shard_dims="dp"):
+    """Wrap a DataLoader so every yielded batch is placed batch-sharded
+    on the data axis of the mesh (upstream dist.shard_dataloader).
+    Dict batches are supported via their keys (``input_keys`` restricts
+    which entries get sharded)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    if isinstance(shard_dims, int):
+        shard_dims = mesh.dim_names[shard_dims]
+    if not isinstance(shard_dims, str):
+        raise NotImplementedError(
+            "per-input shard_dims lists are not supported; pass one "
+            "mesh dim name (str) or index (int)")
+    dim = shard_dims
+    axis_size = int(dict(zip(mesh.dim_names, mesh.shape))[dim])
+
+    def _place(it, sh):
+        t = it if isinstance(it, Tensor) else Tensor(np.asarray(it))
+        if t.shape and t.shape[0] % axis_size != 0:
+            raise ValueError(
+                f"shard_dataloader: batch dim {t.shape[0]} not "
+                f"divisible by mesh axis {dim!r} ({axis_size}); use "
+                "drop_last=True or a divisible batch size")
+        t._value = jax.device_put(t._value, sh)
+        return t
+
+    class _Sharded:
+        def __init__(self, loader):
+            self._loader = loader
+
+        def __len__(self):
+            return len(self._loader)
+
+        def __iter__(self):
+            jmesh = mesh.get_jax_mesh()
+            sh = NamedSharding(jmesh, PartitionSpec(dim))
+            for batch in self._loader:
+                if isinstance(batch, dict):
+                    keys = input_keys or list(batch)
+                    yield {k: (_place(v, sh) if k in keys else v)
+                           for k, v in batch.items()}
+                    continue
+                items = batch if isinstance(batch, (list, tuple)) \
+                    else [batch]
+                out = [_place(it, sh) for it in items]
+                yield out if isinstance(batch, (list, tuple)) else out[0]
+
+    return _Sharded(dataloader)

@@ -880,3 +880,91 @@ def test_pipeline_engine_syncs_optimizer_state():
     eng2 = PipelineParallel(net, None, _Strat())
     with _pytest.raises(ValueError, match="fresh optimizer"):
         eng2.train_batch((x, y), opt2)
+
+
+def test_auto_parallel_reshard_and_dataloader():
+    """Upstream dist.reshard / shard_dataloader parity: eager reshard
+    re-places the tensor; traced reshard becomes a sharding constraint;
+    shard_dataloader yields dp-sharded batches."""
+    _need_devices(4)
+    from paddle_tpu.distributed import (ProcessMesh, Shard, Replicate,
+                                        reshard, shard_dataloader,
+                                        shard_tensor)
+    from paddle_tpu.tensor import Tensor
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    mesh = ProcessMesh(np.arange(4).reshape(2, 2), dim_names=["dp", "mp"])
+    x = Tensor(np.arange(16, dtype=np.float32).reshape(4, 4))
+    xs = reshard(x, mesh, [Shard(0), Replicate()])
+    assert xs.process_mesh is mesh
+    np.testing.assert_allclose(np.asarray(xs.numpy()),
+                               np.arange(16).reshape(4, 4))
+    xr = reshard(xs, mesh, [Replicate(), Replicate()])
+    assert xr.placements[0].__class__.__name__ == "Replicate"
+
+    # traced reshard compiles (constraint path)
+    def f(v):
+        t = Tensor(v)
+        return reshard(t, mesh, [Shard(0)])._value * 2.0
+
+    out = jax.jit(f)(x._value)
+    np.testing.assert_allclose(np.asarray(out),
+                               2.0 * np.arange(16).reshape(4, 4))
+
+    class Synth(Dataset):
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return (np.full(3, i, np.float32),
+                    np.asarray([i], np.int64))
+
+    loader = shard_dataloader(DataLoader(Synth(), batch_size=4),
+                              mesh, shard_dims="dp")
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert xb.shape[0] == 4
+    # placed with a dp-sharded layout
+    assert "dp" in str(xb._value.sharding.spec)
+
+
+def test_reshard_returns_new_tensor():
+    """Review finding: reshard must not re-place the caller's tensor in
+    place (upstream dist.reshard returns a new tensor)."""
+    _need_devices(2)
+    from paddle_tpu.distributed import (ProcessMesh, Shard, Replicate,
+                                        reshard, shard_tensor)
+    from paddle_tpu.tensor import Tensor
+
+    mesh = ProcessMesh(np.arange(2), dim_names=["dp"])
+    x = shard_tensor(Tensor(np.arange(8, dtype=np.float32).reshape(4, 2)),
+                     mesh, [Shard(0)])
+    before = x._value.sharding
+    y = reshard(x, mesh, [Replicate()])
+    assert y is not x
+    assert x._value.sharding == before, "reshard mutated its input"
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               np.asarray(x.numpy()))
+
+
+def test_shard_dataloader_rejects_indivisible_batch():
+    _need_devices(2)
+    import pytest as _pytest
+    from paddle_tpu.distributed import ProcessMesh, shard_dataloader
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Synth(Dataset):
+        def __len__(self):
+            return 9   # 9 % 4 -> last batch of 1, indivisible by dp=2
+
+        def __getitem__(self, i):
+            return np.full(3, i, np.float32)
+
+    mesh = ProcessMesh(np.arange(2), dim_names=["dp"])
+    loader = shard_dataloader(DataLoader(Synth(), batch_size=4), mesh,
+                              shard_dims="dp")
+    with _pytest.raises(ValueError, match="drop_last"):
+        list(loader)
