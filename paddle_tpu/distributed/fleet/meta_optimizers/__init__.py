@@ -1,3 +1,6 @@
 from .dygraph_optimizer import (  # noqa
     HybridParallelOptimizer, HybridParallelGradScaler,
     DygraphShardingOptimizer)
+from .static_optimizers import (  # noqa
+    AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
+    ShardingOptimizer, PipelineOptimizer)

@@ -1,0 +1,102 @@
+"""Static-world meta optimizers (parity: python/paddle/distributed/
+fleet/meta_optimizers/ — AMPOptimizer, RecomputeOptimizer,
+GradientMergeOptimizer, ShardingOptimizer, PipelineOptimizer;
+SURVEY.md §2.2 "Fleet static meta_optimizers" row).
+
+Upstream these rewrite the static Program when the matching
+DistributedStrategy flag is on.  On TPU there is no Program IR to
+rewrite — the SAME knobs configure the compiled step (see
+fleet.distributed_runner and distributed.passes), so each meta
+optimizer here is a thin adapter: it asserts its strategy flag, applies
+the knob to the wrapped optimizer's eventual runner via the passes
+machinery, and otherwise delegates.  The value is API parity for
+upstream code that constructs meta optimizers directly.
+"""
+
+from __future__ import annotations
+
+
+class _MetaOptimizerBase:
+    """Wraps (optimizer, strategy); ``apply_to_runner`` pushes the knob
+    onto a DistributedRunner before its first step."""
+
+    _pass_name: str = ""
+    _flag: str = ""
+
+    def __init__(self, optimizer, strategy=None):
+        self._inner_opt = optimizer
+        self._strategy = strategy
+        if strategy is not None and self._flag:
+            setattr(strategy, self._flag, True)
+
+    def __getattr__(self, item):
+        try:
+            inner = self.__dict__["_inner_opt"]
+        except KeyError:
+            raise AttributeError(item) from None
+        return getattr(inner, item)
+
+    def _pass_attrs(self):
+        if self._strategy is None:
+            return {}
+        return dict(getattr(self._strategy, self._flag + "_configs", {}))
+
+    def apply_to_runner(self, runner):
+        from ...passes import apply_pass
+        return apply_pass(runner, self._pass_name, self._pass_attrs())
+
+    # upstream surface
+    def minimize(self, loss, **kwargs):
+        return self._inner_opt.minimize(loss, **kwargs)
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        return self._inner_opt.clear_grad(*a, **k)
+
+
+class AMPOptimizer(_MetaOptimizerBase):
+    _pass_name = "amp"
+    _flag = "amp"
+
+
+class RecomputeOptimizer(_MetaOptimizerBase):
+    _pass_name = "recompute"
+    _flag = "recompute"
+
+    # upstream RecomputeOptimizer takes checkpoints via this setter
+    def _set_checkpoints(self, checkpoints):
+        if self._strategy is not None:
+            self._strategy.recompute_configs = {"checkpoints":
+                                                list(checkpoints)}
+
+    def backward(self, loss, **kwargs):
+        loss.backward()
+
+
+class GradientMergeOptimizer(_MetaOptimizerBase):
+    _pass_name = "gradient_merge"
+    _flag = "gradient_merge"
+
+    def __init__(self, optimizer, k_steps=1, avg=True, strategy=None):
+        super().__init__(optimizer, strategy)
+        self._k_steps = int(k_steps)
+        if strategy is not None:
+            strategy.gradient_merge_configs = {"k_steps": int(k_steps),
+                                               "avg": bool(avg)}
+
+    def _pass_attrs(self):
+        attrs = super()._pass_attrs()
+        attrs.setdefault("k_steps", self._k_steps)
+        return attrs
+
+
+class ShardingOptimizer(_MetaOptimizerBase):
+    _pass_name = "sharding"
+    _flag = "sharding"
+
+
+class PipelineOptimizer(_MetaOptimizerBase):
+    _pass_name = "pipeline"
+    _flag = "pipeline"

@@ -148,3 +148,32 @@ def test_pass_manager_chains():
                  new_pass("sharding", {"stage": 3})]).apply(s)
     assert s.amp is True and s.sharding is True
     assert s.sharding_configs["stage"] == 3
+
+
+def test_static_meta_optimizers_apply_knobs():
+    """Upstream fleet static meta_optimizers parity: each wraps an
+    optimizer, flips its strategy flag, and pushes the knob onto a
+    runner via the passes machinery."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import (
+        AMPOptimizer, RecomputeOptimizer, GradientMergeOptimizer,
+        ShardingOptimizer)
+    from paddle_tpu.distributed.runner import DistributedRunner
+
+    collective.set_mesh(collective.build_mesh({}))
+    net, opt, x, y = _toy()
+    s = DistributedStrategy()
+    mo = GradientMergeOptimizer(opt, k_steps=4, strategy=s)
+    assert s.gradient_merge is True
+    assert s.gradient_merge_configs["k_steps"] == 4
+
+    r = DistributedRunner(net, opt, nn.MSELoss())
+    mo.apply_to_runner(r)
+    assert r.accumulate_steps == 4
+
+    s2 = DistributedStrategy()
+    AMPOptimizer(opt, strategy=s2)
+    RecomputeOptimizer(opt, strategy=s2)
+    ShardingOptimizer(opt, strategy=s2)
+    assert s2.amp and s2.recompute and s2.sharding
+    # delegation surface works
+    assert mo.get_lr() == opt.get_lr()
