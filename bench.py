@@ -168,6 +168,51 @@ def bench_resnet():
         "mfu": round(ips * flops_img / (peak * 1e12), 4)}), flush=True)
 
 
+def bench_flash_micro():
+    """Pallas flash kernel vs composed XLA attention, fwd+bwd wall time
+    per call at seq 1k/4k/8k (VERDICT r2 item 5 microbench line)."""
+    _maybe_force_cpu()
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import pallas_ops
+
+    print("devices-ok", jax.devices(), flush=True)
+    b, h, d = 1, 8, 64
+    out = {}
+    # on CPU (dryrun) the "pallas" path falls back to the composed form:
+    # keep sequences tiny so the O(S^2) bwd can't blow the budget
+    seqs = (1024, 4096, 8192) if jax.default_backend() == "tpu" \
+        else (256,)
+    for s in seqs:
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(b * h, s, d).astype(np.float32)
+                        ).astype(jnp.bfloat16)
+        empty = jnp.zeros((0,), jnp.int32)
+
+        def loss_pallas(q_, k_, v_):
+            return pallas_ops._flash_core(q_, k_, v_, empty, empty,
+                                          True).astype(jnp.float32).sum()
+
+        def loss_ref(q_, k_, v_):
+            return pallas_ops._flash_reference(
+                q_, k_, v_, True).astype(jnp.float32).sum()
+
+        for tag, fn in (("pallas", loss_pallas), ("xla", loss_ref)):
+            if tag == "xla" and s > 4096:
+                continue   # O(S^2) composed bwd at 8k risks OOM/time
+            g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
+            r = g(q, q, q)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = g(q, q, q)
+            jax.block_until_ready(r)
+            out[f"flash_{tag}_s{s}_ms"] = round(
+                (time.perf_counter() - t0) / 5 * 1000, 2)
+    print("RESULT " + json.dumps(out), flush=True)
+
+
 def _parse_result(line):
     try:
         return json.loads(line[len("RESULT "):])
@@ -238,6 +283,8 @@ def main():
         return bench_gpt()
     if mode == "resnet":
         return bench_resnet()
+    if mode == "flash":
+        return bench_flash_micro()
 
     t_start = time.time()
 
@@ -274,6 +321,11 @@ def main():
             for k in ("step_ms", "mfu"):
                 if k in resnet:
                     out["resnet50_" + k] = resnet[k]
+    if (gpt is not None and remaining() > 90
+            and not os.environ.get("GRAFT_BENCH_GPT_ONLY")):
+        flash, _ferr = _run_child("flash", remaining())
+        if flash is not None:
+            out.update(flash)
     print(json.dumps(out), flush=True)
 
 
