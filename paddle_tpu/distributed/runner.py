@@ -112,7 +112,21 @@ class DistributedRunner:
             p._value = self._shard(p._value, self._pspecs[n])
         params = F.param_dict(self.network)
         if self._opt_state is None:
-            self._opt_state = self.optimizer.init_state_tree(params)
+            # a checkpoint restored via optimizer.set_state_dict lands
+            # in _opt_state_tree; adopt it when the keys line up
+            restored = getattr(self.optimizer, "_opt_state_tree", None)
+            if restored and set(restored) == set(params):
+                self._opt_state = restored
+            else:
+                if restored:
+                    import warnings
+                    diff = sorted(set(restored) ^ set(params))[:8]
+                    warnings.warn(
+                        "DistributedRunner: restored optimizer state "
+                        "keys do not match this network's parameters; "
+                        f"re-initializing moments (key diff sample: "
+                        f"{diff})")
+                self._opt_state = self.optimizer.init_state_tree(params)
         placed_state = {}
         for n, st in self._opt_state.items():
             pspec = self._pspecs.get(n, P())
@@ -297,6 +311,10 @@ class DistributedRunner:
             self._name_to_param[n]._value = v
             params[n] = v
         self._opt_state = new_s
+        # keep the optimizer's canonical slots in sync for checkpointing
+        self.optimizer._opt_state_tree = new_s
+        if hasattr(self.optimizer, "_global_step"):
+            self.optimizer._global_step += 1
         for n, v in new_buf.items():
             b = self._name_to_buf.get(n)
             if b is not None:

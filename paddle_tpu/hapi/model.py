@@ -172,7 +172,6 @@ class Model:
             runner = self._mesh_runner() if update else None
             if runner is not None:
                 loss_val, out_vals = runner.train_step(inputs_v, labels_v)
-                self._optimizer._global_step += 1
                 metrics = self._update_metrics(out_vals, labels_v)
                 return self._format_loss(loss_val), metrics
             if self._use_jit:
@@ -187,7 +186,17 @@ class Model:
         frozen = F.frozen_dict(net)
         buffers = F.buffer_dict(net)
         if self._opt_state is None:
-            self._opt_state = self._optimizer.init_state_tree(params)
+            restored = getattr(self._optimizer, "_opt_state_tree", None)
+            if restored and set(restored) == set(params):
+                self._opt_state = restored
+            else:
+                if restored:
+                    import warnings
+                    warnings.warn(
+                        "Model: restored optimizer state keys do not "
+                        "match the network parameters; re-initializing "
+                        "moments")
+                self._opt_state = self._optimizer.init_state_tree(params)
         lr = jnp.asarray(self._optimizer.get_lr(), dtype=jnp.float32)
         key = _random.default_generator().draw_key()
         loss_val, out_vals, new_params, new_opt_state, new_buf = \
@@ -198,6 +207,7 @@ class Model:
             for n, v in new_params.items():
                 name_to_param[n]._value = v
             self._opt_state = new_opt_state
+            self._optimizer._opt_state_tree = new_opt_state
             name_to_buf = dict(net.named_buffers())
             for n, v in new_buf.items():
                 if n in name_to_buf and name_to_buf[n] is not None:

@@ -129,6 +129,9 @@ class Optimizer:
         return new_st
 
     def step(self):
+        # the eager path is now the live state: drop any engine tree so
+        # state_dict() doesn't checkpoint stale restore-time moments
+        self._opt_state_tree = None
         params_grads = [(p, p.grad) for p in self._parameter_list
                         if not p.stop_gradient and p.grad is not None]
         if self._grad_clip is not None:
@@ -223,9 +226,23 @@ class Optimizer:
     # -- checkpoint ---------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
-        for name, st in self._state.items():
-            for k, v in st.items():
-                out[f"{name}.{k}"] = Tensor(v)
+        # the compiled-step engines (DistributedRunner, PipelineParallel,
+        # hapi jit path) keep moments in _opt_state_tree and sync it
+        # here after each step; when present it is the live state.
+        # Materialise host copies: the engine DONATES the tree's buffers
+        # into the next compiled step, which would leave aliased
+        # checkpoint tensors pointing at deleted device arrays.
+        tree = getattr(self, "_opt_state_tree", None)
+        if tree:
+            import jax as _jax
+            host_tree = _jax.device_get(tree)   # one batched transfer
+            for name, st in host_tree.items():
+                for k, v in st.items():
+                    out[f"{name}.{k}"] = Tensor(np.asarray(v))
+        else:
+            for name, st in self._state.items():
+                for k, v in st.items():
+                    out[f"{name}.{k}"] = Tensor(v)
         if isinstance(self._learning_rate, LRScheduler):
             out["LR_Scheduler"] = self._learning_rate.state_dict()
         out["global_step"] = self._global_step
@@ -266,9 +283,23 @@ class Optimizer:
                        tuple(np.shape(v)) == tuple(param.shape)
                        for v in st.values())
 
+        # engine-keyed groups (compiled-step trees use hierarchical /
+        # stacked names like "pp_stack.0.attn.qkv_proj.weight") are
+        # classified FIRST so the positional-remap heuristic below never
+        # scrambles them onto unrelated parameters
+        def _auto_named(n):
+            tail = n.rsplit("_", 1)[-1]
+            return tail.isdigit() and "." not in n
+
+        engine_groups = {n: st for n, st in groups.items()
+                         if n not in params_by_name
+                         and not _auto_named(n)}
+        groups = {n: st for n, st in groups.items()
+                  if n not in engine_groups}
         matched = {n: st for n, st in groups.items()
                    if n in params_by_name and
                    shapes_ok(params_by_name[n], st)}
+        did_remap = False
         if params_by_name and groups and not matched and \
                 len(groups) == len(params_by_name):
             warnings.warn(
@@ -283,6 +314,7 @@ class Optimizer:
             current = [p.name for p in (self._parameter_list or [])]
             remapped = {current[i]: groups[k]
                         for i, k in enumerate(sorted(groups, key=ordinal))}
+            did_remap = True
             matched = {n: st for n, st in remapped.items()
                        if shapes_ok(params_by_name[n], st)}
             if len(matched) != len(remapped):
@@ -290,13 +322,19 @@ class Optimizer:
                     "optimizer.set_state_dict: positional remap dropped "
                     f"{len(remapped) - len(matched)} slot group(s) whose "
                     "shapes do not fit the target parameters.")
-        elif len(matched) != len(groups):
+        if not did_remap:
             dropped = sorted(set(groups) - set(matched))
-            warnings.warn(
-                "optimizer.set_state_dict: ignoring slot groups that "
-                f"match no current parameter by name+shape: {dropped}")
+            if dropped:
+                warnings.warn(
+                    "optimizer.set_state_dict: ignoring slot groups "
+                    "that match no current parameter by name+shape: "
+                    f"{dropped}")
         for name, st in matched.items():
             self._state.setdefault(name, {}).update(st)
+        if matched or engine_groups:
+            tree = {n: dict(st) for n, st in self._state.items()}
+            tree.update({n: dict(st) for n, st in engine_groups.items()})
+            self._opt_state_tree = tree
 
 
 class SGD(Optimizer):

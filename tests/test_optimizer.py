@@ -196,3 +196,50 @@ def test_set_state_dict_cross_process_remap_warns():
     np.testing.assert_allclose(
         np.asarray(opt._state[names[0]]["moment1"]),
         np.full((3, 3), 1.0, np.float32))
+
+
+def test_state_dict_fresh_after_eager_steps_post_restore():
+    """Review finding: after a restore (which populates the jit-engine
+    state slot) followed by EAGER training steps, state_dict must carry
+    the live eager moments, not the stale restore-time tree."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    net = nn.Linear(4, 3)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.rand(8, 4).astype(np.float32))
+
+    def one_step(o, n):
+        loss = (n(x) ** 2.0).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+
+    one_step(opt, net)
+    sd = {k: (v.numpy().copy() if hasattr(v, "numpy") else v)
+          for k, v in opt.state_dict().items()}
+
+    paddle.seed(1)
+    net2 = nn.Linear(4, 3)
+    opt2 = optimizer.Adam(learning_rate=1e-2,
+                          parameters=net2.parameters())
+    opt2.set_state_dict(opt.state_dict())
+    for _ in range(3):
+        one_step(opt2, net2)
+    sd2 = opt2.state_dict()
+    # param_N numbering differs across optimizer instances: compare the
+    # moment1 slots positionally (ordinal order is the stable identity)
+    def moments(d):
+        keys = sorted(k for k in d if k.endswith(".moment1"))
+        return [np.asarray(d[k].numpy() if hasattr(d[k], "numpy")
+                           else d[k]) for k in keys]
+
+    m1, m2 = moments(sd), moments(sd2)
+    assert m1 and len(m1) == len(m2)
+    changed = any(not np.allclose(a, b) for a, b in zip(m1, m2))
+    assert changed, ("state_dict returned stale restore-time moments "
+                     "after eager steps")
