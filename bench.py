@@ -323,9 +323,14 @@ def main():
                     out["resnet50_" + k] = resnet[k]
     if (gpt is not None and remaining() > 90
             and not os.environ.get("GRAFT_BENCH_GPT_ONLY")):
-        flash, _ferr = _run_child("flash", remaining())
+        flash, ferr = _run_child("flash", remaining())
         if flash is not None:
             out.update(flash)
+        else:
+            out["flash_microbench_error"] = ferr[-500:]
+    elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        out["flash_microbench_skipped"] = (
+            "gpt bench failed" if gpt is None else "out of budget")
     print(json.dumps(out), flush=True)
 
 
