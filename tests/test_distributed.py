@@ -1051,3 +1051,54 @@ def test_runner_optimizer_state_roundtrip():
                            mesh=mesh)
     got = float(r2.train_step([x], [y]))
     np.testing.assert_allclose(got, ref, rtol=1e-4)
+
+
+def test_model_save_load_after_mesh_fit():
+    """User-facing checkpoint path: Model.fit on a mesh, save, load into
+    a fresh Model, continue — optimizer moments must survive."""
+    _need_devices(2)
+    import tempfile, os as _os
+    import paddle_tpu.hapi as hapi
+    from paddle_tpu.io.dataset import Dataset
+
+    class Synth(Dataset):
+        def __init__(self, n=16):
+            rng = np.random.RandomState(5)
+            self.x = rng.rand(n, 6).astype(np.float32)
+            self.y = rng.rand(n, 2).astype(np.float32)
+
+        def __len__(self):
+            return len(self.x)
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+    mesh = collective.build_mesh({"dp": 2}, devices=jax.devices()[:2])
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = nn.Linear(6, 2)
+    model = hapi.Model(net)
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    model.prepare(opt, nn.MSELoss())
+    model.fit(Synth(), batch_size=8, epochs=2, verbose=0)
+    d = tempfile.mkdtemp()
+    path = _os.path.join(d, "ckpt")
+    model.save(path)
+    assert _os.path.exists(path + ".pdparams")
+    assert _os.path.exists(path + ".pdopt")
+
+    paddle.seed(9)
+    net2 = nn.Linear(6, 2)
+    model2 = hapi.Model(net2)
+    opt2 = optimizer.Adam(learning_rate=1e-2,
+                          parameters=net2.parameters())
+    model2.prepare(opt2, nn.MSELoss())
+    model2.load(path)
+    np.testing.assert_allclose(np.asarray(net2.weight.numpy()),
+                               np.asarray(net.weight.numpy()), rtol=1e-6)
+    # moments restored (not zeros)
+    sd = opt2.state_dict()
+    m = [np.abs(np.asarray(v.numpy())).sum()
+         for k, v in sd.items() if k.endswith(".moment1")]
+    assert m and sum(m) > 0
+    model2.fit(Synth(), batch_size=8, epochs=1, verbose=0)
