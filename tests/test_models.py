@@ -104,3 +104,55 @@ def test_gpt_causality():
     np.testing.assert_allclose(out1[:, :-1], out2[:, :-1],
                                rtol=1e-5, atol=1e-6)
     assert not np.allclose(out1[:, -1], out2[:, -1])
+
+
+def test_resnet18_train_step_with_bn_buffers():
+    """Config-2 family: ResNet train step through the compiled runner —
+    BN running stats must update through the step."""
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(0)
+    net = resnet18(num_classes=10)
+    opt = optimizer.Momentum(learning_rate=0.01, momentum=0.9,
+                             parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 10, (4,)).astype(np.int64)
+    mesh = collective.build_mesh({})
+    collective.set_mesh(mesh)
+    runner = DistributedRunner(net, opt, nn.CrossEntropyLoss(),
+                               mesh=mesh)
+    bn_before = {n: np.asarray(b._value).copy()
+                 for n, b in net.named_buffers()
+                 if b is not None and "mean" in n}
+    l1 = float(runner.train_step([x], [y]))
+    l2 = float(runner.train_step([x], [y]))
+    assert np.isfinite([l1, l2]).all()
+    changed = any(
+        not np.allclose(np.asarray(dict(net.named_buffers())[n]._value),
+                        v)
+        for n, v in bn_before.items())
+    assert changed, "BatchNorm running stats did not update"
+
+
+def test_vit_tiny_train_step():
+    """Config-5 family: ViT train step converges."""
+    from paddle_tpu.vision.models import VisionTransformer
+
+    paddle.seed(0)
+    net = VisionTransformer(img_size=32, patch_size=8, in_chans=3,
+                            num_classes=5, embed_dim=32, depth=2,
+                            num_heads=4, drop_rate=0.0,
+                            attn_drop_rate=0.0)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=net.parameters())
+    rng = np.random.RandomState(1)
+    x = rng.rand(4, 3, 32, 32).astype(np.float32)
+    y = rng.randint(0, 5, (4,)).astype(np.int64)
+    mesh = collective.build_mesh({})
+    collective.set_mesh(mesh)
+    runner = DistributedRunner(net, opt, nn.CrossEntropyLoss(),
+                               mesh=mesh)
+    losses = [float(runner.train_step([x], [y])) for _ in range(5)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
