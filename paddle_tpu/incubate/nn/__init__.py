@@ -1,1 +1,4 @@
 from . import functional  # noqa
+from .layers import (  # noqa
+    FusedMultiHeadAttention, FusedFeedForward,
+    FusedTransformerEncoderLayer)

@@ -90,3 +90,29 @@ def test_fused_mha_gradients_flow():
     for t in (x, qkv_w, lin_w):
         g = np.asarray(t.grad.numpy())
         assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_fused_layer_classes_train():
+    """Layer wrappers (upstream incubate.nn.FusedTransformerEncoderLayer
+    family) train end to end."""
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.incubate.nn import FusedTransformerEncoderLayer
+
+    paddle.seed(0)
+    layer = FusedTransformerEncoderLayer(
+        d_model=16, nhead=4, dim_feedforward=32, dropout_rate=0.0,
+        normalize_before=True)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=layer.parameters())
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.randn(2, 6, 16).astype(np.float32))
+    losses = []
+    for _ in range(4):
+        out = layer(x)
+        loss = (out ** 2.0).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
