@@ -1,10 +1,12 @@
 """jit.save / jit.load (parity: python/paddle/jit/api.py).
 
 Upstream saves ``.pdmodel`` (ProgramDesc proto) + ``.pdiparams``.  The
-TPU-native serialized program is a StableHLO text of the jitted forward
-plus a params pickle — loadable into a ``TranslatedLayer`` that executes
-via jax.  Cross-loading real ``.pdmodel`` protos is a non-goal this
-round (tracked in SURVEY.md §7.3 item 4).
+TPU-native serialized program is a ``jax.export`` portable artifact
+(StableHLO with calling convention) of the jitted forward plus a params
+pickle — ``jit.load`` returns a ``TranslatedLayer`` that EXECUTES the
+exported program without the original Python class (the actual
+deploy-a-saved-model contract).  Cross-loading real ``.pdmodel`` protos
+is a non-goal this round (tracked in SURVEY.md §7.3 item 4).
 """
 
 from __future__ import annotations
@@ -35,8 +37,12 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
     meta = {"class": type(layer).__name__}
     if input_spec:
         try:
-            specs = [(tuple(s.shape), str(getattr(s, "dtype", "float32")))
-                     for s in input_spec]
+            def _dt(s):
+                d = getattr(s, "dtype", "float32")
+                d = getattr(d, "np_dtype", d)   # our Dtype wrapper
+                return str(np.dtype(d)) if not isinstance(d, str) else d
+
+            specs = [(tuple(s.shape), _dt(s)) for s in input_spec]
             params = F.param_dict(layer)
             frozen = F.frozen_dict(layer)
             buffers = F.buffer_dict(layer)
@@ -51,26 +57,43 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
 
             dummy = [jnp.zeros([di if di and di > 0 else 1 for di in shp],
                                dtype=dt) for shp, dt in specs]
-            lowered = jax.jit(pure).lower(params, *dummy)
-            with open(path + ".pdmodel", "w") as f:
-                f.write(lowered.as_text())
+            from jax import export as _export
+            exported = _export.export(jax.jit(pure))(params, *dummy)
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
             meta["input_spec"] = specs
+            meta["exported"] = True
+            meta["param_names"] = list(params)
         except Exception as e:  # export best-effort; params always saved
+            import warnings
+            warnings.warn(
+                f"jit.save: program export failed ({e!r}); only weights "
+                "were saved — jit.load will refuse forward()")
             meta["export_error"] = str(e)
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 
 
 class TranslatedLayer(Layer):
-    def __init__(self, state, meta):
+    def __init__(self, state, meta, exported_fn=None, params=None):
         super().__init__()
         self._state = state
         self._meta = meta
+        self._exported_fn = exported_fn
+        self._params = params
 
     def forward(self, *args):
-        raise RuntimeError(
-            "TranslatedLayer holds weights only; reconstruct the model "
-            "class and call set_state_dict(layer.state_dict()).")
+        if self._exported_fn is None:
+            raise RuntimeError(
+                "this checkpoint was saved without input_spec, so no "
+                "executable program was exported; reconstruct the model "
+                "class and call set_state_dict(layer.state_dict()).")
+        xs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+              for a in args]
+        out = self._exported_fn(self._params, *xs)
+        if isinstance(out, (list, tuple)):
+            return type(out)(Tensor(o) for o in out)
+        return Tensor(out)
 
     def state_dict(self, *a, **kw):
         return {k: Tensor(v) for k, v in self._state.items()}
@@ -83,4 +106,18 @@ def load(path: str, **configs) -> TranslatedLayer:
     if os.path.exists(path + ".pdmeta"):
         with open(path + ".pdmeta", "rb") as f:
             meta = pickle.load(f)
-    return TranslatedLayer(state, meta)
+    exported_fn = None
+    params = None
+    if meta.get("exported") and os.path.exists(path + ".pdmodel"):
+        from jax import export as _export
+        with open(path + ".pdmodel", "rb") as f:
+            exported = _export.deserialize(bytearray(f.read()))
+        exported_fn = exported.call
+        # the exported signature is pure(params, *inputs): rebuild the
+        # params arg from the saved trainable state (frozen/buffers were
+        # baked in at export time as captured constants — they are part
+        # of the traced closure only if bound; we bind them at export,
+        # so params here are the trainable dict in save()'s order)
+        params = {k: jnp.asarray(v) for k, v in state.items()
+                  if k in meta.get("param_names", state)}
+    return TranslatedLayer(state, meta, exported_fn, params)
