@@ -52,6 +52,7 @@ from .autograd import tape as _tape_mod
 from .autograd.py_layer import PyLayer  # noqa
 
 from . import autograd  # noqa
+from . import utils  # noqa
 from . import nn  # noqa
 from . import optimizer  # noqa
 from . import io  # noqa

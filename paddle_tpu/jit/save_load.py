@@ -46,6 +46,11 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
             params = F.param_dict(layer)
             frozen = F.frozen_dict(layer)
             buffers = F.buffer_dict(layer)
+            # snapshot + restore training flags: export must not mutate
+            # the caller's live model (dropout/BN would silently switch
+            # to inference for the rest of a training run)
+            modes = [(l, l.training)
+                     for l in layer.sublayers(include_self=True)]
             layer.eval()
 
             def pure(params, *xs):
@@ -55,10 +60,25 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
                         out = layer(*[Tensor(x) for x in xs])
                 return F.unwrap_structure(out)
 
-            dummy = [jnp.zeros([di if di and di > 0 else 1 for di in shp],
-                               dtype=dt) for shp, dt in specs]
             from jax import export as _export
-            exported = _export.export(jax.jit(pure))(params, *dummy)
+            # dynamic dims (None/-1) become jax.export symbolic
+            # dimensions so the loaded program accepts any size there
+            sym_ct = 0
+            arg_avals = []
+            for shp, dt in specs:
+                dims = []
+                for di in shp:
+                    if di is None or (isinstance(di, int) and di < 0):
+                        dims.append(f"d{sym_ct}")
+                        sym_ct += 1
+                    else:
+                        dims.append(str(di))
+                if sym_ct:
+                    shape = _export.symbolic_shape(",".join(dims))
+                else:
+                    shape = tuple(int(d) for d in dims)
+                arg_avals.append(jax.ShapeDtypeStruct(shape, dt))
+            exported = _export.export(jax.jit(pure))(params, *arg_avals)
             with open(path + ".pdmodel", "wb") as f:
                 f.write(exported.serialize())
             meta["input_spec"] = specs
@@ -70,6 +90,9 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
                 f"jit.save: program export failed ({e!r}); only weights "
                 "were saved — jit.load will refuse forward()")
             meta["export_error"] = str(e)
+        finally:
+            for l, was_training in modes:
+                l.training = was_training
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 
@@ -91,9 +114,15 @@ class TranslatedLayer(Layer):
         xs = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
               for a in args]
         out = self._exported_fn(self._params, *xs)
-        if isinstance(out, (list, tuple)):
-            return type(out)(Tensor(o) for o in out)
-        return Tensor(out)
+
+        def rewrap(o):   # structural inverse of F.unwrap_structure
+            if isinstance(o, (list, tuple)):
+                return type(o)(rewrap(v) for v in o)
+            if isinstance(o, dict):
+                return {k: rewrap(v) for k, v in o.items()}
+            return Tensor(o)
+
+        return rewrap(out)
 
     def state_dict(self, *a, **kw):
         return {k: Tensor(v) for k, v in self._state.items()}

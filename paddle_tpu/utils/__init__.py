@@ -1,0 +1,57 @@
+"""paddle.utils parity surface (python/paddle/utils/): run_check install
+verification plus small helpers."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+
+def run_check() -> None:
+    """Upstream paddle.utils.run_check(): verify the install can build a
+    model and run a compiled train step on the available device(s)."""
+    import numpy as np
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+
+    dev = jax.devices()[0]
+    print(f"Running verify PaddlePaddle-TPU program ... "
+          f"device: {dev.platform}:{dev.id}")
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    x = Tensor(np.random.RandomState(0).rand(4, 8).astype(np.float32))
+    loss0 = None
+    for _ in range(3):
+        loss = (net(x) ** 2.0).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        loss0 = loss0 if loss0 is not None else float(loss.numpy())
+    assert float(loss.numpy()) <= loss0, "train step did not reduce loss"
+    n = len(jax.devices())
+    print(f"PaddlePaddle-TPU works! {n} device(s) available.")
+
+
+def try_import(name: str):
+    """paddle.utils.try_import parity."""
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(
+            f"{name} is required but not installed (pip installs are "
+            f"disabled in this environment): {e}") from e
+
+
+def flatten(nested) -> list:
+    out = []
+    stack = [nested]
+    while stack:
+        cur = stack.pop()
+        if isinstance(cur, (list, tuple)):
+            stack.extend(reversed(cur))
+        else:
+            out.append(cur)
+    return out

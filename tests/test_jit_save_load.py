@@ -47,3 +47,35 @@ def test_jit_load_without_program_refuses_forward():
     loaded = load(path)
     with pytest.raises(RuntimeError, match="input_spec"):
         loaded(Tensor(np.zeros((1, 4), np.float32)))
+
+
+def test_jit_save_load_dynamic_batch():
+    """Review finding: InputSpec([None, 4]) must export a program that
+    accepts ANY batch size (symbolic dims), not just 1."""
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    net.eval()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "m")
+    from paddle_tpu.jit.save_load import save, load
+    save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = load(path)
+    rng = np.random.RandomState(0)
+    for b in (1, 3, 7):
+        x = rng.rand(b, 4).astype(np.float32)
+        ref = np.asarray(net(Tensor(x)).numpy())
+        out = loaded(Tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5)
+
+
+def test_jit_save_preserves_training_mode():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    net.train()
+    d = tempfile.mkdtemp()
+    from paddle_tpu.jit.save_load import save
+    save(net, os.path.join(d, "m"),
+         input_spec=[InputSpec([2, 4], "float32")])
+    assert net.training and net[1].training, \
+        "jit.save left the model in eval mode"

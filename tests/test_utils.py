@@ -1,0 +1,21 @@
+"""paddle.utils parity tests."""
+
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "works" in out
+
+
+def test_try_import():
+    assert paddle.utils.try_import("numpy") is not None
+    with pytest.raises(ImportError):
+        paddle.utils.try_import("definitely_not_a_module_xyz")
+
+
+def test_flatten():
+    assert paddle.utils.flatten([1, [2, (3, 4)], 5]) == [1, 2, 3, 4, 5]
