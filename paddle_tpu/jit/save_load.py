@@ -36,6 +36,11 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
         pickle.dump(state, f, protocol=4)
     meta = {"class": type(layer).__name__}
     if input_spec:
+        # snapshot + restore training flags: export must not mutate
+        # the caller's live model (dropout/BN would silently switch
+        # to inference for the rest of a training run)
+        modes = [(l, l.training)
+                 for l in layer.sublayers(include_self=True)]
         try:
             def _dt(s):
                 d = getattr(s, "dtype", "float32")
@@ -46,11 +51,6 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
             params = F.param_dict(layer)
             frozen = F.frozen_dict(layer)
             buffers = F.buffer_dict(layer)
-            # snapshot + restore training flags: export must not mutate
-            # the caller's live model (dropout/BN would silently switch
-            # to inference for the rest of a training run)
-            modes = [(l, l.training)
-                     for l in layer.sublayers(include_self=True)]
             layer.eval()
 
             def pure(params, *xs):
@@ -62,19 +62,24 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
 
             from jax import export as _export
             # dynamic dims (None/-1) become jax.export symbolic
-            # dimensions so the loaded program accepts any size there
+            # dimensions; all args must share ONE SymbolicScope or
+            # jax.export rejects the mix at export time
+            scope = _export.SymbolicScope()
             sym_ct = 0
             arg_avals = []
             for shp, dt in specs:
                 dims = []
+                has_sym = False
                 for di in shp:
                     if di is None or (isinstance(di, int) and di < 0):
                         dims.append(f"d{sym_ct}")
                         sym_ct += 1
+                        has_sym = True
                     else:
                         dims.append(str(di))
-                if sym_ct:
-                    shape = _export.symbolic_shape(",".join(dims))
+                if has_sym:
+                    shape = _export.symbolic_shape(",".join(dims),
+                                                   scope=scope)
                 else:
                     shape = tuple(int(d) for d in dims)
                 arg_avals.append(jax.ShapeDtypeStruct(shape, dt))

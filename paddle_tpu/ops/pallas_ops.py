@@ -131,9 +131,13 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
         acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
     def body():
-        q = q_ref[0].astype(jnp.float32)     # [block_q, d]
-        k = k_ref[0].astype(jnp.float32)     # [block_k, d]
-        v = v_ref[0].astype(jnp.float32)     # [block_k, d]
+        # keep the matmul inputs in their storage dtype (bf16 in training)
+        # with f32 accumulation: bf16×bf16→f32 is the native full-rate MXU
+        # mode, while f32×f32 runs at 1/4 rate (this one cast was worth
+        # ~2.5× on the whole attention step)
+        q = q_ref[0]                         # [block_q, d]
+        k = k_ref[0]                         # [block_k, d]
+        v = v_ref[0]                         # [block_k, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -157,7 +161,7 @@ def _flash_kernel(*refs, scale: float, causal: bool, block_q: int,
         alpha = jnp.exp(jnp.maximum(m_prev, _LSE_FLOOR) - m_safe)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[...] = jnp.broadcast_to(m_new, (block_q, _LANES))
         l_scr[...] = jnp.broadcast_to(l_new, (block_q, _LANES))
@@ -198,7 +202,7 @@ def _pallas_flash_bh(q, k, v, q_seg=None, k_seg=None, *, causal: bool,
     block_q = _fit_block(
         sq, block_q or _block_default("PADDLE_TPU_FLASH_BQ", 512))
     block_k = _fit_block(
-        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 512))
+        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 1024))
     scale = 1.0 / math.sqrt(d)
     grid = (bh, sq // block_q, sk // block_k)
     has_seg = q_seg is not None
@@ -248,9 +252,112 @@ def _pallas_flash_bh(q, k, v, q_seg=None, k_seg=None, *, causal: bool,
 # ---------------------------------------------------------------------------
 # Pallas backward kernels — standard flash-attention backward: recompute
 # P per block from the saved lse; never materialise [Sq, Sk] in HBM.
-# dQ kernel streams K/V blocks per Q block; dK/dV kernel streams Q
-# blocks per K/V block.
+#
+# Preferred path: ONE fused kernel over grid (bh, kv, q) computing dq,
+# dk, dv AND the delta rowsum in a single sweep — s/p are recomputed
+# once per (q, kv) block pair instead of once in a dQ pass and again in
+# a dK/dV pass (5 block-matmuls vs 7, half the HBM input reads, no
+# [bh, sq, LANES] delta broadcast in XLA).  dq accumulates in a
+# whole-sequence VMEM scratch (grid steps run sequentially on a TPU
+# core, so scratch persists across the kv loop) and is flushed on the
+# last kv iteration.  The split dQ / dK/dV kernels are kept below as a
+# fallback for shapes whose full-seq dq scratch would not fit VMEM.
 # ---------------------------------------------------------------------------
+def _flash_bwd_fused_kernel(*refs, scale: float, causal: bool,
+                            block_q: int, block_k: int, seq_q: int,
+                            seq_k: int, has_seg: bool):
+    from jax.experimental import pallas as pl
+
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, qs_ref, ks_ref,
+         dq_ref, dk_ref, dv_ref, dq_scr, delta_scr, dk_scr,
+         dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, dk_ref,
+         dv_ref, dq_scr, delta_scr, dk_scr, dv_scr) = refs
+        qs_ref = ks_ref = None
+
+    kv_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+    n_kv = seq_k // block_k
+    n_q = seq_q // block_q
+    qrows = pl.ds(q_idx * block_q, block_q)
+
+    @pl.when(kv_idx == 0)
+    def _init_q():
+        # first kv sweep visits every q block: zero its dq rows and
+        # compute delta_i = rowsum(dO_i * O_i) once per q row
+        dq_scr[qrows, :] = jnp.zeros((block_q, dq_scr.shape[1]),
+                                     jnp.float32)
+        d_row = jnp.sum(do_ref[0].astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        delta_scr[qrows, :] = jnp.broadcast_to(d_row, (block_q, _LANES))
+
+    @pl.when(q_idx == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    def body():
+        # bf16 matmul inputs + f32 accumulation (full-rate MXU)
+        q = q_ref[0]                              # [bq, d]
+        k = k_ref[0]                              # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]                   # [bq, 1]
+        delta = delta_scr[qrows, :1]              # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        if has_seg:
+            s = jnp.where(qs_ref[0][:, :1] == ks_ref[0][:1, :], s,
+                          -jnp.inf)
+        p = jnp.exp(s - lse)                      # [bq, bk]
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, bk]
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bk, d]
+        dq_scr[qrows, :] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)   # [bq, d]
+
+    if causal and not has_seg:
+        @pl.when(q_idx * block_q + block_q - 1 >= kv_idx * block_k)
+        def _run():
+            body()
+    else:
+        body()
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _flush_dq():
+        dq_ref[0] = dq_scr[qrows, :].astype(dq_ref.dtype)
+
+    @pl.when(q_idx == n_q - 1)
+    def _flush_dkv():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# VMEM budget for the fused backward's whole-sequence scratch (dq
+# [Sq, D] + delta [Sq, LANES], both f32): beyond this use the split
+# dQ / dK/dV kernels whose scratch is one block.
+_FUSED_BWD_MAX_SCRATCH_BYTES = 4 << 20
+
+
+
 def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
                          block_q: int, block_k: int, seq_k: int,
                          has_seg: bool):
@@ -272,10 +379,11 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
         dq_scr[...] = jnp.zeros_like(dq_scr[...])
 
     def body():
-        q = q_ref[0].astype(jnp.float32)          # [bq, d]
-        k = k_ref[0].astype(jnp.float32)          # [bk, d]
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)        # [bq, d]
+        # bf16 matmul inputs + f32 accumulation (full-rate MXU; see fwd)
+        q = q_ref[0]                              # [bq, d]
+        k = k_ref[0]                              # [bk, d]
+        v = v_ref[0]
+        do = do_ref[0]                            # [bq, d]
         lse = lse_ref[0][:, :1]                   # [bq, 1]
         delta = delta_ref[0][:, :1]               # [bq, 1]
         s = jax.lax.dot_general(
@@ -294,7 +402,7 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bq, bk]
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
         dq_scr[...] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -335,10 +443,11 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool,
         dv_scr[...] = jnp.zeros_like(dv_scr[...])
 
     def body():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # bf16 matmul inputs + f32 accumulation (full-rate MXU; see fwd)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0][:, :1]
         delta = delta_ref[0][:, :1]
         s = jax.lax.dot_general(
@@ -354,13 +463,14 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool,
             s = jnp.where(qs_ref[0][:, :1] == ks_ref[0][:1, :], s,
                           -jnp.inf)
         p = jnp.exp(s - lse)                      # [bq, bk]
+        p_lo = p.astype(do.dtype)
         dv_scr[...] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p_lo, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bk, d]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bq, bk]
-        ds = p * (dp - delta) * scale
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
         dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)   # [bk, d]
@@ -392,19 +502,66 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
     block_q = _fit_block(
         sq, block_q or _block_default("PADDLE_TPU_FLASH_BQ", 512))
     block_k = _fit_block(
-        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 512))
+        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 1024))
     scale = 1.0 / math.sqrt(d)
     has_seg = q_seg is not None
-    # delta_i = rowsum(dO_i * O_i) — cheap elementwise+reduce in XLA
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                      # [bh, sq]
-    # lane/sublane-broadcast layouts (Mosaic block constraint)
     lse_b = jax.lax.broadcast_in_dim(lse, (bh, sq, _LANES), (0, 1))
-    delta_b = jax.lax.broadcast_in_dim(delta, (bh, sq, _LANES), (0, 1))
     if has_seg:
         qs_b = jax.lax.broadcast_in_dim(q_seg, (bh, sq, _LANES), (0, 1))
         ks_b = jax.lax.broadcast_in_dim(
             k_seg, (bh, _SUBLANES, sk), (0, 2))
+
+    # the fused sweep does 5 block-matmuls where the split pair does 7,
+    # but measures ~18% SLOWER on v5e (the whole-seq dq scratch RMW
+    # defeats Mosaic's software pipelining of the simple per-block
+    # accumulators), so the split kernels are the default; flag kept
+    # for re-evaluation on other TPU generations.
+    fused_scratch = sq * (d + _LANES) * 4
+    if (fused_scratch <= _FUSED_BWD_MAX_SCRATCH_BYTES
+            and os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD")):
+        # single-sweep fused backward; grid (bh, kv, q) with q minor
+        qspec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, b * 0))
+        kspec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0))
+        rowq = pl.BlockSpec((1, block_q, _LANES),
+                            lambda b, j, i: (b, i, b * 0))
+        rowk = pl.BlockSpec((1, _SUBLANES, block_k),
+                            lambda b, j, i: (b, b * 0, j))
+        in_specs = [qspec, kspec, kspec, qspec, qspec, rowq]
+        args = [q, k, v, do, out, lse_b]
+        if has_seg:
+            in_specs += [rowq, rowk]
+            args += [qs_b, ks_b]
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _flash_bwd_fused_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k, seq_q=sq, seq_k=sk,
+                has_seg=has_seg),
+            grid=(bh, sk // block_k, sq // block_q),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, b * 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((sq, d), jnp.float32),        # dq accumulator
+                pltpu.VMEM((sq, _LANES), jnp.float32),   # delta rows
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(*args)
+        return dq, dk, dv
+
+    # split-kernel fallback (large Sq): delta in XLA, two passes
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                      # [bh, sq]
+    delta_b = jax.lax.broadcast_in_dim(delta, (bh, sq, _LANES), (0, 1))
 
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, b * 0))

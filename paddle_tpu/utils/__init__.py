@@ -3,8 +3,6 @@ verification plus small helpers."""
 
 from __future__ import annotations
 
-from typing import Sequence
-
 
 def run_check() -> None:
     """Upstream paddle.utils.run_check(): verify the install can build a
@@ -29,7 +27,10 @@ def run_check() -> None:
         opt.step()
         opt.clear_grad()
         loss0 = loss0 if loss0 is not None else float(loss.numpy())
-    assert float(loss.numpy()) <= loss0, "train step did not reduce loss"
+    if not float(loss.numpy()) <= loss0:
+        raise RuntimeError(
+            "PaddlePaddle-TPU run_check failed: the train step did not "
+            f"reduce the loss ({loss0} -> {float(loss.numpy())})")
     n = len(jax.devices())
     print(f"PaddlePaddle-TPU works! {n} device(s) available.")
 
@@ -41,8 +42,8 @@ def try_import(name: str):
         return importlib.import_module(name)
     except ImportError as e:
         raise ImportError(
-            f"{name} is required but not installed (pip installs are "
-            f"disabled in this environment): {e}") from e
+            f"{name} is required for this feature; please install it "
+            f"(e.g. `pip install {name}`): {e}") from e
 
 
 def flatten(nested) -> list:

@@ -244,3 +244,33 @@ def test_pallas_kernel_non_block_multiple_seq(_interpret_mode):
     ref = pallas_ops._flash_reference(qbh, kbh, vbh, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_fused_bwd_matches_composed(_interpret_mode, monkeypatch):
+    """The single-sweep fused backward (PADDLE_TPU_FLASH_FUSED_BWD) —
+    off by default on v5e for perf — must stay numerically correct."""
+    monkeypatch.setenv("PADDLE_TPU_FLASH_FUSED_BWD", "1")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BQ", "128")
+    monkeypatch.setenv("PADDLE_TPU_FLASH_BK", "128")
+    rng = np.random.RandomState(12)
+    b, s, h, d = 1, 256, 2, 16
+    q, k, v = _rand_qkv(rng, b=b, s=s, h=h, d=d)
+    qbh = jnp.moveaxis(jnp.asarray(q), 2, 1).reshape(b * h, s, d)
+    kbh = jnp.moveaxis(jnp.asarray(k), 2, 1).reshape(b * h, s, d)
+    vbh = jnp.moveaxis(jnp.asarray(v), 2, 1).reshape(b * h, s, d)
+    empty = jnp.zeros((0,), jnp.int32)
+    for causal in (False, True):
+        def f_kernel(q_, k_, v_):
+            return pallas_ops._flash_core(q_, k_, v_, empty, empty,
+                                          causal).sum()
+
+        def f_ref(q_, k_, v_):
+            return pallas_ops._flash_reference(q_, k_, v_, causal).sum()
+
+        # multiple q/kv blocks so the fused kernel's cross-sweep dq
+        # accumulation and flush-ordering are actually exercised
+        g_kernel = jax.grad(f_kernel, argnums=(0, 1, 2))(qbh, kbh, vbh)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(qbh, kbh, vbh)
+        for gk, gr in zip(g_kernel, g_ref):
+            np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                       rtol=5e-4, atol=5e-5)
