@@ -192,7 +192,9 @@ def _pallas_flash_bh(q, k, v, q_seg=None, k_seg=None, *, causal: bool,
                      block_q: Optional[int] = None,
                      block_k: Optional[int] = None):
     """q: [BH, Sq, D]; k/v: [BH, Sk, D] → (out [BH, Sq, D],
-    lse [BH, Sq]).  Sq/Sk must divide by the blocks (caller guards).
+    lse [BH, Sq, LANES] — per-row log-sum-exp lane-broadcast across the
+    last dim; value at [..., 0], kept in this layout for the backward).
+    Sq/Sk must divide by the blocks (caller guards).
     q_seg/k_seg: optional [BH, S*] int32 segment ids (varlen packing)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -246,7 +248,10 @@ def _pallas_flash_bh(q, k, v, q_seg=None, k_seg=None, *, causal: bool,
         ],
         interpret=_interpret(),
     )(*args)
-    return out, lse[:, :, 0]
+    # lse stays in its [BH, Sq, LANES] lane-broadcast form: the backward
+    # kernels read it directly, avoiding a 50MB-per-layer slice + re-
+    # broadcast round-trip through HBM (measured ~3 ms/step on GPT-2)
+    return out, lse
 
 
 # ---------------------------------------------------------------------------
@@ -364,11 +369,11 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
     from jax.experimental import pallas as pl
 
     if has_seg:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref, \
-            dq_ref, dq_scr = refs
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, qs_ref, ks_ref, \
+            dq_ref, dq_scr, delta_scr = refs
     else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, \
-            dq_scr = refs
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, \
+            dq_scr, delta_scr = refs
         qs_ref = ks_ref = None
 
     q_idx = pl.program_id(1)
@@ -377,6 +382,12 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
     @pl.when(kv_idx == 0)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr[...])
+        # delta_i = rowsum(dO_i * O_i), computed once per q block in
+        # VMEM instead of as an XLA pass + [BH, Sq, LANES] broadcast
+        d_row = jnp.sum(do_ref[0].astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
+        delta_scr[...] = jnp.broadcast_to(d_row, delta_scr.shape)
 
     def body():
         # bf16 matmul inputs + f32 accumulation (full-rate MXU; see fwd)
@@ -385,7 +396,7 @@ def _flash_bwd_dq_kernel(*refs, scale: float, causal: bool,
         v = v_ref[0]
         do = do_ref[0]                            # [bq, d]
         lse = lse_ref[0][:, :1]                   # [bq, 1]
-        delta = delta_ref[0][:, :1]               # [bq, 1]
+        delta = delta_scr[:, :1]                  # [bq, 1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -427,10 +438,10 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool,
     from jax.experimental import pallas as pl
 
     if has_seg:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qs_ref, ks_ref, \
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, qs_ref, ks_ref, \
             dk_ref, dv_ref, dk_scr, dv_scr = refs
     else:
-        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, \
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref, \
             dk_scr, dv_scr = refs
         qs_ref = ks_ref = None
 
@@ -449,7 +460,11 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool,
         v = v_ref[0]
         do = do_ref[0]
         lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
+        # delta recomputed per visit (cheap VPU rowsum on the streamed
+        # dO/O blocks; replaces the XLA delta pass + lane broadcast)
+        delta = jnp.sum(do.astype(jnp.float32)
+                        * o_ref[0].astype(jnp.float32), axis=-1,
+                        keepdims=True)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -493,7 +508,11 @@ def _flash_bwd_dkv_kernel(*refs, scale: float, causal: bool,
 def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
                       causal: bool, block_q: Optional[int] = None,
                       block_k: Optional[int] = None):
-    """Flash backward; q [BH,Sq,D], k/v [BH,Sk,D] → (dq, dk, dv)."""
+    """Flash backward; q [BH,Sq,D], k/v [BH,Sk,D] → (dq, dk, dv).
+
+    ``lse`` arrives in the forward's [BH, Sq, LANES] lane-broadcast
+    form and is consumed directly; delta is computed inside the kernels
+    from the streamed dO/O blocks (no XLA delta pass, no broadcasts)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -505,7 +524,7 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
         sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 1024))
     scale = 1.0 / math.sqrt(d)
     has_seg = q_seg is not None
-    lse_b = jax.lax.broadcast_in_dim(lse, (bh, sq, _LANES), (0, 1))
+    lse_b = lse
     if has_seg:
         qs_b = jax.lax.broadcast_in_dim(q_seg, (bh, sq, _LANES), (0, 1))
         ks_b = jax.lax.broadcast_in_dim(
@@ -558,18 +577,14 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
         )(*args)
         return dq, dk, dv
 
-    # split-kernel fallback (large Sq): delta in XLA, two passes
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                      # [bh, sq]
-    delta_b = jax.lax.broadcast_in_dim(delta, (bh, sq, _LANES), (0, 1))
-
+    # split kernels: dQ pass then dK/dV pass
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0))
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, b * 0))
     rowq = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, b * 0))
     rowk = pl.BlockSpec((1, _SUBLANES, block_k),
                         lambda b, i, j: (b, b * 0, j))
-    in_specs = [qspec, kspec, kspec, qspec, rowq, rowq]
-    args = [q, k, v, do, lse_b, delta_b]
+    in_specs = [qspec, kspec, kspec, qspec, qspec, rowq]
+    args = [q, k, v, do, out, lse_b]
     if has_seg:
         in_specs += [rowq, rowk]
         args += [qs_b, ks_b]
@@ -581,7 +596,8 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
         in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, b * 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32),
+                        pltpu.VMEM((block_q, _LANES), jnp.float32)],
         interpret=_interpret(),
     )(*args)
 
@@ -591,8 +607,8 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
     rowq2 = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, b * 0))
     rowk2 = pl.BlockSpec((1, _SUBLANES, block_k),
                          lambda b, j, i: (b, b * 0, j))
-    in_specs2 = [qspec2, kspec2, kspec2, qspec2, rowq2, rowq2]
-    args2 = [q, k, v, do, lse_b, delta_b]
+    in_specs2 = [qspec2, kspec2, kspec2, qspec2, qspec2, rowq2]
+    args2 = [q, k, v, do, out, lse_b]
     if has_seg:
         in_specs2 += [rowq2, rowk2]
         args2 += [qs_b, ks_b]
