@@ -2,3 +2,8 @@ from .api import (  # noqa
     ProcessMesh, shard_tensor, shard_op, dtensor_from_fn, reshard,
     shard_dataloader, Placement, Replicate, Shard, Partial)
 from .engine import Engine, DistModel, to_static  # noqa
+from .spmd_rules import DistSpec, infer_forward, replicated  # noqa
+from .cost_model import (  # noqa
+    MeshCostInfo, AxisLink, CommOpCost, reshard_cost, all_reduce_cost,
+    all_gather_cost, reduce_scatter_cost, all_to_all_cost, p2p_cost)
+from .planner import plan_tensor_parallel, PlanEntry  # noqa

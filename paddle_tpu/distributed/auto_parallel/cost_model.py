@@ -1,0 +1,190 @@
+"""Communication / reshard cost model for the auto-parallel planner.
+
+Parity: upstream's cost model under auto_parallel (comm+comp op costs
+feeding the planner — SURVEY.md §2.2 "Auto-parallel (semi-auto)": cost
+model).  Upstream prices NCCL collectives per cluster topology; the
+TPU-native version prices XLA collectives per mesh AXIS, distinguishing
+ICI (intra-slice torus links) from DCN (inter-slice) — the distinction
+that decides which axes should carry mp/sep vs dp/pp in a multi-slice
+mesh (SURVEY.md §5.8).
+
+All costs are alpha-beta estimates in microseconds:
+``t = alpha * steps + bytes_on_wire / bandwidth``.  They are meant for
+RANKING placements, not for wall-clock prediction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from .spmd_rules import DistSpec
+
+# v5e-class defaults (per-direction, per-link): ICI ~4.5e10 B/s and
+# ~1 us hop latency; DCN ~2.5e9 B/s and ~10 us.  Override per axis via
+# MeshCostInfo.
+_ICI_BW = 45e9
+_DCN_BW = 2.5e9
+_ICI_ALPHA_US = 1.0
+_DCN_ALPHA_US = 10.0
+
+
+@dataclass
+class AxisLink:
+    bandwidth: float
+    alpha_us: float
+
+    @classmethod
+    def ici(cls):
+        return cls(_ICI_BW, _ICI_ALPHA_US)
+
+    @classmethod
+    def dcn(cls):
+        return cls(_DCN_BW, _DCN_ALPHA_US)
+
+
+@dataclass
+class MeshCostInfo:
+    """Mesh axis sizes + link class per axis.  By convention dp/pp-outer
+    axes ride DCN on multi-slice deployments; everything else ICI."""
+
+    axis_sizes: Dict[str, int]
+    links: Dict[str, AxisLink] = field(default_factory=dict)
+    dcn_axes: Sequence[str] = ()
+
+    def link(self, axis: str) -> AxisLink:
+        if axis in self.links:
+            return self.links[axis]
+        return AxisLink.dcn() if axis in self.dcn_axes else AxisLink.ici()
+
+    def size(self, axis) -> int:
+        if isinstance(axis, tuple):
+            n = 1
+            for a in axis:
+                n *= self.axis_sizes.get(a, 1)
+            return n
+        return self.axis_sizes.get(axis, 1)
+
+
+def _bytes(shape: Sequence[int], dtype) -> float:
+    return float(np.prod(shape)) * np.dtype(dtype).itemsize
+
+
+def _ring_cost(nbytes: float, n: int, link: AxisLink,
+               steps_factor: float) -> float:
+    """Bandwidth-optimal ring collective: (n-1)/n of the data crosses
+    each link, ``steps_factor``×(n-1) latency hops."""
+    if n <= 1:
+        return 0.0
+    return (link.alpha_us * steps_factor * (n - 1)
+            + (nbytes * (n - 1) / n) / link.bandwidth * 1e6)
+
+
+def _axis_link(axis, mesh: MeshCostInfo) -> AxisLink:
+    """Link class for a (possibly multi-axis) collective: the SLOWEST
+    member link bounds the ring — one DCN axis makes it a DCN ring."""
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    links = [mesh.link(a) for a in axes]
+    return min(links, key=lambda l: l.bandwidth)
+
+
+def all_reduce_cost(nbytes, axis, mesh: MeshCostInfo) -> float:
+    # reduce-scatter + all-gather
+    return _ring_cost(nbytes, mesh.size(axis), _axis_link(axis, mesh),
+                      2.0)
+
+
+def all_gather_cost(nbytes, axis, mesh: MeshCostInfo) -> float:
+    """``nbytes`` = FULL (gathered) size."""
+    return _ring_cost(nbytes, mesh.size(axis), _axis_link(axis, mesh),
+                      1.0)
+
+
+def reduce_scatter_cost(nbytes, axis, mesh: MeshCostInfo) -> float:
+    return _ring_cost(nbytes, mesh.size(axis), _axis_link(axis, mesh),
+                      1.0)
+
+
+def all_to_all_cost(nbytes, axis, mesh: MeshCostInfo) -> float:
+    n = mesh.size(axis)
+    link = _axis_link(axis, mesh)
+    if n <= 1:
+        return 0.0
+    return (link.alpha_us * (n - 1)
+            + (nbytes * (n - 1) / n / n) / link.bandwidth * 1e6)
+
+
+def p2p_cost(nbytes, axis, mesh: MeshCostInfo) -> float:
+    link = mesh.link(axis)
+    return link.alpha_us + nbytes / link.bandwidth * 1e6
+
+
+def reshard_cost(src: DistSpec, dst: DistSpec, shape: Sequence[int],
+                 dtype, mesh: MeshCostInfo) -> float:
+    """Price moving one tensor ``src`` → ``dst``.
+
+    Decomposed per upstream's reshard planner into the three primitive
+    transitions, priced at the FULL tensor size divided by what stays
+    sharded:
+
+    * partial → settled: all-reduce over the partial axes (or
+      reduce-scatter when the destination shards a dim on that axis);
+    * sharded dim → replicated/resharded: all-gather over the axes
+      leaving the dim;
+    * replicated → sharded: free (local slice).
+    """
+    if src == dst:
+        return 0.0
+    full = _bytes(shape, dtype)
+    cost = 0.0
+    # axes that keep sharding the same dim in both: data stays local
+    kept = set()
+    for i in range(min(src.ndim, dst.ndim)):
+        kept.update(set(src.axes_of(i)) & set(dst.axes_of(i)))
+
+    def _local(nb, axes_set):
+        n = 1
+        for a in axes_set:
+            n *= mesh.size(a)
+        return nb / max(n, 1)
+
+    # 1. settle partials
+    for ax in src.partial - dst.partial:
+        dst_scatter = any(ax in dst.axes_of(i)
+                          for i in range(dst.ndim))
+        nb = _local(full, kept - {ax})
+        if dst_scatter:
+            cost += reduce_scatter_cost(nb, ax, mesh)
+        else:
+            cost += all_reduce_cost(nb, ax, mesh)
+    # 2. gather dims whose axes leave
+    for i in range(src.ndim):
+        leaving = set(src.axes_of(i)) - (set(dst.axes_of(i))
+                                         if i < dst.ndim else set())
+        for ax in leaving:
+            cost += all_gather_cost(_local(full, kept), ax, mesh)
+    # 3. replicated → sharded: local slice, free
+    return cost
+
+
+@dataclass
+class CommOpCost:
+    """Named entry mirroring upstream's per-collective cost classes."""
+
+    op: str
+    nbytes: float
+    axis: object
+    mesh: MeshCostInfo
+
+    _FNS = {
+        "all_reduce": all_reduce_cost,
+        "all_gather": all_gather_cost,
+        "reduce_scatter": reduce_scatter_cost,
+        "all_to_all": all_to_all_cost,
+        "p2p": p2p_cost,
+    }
+
+    def time_us(self) -> float:
+        return self._FNS[self.op](self.nbytes, self.axis, self.mesh)

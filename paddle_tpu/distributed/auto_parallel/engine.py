@@ -48,9 +48,9 @@ class Engine:
         self._mesh = None
 
     # -- planning -----------------------------------------------------------
-    def _ensure_runner(self):
-        if self._runner is not None:
-            return
+    def _resolve_mesh(self):
+        if self._mesh is not None:
+            return self._mesh
         pm = _mesh_from_annotations(self._model)
         if pm is not None:
             jmesh = pm.get_jax_mesh()
@@ -60,6 +60,31 @@ class Engine:
                     if k.endswith("_degree") and v and v > 1}
             jmesh = coll.build_mesh(axes)
         self._mesh = jmesh
+        return jmesh
+
+    def plan(self, tokens_per_step: int, mp_axis: str = "mp",
+             dcn_axes=(), mesh_info=None):
+        """Run the SPMD-rule/cost-model placement planner BEFORE the
+        first step: profitable Linear pairs get Megatron col/row
+        ``dist_spec`` annotations which the runner then realises.
+        Returns the per-pair costing decisions (see planner.PlanEntry).
+        Must be called before fit/evaluate/predict compile the step."""
+        if self._runner is not None:
+            raise RuntimeError(
+                "Engine.plan must run before the step is compiled; "
+                "create a fresh Engine to re-plan")
+        from .cost_model import MeshCostInfo
+        from .planner import plan_tensor_parallel
+        jmesh = self._resolve_mesh()
+        info = mesh_info or MeshCostInfo(axis_sizes=dict(jmesh.shape),
+                                         dcn_axes=tuple(dcn_axes))
+        return plan_tensor_parallel(self._model, info, tokens_per_step,
+                                    mp_axis=mp_axis)
+
+    def _ensure_runner(self):
+        if self._runner is not None:
+            return
+        jmesh = self._resolve_mesh()
         sharding_stage = 0
         if self._strategy is not None and \
                 getattr(self._strategy, "sharding", False):

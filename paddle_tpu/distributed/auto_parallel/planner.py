@@ -1,0 +1,122 @@
+"""Placement planner: SPMD rules + cost model → parameter dist specs.
+
+Parity: the planning half of upstream auto_parallel (completer +
+parallel tuner) reduced to its load-bearing decision for dense
+transformer/MLP models: WHICH weight matrices to tensor-shard on the
+'mp' axis.  Upstream reaches the same placement through SPMD-rule
+completion + cost comparison; this planner prices the two candidate
+plans directly with the cost model:
+
+* replicated: no comm, every rank does the full matmul pair;
+* Megatron col→row pair: each rank does 1/mp of the FLOPs, one
+  all-reduce of the pair's output activation per fwd (and one in bwd).
+
+The tp plan wins when the per-step matmul time saved exceeds the
+all-reduce cost — exactly the tradeoff the cost model exists to price.
+Placements are written as ``dist_spec`` annotations, which
+DistributedRunner/XLA then realise (collectives emitted by SPMD
+propagation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from ...nn.layer import Layer
+from .cost_model import MeshCostInfo, all_reduce_cost
+
+# practical bf16 matmul throughput to price FLOP savings against
+# (v5e-class; ranking-only, same caveat as the comm numbers)
+_MATMUL_FLOPS_PER_US = 160e6
+
+
+@dataclass
+class PlanEntry:
+    first: Layer                     # column-sharded linear
+    second: Layer                    # row-sharded linear
+    saved_us: float                  # matmul time saved per step
+    comm_us: float                   # all-reduce cost per step
+    applied: bool = False
+
+
+def _linear_chains(model: Layer) -> List[Tuple[Layer, Layer]]:
+    """Consecutive Linear pairs A[in,h] → B[h,out] inside each
+    container, the col→row tp pattern (attention qkv/proj and MLP
+    fc1/fc2 both have this shape).  Strictly ``nn.Linear``: an
+    Embedding also carries a 2-D weight but is a gather, not a matmul,
+    and must not be priced as one."""
+    pairs = []
+    from ...nn.common import Linear
+
+    def walk(layer):
+        lins = []
+        for child in layer.children():
+            if isinstance(child, Linear) and \
+                    getattr(child.weight, "dist_spec", None) is None:
+                lins.append(child)
+            elif not list(child.parameters()):
+                continue   # activation/dropout: chain-transparent
+            else:
+                if lins:
+                    _pair(lins)
+                    lins = []
+                walk(child)
+        if lins:
+            _pair(lins)
+
+    def _pair(lins):
+        i = 0
+        while i + 1 < len(lins):
+            a, b = lins[i], lins[i + 1]
+            if a.weight.shape[1] == b.weight.shape[0]:
+                pairs.append((a, b))
+                i += 2
+            else:
+                i += 1
+
+    walk(model)
+    return pairs
+
+
+def plan_tensor_parallel(model: Layer, mesh: MeshCostInfo,
+                         tokens_per_step: int,
+                         mp_axis: str = "mp",
+                         dtype="bfloat16") -> List[PlanEntry]:
+    """Annotate profitable Linear pairs with Megatron col/row specs.
+
+    ``tokens_per_step`` is the activation row count (batch × seq) the
+    plan is priced at.  Returns the per-pair decisions (applied or not)
+    so callers/tests can inspect the costing.
+    """
+    mp = mesh.size(mp_axis)
+    entries: List[PlanEntry] = []
+    if mp <= 1:
+        return entries
+    itemsize = np.dtype(dtype).itemsize
+    for a, b in _linear_chains(model):
+        k_in, h = a.weight.shape
+        _, n_out = b.weight.shape
+        # fwd+bwd matmul time saved: 3 passes (fwd, dgrad, wgrad) of
+        # the pair's 2 matmuls, each cut to 1/mp
+        flops = 3.0 * 2.0 * tokens_per_step * h * (k_in + n_out)
+        saved = flops * (1 - 1.0 / mp) / _MATMUL_FLOPS_PER_US
+        # fwd all-reduces the pair OUTPUT [T, n_out]; bwd all-reduces
+        # the INPUT gradient [T, k_in] (the mirror-image collective)
+        comm = (all_reduce_cost(
+                    float(tokens_per_step) * n_out * itemsize,
+                    mp_axis, mesh)
+                + all_reduce_cost(
+                    float(tokens_per_step) * k_in * itemsize,
+                    mp_axis, mesh))
+        e = PlanEntry(a, b, saved, comm)
+        if saved > comm:
+            a.weight.dist_spec = (None, mp_axis)
+            if getattr(a, "bias", None) is not None:
+                a.bias.dist_spec = (mp_axis,)
+            b.weight.dist_spec = (mp_axis, None)
+            e.applied = True
+        entries.append(e)
+    return entries

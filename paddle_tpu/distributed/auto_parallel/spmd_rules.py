@@ -1,0 +1,465 @@
+"""SPMD inference rules for semi-auto parallel.
+
+Parity: upstream's per-op SPMD rules (paddle/phi/infermeta/spmd_rules/,
+exposed through DistAttr inference — SURVEY.md §2.2 "Auto-parallel
+(semi-auto)").  Upstream implements one C++ rule per op that maps input
+``dims_mapping``s to output dist attrs and flags the reshards needed
+when inputs disagree.
+
+TPU-native stance: at RUN time XLA's SPMD partitioner already does this
+propagation on the compiled program.  These rules exist for the layer
+ABOVE the compiler — the planner: ``Engine``/``shard_op`` use them to
+pick placements and to PRICE alternatives (with ``cost_model``) before
+anything is compiled, and they are pure shape/spec functions, so the
+whole rule set is unit-testable with no devices (the upstream
+test/auto_parallel pattern the survey calls out as worth copying).
+
+A placement here is a ``DistSpec``:
+
+* ``dims``: one entry per tensor dim — a mesh axis name, a tuple of
+  axis names (multi-axis sharding of one dim), or ``None``
+  (replicated dim);
+* ``partial``: mesh axes along which the tensor holds partial sums
+  (the product of a contraction whose contracted dim was sharded) —
+  upstream's ``Partial`` placement.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "DistSpec", "replicated", "infer_forward", "matmul_rule",
+    "elementwise_rule", "multiply_rule", "reduction_rule",
+    "nonlinear_reduction_rule", "reshape_rule",
+    "transpose_rule", "embedding_rule", "softmax_rule", "layer_norm_rule",
+    "concat_rule", "split_rule", "flash_attention_rule",
+    "cross_entropy_rule",
+]
+
+
+def _norm_dim(entry):
+    if entry is None:
+        return None
+    if isinstance(entry, (list, tuple)):
+        t = tuple(entry)
+        return t[0] if len(t) == 1 else t
+    return entry
+
+
+@dataclass(frozen=True)
+class DistSpec:
+    """Sharding of one tensor over a named mesh."""
+
+    dims: Tuple[object, ...]                  # axis | tuple | None per dim
+    partial: frozenset = field(default_factory=frozenset)
+
+    def __init__(self, dims: Sequence, partial=()):  # noqa: D401
+        object.__setattr__(self, "dims",
+                           tuple(_norm_dim(d) for d in dims))
+        object.__setattr__(self, "partial", frozenset(partial))
+
+    # -- helpers ----------------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.dims)
+
+    def axes_of(self, i: int) -> Tuple[str, ...]:
+        d = self.dims[i]
+        if d is None:
+            return ()
+        return d if isinstance(d, tuple) else (d,)
+
+    def used_axes(self) -> frozenset:
+        out = set(self.partial)
+        for i in range(self.ndim):
+            out.update(self.axes_of(i))
+        return frozenset(out)
+
+    def with_dim(self, i: int, axis) -> "DistSpec":
+        dims = list(self.dims)
+        dims[i] = axis
+        return DistSpec(dims, self.partial)
+
+    def drop_partial(self) -> "DistSpec":
+        return DistSpec(self.dims, ())
+
+    def __repr__(self):
+        return f"DistSpec({list(self.dims)!r}, partial={set(self.partial) or '{}'})"
+
+
+def replicated(ndim: int) -> DistSpec:
+    return DistSpec((None,) * ndim)
+
+
+@dataclass
+class RuleResult:
+    """Outcome of a rule: the specs each input must be RESHARDED to
+    (equal to the given input spec when no reshard is needed), and the
+    output spec(s) produced under those input placements."""
+
+    in_specs: List[DistSpec]
+    out_specs: List[DistSpec]
+
+    @property
+    def out_spec(self) -> DistSpec:
+        return self.out_specs[0]
+
+    def reshards(self, given: Sequence[DistSpec]) -> List[int]:
+        """Indices of inputs whose placement must change."""
+        return [i for i, (a, b) in enumerate(zip(given, self.in_specs))
+                if a != b]
+
+
+def _merge_dim(a, b):
+    """Merge one dim's sharding from two operands: equal wins, one-sided
+    wins, conflict → replicate (the cheap deterministic resolution
+    upstream's rules also use for mismatched dims_mappings)."""
+    if a == b:
+        return a, False
+    if a is None:
+        return b, False
+    if b is None:
+        return a, False
+    return None, True
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+def matmul_rule(x: DistSpec, y: DistSpec, trans_x: bool = False,
+                trans_y: bool = False) -> RuleResult:
+    """[..., M, K] @ [..., K, N] (modulo transposes).
+
+    Factor sharding (same scheme as GSPMD / upstream matmul.cc):
+    batch dims merge elementwise; M comes from x, N from y; a K sharded
+    identically on both sides is allowed and makes the output PARTIAL on
+    that axis (the Megatron row-parallel pattern); a K sharded on one
+    side only forces that operand's K to replicate.
+    """
+    if x.ndim < 2 or y.ndim < 2:
+        raise ValueError("matmul_rule expects ndim >= 2 operands")
+    xm, xk = (-1, -2) if trans_x else (-2, -1)
+    yk, yn = (-1, -2) if trans_y else (-2, -1)
+    xdims = list(x.dims)
+    ydims = list(y.dims)
+    kx, ky = xdims[xk], ydims[yk]
+    partial = set()
+    if kx == ky and kx is not None:
+        partial.update(x.axes_of(x.ndim + xk))
+    else:
+        # one-sided (or conflicting) contraction sharding → replicate K
+        kx = ky = None
+    xdims[xk], ydims[yk] = kx, ky
+    nb = max(x.ndim, y.ndim) - 2
+    out_batch = []
+    xin = list(xdims)
+    yin = list(ydims)
+    for i in range(nb):
+        xi = i - (nb - (x.ndim - 2))
+        yi = i - (nb - (y.ndim - 2))
+        a = xdims[xi] if xi >= 0 else None
+        b = ydims[yi] if yi >= 0 else None
+        m, conflict = _merge_dim(a, b)
+        if conflict:
+            m = None
+            if xi >= 0:
+                xin[xi] = None
+            if yi >= 0:
+                yin[yi] = None
+        out_batch.append(m)
+    m_axis = xin[xm]
+    n_axis = yin[yn]
+    # an axis cannot shard two output dims at once: priority
+    # batch > N > M (a batch axis usually carries dp; N wins ties
+    # with M — the Megatron column layout)
+    used = set()
+    for b in out_batch:
+        used.update(b if isinstance(b, tuple) else
+                    ((b,) if b is not None else ()))
+    if n_axis is not None and n_axis in used:
+        n_axis = None
+        yin[yn] = None
+    used.update((n_axis,) if n_axis is not None else ())
+    if m_axis is not None and m_axis in used:
+        m_axis = None
+        xin[xm] = None
+    out = out_batch + [m_axis, n_axis]
+    # matmul is linear in each operand, so ONE side's incoming partial
+    # may flow through to the output; both sides partial would multiply
+    # two pending sums — settle y first (reshard flagged via in_specs)
+    y_partial = y.partial
+    if x.partial and y.partial:
+        y_partial = frozenset()
+    return RuleResult([DistSpec(xin, x.partial),
+                       DistSpec(yin, y_partial)],
+                      [DistSpec(out,
+                                partial | set(x.partial) | set(y_partial))])
+
+
+def elementwise_rule(*specs: DistSpec,
+                     shapes: Optional[Sequence[Sequence[int]]] = None
+                     ) -> RuleResult:
+    """Broadcast-aware elementwise merge (add/mul/...).
+
+    Right-aligned dims merge; a conflict replicates the dim.  Inputs
+    carrying partial sums keep them only if EVERY input is partial on
+    the same axes (else the add of a partial with a replicated operand
+    would double-count — callers must all-reduce first, which the
+    returned in_specs express by dropping ``partial``).
+    """
+    nd = max(s.ndim for s in specs)
+    common_partial = frozenset.intersection(*[s.partial for s in specs]) \
+        if specs else frozenset()
+    out_dims: List = []
+    new_in = [list(s.dims) for s in specs]
+    for d in range(nd):
+        cands = []
+        for si, s in enumerate(specs):
+            i = d - (nd - s.ndim)
+            if i >= 0:
+                size = shapes[si][i] if shapes else None
+                if size == 1:
+                    continue      # broadcasting dim: sharding irrelevant
+                cands.append((si, i, s.dims[i]))
+        merged = None
+        for _, _, a in cands:
+            m, conflict = _merge_dim(merged, a)
+            merged = None if conflict else m
+            if conflict:
+                break
+        out_dims.append(merged)
+        for si, i, a in cands:
+            if a != merged and a is not None:
+                new_in[si][i] = merged
+    ins = [DistSpec(dims, s.partial & common_partial)
+           for dims, s in zip(new_in, specs)]
+    return RuleResult(ins, [DistSpec(out_dims, common_partial)])
+
+
+def multiply_rule(*specs: DistSpec,
+                  shapes: Optional[Sequence[Sequence[int]]] = None
+                  ) -> RuleResult:
+    """Elementwise multiply/divide: partial sums do NOT distribute
+    through a product (Σaᵢ·Σbᵢ ≠ Σaᵢbᵢ), so every input must settle
+    its partials first; dims merge as in elementwise_rule."""
+    r = elementwise_rule(*[s.drop_partial() for s in specs],
+                         shapes=shapes)
+    return RuleResult(r.in_specs, [r.out_spec.drop_partial()])
+
+
+def reduction_rule(x: DistSpec, axes: Sequence[int],
+                   keepdim: bool = False) -> RuleResult:
+    """SUM over ``axes``: reduced dims' mesh axes become partial on the
+    output (Σ distributes over shards); kept dims propagate."""
+    axes = [a % x.ndim for a in axes]
+    partial = set(x.partial)
+    out_dims: List = []
+    for i, d in enumerate(x.dims):
+        if i in axes:
+            partial.update(x.axes_of(i))
+            if keepdim:
+                out_dims.append(None)
+        else:
+            out_dims.append(d)
+    return RuleResult([x], [DistSpec(out_dims, partial)])
+
+
+def nonlinear_reduction_rule(x: DistSpec, axes: Sequence[int],
+                             keepdim: bool = False) -> RuleResult:
+    """mean/max/min over ``axes``: shard-wise results do not combine by
+    summation (Σ of shard means ≠ global mean; Σ of shard maxes is
+    meaningless), so the reduced dims must be REPLICATED first —
+    expressed as an input reshard, never as a Partial output."""
+    axes = [a % x.ndim for a in axes]
+    in_dims = [None if i in axes else d for i, d in enumerate(x.dims)]
+    out_dims = [d for i, d in enumerate(in_dims)
+                if i not in axes or keepdim]
+    xin = DistSpec(in_dims)
+    return RuleResult([xin], [DistSpec(out_dims)])
+
+
+def reshape_rule(x: DistSpec, in_shape: Sequence[int],
+                 out_shape: Sequence[int]) -> RuleResult:
+    """Propagate sharding through reshape when a sharded input dim maps
+    to an output dim it left-aligns with (leading-factor rule: the
+    sharded dim must be the MAJOR factor of its group).  Anything more
+    exotic replicates."""
+    groups = _reshape_groups(list(in_shape), list(out_shape))
+    if groups is None:
+        return RuleResult([x.drop_partial()],
+                          [replicated(len(out_shape))])
+    out_dims: List = [None] * len(out_shape)
+    new_in = list(x.dims)
+    for in_dims, out_dims_idx in groups:
+        shard = [i for i in in_dims if x.dims[i] is not None]
+        if not shard:
+            continue
+        lead = in_dims[0]
+        if shard != [lead]:
+            for i in shard:           # non-leading shard: replicate
+                new_in[i] = None
+            continue
+        out_dims[out_dims_idx[0]] = x.dims[lead]
+    return RuleResult([DistSpec(new_in, x.partial)],
+                      [DistSpec(out_dims, x.partial)])
+
+
+def _reshape_groups(a: List[int], b: List[int]):
+    """Greedy factor grouping: returns [(in_dim_idxs, out_dim_idxs)]
+    covering both shapes, or None when sizes cannot be grouped."""
+    out = []
+    i = j = 0
+    while i < len(a) and j < len(b):
+        ii, jj = [i], [j]
+        pa, pb = a[i], b[j]
+        i += 1
+        j += 1
+        while pa != pb:
+            if pa < pb:
+                if i >= len(a):
+                    return None
+                pa *= a[i]
+                ii.append(i)
+                i += 1
+            else:
+                if j >= len(b):
+                    return None
+                pb *= b[j]
+                jj.append(j)
+                j += 1
+        out.append((ii, jj))
+    if i < len(a) or j < len(b):      # trailing 1s
+        if all(v == 1 for v in a[i:]) and all(v == 1 for v in b[j:]):
+            return out
+        return None
+    return out
+
+
+def transpose_rule(x: DistSpec, perm: Sequence[int]) -> RuleResult:
+    return RuleResult([x], [DistSpec([x.dims[p] for p in perm],
+                                     x.partial)])
+
+
+def embedding_rule(table: DistSpec, ids: DistSpec) -> RuleResult:
+    """Gather rows: vocab-sharded table ([mp, None]) makes the output
+    PARTIAL on the vocab axis (out-of-shard rows contribute zero — the
+    VocabParallelEmbedding masked-lookup pattern); hidden-dim sharding
+    propagates to the last output dim."""
+    vocab_axes = table.axes_of(0)
+    out_dims = list(ids.dims) + [table.dims[1] if table.ndim > 1
+                                 else None]
+    return RuleResult([table, ids],
+                      [DistSpec(out_dims,
+                                set(table.partial) | set(vocab_axes))])
+
+
+def softmax_rule(x: DistSpec, axis: int = -1) -> RuleResult:
+    """The normalized axis must not be sharded (a sharded softmax dim
+    needs the mp all-reduce pattern instead) → rule requires that dim
+    replicated; other dims propagate."""
+    axis = axis % x.ndim
+    xin = x
+    if x.dims[axis] is not None:
+        xin = x.with_dim(axis, None)
+    return RuleResult([xin.drop_partial()], [xin.drop_partial()])
+
+
+def layer_norm_rule(x: DistSpec, begin_norm_axis: int = -1) -> RuleResult:
+    """Normalized (trailing) dims replicate; leading dims propagate."""
+    begin = begin_norm_axis % x.ndim
+    dims = [d if i < begin else None for i, d in enumerate(x.dims)]
+    return RuleResult([DistSpec(dims)], [DistSpec(dims)])
+
+
+def concat_rule(specs: Sequence[DistSpec], axis: int) -> RuleResult:
+    """Concat dim must be replicated on every input; others merge."""
+    nd = specs[0].ndim
+    axis = axis % nd
+    merged: List = []
+    for d in range(nd):
+        m = None
+        for s in specs:
+            m, conflict = _merge_dim(m, s.dims[d])
+            if conflict:
+                m = None
+                break
+        merged.append(None if d == axis else m)
+    ins = [DistSpec(merged) for _ in specs]
+    return RuleResult(list(ins), [DistSpec(merged)])
+
+
+def split_rule(x: DistSpec, axis: int, num: int) -> RuleResult:
+    axis = axis % x.ndim
+    xin = x.with_dim(axis, None) if x.dims[axis] is not None else x
+    return RuleResult([xin], [xin] * num)
+
+
+def flash_attention_rule(q: DistSpec, k: DistSpec, v: DistSpec
+                         ) -> RuleResult:
+    """[B, S, H, D]: batch merges across q/k/v; heads may shard (mp);
+    D replicates; S may shard only on a context-parallel axis for q
+    (ring/Ulysses handle the K/V exchange) — the plain kernel requires
+    K/V sequence replicated."""
+    b, _ = _merge_dim(_merge_dim(q.dims[0], k.dims[0])[0], v.dims[0])
+    h, _ = _merge_dim(_merge_dim(q.dims[2], k.dims[2])[0], v.dims[2])
+    qs = DistSpec([b, q.dims[1], h, None])
+    kv = DistSpec([b, None, h, None])
+    return RuleResult([qs, kv, kv], [qs])
+
+
+def cross_entropy_rule(logits: DistSpec, label: DistSpec) -> RuleResult:
+    """Vocab (last) dim sharded → ParallelCrossEntropy: output loss is
+    partial on the vocab axes; batch dims merge with the label."""
+    vocab_axes = logits.axes_of(logits.ndim - 1)
+    out_dims = []
+    lin = list(label.dims)
+    for i in range(logits.ndim - 1):
+        m, conflict = _merge_dim(logits.dims[i],
+                                 label.dims[i] if i < label.ndim else None)
+        if conflict:
+            m = None
+        out_dims.append(m)
+        if i < label.ndim:
+            lin[i] = m
+    return RuleResult([logits, DistSpec(lin, label.partial)],
+                      [DistSpec(out_dims, set(vocab_axes))])
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+_RULES = {
+    "matmul": matmul_rule,
+    "elementwise": elementwise_rule,
+    "add": elementwise_rule,
+    "multiply": multiply_rule,
+    "divide": multiply_rule,
+    "reduction": reduction_rule,
+    "sum": reduction_rule,
+    "mean": nonlinear_reduction_rule,
+    "max": nonlinear_reduction_rule,
+    "min": nonlinear_reduction_rule,
+    "reshape": reshape_rule,
+    "transpose": transpose_rule,
+    "embedding": embedding_rule,
+    "softmax": softmax_rule,
+    "layer_norm": layer_norm_rule,
+    "concat": concat_rule,
+    "split": split_rule,
+    "flash_attention": flash_attention_rule,
+    "cross_entropy": cross_entropy_rule,
+}
+
+
+def infer_forward(op: str, *specs, **attrs) -> RuleResult:
+    """Look up and apply the SPMD rule for ``op`` (upstream
+    ``SpmdRuleFactory`` entry point)."""
+    try:
+        rule = _RULES[op]
+    except KeyError:
+        raise NotImplementedError(
+            f"no SPMD rule registered for op {op!r}; known: "
+            f"{sorted(_RULES)}") from None
+    return rule(*specs, **attrs)
