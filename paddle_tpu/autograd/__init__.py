@@ -4,3 +4,5 @@ from .tape import (  # noqa
     backward, grad, no_grad, enable_grad, is_grad_enabled,
     set_grad_enabled, reset_tape)
 from .py_layer import PyLayer, PyLayerContext  # noqa
+from .functional import (  # noqa
+    jvp, vjp, jacobian, hessian, Jacobian, Hessian)

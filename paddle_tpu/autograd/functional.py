@@ -1,0 +1,197 @@
+"""Functional higher-order autograd (parity:
+python/paddle/incubate/autograd/ — jvp, vjp, Jacobian, Hessian — and
+the 2.6-era functional ``paddle.autograd.jacobian/hessian``).
+
+TPU-native: these ARE jax's transforms.  The user function is lifted
+to a pure jax function (Tensor wrappers in, Tensor wrappers out, eager
+tape suppressed inside) and handed to ``jax.jvp`` / ``jax.vjp`` /
+``jax.jacfwd`` / ``jax.jacrev`` — forward-over-reverse for the
+Hessian, the composition upstream implements by stacking its prim
+rules.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["jvp", "vjp", "jacobian", "hessian", "Jacobian", "Hessian"]
+
+
+def _values(xs):
+    from ..tensor import Tensor
+    if isinstance(xs, (list, tuple)):
+        return [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in xs]
+    return [xs._value if isinstance(xs, Tensor) else jnp.asarray(xs)]
+
+
+def _is_seq(xs) -> bool:
+    return isinstance(xs, (list, tuple))
+
+
+def _pure(func: Callable, n: int, seq_in: bool):
+    """Wrap a Tensor-level callable as a pure jax fn of n arrays."""
+
+    def fn(*vals):
+        from ..tensor import Tensor
+        from . import tape as _tape
+        with _tape.no_grad_ctx():
+            args = [Tensor(v) for v in vals]
+            out = func(*args) if (seq_in or n > 1) else func(args[0])
+        if isinstance(out, (list, tuple)):
+            return tuple(o._value for o in out)
+        return out._value
+
+    return fn
+
+
+def _rewrap_like(vals, like_seq: bool):
+    from ..tensor import Tensor
+    outs = tuple(Tensor(v, stop_gradient=True) for v in vals)
+    return outs if like_seq or len(outs) != 1 else outs[0]
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns ``(func(xs), J @ v)`` (upstream
+    incubate.autograd.jvp).  ``v`` defaults to ones like ``xs``."""
+    seq = _is_seq(xs)
+    vals = _values(xs)
+    if v is None:
+        tans = [jnp.ones_like(a) for a in vals]
+    else:
+        tans = _values(v)
+    fn = _pure(func, len(vals), seq)
+    out, tangent = jax.jvp(fn, tuple(vals), tuple(tans))
+    multi_out = isinstance(out, tuple)
+    outs = out if multi_out else (out,)
+    tangents = tangent if multi_out else (tangent,)
+    return (_rewrap_like(outs, multi_out),
+            _rewrap_like(tangents, multi_out))
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns ``(func(xs), vᵀ @ J)`` (upstream
+    incubate.autograd.vjp)."""
+    seq = _is_seq(xs)
+    vals = _values(xs)
+    fn = _pure(func, len(vals), seq)
+    out, pullback = jax.vjp(fn, *vals)
+    multi_out = isinstance(out, tuple)
+    if v is None:
+        cts = tuple(jnp.ones_like(o)
+                    for o in (out if multi_out else (out,)))
+        cts = cts if multi_out else cts[0]
+    else:
+        cvals = _values(v)
+        cts = tuple(cvals) if multi_out else cvals[0]
+    grads = pullback(cts)
+    outs = out if multi_out else (out,)
+    return (_rewrap_like(outs, multi_out),
+            _rewrap_like(grads, seq))
+
+
+def jacobian(func: Callable, xs, batch_axis=None) -> Union[Tensor, tuple]:
+    """Full Jacobian of ``func`` at ``xs`` via jacrev (upstream
+    paddle.autograd.jacobian functional form).
+
+    For scalar-to-tensor or tensor-to-tensor ``func``; with
+    ``batch_axis=0`` the leading dim is treated as batch (a jax vmap
+    over per-example jacrev)."""
+    seq = _is_seq(xs)
+    vals = _values(xs)
+    fn = _pure(func, len(vals), seq)
+    argnums = tuple(range(len(vals)))
+    if batch_axis is None:
+        jac = jax.jacrev(fn, argnums=argnums)(*vals)
+    elif batch_axis == 0:
+        def single(*one):
+            return fn(*one)
+        jac = jax.vmap(jax.jacrev(single, argnums=argnums))(*vals)
+    else:
+        raise ValueError("batch_axis must be None or 0")
+    # jac: per-output (if multi) × per-input pytree of arrays
+    from ..tensor import Tensor
+
+    def wrap(j):
+        if isinstance(j, tuple):
+            return tuple(wrap(x) for x in j)
+        return Tensor(j, stop_gradient=True)
+    out = wrap(jac)
+    if not seq and isinstance(out, tuple) and len(out) == 1:
+        return out[0]
+    return out
+
+
+def hessian(func: Callable, xs, batch_axis=None):
+    """Hessian of a SCALAR-output ``func`` — forward-over-reverse
+    (jacfwd∘jacrev), the efficient composition on TPU."""
+    seq = _is_seq(xs)
+    vals = _values(xs)
+    fn = _pure(func, len(vals), seq)
+    argnums = tuple(range(len(vals)))
+
+    def scalar_fn(*a):
+        out = fn(*a)
+        if isinstance(out, tuple):
+            raise ValueError("hessian expects a single scalar output")
+        return jnp.reshape(out, ())
+
+    hess_fn = jax.jacfwd(jax.jacrev(scalar_fn, argnums=argnums),
+                         argnums=argnums)
+    if batch_axis is None:
+        h = hess_fn(*vals)
+    elif batch_axis == 0:
+        h = jax.vmap(hess_fn)(*vals)
+    else:
+        raise ValueError("batch_axis must be None or 0")
+
+    from ..tensor import Tensor
+
+    def wrap(j):
+        if isinstance(j, tuple):
+            return tuple(wrap(x) for x in j)
+        return Tensor(j, stop_gradient=True)
+    out = wrap(h)
+    if not seq and isinstance(out, tuple) and len(out) == 1:
+        inner = out[0]
+        if isinstance(inner, tuple) and len(inner) == 1:
+            return inner[0]
+        return inner
+    return out
+
+
+class Jacobian:
+    """Lazy Jacobian object (upstream paddle.autograd.Jacobian): index
+    ``J[i, j]`` or materialise via ``paddle.autograd.jacobian``."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._mat = jacobian(func, xs,
+                             batch_axis=0 if is_batched else None)
+
+    def __getitem__(self, idx):
+        from ..tensor import Tensor
+        m = self._mat
+        if isinstance(m, tuple):
+            raise TypeError("indexing a multi-input Jacobian; select "
+                            "the input first via .tensors")
+        return Tensor(m._value[idx], stop_gradient=True)
+
+    @property
+    def tensors(self):
+        return self._mat
+
+    @property
+    def shape(self):
+        from ..tensor import Tensor
+        m = self._mat
+        return m.shape if isinstance(m, Tensor) else \
+            [t.shape for t in m]
+
+
+class Hessian(Jacobian):
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._mat = hessian(func, xs,
+                            batch_axis=0 if is_batched else None)

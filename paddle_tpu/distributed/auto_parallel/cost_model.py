@@ -139,10 +139,12 @@ def reshard_cost(src: DistSpec, dst: DistSpec, shape: Sequence[int],
         return 0.0
     full = _bytes(shape, dtype)
     cost = 0.0
-    # axes that keep sharding the same dim in both: data stays local
-    kept = set()
-    for i in range(min(src.ndim, dst.ndim)):
-        kept.update(set(src.axes_of(i)) & set(dst.axes_of(i)))
+    # every axis currently sharding a dim of src divides the bytes a
+    # rank holds — collectives are priced at that LOCAL size (pricing
+    # at full size inflated mp-sharded settles by the mp factor)
+    src_shard_axes = set()
+    for i in range(src.ndim):
+        src_shard_axes.update(src.axes_of(i))
 
     def _local(nb, axes_set):
         n = 1
@@ -150,21 +152,23 @@ def reshard_cost(src: DistSpec, dst: DistSpec, shape: Sequence[int],
             n *= mesh.size(a)
         return nb / max(n, 1)
 
-    # 1. settle partials
+    # 1. settle partials (tensor still sharded by all src dim axes)
     for ax in src.partial - dst.partial:
         dst_scatter = any(ax in dst.axes_of(i)
                           for i in range(dst.ndim))
-        nb = _local(full, kept - {ax})
+        nb = _local(full, src_shard_axes)
         if dst_scatter:
             cost += reduce_scatter_cost(nb, ax, mesh)
         else:
             cost += all_reduce_cost(nb, ax, mesh)
-    # 2. gather dims whose axes leave
+    # 2. gather dims whose axes leave: the gather of ``ax`` produces
+    # bytes = full over whatever OTHER axes still shard the tensor
     for i in range(src.ndim):
         leaving = set(src.axes_of(i)) - (set(dst.axes_of(i))
                                          if i < dst.ndim else set())
         for ax in leaving:
-            cost += all_gather_cost(_local(full, kept), ax, mesh)
+            cost += all_gather_cost(
+                _local(full, src_shard_axes - {ax}), ax, mesh)
     # 3. replicated → sharded: local slice, free
     return cost
 

@@ -68,10 +68,18 @@ def _linear_chains(model: Layer) -> List[Tuple[Layer, Layer]]:
             _pair(lins)
 
     def _pair(lins):
+        # pair only a strict expand→contract shape signature
+        # (a: in<out, b: in>out, a.out == b.in — the MLP/ffn pattern).
+        # Definition-order adjacency alone mispairs parallel
+        # projections: q/k/v/out in an attention block are consecutive
+        # same-shaped Linears with NO dataflow between them, and square
+        # chains are therefore skipped (conservative by design).
         i = 0
         while i + 1 < len(lins):
             a, b = lins[i], lins[i + 1]
-            if a.weight.shape[1] == b.weight.shape[0]:
+            a_in, a_out = a.weight.shape
+            b_in, b_out = b.weight.shape
+            if a_out == b_in and a_in < a_out and b_in > b_out:
                 pairs.append((a, b))
                 i += 2
             else:

@@ -173,16 +173,21 @@ def matmul_rule(x: DistSpec, y: DistSpec, trans_x: bool = False,
     n_axis = yin[yn]
     # an axis cannot shard two output dims at once: priority
     # batch > N > M (a batch axis usually carries dp; N wins ties
-    # with M — the Megatron column layout)
+    # with M — the Megatron column layout).  Compare FLATTENED axis
+    # members so multi-axis dims (tuples) collide correctly.
+    def _members(a):
+        if a is None:
+            return ()
+        return a if isinstance(a, tuple) else (a,)
+
     used = set()
-    for b in out_batch:
-        used.update(b if isinstance(b, tuple) else
-                    ((b,) if b is not None else ()))
-    if n_axis is not None and n_axis in used:
+    for bdim in out_batch:
+        used.update(_members(bdim))
+    if n_axis is not None and used & set(_members(n_axis)):
         n_axis = None
         yin[yn] = None
-    used.update((n_axis,) if n_axis is not None else ())
-    if m_axis is not None and m_axis in used:
+    used.update(_members(n_axis))
+    if m_axis is not None and used & set(_members(m_axis)):
         m_axis = None
         xin[xm] = None
     out = out_batch + [m_axis, n_axis]
@@ -411,7 +416,9 @@ def flash_attention_rule(q: DistSpec, k: DistSpec, v: DistSpec
 
 def cross_entropy_rule(logits: DistSpec, label: DistSpec) -> RuleResult:
     """Vocab (last) dim sharded → ParallelCrossEntropy: output loss is
-    partial on the vocab axes; batch dims merge with the label."""
+    partial on the vocab axes; batch dims merge with the label.  CE is
+    nonlinear in the logits, so an INCOMING partial must settle first
+    (reshard flagged by dropping it from in_specs)."""
     vocab_axes = logits.axes_of(logits.ndim - 1)
     out_dims = []
     lin = list(label.dims)
@@ -423,7 +430,8 @@ def cross_entropy_rule(logits: DistSpec, label: DistSpec) -> RuleResult:
         out_dims.append(m)
         if i < label.ndim:
             lin[i] = m
-    return RuleResult([logits, DistSpec(lin, label.partial)],
+    return RuleResult([logits.drop_partial(),
+                       DistSpec(lin, label.partial)],
                       [DistSpec(out_dims, set(vocab_axes))])
 
 

@@ -5,3 +5,4 @@ Grown as features land; nn.functional fused ops alias the main ops
 from . import distributed  # noqa
 from . import nn  # noqa
 from . import asp  # noqa
+from . import autograd  # noqa

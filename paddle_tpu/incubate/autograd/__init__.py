@@ -1,0 +1,20 @@
+"""paddle.incubate.autograd parity (upstream incubate/autograd/ —
+functional jvp/vjp/Jacobian/Hessian; the prim-rule machinery upstream
+needs for higher-order is jax's composable transforms here)."""
+
+from ...autograd.functional import (  # noqa
+    jvp, vjp, jacobian, hessian, Jacobian, Hessian)
+
+
+def enable_prim():
+    """Upstream toggles its primitive-op lowering for higher-order
+    autodiff; jax transforms compose natively, so this is a no-op kept
+    for script compatibility."""
+
+
+def disable_prim():
+    pass
+
+
+def prim_enabled():
+    return True

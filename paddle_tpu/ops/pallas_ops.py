@@ -634,6 +634,512 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
 
 
 # ---------------------------------------------------------------------------
+# Packed-heads kernels — the transpose-free layout.
+#
+# The [B,S,H,D]→[B*H,S,D] form above needs a physical S↔H transpose of
+# q/k/v/out in BOTH directions of every layer (XLA materialises a
+# layout-change copy per tensor because pallas_call pins default
+# layouts — measured ~4 ms/step on the GPT-2 bench, plus bigger grids).
+# Here the kernels instead read the projection output directly as
+# [B, S, H*D] (a free reshape): heads are packed into 128-lane groups
+# (``hpb`` heads per block when D < 128), the grid walks (B*G, ...)
+# with G = H/hpb lane-groups, and each kernel unrolls the per-head
+# online softmax over static lane slices of its block.  lse is stored
+# in the SAME [B, Sq, H*D] layout (per-head value broadcast over that
+# head's d lanes), so forward and backward agree without any
+# re-broadcasts.
+# ---------------------------------------------------------------------------
+def _packed_geometry(h: int, d: int):
+    """lane-block width, heads per block, and group count — or None
+    when the packed layout doesn't apply to this head size."""
+    if d >= 128:
+        if d % 128:
+            return None
+        lb, hpb = d, 1
+    else:
+        if 128 % d:
+            return None
+        hpb = 128 // d
+        lb = 128
+        if h % hpb:
+            return None
+    return lb, hpb, h // hpb
+
+
+def _flash_packed_fwd_kernel(*refs, scale: float, causal: bool,
+                             block_q: int, block_k: int, seq_k: int,
+                             d: int, hpb: int, has_seg: bool):
+    from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, qs_ref, ks_ref, o_ref, lse_ref, \
+            m_scr, l_scr, acc_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
+        qs_ref = ks_ref = None
+
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
+
+    def body():
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            cmask = q_pos >= k_pos
+        if has_seg:
+            smask = qs_ref[0][:, :1] == ks_ref[0][:1, :]
+        for hh in range(hpb):
+            dsl = slice(hh * d, (hh + 1) * d)
+            lsl = slice(hh * _LANES, (hh + 1) * _LANES)
+            q = q_ref[0][:, dsl]
+            k = k_ref[0][:, dsl]
+            v = v_ref[0][:, dsl]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = jnp.where(cmask, s, -jnp.inf)
+            if has_seg:
+                s = jnp.where(smask, s, -jnp.inf)
+            m_prev = m_scr[:, lsl][:, :1]
+            l_prev = l_scr[:, lsl][:, :1]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m_prev, m_cur)
+            m_safe = jnp.maximum(m_new, _LSE_FLOOR)
+            p = jnp.exp(s - m_safe)
+            alpha = jnp.exp(jnp.maximum(m_prev, _LSE_FLOOR) - m_safe)
+            l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+            acc_scr[:, dsl] = acc_scr[:, dsl] * alpha + \
+                jax.lax.dot_general(
+                    p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            m_scr[:, lsl] = jnp.broadcast_to(m_new, (block_q, _LANES))
+            l_scr[:, lsl] = jnp.broadcast_to(l_new, (block_q, _LANES))
+
+    if causal and not has_seg:
+        @pl.when(kv_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_kv = seq_k // block_k
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finish():
+        # assemble the full lane-block then write each ref ONCE —
+        # ref[0][:, sl] = x is a chained setitem on a VALUE, not a ref
+        # write, and fails (verified in interpret mode)
+        o_cols = []
+        lse_cols = []
+        for hh in range(hpb):
+            dsl = slice(hh * d, (hh + 1) * d)
+            lsl = slice(hh * _LANES, (hh + 1) * _LANES)
+            l_fin = l_scr[:, lsl][:, :1]
+            o_cols.append((acc_scr[:, dsl]
+                           / jnp.maximum(l_fin, 1e-30)).astype(
+                o_ref.dtype))
+            lse = (jnp.maximum(m_scr[:, lsl][:, :1], _LSE_FLOOR)
+                   + jnp.log(jnp.maximum(l_fin, 1e-30)))
+            lse_cols.append(jnp.broadcast_to(lse, (block_q, d)))
+        o_ref[0] = jnp.concatenate(o_cols, axis=-1)
+        lse_ref[0] = jnp.concatenate(lse_cols, axis=-1)
+
+
+def _flash_packed_bwd_dq_kernel(*refs, scale: float, causal: bool,
+                                block_q: int, block_k: int, seq_k: int,
+                                d: int, hpb: int, has_seg: bool):
+    from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, qs_ref, ks_ref, \
+            dq_ref, dq_scr, delta_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dq_ref, \
+            dq_scr, delta_scr = refs
+        qs_ref = ks_ref = None
+
+    q_idx = pl.program_id(1)
+    kv_idx = pl.program_id(2)
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr[...])
+        for hh in range(hpb):
+            dsl = slice(hh * d, (hh + 1) * d)
+            lsl = slice(hh * _LANES, (hh + 1) * _LANES)
+            d_row = jnp.sum(do_ref[0][:, dsl].astype(jnp.float32)
+                            * o_ref[0][:, dsl].astype(jnp.float32),
+                            axis=-1, keepdims=True)
+            delta_scr[:, lsl] = jnp.broadcast_to(d_row,
+                                                 (block_q, _LANES))
+
+    def body():
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            cmask = q_pos >= k_pos
+        if has_seg:
+            smask = qs_ref[0][:, :1] == ks_ref[0][:1, :]
+        for hh in range(hpb):
+            dsl = slice(hh * d, (hh + 1) * d)
+            lsl = slice(hh * _LANES, (hh + 1) * _LANES)
+            q = q_ref[0][:, dsl]
+            k = k_ref[0][:, dsl]
+            v = v_ref[0][:, dsl]
+            do = do_ref[0][:, dsl]
+            lse = lse_ref[0][:, hh * d:hh * d + 1]
+            delta = delta_scr[:, lsl][:, :1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = jnp.where(cmask, s, -jnp.inf)
+            if has_seg:
+                s = jnp.where(smask, s, -jnp.inf)
+            p = jnp.exp(s - lse)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta) * scale).astype(k.dtype)
+            dq_scr[:, dsl] += jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    if causal and not has_seg:
+        @pl.when(kv_idx * block_k <= q_idx * block_q + block_q - 1)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_kv = seq_k // block_k
+
+    @pl.when(kv_idx == n_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _flash_packed_bwd_dkv_kernel(*refs, scale: float, causal: bool,
+                                 block_q: int, block_k: int,
+                                 seq_q: int, d: int, hpb: int,
+                                 has_seg: bool):
+    from jax.experimental import pallas as pl
+
+    if has_seg:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, qs_ref, ks_ref, \
+            dk_ref, dv_ref, dk_scr, dv_scr = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, dk_ref, dv_ref, \
+            dk_scr, dv_scr = refs
+        qs_ref = ks_ref = None
+
+    kv_idx = pl.program_id(1)
+    q_idx = pl.program_id(2)
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr[...])
+        dv_scr[...] = jnp.zeros_like(dv_scr[...])
+
+    def body():
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            cmask = q_pos >= k_pos
+        if has_seg:
+            smask = qs_ref[0][:, :1] == ks_ref[0][:1, :]
+        for hh in range(hpb):
+            dsl = slice(hh * d, (hh + 1) * d)
+            q = q_ref[0][:, dsl]
+            k = k_ref[0][:, dsl]
+            v = v_ref[0][:, dsl]
+            do = do_ref[0][:, dsl]
+            lse = lse_ref[0][:, hh * d:hh * d + 1]
+            delta = jnp.sum(do.astype(jnp.float32)
+                            * o_ref[0][:, dsl].astype(jnp.float32),
+                            axis=-1, keepdims=True)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = jnp.where(cmask, s, -jnp.inf)
+            if has_seg:
+                s = jnp.where(smask, s, -jnp.inf)
+            p = jnp.exp(s - lse)
+            dv_scr[:, dsl] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta) * scale).astype(q.dtype)
+            dk_scr[:, dsl] += jax.lax.dot_general(
+                ds, q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+
+    if causal and not has_seg:
+        @pl.when(q_idx * block_q + block_q - 1 >= kv_idx * block_k)
+        def _run():
+            body()
+    else:
+        body()
+
+    n_q = seq_q // block_q
+
+    @pl.when(q_idx == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _pallas_flash_packed(q, k, v, h, d, q_seg=None, k_seg=None, *,
+                         causal: bool, block_q: Optional[int] = None,
+                         block_k: Optional[int] = None):
+    """q [B, Sq, H*D]; k/v [B, Sk, H*D] → (out [B, Sq, H*D],
+    lse [B, Sq, H*D] f32, per-head value broadcast over its d lanes).
+    Segment ids are [B, S*] (NOT per-head — the packed grid reuses one
+    mask per lane-group)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, hd = q.shape
+    sk = k.shape[1]
+    lb, hpb, g = _packed_geometry(h, d)
+    block_q = _fit_block(
+        sq, block_q or _block_default("PADDLE_TPU_FLASH_BQ", 512))
+    block_k = _fit_block(
+        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 1024))
+    scale = 1.0 / math.sqrt(d)
+    has_seg = q_seg is not None
+    kw = dict(scale=scale, causal=causal, block_q=block_q,
+              block_k=block_k, d=d, hpb=hpb, has_seg=has_seg)
+
+    qspec = pl.BlockSpec((1, block_q, lb),
+                         lambda bg, i, j: (bg // g, i, bg % g))
+    kspec = pl.BlockSpec((1, block_k, lb),
+                         lambda bg, i, j: (bg // g, j, bg % g))
+    if has_seg:
+        qs_b = jax.lax.broadcast_in_dim(q_seg, (b, sq, _LANES), (0, 1))
+        ks_b = jax.lax.broadcast_in_dim(k_seg, (b, _SUBLANES, sk),
+                                        (0, 2))
+        segq = pl.BlockSpec((1, block_q, _LANES),
+                            lambda bg, i, j: (bg // g, i, bg * 0))
+        segk = pl.BlockSpec((1, _SUBLANES, block_k),
+                            lambda bg, i, j: (bg // g, bg * 0, j))
+    in_specs = [qspec, kspec, kspec]
+    args = [q, k, v]
+    if has_seg:
+        in_specs += [segq, segk]
+        args += [qs_b, ks_b]
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_packed_fwd_kernel, seq_k=sk, **kw),
+        grid=(b * g, sq // block_q, sk // block_k),
+        in_specs=in_specs,
+        out_specs=[qspec, qspec],
+        out_shape=[jax.ShapeDtypeStruct((b, sq, hd), q.dtype),
+                   jax.ShapeDtypeStruct((b, sq, hd), jnp.float32)],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hpb * _LANES), jnp.float32),
+            pltpu.VMEM((block_q, hpb * _LANES), jnp.float32),
+            pltpu.VMEM((block_q, lb), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+    return out, lse
+
+
+def _pallas_flash_packed_bwd(q, k, v, out, lse, do, h, d, q_seg=None,
+                             k_seg=None, *, causal: bool,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, hd = q.shape
+    sk = k.shape[1]
+    lb, hpb, g = _packed_geometry(h, d)
+    block_q = _fit_block(
+        sq, block_q or _block_default("PADDLE_TPU_FLASH_BQ", 512))
+    block_k = _fit_block(
+        sk, block_k or _block_default("PADDLE_TPU_FLASH_BK", 1024))
+    scale = 1.0 / math.sqrt(d)
+    has_seg = q_seg is not None
+    kw = dict(scale=scale, causal=causal, block_q=block_q,
+              block_k=block_k, d=d, hpb=hpb, has_seg=has_seg)
+    if has_seg:
+        qs_b = jax.lax.broadcast_in_dim(q_seg, (b, sq, _LANES), (0, 1))
+        ks_b = jax.lax.broadcast_in_dim(k_seg, (b, _SUBLANES, sk),
+                                        (0, 2))
+
+    # dq pass: grid (b*g, q, kv) — kv minor
+    qspec = pl.BlockSpec((1, block_q, lb),
+                         lambda bg, i, j: (bg // g, i, bg % g))
+    kspec = pl.BlockSpec((1, block_k, lb),
+                         lambda bg, i, j: (bg // g, j, bg % g))
+    in_specs = [qspec, kspec, kspec, qspec, qspec, qspec]
+    args = [q, k, v, do, out, lse]
+    if has_seg:
+        in_specs += [
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bg, i, j: (bg // g, i, bg * 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda bg, i, j: (bg // g, bg * 0, j))]
+        args += [qs_b, ks_b]
+    dq = pl.pallas_call(
+        functools.partial(_flash_packed_bwd_dq_kernel, seq_k=sk, **kw),
+        grid=(b * g, sq // block_q, sk // block_k),
+        in_specs=in_specs,
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, sq, hd), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, lb), jnp.float32),
+            pltpu.VMEM((block_q, hpb * _LANES), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(*args)
+
+    # dkv pass: grid (b*g, kv, q) — q minor
+    qspec2 = pl.BlockSpec((1, block_q, lb),
+                          lambda bg, j, i: (bg // g, i, bg % g))
+    kspec2 = pl.BlockSpec((1, block_k, lb),
+                          lambda bg, j, i: (bg // g, j, bg % g))
+    in_specs2 = [qspec2, kspec2, kspec2, qspec2, qspec2, qspec2]
+    args2 = [q, k, v, do, out, lse]
+    if has_seg:
+        in_specs2 += [
+            pl.BlockSpec((1, block_q, _LANES),
+                         lambda bg, j, i: (bg // g, i, bg * 0)),
+            pl.BlockSpec((1, _SUBLANES, block_k),
+                         lambda bg, j, i: (bg // g, bg * 0, j))]
+        args2 += [qs_b, ks_b]
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_packed_bwd_dkv_kernel, seq_q=sq, **kw),
+        grid=(b * g, sk // block_k, sq // block_q),
+        in_specs=in_specs2,
+        out_specs=[kspec2, kspec2],
+        out_shape=[jax.ShapeDtypeStruct((b, sk, hd), k.dtype),
+                   jax.ShapeDtypeStruct((b, sk, hd), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, lb), jnp.float32),
+                        pltpu.VMEM((block_k, lb), jnp.float32)],
+        interpret=_interpret(),
+    )(*args2)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_core_packed(q, k, v, q_seg, k_seg, causal, h, d):
+    out, _ = _flash_packed_fwd(q, k, v, q_seg, k_seg, causal, h, d)
+    return out
+
+
+def _to_bh(x, h, d):
+    b, s, _ = x.shape
+    return jnp.moveaxis(x.reshape(b, s, h, d), 2, 1).reshape(
+        b * h, s, d)
+
+
+def _from_bh(x, b, h):
+    bh, s, d = x.shape
+    return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2).reshape(
+        b, s, h * d)
+
+
+def _rep_seg(seg, h):
+    return None if seg is None else jnp.repeat(seg, h, axis=0)
+
+
+def _flash_packed_fwd(q, k, v, q_seg, k_seg, causal, h, d):
+    qs, ks = _seg_or_none(q_seg), _seg_or_none(k_seg)
+    try:
+        out, lse = _pallas_flash_packed(q, k, v, h, d, qs, ks,
+                                        causal=causal)
+        return out, (q, k, v, out, lse, q_seg, k_seg)
+    except Exception as e:  # pragma: no cover - TPU only
+        _warn_once(
+            "pallas_packed_fwd",
+            f"packed flash-attention kernel failed ({e!r}); falling "
+            "back to the composed XLA form.")
+    b = q.shape[0]
+    out_bh = _flash_reference(_to_bh(q, h, d), _to_bh(k, h, d),
+                              _to_bh(v, h, d), causal,
+                              _rep_seg(qs, h), _rep_seg(ks, h))
+    out = _from_bh(out_bh, b, h)
+    lse = jnp.zeros((0,), jnp.float32)
+    return out, (q, k, v, out, lse, q_seg, k_seg)
+
+
+def _flash_packed_bwd(causal, h, d, res, g):
+    q, k, v, out, lse, q_seg, k_seg = res
+    qs, ks = _seg_or_none(q_seg), _seg_or_none(k_seg)
+    if lse.size:
+        try:
+            dq, dk, dv = _pallas_flash_packed_bwd(
+                q, k, v, out, lse, g, h, d, qs, ks, causal=causal)
+            return (dq, dk, dv, _int_zero_ct(q_seg),
+                    _int_zero_ct(k_seg))
+        except Exception as e:  # pragma: no cover - TPU only
+            _warn_once(
+                "pallas_packed_bwd",
+                f"packed flash-attention backward failed ({e!r}); "
+                "falling back to the composed XLA backward.")
+    b = q.shape[0]
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: _from_bh(_flash_reference(
+            _to_bh(q_, h, d), _to_bh(k_, h, d), _to_bh(v_, h, d),
+            causal, _rep_seg(qs, h), _rep_seg(ks, h)), b, h),
+        q, k, v)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, _int_zero_ct(q_seg), _int_zero_ct(k_seg)
+
+
+_flash_core_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
+
+
+def _packed_healthy() -> bool:
+    """Eager self-test of the packed kernel (see _pallas_healthy)."""
+    if "packed_ok" not in _PALLAS_HEALTH:
+        try:
+            z = jnp.zeros((1, 256, 256), jnp.bfloat16)   # h=4, d=64
+            out, _ = _pallas_flash_packed(z, z, z, 4, 64, causal=True,
+                                          block_q=128, block_k=128)
+            jax.block_until_ready(out)
+            _PALLAS_HEALTH["packed_ok"] = True
+        except Exception as e:
+            _warn_once(
+                "pallas_packed_probe",
+                f"packed flash-attention kernel failed its self-test "
+                f"({e!r}); using the [B*H, S, D] kernel layout.")
+            _PALLAS_HEALTH["packed_ok"] = False
+    return _PALLAS_HEALTH["packed_ok"]
+
+
+def _packed_eligible(h: int, d: int, sq: int, sk: int) -> bool:
+    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") or \
+            os.environ.get("PADDLE_TPU_FLASH_NO_PACKED"):
+        return False
+    if not _on_tpu() and not _interpret():
+        return False
+    if _packed_geometry(h, d) is None:
+        return False
+    min_s = 128 if _interpret() else 256
+    return (sq >= min_s and sq % 128 == 0 and sk % 128 == 0
+            and _packed_healthy())
+
+
+# ---------------------------------------------------------------------------
 # Composed XLA form — numerics oracle + portable fallback + dropout path
 # ---------------------------------------------------------------------------
 def _flash_reference(q, k, v, causal, q_seg=None, k_seg=None,
@@ -786,11 +1292,8 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
         rep = hq // hkv
         key = jnp.repeat(key, rep, axis=2)
         value = jnp.repeat(value, rep, axis=2)
-    q = jnp.moveaxis(query, 2, 1).reshape(b * hq, sq, d)
-    k = jnp.moveaxis(key, 2, 1).reshape(b * hq, sk, d)
-    v = jnp.moveaxis(value, 2, 1).reshape(b * hq, sk, d)
 
-    qs = ks = None
+    qseg = kseg = None
     if segment_ids is not None:
         qseg = jnp.asarray(segment_ids, jnp.int32)
         kseg = (jnp.asarray(kv_segment_ids, jnp.int32)
@@ -798,10 +1301,29 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
         if kseg.shape[1] != sk:
             raise ValueError(
                 f"kv_segment_ids length {kseg.shape[1]} != Sk {sk}")
-        qs = jnp.repeat(qseg, hq, axis=0)          # [B*H, Sq]
-        ks = jnp.repeat(kseg, hq, axis=0)          # [B*H, Sk]
 
-    if dropout > 0.0 and training:
+    empty = jnp.zeros((0,), jnp.int32)
+    use_dropout = dropout > 0.0 and training
+
+    if not use_dropout and _packed_eligible(hq, d, sq, sk):
+        # transpose-free path: [B,S,H,D] → [B,S,H*D] is a free reshape;
+        # segment ids stay [B, S] (one mask per lane-group)
+        qp = query.reshape(b, sq, hq * d)
+        kp = key.reshape(b, sk, hq * d)
+        vp = value.reshape(b, sk, hq * d)
+        out = _flash_core_packed(
+            qp, kp, vp,
+            qseg if qseg is not None else empty,
+            kseg if kseg is not None else empty, causal, hq, d)
+        return out.reshape(b, sq, hq, d)
+
+    q = jnp.moveaxis(query, 2, 1).reshape(b * hq, sq, d)
+    k = jnp.moveaxis(key, 2, 1).reshape(b * hq, sk, d)
+    v = jnp.moveaxis(value, 2, 1).reshape(b * hq, sk, d)
+    qs = None if qseg is None else jnp.repeat(qseg, hq, axis=0)
+    ks = None if kseg is None else jnp.repeat(kseg, hq, axis=0)
+
+    if use_dropout:
         # dropout path: composed XLA form (correct semantics; the
         # streaming kernel covers the dropout-free configuration)
         _warn_once(
@@ -813,7 +1335,6 @@ def flash_attention(query, key, value, causal=False, dropout=0.0,
         out = _flash_reference(q, k, v, causal, qs, ks,
                                dropout_key=dkey, dropout_p=float(dropout))
     else:
-        empty = jnp.zeros((0,), jnp.int32)
         out = _flash_core(q, k, v,
                           qs if qs is not None else empty,
                           ks if ks is not None else empty, causal)

@@ -401,3 +401,53 @@ def test_planner_leaves_annotated_layers_alone():
     net = _MLP(h=2048)
     net.fc1.weight.dist_spec = (None, "mp")   # user already placed it
     assert _linear_chains(net) == []
+
+
+def test_planner_skips_parallel_projections():
+    """q/k/v/out are consecutive SAME-shaped Linears with no dataflow
+    between them — shape adjacency must not pair them (review
+    finding: only strict expand->contract pairs qualify)."""
+    from paddle_tpu.distributed.auto_parallel.planner import \
+        _linear_chains
+
+    class FakeAttn(nn.Layer):
+        def __init__(self, e=64):
+            super().__init__()
+            self.q_proj = nn.Linear(e, e)
+            self.k_proj = nn.Linear(e, e)
+            self.v_proj = nn.Linear(e, e)
+            self.out_proj = nn.Linear(e, e)
+            self.fc1 = nn.Linear(e, 4 * e)
+            self.fc2 = nn.Linear(4 * e, e)
+
+    net = FakeAttn()
+    pairs = _linear_chains(net)
+    assert [(a is net.fc1, b is net.fc2) for a, b in pairs] == \
+        [(True, True)]
+
+
+def test_cross_entropy_settles_incoming_partial():
+    logits = DistSpec(["dp", None, None], partial={"pp"})
+    r = cross_entropy_rule(logits, DistSpec(["dp", None]))
+    assert r.in_specs[0].partial == frozenset()
+    assert r.reshards([logits, DistSpec(["dp", None])]) == [0]
+
+
+def test_matmul_multi_axis_dim_collision():
+    # batch on 'mp', N on ('mp','sep'): flattened members collide → N
+    # replicates (an axis cannot shard two output dims)
+    r = matmul_rule(DistSpec(["mp", None, None]),
+                    DistSpec([None, ("mp", "sep")]))
+    assert r.out_spec.dims == ("mp", None, None)
+    assert r.in_specs[1] == replicated(2)
+
+
+def test_reshard_cost_prices_local_bytes():
+    m = _mesh(mp=4, pp=2)
+    shape, dt = (1024, 1024), "float32"   # 4 MB full
+    # mp-sharded tensor with a pp partial: the settle moves 1 MB/rank
+    src = DistSpec(["mp", None], partial={"pp"})
+    got = reshard_cost(src, DistSpec(["mp", None]), shape, dt, m)
+    assert got == pytest.approx(all_reduce_cost(1 << 20, "pp", m))
+    # pricing at full size would be ~4x this
+    assert got < 0.5 * all_reduce_cost(4 << 20, "pp", m)

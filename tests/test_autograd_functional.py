@@ -1,0 +1,130 @@
+"""Functional higher-order autograd tests (upstream
+test/autograd/test_autograd_functional_dynamic.py analogs): jvp/vjp
+against finite differences, jacobian/hessian against closed forms."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.autograd import (jvp, vjp, jacobian, hessian,
+                                 Jacobian, Hessian)
+from paddle_tpu.tensor import Tensor
+
+
+def _f_scalar(x):
+    # f(x) = sum(x^3): grad 3x^2, hessian diag(6x)
+    return (x ** 3.0).sum()
+
+
+def test_jvp_matches_directional_derivative():
+    x = Tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    v = Tensor(np.array([0.5, -1.0, 2.0], np.float32))
+    out, tangent = jvp(_f_scalar, x, v)
+    # d/dt f(x + t v) = 3x^2 . v
+    expect = float((3 * np.array([1, 4, 9]) *
+                    np.array([0.5, -1.0, 2.0])).sum())
+    np.testing.assert_allclose(float(tangent.numpy()), expect,
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(out.numpy()), 36.0, rtol=1e-5)
+
+
+def test_vjp_matches_gradient():
+    x = Tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    out, grads = vjp(_f_scalar, x)
+    np.testing.assert_allclose(np.asarray(grads.numpy()),
+                               3 * np.array([1, 4, 9], np.float32),
+                               rtol=1e-5)
+
+
+def test_vjp_multi_input():
+    def f(a, b):
+        return (a * b).sum()
+
+    a = Tensor(np.array([1.0, 2.0], np.float32))
+    b = Tensor(np.array([3.0, 4.0], np.float32))
+    out, grads = vjp(f, [a, b])
+    np.testing.assert_allclose(np.asarray(grads[0].numpy()), [3, 4],
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(grads[1].numpy()), [1, 2],
+                               rtol=1e-6)
+
+
+def test_jacobian_linear_map():
+    w = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32)
+
+    def f(x):
+        return Tensor(w) @ x
+
+    x = Tensor(np.array([1.0, 1.0], np.float32))
+    jac = jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(jac.numpy()), w, rtol=1e-6)
+
+
+def test_jacobian_batched():
+    def f(x):
+        return x ** 2.0
+
+    x = Tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    jac = jacobian(f, x, batch_axis=0)
+    # per-example jacobian diag(2x)
+    expect = np.stack([np.diag([2.0, 4.0]), np.diag([6.0, 8.0])])
+    np.testing.assert_allclose(np.asarray(jac.numpy()), expect,
+                               rtol=1e-6)
+    with pytest.raises(ValueError, match="batch_axis"):
+        jacobian(f, x, batch_axis=1)
+
+
+def test_hessian_quadratic():
+    a = np.array([[2.0, 1.0], [1.0, 4.0]], np.float32)
+
+    def f(x):
+        return 0.5 * (x @ (Tensor(a) @ x))
+
+    x = Tensor(np.array([1.0, -1.0], np.float32))
+    h = hessian(f, x)
+    np.testing.assert_allclose(np.asarray(h.numpy()), a, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_jacobian_hessian_objects():
+    def f(x):
+        return (x ** 3.0).sum()
+
+    x = Tensor(np.array([1.0, 2.0], np.float32))
+    J = Jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(J.tensors.numpy()),
+                               [3.0, 12.0], rtol=1e-6)
+    np.testing.assert_allclose(float(J[1].numpy()), 12.0, rtol=1e-6)
+    H = Hessian(f, x)
+    np.testing.assert_allclose(np.asarray(H.tensors.numpy()),
+                               np.diag([6.0, 12.0]), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_incubate_autograd_namespace():
+    import paddle_tpu.incubate.autograd as ia
+    assert ia.jvp is jvp and ia.Hessian is Hessian
+    ia.enable_prim()
+    assert ia.prim_enabled()
+    ia.disable_prim()
+
+
+def test_functional_autograd_through_layers():
+    """Hessian of a tiny MLP loss — the upstream science/PINN use case
+    (forward-over-reverse through real Layers)."""
+    paddle.seed(0)
+    net = nn.Linear(3, 1)
+
+    def loss(x):
+        return (net(x) ** 2.0).sum()
+
+    x = Tensor(np.ones((2, 3), np.float32))
+    h = hessian(loss, x)
+    # Hessian of sum((xW+b)^2) wrt x is block-diag 2 W W^T per row
+    w = np.asarray(net.weight.numpy())           # [3, 1]
+    blk = 2.0 * (w @ w.T)                        # [3, 3]
+    hv = np.asarray(h.numpy()).reshape(6, 6)
+    np.testing.assert_allclose(hv[:3, :3], blk, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hv[3:, 3:], blk, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(hv[:3, 3:], 0, atol=1e-6)

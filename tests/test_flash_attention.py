@@ -274,3 +274,118 @@ def test_pallas_fused_bwd_matches_composed(_interpret_mode, monkeypatch):
         for gk, gr in zip(g_kernel, g_ref):
             np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
                                        rtol=5e-4, atol=5e-5)
+
+
+def _composed_oracle_bh(q, k, v, causal, q_seg=None, k_seg=None):
+    """Standalone composed attention (same math as
+    pallas_ops._flash_reference) usable while the module's fallback is
+    monkeypatched to raise."""
+    import math as _math
+    scale = 1.0 / _math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s.shape[-2], s.shape[-1]), bool))
+        s = jnp.where(mask, s, -jnp.inf)
+    if q_seg is not None:
+        s = jnp.where(q_seg[:, :, None] == k_seg[:, None, :], s,
+                      -jnp.inf)
+    lse = jax.scipy.special.logsumexp(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - jnp.maximum(lse, -1e30))
+    return jnp.einsum("bqk,bkd->bqd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.fixture()
+def _no_fallback(monkeypatch):
+    """Fail the test if the packed kernel silently degrades to the
+    composed form (the original packed tests passed vacuously through
+    the fallback — a real ref-write bug was hidden)."""
+    def boom(*a, **k):
+        raise AssertionError(
+            "packed kernel fell back to _flash_reference")
+    monkeypatch.setattr(pallas_ops, "_flash_reference", boom)
+    pallas_ops._PALLAS_HEALTH.pop("packed_ok", None)
+    yield
+    pallas_ops._PALLAS_HEALTH.pop("packed_ok", None)
+
+
+def test_pallas_packed_kernels_match_composed(_interpret_mode,
+                                              _no_fallback):
+    """The transpose-free packed-heads layout ([B,S,H*D], heads packed
+    into 128-lane groups) — fwd and bwd vs the composed oracle, with
+    multiple q/kv blocks, causal and full."""
+    from paddle_tpu.ops.pallas_ops import (
+        _flash_core_packed, _packed_geometry)
+    _flash_reference = _composed_oracle_bh
+    assert _packed_geometry(4, 64) == (128, 2, 2)
+    assert _packed_geometry(2, 128) == (128, 1, 2)
+    assert _packed_geometry(3, 64) is None          # h % hpb != 0
+    rng = np.random.RandomState(13)
+    b, s, h, d = 2, 256, 4, 32                      # hpb=4, g=1
+    x = rng.randn(b, s, h * d).astype(np.float32)
+    qp = jnp.asarray(x)
+    kp = jnp.asarray(rng.randn(b, s, h * d).astype(np.float32))
+    vp = jnp.asarray(rng.randn(b, s, h * d).astype(np.float32))
+    empty = jnp.zeros((0,), jnp.int32)
+
+    def to_bh(t):
+        return jnp.moveaxis(t.reshape(b, s, h, d), 2, 1).reshape(
+            b * h, s, d)
+
+    for causal in (False, True):
+        def f_packed(q_, k_, v_):
+            return _flash_core_packed(q_, k_, v_, empty, empty,
+                                      causal, h, d).sum()
+
+        def f_ref(q_, k_, v_):
+            return _flash_reference(to_bh(q_), to_bh(k_), to_bh(v_),
+                                    causal).sum()
+
+        out_p = _flash_core_packed(qp, kp, vp, empty, empty, causal,
+                                   h, d)
+        out_r = _flash_reference(to_bh(qp), to_bh(kp), to_bh(vp),
+                                 causal)
+        np.testing.assert_allclose(
+            np.asarray(to_bh(out_p)), np.asarray(out_r),
+            rtol=2e-4, atol=2e-5)
+        g_p = jax.grad(f_packed, argnums=(0, 1, 2))(qp, kp, vp)
+        g_r = jax.grad(f_ref, argnums=(0, 1, 2))(qp, kp, vp)
+        for gp_, gr_ in zip(g_p, g_r):
+            np.testing.assert_allclose(np.asarray(gp_),
+                                       np.asarray(gr_),
+                                       rtol=5e-4, atol=5e-5)
+
+
+def test_pallas_packed_segment_ids(_interpret_mode, _no_fallback):
+    from paddle_tpu.ops.pallas_ops import _flash_core_packed
+    _flash_reference = _composed_oracle_bh
+    rng = np.random.RandomState(14)
+    b, s, h, d = 1, 128, 2, 64
+    qp = jnp.asarray(rng.randn(b, s, h * d).astype(np.float32))
+    seg = jnp.asarray(
+        np.repeat(np.arange(2, dtype=np.int32), 64)[None, :])
+
+    def to_bh(t):
+        return jnp.moveaxis(t.reshape(b, s, h, d), 2, 1).reshape(
+            b * h, s, d)
+
+    out_p = _flash_core_packed(qp, qp, qp, seg, seg, False, h, d)
+    seg_bh = jnp.repeat(seg, h, axis=0)
+    out_r = _flash_reference(to_bh(qp), to_bh(qp), to_bh(qp), False,
+                             seg_bh, seg_bh)
+    np.testing.assert_allclose(np.asarray(to_bh(out_p)),
+                               np.asarray(out_r), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_attention_public_uses_packed(_interpret_mode,
+                                            _no_fallback):
+    """End-to-end through the public op at GPT-like head geometry."""
+    rng = np.random.RandomState(15)
+    b, s, h, d = 1, 256, 4, 64
+    q = rng.randn(b, s, h, d).astype(np.float32)
+    out, _ = F.flash_attention(Tensor(q), Tensor(q), Tensor(q),
+                               causal=True)
+    ref = _oracle(q, q, q, causal=True)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                               rtol=2e-4, atol=2e-5)
