@@ -26,7 +26,7 @@ from .flags import set_flags, get_flags  # noqa
 
 from .framework import dtype as _dtype_mod
 from .framework.dtype import (  # noqa
-    DType, set_default_dtype, get_default_dtype)
+    DType, set_default_dtype, get_default_dtype, finfo, iinfo)
 from .framework.dtype import (  # noqa
     bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
     float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2)

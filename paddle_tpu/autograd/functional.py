@@ -107,9 +107,7 @@ def jacobian(func: Callable, xs, batch_axis=None) -> Union[Tensor, tuple]:
     if batch_axis is None:
         jac = jax.jacrev(fn, argnums=argnums)(*vals)
     elif batch_axis == 0:
-        def single(*one):
-            return fn(*one)
-        jac = jax.vmap(jax.jacrev(single, argnums=argnums))(*vals)
+        jac = jax.vmap(jax.jacrev(fn, argnums=argnums))(*vals)
     else:
         raise ValueError("batch_axis must be None or 0")
     # jac: per-output (if multi) × per-input pytree of arrays

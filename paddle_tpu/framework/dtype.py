@@ -108,3 +108,53 @@ def is_floating(d) -> bool:
 
 def is_integer(d) -> bool:
     return jnp.issubdtype(to_jax_dtype(d), jnp.integer)
+
+
+class _FInfo:
+    """paddle.finfo result (mirrors numpy/ml_dtypes finfo fields)."""
+
+    __slots__ = ("dtype", "min", "max", "eps", "tiny", "smallest_normal",
+                 "resolution", "bits")
+
+    def __init__(self, d):
+        # ml_dtypes.finfo handles bfloat16/float8 AND the standard
+        # float dtypes (np.finfo rejects the extended ones)
+        fi = ml_dtypes.finfo(d)
+        self.dtype = str(d)
+        self.min = float(fi.min)
+        self.max = float(fi.max)
+        self.eps = float(fi.eps)
+        self.tiny = float(fi.tiny)
+        self.smallest_normal = float(getattr(fi, "smallest_normal",
+                                             fi.tiny))
+        self.resolution = float(fi.resolution)
+        self.bits = int(fi.bits)
+
+    def __repr__(self):
+        return (f"finfo(dtype={self.dtype}, min={self.min}, "
+                f"max={self.max}, eps={self.eps})")
+
+
+class _IInfo:
+    __slots__ = ("dtype", "min", "max", "bits")
+
+    def __init__(self, d):
+        ii = np.iinfo(d)
+        self.dtype = str(d)
+        self.min = int(ii.min)
+        self.max = int(ii.max)
+        self.bits = int(ii.bits)
+
+    def __repr__(self):
+        return (f"iinfo(dtype={self.dtype}, min={self.min}, "
+                f"max={self.max}, bits={self.bits})")
+
+
+def finfo(dtype):
+    """paddle.finfo parity (floating-point type limits)."""
+    return _FInfo(to_jax_dtype(dtype))
+
+
+def iinfo(dtype):
+    """paddle.iinfo parity (integer type limits)."""
+    return _IInfo(to_jax_dtype(dtype))

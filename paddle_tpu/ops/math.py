@@ -554,6 +554,47 @@ def trapezoid(y, x=None, dx=None, axis=-1):
     return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0, axis=axis)
 
 
+@primitive
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    """Running trapezoid integral (upstream paddle.cumulative_trapezoid;
+    output has one fewer element along ``axis``)."""
+    n = y.shape[axis]
+    lo = jax.lax.slice_in_dim(y, 0, n - 1, axis=axis)
+    hi = jax.lax.slice_in_dim(y, 1, n, axis=axis)
+    avg = (lo + hi) * 0.5
+    if x is not None:
+        xs = jnp.asarray(unwrap(x))
+        if xs.ndim == 1:
+            shape = [1] * y.ndim
+            shape[axis] = xs.shape[0]
+            xs = xs.reshape(shape)
+        w = jnp.diff(xs, axis=axis if xs.ndim == y.ndim else -1)
+        avg = avg * w
+    else:
+        avg = avg * (1.0 if dx is None else dx)
+    return jnp.cumsum(avg, axis=axis)
+
+
+@primitive
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@primitive
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@primitive
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+@primitive
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
 # -- non-primitive conveniences (python-level, compose primitives) ---------
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
     out = jnp.allclose(unwrap(x), unwrap(y), rtol=float(rtol),
@@ -572,6 +613,23 @@ def equal_all(x, y):
 
 def numel(x):
     return Tensor(np.prod(unwrap(x).shape).astype(np.int64))
+
+
+def rank(x):
+    """paddle.rank: the number of dimensions, as a 0-d int64 Tensor."""
+    return Tensor(np.asarray(np.ndim(unwrap(x)), dtype=np.int64))
+
+
+@primitive
+def as_complex(x):
+    """[..., 2] real pairs → complex (paddle.as_complex)."""
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@primitive
+def as_real(x):
+    """complex → [..., 2] real pairs (paddle.as_real)."""
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
 
 
 @primitive

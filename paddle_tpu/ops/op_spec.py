@@ -170,6 +170,18 @@ def randint(*shape, lo=0, hi=10, dtype=np.int64):
     return gen
 
 
+def _np_i1(x):
+    """Modified Bessel I1 oracle: truncated power series (numpy has
+    i0 built in but not i1); exact to f64 precision for |x| ≲ 5."""
+    import math as _m
+    half = x / 2.0
+    out = np.zeros_like(x)
+    for k in range(30):
+        out = out + half ** (2 * k + 1) / (
+            _m.factorial(k) * _m.factorial(k + 1))
+    return out
+
+
 def randbool(*shape):
     def gen(rng):
         return rng.rand(*shape) > 0.5
@@ -398,6 +410,28 @@ def build_specs():
         OpSpec("nansum", lambda x: P.nansum(x, axis=1),
                lambda a: np.nansum(a, axis=1), [randn(3, 4)],
                check_grad=False),
+        OpSpec("nanmedian", lambda x: P.nanmedian(x, axis=1),
+               lambda a: np.nanmedian(a, axis=1), [randn(3, 4)],
+               check_grad=False),
+        OpSpec("nan_to_num", lambda x: P.nan_to_num(x, nan=1.5),
+               lambda a: np.nan_to_num(a, nan=1.5), [randn(3, 4)],
+               check_grad=False),
+        OpSpec("cumulative_trapezoid",
+               lambda x: P.cumulative_trapezoid(x, dx=0.5, axis=1),
+               lambda a: np.cumsum((a[:, :-1] + a[:, 1:]) * 0.25,
+                                   axis=1), [randn(3, 5)]),
+        OpSpec("i0", lambda x: P.i0(x),
+               lambda a: np.i0(a.astype(np.float64)).astype(a.dtype),
+               [randn(3, 4)], check_grad=False),
+        OpSpec("as_complex", lambda x: P.as_real(P.as_complex(x)),
+               lambda a: a, [randn(3, 4, 2)], dtypes=("float32",),
+               check_grad=False, covers="as_complex"),
+        OpSpec("as_real", lambda x: P.as_real(P.as_complex(x)),
+               lambda a: a, [randn(3, 4, 2)], dtypes=("float32",),
+               check_grad=False, covers="as_real"),
+        OpSpec("i1", lambda x: P.i1(x),
+               lambda a: _np_i1(a.astype(np.float64)).astype(a.dtype),
+               [randn(3, 4)], check_grad=False),
         OpSpec("cumsum", lambda x: P.cumsum(x, axis=1),
                lambda a: np.cumsum(a, axis=1), [randn(3, 4)]),
         OpSpec("cumprod", lambda x: P.cumprod(x, dim=1),
