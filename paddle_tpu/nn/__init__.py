@@ -24,7 +24,13 @@ from .container import (  # noqa
     Sequential, LayerList, ParameterList, LayerDict)
 from .loss import (  # noqa
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
-    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss)
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CosineEmbeddingLoss,
+    HuberLoss, SoftMarginLoss, HingeEmbeddingLoss, PoissonNLLLoss,
+    GaussianNLLLoss, TripletMarginLoss, MultiLabelSoftMarginLoss,
+    CTCLoss, PairwiseDistance)
+from .rnn import (  # noqa
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    SimpleRNN, LSTM, GRU)
 from .transformer import (  # noqa
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer)

@@ -17,7 +17,10 @@ from ...ops.nn_ops import (  # noqa
     l1_loss, smooth_l1_loss, nll_loss, kl_div, margin_ranking_loss,
     hinge_embedding_loss, cosine_similarity, cosine_embedding_loss,
     scaled_dot_product_attention, interpolate, upsample, pixel_shuffle,
-    pixel_unshuffle, channel_shuffle, temporal_shift, linear)
+    pixel_unshuffle, channel_shuffle, temporal_shift, linear,
+    square_error_cost, pairwise_distance, huber_loss, soft_margin_loss,
+    poisson_nll_loss, gaussian_nll_loss, triplet_margin_loss,
+    multi_label_soft_margin_loss, ctc_loss)
 from ...ops.manipulation import pad, unfold  # noqa
 from ...ops.creation import one_hot  # noqa
 

@@ -127,3 +127,123 @@ class CosineEmbeddingLoss(Layer):
         return ops.cosine_embedding_loss(input1, input2, label,
                                          margin=self.margin,
                                          reduction=self.reduction)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):
+        return ops.huber_loss(input, label, delta=self.delta,
+                              reduction=self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return ops.soft_margin_loss(input, label,
+                                    reduction=self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return ops.hinge_embedding_loss(input, label,
+                                        margin=self.margin,
+                                        reduction=self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input = log_input
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return ops.poisson_nll_loss(
+            input, label, log_input=self.log_input, full=self.full,
+            epsilon=self.epsilon, reduction=self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        return ops.gaussian_nll_loss(
+            input, label, variance, full=self.full,
+            epsilon=self.epsilon, reduction=self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.margin = margin
+        self.p = p
+        self.epsilon = epsilon
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return ops.triplet_margin_loss(
+            input, positive, negative, margin=self.margin, p=self.p,
+            epsilon=self.epsilon, swap=self.swap,
+            reduction=self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return ops.multi_label_soft_margin_loss(
+            input, label, weight=self.weight, reduction=self.reduction)
+
+
+class CTCLoss(Layer):
+    """CTC loss (upstream warpctc wrapper; here a lax.scan alpha
+    recursion — see ops/nn_ops.py ctc_loss)."""
+
+    def __init__(self, blank=0, reduction="mean", name=None):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return ops.ctc_loss(log_probs, labels, input_lengths,
+                            label_lengths, blank=self.blank,
+                            reduction=self.reduction,
+                            norm_by_times=norm_by_times)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        return ops.pairwise_distance(x, y, p=self.p,
+                                     epsilon=self.epsilon,
+                                     keepdim=self.keepdim)

@@ -1,0 +1,314 @@
+"""Recurrent layers (parity: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell/LSTMCell/GRUCell, RNN, BiRNN, SimpleRNN/LSTM/GRU).
+
+Each (layer, direction) lowers to ONE fused lax.scan op
+(ops/rnn_ops.py); cells are also usable step-wise (eager single step)
+and through the generic ``RNN``/``BiRNN`` wrappers, which dispatch to
+the fused scan for the built-in cells and fall back to a Python loop
+for custom cells.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+
+from ..tensor import Tensor
+from .. import ops
+from .layer import Layer
+from . import initializer as I
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+           "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        H = self.hidden_size
+        n = getattr(self, "state_components", 1)
+        zeros = [Tensor(np.full((batch, H), init_value, np.float32))
+                 for _ in range(n)]
+        return tuple(zeros) if n > 1 else zeros[0]
+
+
+def _uniform_attr(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-k, k)
+
+
+class _BuiltinCell(RNNCellBase):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        g = self.GATES
+        init = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [g * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [g * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        if bias_ih_attr is not False:
+            self.bias_ih = self.create_parameter(
+                [g * hidden_size], attr=bias_ih_attr, is_bias=True,
+                default_initializer=init)
+            self.bias_hh = self.create_parameter(
+                [g * hidden_size], attr=bias_hh_attr, is_bias=True,
+                default_initializer=init)
+        else:
+            self.bias_ih = self.bias_hh = None
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class SimpleRNNCell(_BuiltinCell):
+    GATES = 1
+    state_components = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 **kw):
+        super().__init__(input_size, hidden_size, **kw)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        pre = ops.matmul(inputs, self.weight_ih, transpose_y=True) + \
+            ops.matmul(states, self.weight_hh, transpose_y=True)
+        if self.bias_ih is not None:
+            pre = pre + self.bias_ih + self.bias_hh
+        h = ops.tanh(pre) if self.activation == "tanh" else \
+            ops.relu(pre)
+        return h, h
+
+
+class LSTMCell(_BuiltinCell):
+    GATES = 4
+    state_components = 2
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h, c = states
+        gates = ops.matmul(inputs, self.weight_ih, transpose_y=True) + \
+            ops.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih + self.bias_hh
+        i, f, g, o = ops.chunk(gates, 4, axis=-1)
+        c_new = ops.sigmoid(f) * c + ops.sigmoid(i) * ops.tanh(g)
+        h_new = ops.sigmoid(o) * ops.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_BuiltinCell):
+    GATES = 3
+    state_components = 1
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        h = states
+        gi = ops.matmul(inputs, self.weight_ih, transpose_y=True)
+        gh = ops.matmul(h, self.weight_hh, transpose_y=True)
+        if self.bias_ih is not None:
+            gi = gi + self.bias_ih
+            gh = gh + self.bias_hh
+        ir, iz, ic = ops.chunk(gi, 3, axis=-1)
+        hr, hz, hc = ops.chunk(gh, 3, axis=-1)
+        r = ops.sigmoid(ir + hr)
+        z = ops.sigmoid(iz + hz)
+        c = ops.tanh(ic + r * hc)
+        h_new = (1.0 - z) * c + z * h
+        return h_new, h_new
+
+
+def _cell_scan(cell, x, states, seq_lens, reverse, time_major):
+    """Fused scan for a builtin cell; returns (outputs, final_states)."""
+    from ..ops import rnn_ops as R
+    wi, wh = cell.weight_ih, cell.weight_hh
+    bi, bh = cell.bias_ih, cell.bias_hh
+    if isinstance(cell, LSTMCell):
+        h0, c0 = states
+        out, h, c = R.lstm_layer(x, wi, wh, bi, bh, h0, c0,
+                                 seq_lens=seq_lens, reverse=reverse,
+                                 time_major=time_major)
+        return out, (h, c)
+    if isinstance(cell, GRUCell):
+        out, h = R.gru_layer(x, wi, wh, bi, bh, states,
+                             seq_lens=seq_lens, reverse=reverse,
+                             time_major=time_major)
+        return out, h
+    out, h = R.simple_rnn_layer(x, wi, wh, bi, bh, states,
+                                seq_lens=seq_lens, reverse=reverse,
+                                time_major=time_major,
+                                activation=cell.activation)
+    return out, h
+
+
+class RNN(Layer):
+    """Generic recurrence over a cell (upstream paddle.nn.RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        cell = self.cell
+        if initial_states is None:
+            ref = inputs if not self.time_major else \
+                ops.swapaxes(inputs, 0, 1)
+            initial_states = cell.get_initial_states(ref)
+        if isinstance(cell, (SimpleRNNCell, LSTMCell, GRUCell)):
+            return _cell_scan(cell, inputs, initial_states,
+                              sequence_length, self.is_reverse,
+                              self.time_major)
+        # custom cell: step-wise python loop (unrolled under jit)
+        xs = inputs if self.time_major else ops.swapaxes(inputs, 0, 1)
+        T = xs.shape[0]
+        order = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = [None] * T
+        for t in order:
+            out, states = cell(xs[t], states)
+            outs[t] = out
+        out = ops.stack(outs, axis=0)
+        return (out if self.time_major else ops.swapaxes(out, 0, 1)), \
+            states
+
+
+class BiRNN(Layer):
+    """Bidirectional wrapper over two cells (upstream paddle.nn.BiRNN):
+    outputs concatenated on the feature dim."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False,
+                          time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True,
+                          time_major=time_major)
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        s_fw = s_bw = None
+        if initial_states is not None:
+            s_fw, s_bw = initial_states
+        out_f, st_f = self.rnn_fw(inputs, s_fw, sequence_length)
+        out_b, st_b = self.rnn_bw(inputs, s_bw, sequence_length)
+        return ops.concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack with inter-layer
+    dropout — SimpleRNN/LSTM/GRU share this (upstream RNNBase)."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None,
+                 **cell_kw):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise ValueError(
+                f"direction must be 'forward' or 'bidirect', got "
+                f"{direction!r}")
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self._cells = []
+        from .container import LayerList
+        cells = []
+        for layer in range(num_layers):
+            for direction_i in range(self.num_directions):
+                in_size = input_size if layer == 0 else \
+                    hidden_size * self.num_directions
+                cells.append(self.CELL(
+                    in_size, hidden_size,
+                    weight_ih_attr=weight_ih_attr,
+                    weight_hh_attr=weight_hh_attr,
+                    bias_ih_attr=bias_ih_attr,
+                    bias_hh_attr=bias_hh_attr, **cell_kw))
+        self.cells = LayerList(cells)
+
+    def _cell(self, layer, direction):
+        return self.cells[layer * self.num_directions + direction]
+
+    def forward(self, inputs, initial_states=None,
+                sequence_length=None):
+        D = self.num_directions
+        L = self.num_layers
+        ncomp = self.CELL.state_components
+        batch_ref = inputs if not self.time_major else \
+            ops.swapaxes(inputs, 0, 1)
+
+        def init_for(idx):
+            if initial_states is None:
+                return self._cell(0, 0).get_initial_states(batch_ref)
+            if ncomp == 2:
+                h, c = initial_states
+                return (h[idx], c[idx])
+            return initial_states[idx]
+
+        x = inputs
+        final = []
+        for layer in range(L):
+            outs = []
+            for d in range(D):
+                cell = self._cell(layer, d)
+                out, st = _cell_scan(cell, x, init_for(layer * D + d),
+                                     sequence_length, reverse=(d == 1),
+                                     time_major=self.time_major)
+                outs.append(out)
+                final.append(st)
+            x = outs[0] if D == 1 else ops.concat(outs, axis=-1)
+            if self.dropout > 0 and layer < L - 1:
+                x = ops.dropout(x, p=self.dropout,
+                                training=self.training)
+        if ncomp == 2:
+            h = ops.stack([s[0] for s in final], axis=0)
+            c = ops.stack([s[1] for s in final], axis=0)
+            return x, (h, c)
+        return x, ops.stack(final, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers,
+                         direction, time_major, dropout,
+                         activation=activation, **kw)
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

@@ -936,3 +936,169 @@ def linear(x, weight, bias=None):
     if bias is not None:
         out = out + bias
     return out
+
+
+# ---------------------------------------------------------------------------
+# Additional losses (upstream python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+@primitive
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def _minkowski(d, p, keepdim=False):
+    """|d|_p along the last axis, with the p=inf / p=0 special cases
+    paddle's PairwiseDistance documents."""
+    a = jnp.abs(d)
+    if np.isinf(p):
+        return jnp.max(a, axis=-1, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((a != 0).astype(d.dtype), axis=-1,
+                       keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(a, p), axis=-1,
+                             keepdims=keepdim), 1.0 / p)
+
+
+@primitive
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    return _minkowski(x - y + epsilon, p, keepdim=keepdim)
+
+
+@primitive
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    r = jnp.abs(input - label)
+    loss = jnp.where(r <= delta, 0.5 * jnp.square(r),
+                     delta * (r - 0.5 * delta))
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def soft_margin_loss(input, label, reduction="mean"):
+    # softplus(-y*x) == log1p(exp(-y*x)) without the f32 overflow
+    loss = jax.nn.softplus(-label * input)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        # Stirling approximation for label! (only where label > 1);
+        # computed on a clamped label so the masked-out branch cannot
+        # poison the vjp with log(0) (the jnp.where NaN-grad trap)
+        safe = jnp.where(label > 1.0, label, 1.0)
+        stirling = (safe * jnp.log(safe) - safe
+                    + 0.5 * jnp.log(2.0 * np.pi * safe))
+        loss = loss + jnp.where(label > 1.0, stirling, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + jnp.square(input - label) / var)
+    if full:
+        loss = loss + 0.5 * float(np.log(2.0 * np.pi))
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def dist(a, b):
+        return _minkowski(a - b + epsilon, p)
+
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    loss = jnp.maximum(dp - dn + margin, 0.0)
+    return _reduce_loss(loss, reduction)
+
+
+@primitive
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1.0 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = jnp.mean(loss, axis=-1)
+    return _reduce_loss(loss, reduction)
+
+
+_CTC_NEG_INF = -1e30
+
+
+@primitive(nondiff=(1, 2, 3))
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC loss (upstream warpctc wrapper, paddle signature:
+    log_probs [T, B, C] unscaled logits, time-major).
+
+    TPU-native: the standard log-domain alpha recursion over the
+    blank-extended label sequence, compiled as one lax.scan over time —
+    batched, static shapes, differentiable through jax (no custom
+    backward needed: d loss/d logits comes out of the scan's vjp).
+    """
+    T, B, C = log_probs.shape
+    lp = jax.nn.log_softmax(log_probs.astype(jnp.float32), axis=-1)
+    labels = jnp.asarray(labels, jnp.int32)          # [B, L]
+    L = labels.shape[1]
+    S = 2 * L + 1
+    in_len = jnp.asarray(input_lengths, jnp.int32)
+    lb_len = jnp.asarray(label_lengths, jnp.int32)
+    # extended sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    # allow the s-2 skip where ext[s] != blank and ext[s] != ext[s-2]
+    skip_ok = jnp.concatenate(
+        [jnp.zeros((B, 2), bool),
+         (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2])], axis=1)
+
+    def emit(lp_t):
+        # lp_t [B, C] → per-extended-symbol emission [B, S]
+        return jnp.take_along_axis(lp_t, ext, axis=1)
+
+    alpha0 = jnp.full((B, S), _CTC_NEG_INF, jnp.float32)
+    alpha0 = alpha0.at[:, 0].set(emit(lp[0])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(lb_len > 0, emit(lp[0])[:, 1], _CTC_NEG_INF))
+
+    def step(alpha, lp_t_and_t):
+        lp_t, t = lp_t_and_t
+        prev1 = jnp.concatenate(
+            [jnp.full((B, 1), _CTC_NEG_INF), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((B, 2), _CTC_NEG_INF), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(skip_ok, prev2, _CTC_NEG_INF)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, prev1), prev2)
+        new = merged + emit(lp_t)
+        # past each sequence's input length the alphas freeze
+        new = jnp.where((t < in_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0,
+                            (lp[1:], jnp.arange(1, T)))
+    # final: logsumexp of alpha at s = 2*len-1 (last label) and
+    # s = 2*len (trailing blank)
+    idx_last = jnp.clip(2 * lb_len - 1, 0, S - 1)
+    idx_blank = jnp.clip(2 * lb_len, 0, S - 1)
+    a_last = jnp.take_along_axis(alpha, idx_last[:, None], axis=1)[:, 0]
+    a_blank = jnp.take_along_axis(alpha, idx_blank[:, None],
+                                  axis=1)[:, 0]
+    a_last = jnp.where(lb_len > 0, a_last, _CTC_NEG_INF)
+    nll = -jnp.logaddexp(a_last, a_blank)
+    if norm_by_times:
+        nll = nll / jnp.maximum(in_len.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # paddle/warpctc semantics: per-sample loss is normalised by
+        # its LABEL length before the batch mean
+        return jnp.mean(nll / jnp.maximum(
+            lb_len.astype(jnp.float32), 1.0))
+    return _reduce_loss(nll, reduction)

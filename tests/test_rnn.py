@@ -1,0 +1,217 @@
+"""RNN family tests (upstream test/legacy_test/test_rnn_op.py +
+test_lstm/gru analogs): fused-scan layers vs torch oracle, cells vs
+scan consistency, masking, bidirectional, multi-layer, BPTT."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.tensor import Tensor
+
+
+def _copy_torch_weights(tcell, cell):
+    import torch
+    with torch.no_grad():
+        tcell.weight_ih_l0.copy_(torch.tensor(
+            np.asarray(cell.weight_ih.numpy())))
+        tcell.weight_hh_l0.copy_(torch.tensor(
+            np.asarray(cell.weight_hh.numpy())))
+        tcell.bias_ih_l0.copy_(torch.tensor(
+            np.asarray(cell.bias_ih.numpy())))
+        tcell.bias_hh_l0.copy_(torch.tensor(
+            np.asarray(cell.bias_hh.numpy())))
+
+
+def test_lstm_matches_torch():
+    import torch
+    paddle.seed(0)
+    B, T, I, H = 3, 7, 5, 4
+    lstm = nn.LSTM(I, H)
+    tl = torch.nn.LSTM(I, H, batch_first=True)
+    _copy_torch_weights(tl, lstm.cells[0])
+    x = np.random.RandomState(0).randn(B, T, I).astype(np.float32)
+    out, (h, c) = lstm(Tensor(x))
+    with torch.no_grad():
+        tout, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()), tout.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.numpy()), th.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.numpy()), tc.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_matches_torch():
+    import torch
+    paddle.seed(1)
+    B, T, I, H = 2, 5, 4, 6
+    gru = nn.GRU(I, H)
+    tg = torch.nn.GRU(I, H, batch_first=True)
+    _copy_torch_weights(tg, gru.cells[0])
+    x = np.random.RandomState(1).randn(B, T, I).astype(np.float32)
+    out, h = gru(Tensor(x))
+    with torch.no_grad():
+        tout, th = tg(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()), tout.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.numpy()), th.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_simple_rnn_matches_torch():
+    import torch
+    paddle.seed(2)
+    B, T, I, H = 2, 4, 3, 5
+    rnn = nn.SimpleRNN(I, H)
+    tr = torch.nn.RNN(I, H, batch_first=True)
+    _copy_torch_weights(tr, rnn.cells[0])
+    x = np.random.RandomState(2).randn(B, T, I).astype(np.float32)
+    out, h = rnn(Tensor(x))
+    with torch.no_grad():
+        tout, th = tr(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()), tout.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bidirectional_multilayer_shapes_and_torch():
+    import torch
+    paddle.seed(3)
+    B, T, I, H, L = 2, 6, 4, 3, 2
+    lstm = nn.LSTM(I, H, num_layers=L, direction="bidirect")
+    tl = torch.nn.LSTM(I, H, num_layers=L, batch_first=True,
+                       bidirectional=True)
+    import torch as _t
+    with _t.no_grad():
+        for layer in range(L):
+            for d, suf in enumerate(("", "_reverse")):
+                cell = lstm.cells[layer * 2 + d]
+                getattr(tl, f"weight_ih_l{layer}{suf}").copy_(
+                    _t.tensor(np.asarray(cell.weight_ih.numpy())))
+                getattr(tl, f"weight_hh_l{layer}{suf}").copy_(
+                    _t.tensor(np.asarray(cell.weight_hh.numpy())))
+                getattr(tl, f"bias_ih_l{layer}{suf}").copy_(
+                    _t.tensor(np.asarray(cell.bias_ih.numpy())))
+                getattr(tl, f"bias_hh_l{layer}{suf}").copy_(
+                    _t.tensor(np.asarray(cell.bias_hh.numpy())))
+    x = np.random.RandomState(3).randn(B, T, I).astype(np.float32)
+    out, (h, c) = lstm(Tensor(x))
+    assert out.shape == [B, T, 2 * H]
+    assert h.shape == [2 * L, B, H] and c.shape == [2 * L, B, H]
+    with torch.no_grad():
+        tout, (th, tc) = tl(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()), tout.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.numpy()), th.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_length_masking():
+    paddle.seed(4)
+    B, T, I, H = 2, 6, 3, 4
+    lstm = nn.LSTM(I, H)
+    rng = np.random.RandomState(4)
+    x = rng.randn(B, T, I).astype(np.float32)
+    lens = np.array([4, 6], np.int64)
+    out, (h, c) = lstm(Tensor(x), sequence_length=Tensor(lens))
+    o = np.asarray(out.numpy())
+    # outputs beyond each row's length are zero
+    np.testing.assert_allclose(o[0, 4:], 0.0, atol=1e-7)
+    assert np.abs(o[1, 4:]).sum() > 0
+    # final state equals the state at t = len: recompute on the
+    # truncated sequence
+    out2, (h2, _) = lstm(Tensor(x[:1, :4]))
+    np.testing.assert_allclose(np.asarray(h.numpy())[0, 0],
+                               np.asarray(h2.numpy())[0, 0],
+                               rtol=1e-5, atol=1e-6)
+    # reversed direction consistency: bidirectional final bwd state on
+    # a masked row equals running the truncated row reversed
+    bi = nn.LSTM(I, H, direction="bidirect")
+    _, (hb, _) = bi(Tensor(x), sequence_length=Tensor(lens))
+    _, (hb2, _) = bi(Tensor(x[:1, :4]))
+    np.testing.assert_allclose(np.asarray(hb.numpy())[1, 0],
+                               np.asarray(hb2.numpy())[1, 0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_cell_stepwise_matches_scan():
+    paddle.seed(5)
+    B, T, I, H = 2, 5, 3, 4
+    cell = nn.LSTMCell(I, H)
+    rnn = nn.RNN(cell)
+    rng = np.random.RandomState(5)
+    x = rng.randn(B, T, I).astype(np.float32)
+    out, (h, c) = rnn(Tensor(x))
+    # manual step loop through the cell
+    states = cell.get_initial_states(Tensor(x))
+    for t in range(T):
+        o, states = cell(Tensor(x[:, t]), states)
+        np.testing.assert_allclose(np.asarray(out.numpy())[:, t],
+                                   np.asarray(o.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h.numpy()),
+                               np.asarray(states[0].numpy()),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_birnn_wrapper():
+    paddle.seed(6)
+    B, T, I, H = 2, 4, 3, 5
+    bi = nn.BiRNN(nn.GRUCell(I, H), nn.GRUCell(I, H))
+    x = np.random.RandomState(6).randn(B, T, I).astype(np.float32)
+    out, (st_f, st_b) = bi(Tensor(x))
+    assert out.shape == [B, T, 2 * H]
+    assert st_f.shape == [B, H] and st_b.shape == [B, H]
+
+
+def test_lstm_bptt_trains():
+    """Gradients flow through the scan: a tiny LSTM fits a memory
+    task (predict first input at the last step)."""
+    from paddle_tpu import optimizer
+    paddle.seed(7)
+    B, T, I, H = 8, 6, 2, 16
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lstm = nn.LSTM(I, H)
+            self.fc = nn.Linear(H, 1)
+
+        def forward(self, x):
+            out, _ = self.lstm(x)
+            return self.fc(out[:, -1])
+
+    net = Net()
+    opt = optimizer.Adam(5e-2, parameters=net.parameters())
+    rng = np.random.RandomState(7)
+    loss_fn = nn.MSELoss()
+    first = None
+    for step in range(60):
+        x = rng.randn(B, T, I).astype(np.float32)
+        # integrate over ALL timesteps: grads must flow through the
+        # whole scan for this to be learnable
+        y = x.sum(axis=(1, 2), keepdims=False)[:, None] / T
+        loss = loss_fn(net(Tensor(x)), Tensor(y.astype(np.float32)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss.numpy())
+    assert float(loss.numpy()) < 0.5 * first
+
+
+def test_time_major_layout():
+    paddle.seed(8)
+    B, T, I, H = 2, 5, 3, 4
+    lstm_bm = nn.LSTM(I, H)
+    lstm_tm = nn.LSTM(I, H, time_major=True)
+    lstm_tm.set_state_dict(lstm_bm.state_dict())
+    x = np.random.RandomState(8).randn(B, T, I).astype(np.float32)
+    out_bm, (h1, _) = lstm_bm(Tensor(x))
+    out_tm, (h2, _) = lstm_tm(Tensor(np.swapaxes(x, 0, 1)))
+    np.testing.assert_allclose(
+        np.asarray(out_tm.numpy()),
+        np.swapaxes(np.asarray(out_bm.numpy()), 0, 1),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(h1.numpy()),
+                               np.asarray(h2.numpy()), rtol=1e-5)
