@@ -6,20 +6,26 @@ from . import initializer  # noqa
 from . import functional  # noqa
 from .common import (  # noqa
     Linear, Dropout, Dropout2D, Dropout3D, AlphaDropout, Embedding,
-    Flatten, Identity, Pad1D, Pad2D, Upsample, PixelShuffle,
+    Flatten, Identity, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample,
+    UpsamplingBilinear2D, UpsamplingNearest2D, PixelShuffle,
+    PixelUnshuffle, ChannelShuffle, Unflatten, Fold, Unfold,
     CosineSimilarity, Bilinear)
-from .conv import Conv1D, Conv2D, Conv3D, Conv2DTranspose  # noqa
+from .conv import (  # noqa
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose)
 from .pooling import (  # noqa
     MaxPool2D, AvgPool2D, MaxPool1D, AvgPool1D, AdaptiveAvgPool2D,
-    AdaptiveMaxPool2D, AdaptiveAvgPool1D)
+    AdaptiveMaxPool2D, AdaptiveAvgPool1D, MaxPool3D, AvgPool3D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool3D)
 from .norm import (  # noqa
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
-    LayerNorm, RMSNorm, GroupNorm, InstanceNorm2D, LocalResponseNorm)
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D,
+    InstanceNorm3D, LocalResponseNorm, SpectralNorm)
 from .activation_layers import (  # noqa
     ReLU, ReLU6, LeakyReLU, ELU, SELU, CELU, GELU, Silu, Swish, Hardswish,
     Sigmoid, LogSigmoid, Hardsigmoid, Hardtanh, Tanh, Tanhshrink, Softplus,
     Softsign, Softshrink, Hardshrink, Mish, ThresholdedReLU, Maxout, GLU,
-    Softmax, LogSoftmax, PReLU)
+    Softmax, LogSoftmax, PReLU, Softmax2D, RReLU)
 from .container import (  # noqa
     Sequential, LayerList, ParameterList, LayerDict)
 from .loss import (  # noqa

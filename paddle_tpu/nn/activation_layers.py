@@ -81,3 +81,42 @@ class PReLU(Layer):
 
     def forward(self, x):
         return ops.prelu(x, self.weight, self._data_format)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of [N, C, H, W] (upstream
+    paddle.nn.Softmax2D)."""
+
+    def forward(self, x):
+        if x.ndim not in (3, 4):
+            raise ValueError("Softmax2D expects a 3D/4D input")
+        return ops.softmax(x, axis=-3)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU: training draws the negative slope from
+    U[lower, upper] per element; eval uses the mean slope."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        from ..framework import random as _random
+        import jax
+        import jax.numpy as jnp
+        from ..ops import apply_closure
+        lower, upper = self.lower, self.upper
+        if self.training:
+            key = _random.next_key()
+
+            def _f(v):
+                slope = jax.random.uniform(
+                    key, v.shape, jnp.float32, lower, upper).astype(
+                    v.dtype)
+                return jnp.where(v >= 0, v, v * slope)
+
+            return apply_closure(_f, [x], name="rrelu")
+        mid = (lower + upper) / 2.0
+        return ops.leaky_relu(x, negative_slope=mid)

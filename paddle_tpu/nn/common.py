@@ -212,3 +212,111 @@ class Bilinear(Layer):
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        full = list(x.shape)
+        ax = self.axis % len(full)
+        return ops.reshape(x, full[:ax] + self.shape + full[ax + 1:])
+
+
+class Pad3D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.mode = mode
+        self.value = value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.pad(x, self.padding, mode=self.mode,
+                       value=self.value, data_format=self.data_format)
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return ops.pad(x, self.padding, mode="constant", value=0.0,
+                       data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Layer):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        from . import functional as F
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        from . import functional as F
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="nearest")
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.factor = downscale_factor
+
+    def forward(self, x):
+        from . import functional as F
+        return F.pixel_unshuffle(x, self.factor)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        from . import functional as F
+        return F.channel_shuffle(x, self.groups)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._cfg = dict(kernel_sizes=kernel_sizes, strides=strides,
+                         paddings=paddings, dilations=dilations)
+
+    def forward(self, x):
+        return ops.unfold(x, **self._cfg)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1,
+                 paddings=0, dilations=1, name=None):
+        super().__init__()
+        self._cfg = dict(output_sizes=output_sizes,
+                         kernel_sizes=kernel_sizes, strides=strides,
+                         paddings=paddings, dilations=dilations)
+
+    def forward(self, x):
+        return ops.fold(x, **self._cfg)

@@ -106,3 +106,38 @@ class Conv2DTranspose(_ConvBase):
         return ops.conv2d_transpose(
             x, self.weight, self.bias, self._stride, self._padding,
             self._output_padding, self._dilation, self._groups, output_size)
+
+
+class Conv1DTranspose(_ConvBase):
+    """weight [in, out/groups, k] (paddle transpose-conv convention)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1,
+                         stride, padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv1d_transpose(
+            x, self.weight, self.bias, self._stride[0], self._padding,
+            self._output_padding, self._dilation[0], self._groups,
+            output_size)
+
+
+class Conv3DTranspose(_ConvBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None,
+                 data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3,
+                         stride, padding, dilation, groups, "zeros",
+                         weight_attr, bias_attr, data_format,
+                         transpose=True, output_padding=output_padding)
+
+    def forward(self, x, output_size=None):
+        return ops.conv3d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            self._output_padding, self._dilation, self._groups,
+            output_size)

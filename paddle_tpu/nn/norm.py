@@ -211,3 +211,12 @@ class SpectralNorm(Layer):
                  name=None):
         super().__init__()
         raise NotImplementedError("SpectralNorm pending")
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """[N, C, L] — ops.instance_norm normalises all trailing spatial
+    dims, so the 2D implementation applies unchanged."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """[N, C, D, H, W] — same reduction over trailing dims."""

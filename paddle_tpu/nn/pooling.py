@@ -87,3 +87,61 @@ class AdaptiveAvgPool1D(Layer):
 
     def forward(self, x):
         return ops.adaptive_avg_pool1d(x, self.output_size)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._cfg = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         return_mask=return_mask)
+
+    def forward(self, x):
+        return ops.max_pool3d(x, **self._cfg)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, exclusive=True, divisor_override=None,
+                 data_format="NCDHW", name=None):
+        super().__init__()
+        self._cfg = dict(kernel_size=kernel_size, stride=stride,
+                         padding=padding, ceil_mode=ceil_mode,
+                         exclusive=exclusive,
+                         divisor_override=divisor_override)
+
+    def forward(self, x):
+        return ops.avg_pool3d(x, **self._cfg)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._output_size = output_size
+
+    def forward(self, x):
+        return ops.adaptive_avg_pool3d(x, self._output_size)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return ops.adaptive_max_pool1d(x, self._output_size,
+                                       return_mask=self._return_mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._output_size = output_size
+        self._return_mask = return_mask
+
+    def forward(self, x):
+        return ops.adaptive_max_pool3d(x, self._output_size,
+                                       return_mask=self._return_mask)

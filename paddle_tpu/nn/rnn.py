@@ -28,9 +28,14 @@ class RNNCellBase(Layer):
     def get_initial_states(self, batch_ref, shape=None, dtype=None,
                            init_value=0.0, batch_dim_idx=0):
         batch = batch_ref.shape[batch_dim_idx]
-        H = self.hidden_size
+        if shape is not None:
+            shp = [batch if s in (None, -1) else int(s)
+                   for s in list(shape)]
+        else:
+            shp = [batch, self.hidden_size]
+        npdt = np.dtype(getattr(dtype, "np_dtype", dtype or "float32"))
         n = getattr(self, "state_components", 1)
-        zeros = [Tensor(np.full((batch, H), init_value, np.float32))
+        zeros = [Tensor(np.full(tuple(shp), init_value, npdt))
                  for _ in range(n)]
         return tuple(zeros) if n > 1 else zeros[0]
 
@@ -57,15 +62,18 @@ class _BuiltinCell(RNNCellBase):
         self.weight_hh = self.create_parameter(
             [g * hidden_size, hidden_size], attr=weight_hh_attr,
             default_initializer=init)
-        if bias_ih_attr is not False:
+        if bias_ih_attr is False or bias_hh_attr is False:
+            # upstream drops BOTH biases when either attr is False
+            # (cudnn keeps the pair together); partial-bias layouts
+            # don't exist in paddle checkpoints
+            self.bias_ih = self.bias_hh = None
+        else:
             self.bias_ih = self.create_parameter(
                 [g * hidden_size], attr=bias_ih_attr, is_bias=True,
                 default_initializer=init)
             self.bias_hh = self.create_parameter(
                 [g * hidden_size], attr=bias_hh_attr, is_bias=True,
                 default_initializer=init)
-        else:
-            self.bias_ih = self.bias_hh = None
 
     def extra_repr(self):
         return f"{self.input_size}, {self.hidden_size}"
@@ -177,14 +185,35 @@ class RNN(Layer):
             return _cell_scan(cell, inputs, initial_states,
                               sequence_length, self.is_reverse,
                               self.time_major)
-        # custom cell: step-wise python loop (unrolled under jit)
+        # custom cell: step-wise python loop (unrolled under jit),
+        # with the same sequence_length masking as the fused path
         xs = inputs if self.time_major else ops.swapaxes(inputs, 0, 1)
         T = xs.shape[0]
         order = range(T - 1, -1, -1) if self.is_reverse else range(T)
         states = initial_states
         outs = [None] * T
+        seq = sequence_length
+
+        def _mask(new, old, t):
+            m = ops.unsqueeze(ops.cast(
+                Tensor(np.asarray(t, np.int64)) < seq, "bool"), -1)
+            return ops.where(m, new, old)
+
         for t in order:
-            out, states = cell(xs[t], states)
+            out, new_states = cell(xs[t], states)
+            if seq is not None:
+                if isinstance(new_states, (tuple, list)):
+                    new_states = type(new_states)(
+                        _mask(ns, os_, t)
+                        for ns, os_ in zip(new_states, states))
+                else:
+                    new_states = _mask(new_states, states, t)
+                out = ops.where(
+                    ops.unsqueeze(ops.cast(
+                        Tensor(np.asarray(t, np.int64)) < seq,
+                        "bool"), -1),
+                    out, ops.zeros_like(out))
+            states = new_states
             outs[t] = out
         out = ops.stack(outs, axis=0)
         return (out if self.time_major else ops.swapaxes(out, 0, 1)), \

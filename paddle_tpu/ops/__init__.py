@@ -19,6 +19,7 @@ from .linalg import *  # noqa
 from .logic import *  # noqa
 from .activation import *  # noqa
 from .nn_ops import *  # noqa
+from . import rnn_ops  # noqa  (registers the RNN scan primitives)
 from .array_ops import (  # noqa
     TensorArray, create_array, array_write, array_read, array_length)
 

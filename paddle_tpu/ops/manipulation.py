@@ -438,10 +438,10 @@ def view(x, shape_or_dtype):
 
 
 @primitive
-def unfold(x, kernel_size, strides=1, paddings=0, dilations=1):
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
     # im2col for NCHW input: returns [N, C*kh*kw, L]
-    ks = (kernel_size if isinstance(kernel_size, (list, tuple))
-          else (kernel_size, kernel_size))
+    ks = (kernel_sizes if isinstance(kernel_sizes, (list, tuple))
+          else (kernel_sizes, kernel_sizes))
     st = strides if isinstance(strides, (list, tuple)) else (strides,) * 2
     pd = paddings if isinstance(paddings, (list, tuple)) else (paddings,) * 2
     dl = (dilations if isinstance(dilations, (list, tuple))
@@ -456,7 +456,10 @@ def unfold(x, kernel_size, strides=1, paddings=0, dilations=1):
             patch = xp[:, :, i * dl[0]:i * dl[0] + oh * st[0]:st[0],
                        j * dl[1]:j * dl[1] + ow * st[1]:st[1]]
             cols.append(patch.reshape(n, c, -1))
-    return jnp.concatenate(cols, axis=1).reshape(n, c * ks[0] * ks[1], -1)
+    # channel-major (c, kh, kw) ordering of the C*kh*kw dim (upstream
+    # im2col convention; tap-major concat silently permuted channels)
+    stacked = jnp.stack(cols, axis=2)          # [n, c, kh*kw, L]
+    return stacked.reshape(n, c * ks[0] * ks[1], -1)
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):

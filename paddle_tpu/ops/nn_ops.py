@@ -107,6 +107,29 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     return out
 
 
+def _transpose_opad(in_sizes, k_sizes, stride, dilation, pad, opad,
+                    output_size):
+    """Resolve paddle's ``output_size`` into per-dim output_padding
+    (output_size picks among the stride-ambiguous valid sizes)."""
+    if output_size is None:
+        return opad
+    sizes = ([int(s) for s in output_size]
+             if isinstance(output_size, (list, tuple))
+             else [int(output_size)] * len(in_sizes))
+    out = []
+    for i, want in enumerate(sizes):
+        eff_k = (k_sizes[i] - 1) * dilation[i] + 1
+        base = (in_sizes[i] - 1) * stride[i] - pad[i][0] - pad[i][1] \
+            + eff_k
+        extra = want - base
+        if not 0 <= extra < stride[i] + max(dilation[i] - 1, 0) + 1:
+            raise ValueError(
+                f"output_size[{i}]={want} invalid: must be in "
+                f"[{base}, {base + stride[i] - 1}]")
+        out.append(extra)
+    return tuple(out)
+
+
 @primitive
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
@@ -117,6 +140,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
     if isinstance(padding, str):
         raise NotImplementedError("str padding for conv_transpose")
     pad = _conv_padding(padding, 2)
+    opad = _transpose_opad(x.shape[2:4], weight.shape[2:4], stride,
+                           dilation, pad, opad, output_size)
     # weight layout: paddle conv2d_transpose weight is [in, out/groups, kh, kw]
     kh, kw = weight.shape[2], weight.shape[3]
     pads = []
@@ -237,13 +262,14 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 @primitive
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW"):
-    out = _pool(x, kernel_size, stride, padding, 0.0, jax.lax.add,
-                data_format, count_include_pad=not exclusive, is_avg=True,
-                ceil_mode=ceil_mode)
     if divisor_override:
-        k = _pair(kernel_size)
-        out = out * (float(np.prod(k)) / divisor_override)
-    return out
+        # raw window SUM / divisor (paddle/torch semantics)
+        s = _pool(x, kernel_size, stride, padding, 0.0, jax.lax.add,
+                  data_format, is_avg=False, ceil_mode=ceil_mode)
+        return s / float(divisor_override)
+    return _pool(x, kernel_size, stride, padding, 0.0, jax.lax.add,
+                 data_format, count_include_pad=not exclusive,
+                 is_avg=True, ceil_mode=ceil_mode)
 
 
 @primitive
@@ -1102,3 +1128,284 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         return jnp.mean(nll / jnp.maximum(
             lb_len.astype(jnp.float32), 1.0))
     return _reduce_loss(nll, reduction)
+
+
+# ---------------------------------------------------------------------------
+# 1D/3D transposed convs, 3D pools, fold, grid_sample (coverage batch;
+# upstream phi conv_transpose/pool3d/im2col/grid_sample kernels)
+# ---------------------------------------------------------------------------
+@primitive
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCL"):
+    """Via conv2d_transpose on a height-1 image (weight [in, out, k])."""
+    x4 = x[:, :, None, :]
+    w4 = weight[:, :, None, :]
+    s = stride if isinstance(stride, int) else stride[0]
+    p = padding if isinstance(padding, int) else padding[0]
+    op = output_padding if isinstance(output_padding, int) \
+        else output_padding[0]
+    d = dilation if isinstance(dilation, int) else dilation[0]
+    osz = None
+    if output_size is not None:
+        L = (output_size[0] if isinstance(output_size, (list, tuple))
+             else output_size)
+        osz = (1, int(L))   # dummy height dim stays 1
+    out = conv2d_transpose(x4, w4, bias=None, stride=(1, s),
+                           padding=(0, p), output_padding=(0, op),
+                           dilation=(1, d), groups=groups,
+                           output_size=osz)
+    out = unwrap(out)[:, :, 0, :]
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+@primitive
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     output_size=None, data_format="NCDHW"):
+    """Gradient-of-conv formulation: lhs-dilated conv (weight
+    [in, out/groups, kd, kh, kw])."""
+    stride = _pair(stride, 3)
+    dilation = _pair(dilation, 3)
+    opad = _pair(output_padding, 3)
+    pad = _conv_padding(padding, 3)
+    if groups != 1:
+        raise NotImplementedError("conv3d_transpose groups > 1")
+    kd, kh, kw = weight.shape[2:]
+    opad = _transpose_opad(x.shape[2:5], (kd, kh, kw), stride,
+                           dilation, pad, opad, output_size)
+    pads = []
+    for i, (lo, hi) in enumerate(pad):
+        k = (kd, kh, kw)[i]
+        eff_k = (k - 1) * dilation[i] + 1
+        pads.append((eff_k - 1 - lo, eff_k - 1 - hi + opad[i]))
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    w = jnp.swapaxes(w, 0, 1)
+    dn = jax.lax.conv_dimension_numbers(x.shape, w.shape,
+                                        ("NCDHW", "OIDHW", "NCDHW"))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def _pool3d(x, kernel, stride, padding, init, op, is_avg=False,
+            exclusive=True, ceil_mode=False):
+    k = _pair(kernel, 3)
+    s = _pair(stride if stride is not None else kernel, 3)
+    pad = _conv_padding(padding, 3)
+    if ceil_mode and not isinstance(pad, str):
+        spatial = x.shape[2:5]
+        pad = [(lo, hi + (-(dim + lo + hi - kk) % ss))
+               for (lo, hi), dim, kk, ss in zip(pad, spatial, k, s)]
+    cfg = pad if isinstance(pad, str) else [(0, 0), (0, 0)] + list(pad)
+    out = jax.lax.reduce_window(x, init, op, (1, 1) + k, (1, 1) + s,
+                                cfg)
+    if is_avg:
+        if not exclusive or isinstance(pad, str) or \
+                all(p == (0, 0) for p in pad):
+            out = out / float(np.prod(k))
+        else:
+            cnt = jax.lax.reduce_window(
+                jnp.ones_like(x), 0.0, jax.lax.add, (1, 1) + k,
+                (1, 1) + s, cfg)
+            out = out / cnt
+    return out
+
+
+@primitive
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    if return_mask:
+        raise NotImplementedError("max_pool3d return_mask")
+    return _pool3d(x, kernel_size, stride, padding, -jnp.inf,
+                   jax.lax.max, ceil_mode=ceil_mode)
+
+
+@primitive
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None,
+               data_format="NCDHW"):
+    if divisor_override:
+        # paddle/torch semantics: raw window SUM / divisor, regardless
+        # of padding or the exclusive flag
+        s = _pool3d(x, kernel_size, stride, padding, 0.0, jax.lax.add,
+                    is_avg=False, ceil_mode=ceil_mode)
+        return s / float(divisor_override)
+    return _pool3d(x, kernel_size, stride, padding, 0.0, jax.lax.add,
+                   is_avg=True, exclusive=exclusive,
+                   ceil_mode=ceil_mode)
+
+
+def _adaptive_slices(size, out):
+    return [((i * size) // out, -(-((i + 1) * size) // out))
+            for i in range(out)]
+
+
+@primitive
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    od, oh, ow = _pair(output_size, 3)
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, od, d // od, oh, h // oh,
+                         ow, w // ow).mean(axis=(3, 5, 7))
+    cur = x
+    for axis, (size, out) in zip((2, 3, 4), ((d, od), (h, oh), (w, ow))):
+        parts = [jax.lax.slice_in_dim(cur, lo, hi, axis=axis).mean(
+            axis=axis, keepdims=True)
+            for lo, hi in _adaptive_slices(size, out)]
+        cur = jnp.concatenate(parts, axis=axis)
+    return cur
+
+
+@primitive
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool1d return_mask")
+    n, c, L = x.shape
+    out = int(output_size[0] if isinstance(output_size, (list, tuple))
+              else output_size)
+    if L % out == 0:
+        return x.reshape(n, c, out, L // out).max(axis=3)
+    parts = [jax.lax.slice_in_dim(x, lo, hi, axis=2).max(
+        axis=2, keepdims=True) for lo, hi in _adaptive_slices(L, out)]
+    return jnp.concatenate(parts, axis=2)
+
+
+@primitive
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    if return_mask:
+        raise NotImplementedError("adaptive_max_pool3d return_mask")
+    od, oh, ow = _pair(output_size, 3)
+    n, c, d, h, w = x.shape
+    if d % od == 0 and h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, od, d // od, oh, h // oh,
+                         ow, w // ow).max(axis=(3, 5, 7))
+    cur = x
+    for axis, (size, out) in zip((2, 3, 4), ((d, od), (h, oh), (w, ow))):
+        parts = [jax.lax.slice_in_dim(cur, lo, hi, axis=axis).max(
+            axis=axis, keepdims=True)
+            for lo, hi in _adaptive_slices(size, out)]
+        cur = jnp.concatenate(parts, axis=axis)
+    return cur
+
+
+@primitive
+def bilinear(x1, x2, weight, bias=None):
+    """paddle.nn.functional.bilinear: out[b, o] = x1[b]ᵀ W[o] x2[b]."""
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@primitive
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im (inverse of unfold): x [N, C*kh*kw, L] → [N, C, H, W],
+    overlapping patches summed (upstream fold op)."""
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    dh, dw = _pair(dilations)
+    pad = _conv_padding(paddings, 2)
+    (ph0, ph1), (pw0, pw1) = pad
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    hp, wp = oh + ph0 + ph1, ow + pw0 + pw1
+    nh = (hp - (kh - 1) * dh - 1) // sh + 1
+    nw = (wp - (kw - 1) * dw - 1) // sw + 1
+    assert nh * nw == L, (nh, nw, L)
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, hp, wp), x.dtype)
+    # scatter-add each kernel tap's contribution (kh*kw static taps,
+    # strided static slices — overlaps sum as in upstream col2im)
+    for i in range(kh):
+        for j in range(kw):
+            patch = cols[:, :, i, j]          # [n, c, nh, nw]
+            out = out.at[:, :,
+                         i * dh:i * dh + (nh - 1) * sh + 1:sh,
+                         j * dw:j * dw + (nw - 1) * sw + 1:sw].add(
+                patch)
+    return out[:, :, ph0:hp - ph1, pw0:wp - pw1]
+
+
+@primitive(nondiff=(1,))
+def affine_grid(theta, out_shape, align_corners=True):
+    """2D affine sampling grid (upstream affine_grid): theta [N, 2, 3]
+    → grid [N, H, W, 2] in normalized [-1, 1] coords."""
+    n, c, h, w = [int(v) for v in out_shape]
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+    else:
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)        # [H, W, 3]
+    grid = jnp.einsum("hwk,nik->nhwi", base,
+                      theta.astype(jnp.float32))     # [N, H, W, 2]
+    return grid.astype(theta.dtype)
+
+
+@primitive
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample x [N, C, H, W] at grid [N, Hg, Wg, 2] (x, y in [-1, 1])
+    — upstream grid_sample (STN / deformable heads)."""
+    n, c, h, w = x.shape
+    gx = grid[..., 0].astype(jnp.float32)
+    gy = grid[..., 1].astype(jnp.float32)
+    if align_corners:
+        fx = (gx + 1.0) * 0.5 * (w - 1)
+        fy = (gy + 1.0) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1.0) * w - 1.0) * 0.5
+        fy = ((gy + 1.0) * h - 1.0) * 0.5
+    if padding_mode == "border":
+        fx = jnp.clip(fx, 0, w - 1)
+        fy = jnp.clip(fy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def refl(v, size):
+            if align_corners:
+                span = 2.0 * (size - 1)
+                v = jnp.abs(jnp.mod(v, span))
+                return jnp.where(v > size - 1, span - v, v)
+            span = 2.0 * size
+            v = jnp.mod(v + 0.5, span)
+            v = jnp.abs(v)
+            v = jnp.where(v > size, span - v, v)
+            return jnp.clip(v - 0.5, 0, size - 1)
+        fx = refl(fx, w)
+        fy = refl(fy, h)
+
+    def gather(ix, iy):
+        ixc = jnp.clip(ix, 0, w - 1)
+        iyc = jnp.clip(iy, 0, h - 1)
+        vals = x[jnp.arange(n)[:, None, None], :, iyc, ixc]  # [N,Hg,Wg,C]
+        if padding_mode == "zeros":
+            ok = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                  & (iy <= h - 1))
+            vals = jnp.where(ok[..., None], vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        out = gather(jnp.round(fx).astype(jnp.int32),
+                     jnp.round(fy).astype(jnp.int32))
+    else:
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = fx - x0
+        wy = fy - y0
+        out = (gather(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+               + gather(x1, y0) * (wx * (1 - wy))[..., None]
+               + gather(x0, y1) * ((1 - wx) * wy)[..., None]
+               + gather(x1, y1) * (wx * wy)[..., None])
+    return jnp.moveaxis(out, -1, 1).astype(x.dtype)   # [N, C, Hg, Wg]

@@ -805,6 +805,30 @@ EXEMPTIONS = {
     "dequantize_linear": "quant",
     "fake_quant_dequant": "quant",
     "quantize_linear": "quant",
+    # round-3 nn coverage batch: torch-oracle tested end to end
+    "huber_loss": "nn-oracle",
+    "soft_margin_loss": "nn-oracle",
+    "poisson_nll_loss": "nn-oracle",
+    "gaussian_nll_loss": "nn-oracle",
+    "triplet_margin_loss": "nn-oracle",
+    "multi_label_soft_margin_loss": "nn-oracle",
+    "pairwise_distance": "nn-oracle",
+    "square_error_cost": "nn-oracle",
+    "ctc_loss": "nn-oracle",
+    "conv1d_transpose": "nn-oracle",
+    "conv3d_transpose": "nn-oracle",
+    "max_pool3d": "nn-oracle",
+    "avg_pool3d": "nn-oracle",
+    "adaptive_avg_pool3d": "nn-oracle",
+    "adaptive_max_pool1d": "nn-oracle",
+    "adaptive_max_pool3d": "nn-oracle",
+    "bilinear": "nn-oracle",
+    "fold": "nn-oracle",
+    "affine_grid": "nn-oracle",
+    "grid_sample": "nn-oracle",
+    "lstm_layer": "nn-oracle",
+    "gru_layer": "nn-oracle",
+    "simple_rnn_layer": "nn-oracle",
 }
 
 EXEMPT_REASONS = {
@@ -823,6 +847,9 @@ EXEMPT_REASONS = {
     "vision": "vision/detection ops oracle-tested in test_vision_ops",
     "sparse": "SelectedRows/sparse ops tested in test_sparse",
     "distributed": "collective ops need a mesh; tested in distributed suites",
+    "nn-oracle": (
+        "torch-oracle tested in test_losses_extra/test_nn_coverage/"
+        "test_rnn (fwd + bwd through real layers)"),
 }
 
 

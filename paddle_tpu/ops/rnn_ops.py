@@ -42,8 +42,8 @@ def _run_scan(step, xs, init, reverse):
 def lstm_layer(x, w_ih, w_hh, b_ih, b_hh, h0, c0, seq_lens=None,
                reverse=False, time_major=False):
     """One LSTM direction-layer.  x [B,T,I] (or [T,B,I] time-major);
-    w_ih [4H, I], w_hh [4H, H]; gate order (i, f, g, o)
-    # VERIFY-vs-reference: upstream cudnn gate order.
+    w_ih [4H, I], w_hh [4H, H]; gate order (i, f, g, o) — the cudnn
+    convention, verified against the torch LSTM oracle in test_rnn.
     Returns (outputs [B,T,H], h_T [B,H], c_T [B,H])."""
     seq_lens = unwrap(seq_lens)
     xs = _to_tbi(x, time_major)

@@ -1,0 +1,290 @@
+"""Coverage batch: transposed 1D/3D convs, 3D pools, fold,
+grid_sample/affine_grid, misc layers — torch as the numerics oracle."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor import Tensor
+
+
+def _t(x):
+    import torch
+    return torch.tensor(np.asarray(x))
+
+
+def test_conv1d_transpose_matches_torch():
+    import torch
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 10).astype(np.float32)
+    w = rng.randn(3, 4, 5).astype(np.float32)   # [in, out, k]
+    b = rng.randn(4).astype(np.float32)
+    got = F.conv1d_transpose(Tensor(x), Tensor(w), Tensor(b), stride=2,
+                             padding=1, output_padding=1)
+    exp = torch.conv_transpose1d(_t(x), _t(w), _t(b), stride=2,
+                                 padding=1, output_padding=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_transpose_matches_torch():
+    import torch
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 5, 6).astype(np.float32)
+    w = rng.randn(2, 3, 3, 3, 3).astype(np.float32)
+    got = F.conv3d_transpose(Tensor(x), Tensor(w), stride=2, padding=1)
+    exp = torch.conv_transpose3d(_t(x), _t(w), stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv_transpose_layers():
+    paddle.seed(0)
+    l1 = nn.Conv1DTranspose(3, 6, 4, stride=2)
+    y1 = l1(Tensor(np.random.RandomState(2).randn(2, 3, 8).astype(
+        np.float32)))
+    assert y1.shape[:2] == [2, 6]
+    l3 = nn.Conv3DTranspose(2, 4, 3, stride=2)
+    y3 = l3(Tensor(np.random.RandomState(3).randn(1, 2, 3, 3, 3).astype(
+        np.float32)))
+    assert y3.shape[:2] == [1, 4] and len(y3.shape) == 5
+
+
+def test_pool3d_matches_torch():
+    import torch
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 8, 9, 10).astype(np.float32)
+    got = F.max_pool3d(Tensor(x), 2, stride=2, padding=0)
+    exp = torch.nn.functional.max_pool3d(_t(x), 2, stride=2)
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-5)
+    got2 = F.avg_pool3d(Tensor(x), 3, stride=2, padding=1)
+    # paddle default exclusive=True == torch count_include_pad=False
+    exp2 = torch.nn.functional.avg_pool3d(_t(x), 3, stride=2, padding=1,
+                                          count_include_pad=False)
+    np.testing.assert_allclose(np.asarray(got2.numpy()), exp2.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_adaptive_pools_3d_and_1dmax():
+    import torch
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 9, 10, 11).astype(np.float32)
+    got = F.adaptive_avg_pool3d(Tensor(x), (3, 5, 4))
+    exp = torch.nn.functional.adaptive_avg_pool3d(_t(x), (3, 5, 4))
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    x1 = rng.randn(2, 4, 13).astype(np.float32)
+    got1 = F.adaptive_max_pool1d(Tensor(x1), 5)
+    exp1 = torch.nn.functional.adaptive_max_pool1d(_t(x1), 5)
+    np.testing.assert_allclose(np.asarray(got1.numpy()), exp1.numpy(),
+                               rtol=1e-5)
+    got3 = F.adaptive_max_pool3d(Tensor(x), (3, 2, 5))
+    exp3 = torch.nn.functional.adaptive_max_pool3d(_t(x), (3, 2, 5))
+    np.testing.assert_allclose(np.asarray(got3.numpy()), exp3.numpy(),
+                               rtol=1e-5)
+
+
+def test_fold_inverts_unfold_and_matches_torch():
+    import torch
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    cols = F.unfold(Tensor(x), kernel_sizes=3, strides=2, paddings=1)
+    got = F.fold(cols, output_sizes=(8, 8), kernel_sizes=3, strides=2,
+                 paddings=1)
+    tc = torch.nn.functional.unfold(_t(x), 3, stride=2, padding=1)
+    exp = torch.nn.functional.fold(tc, (8, 8), 3, stride=2, padding=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grid_sample_and_affine_grid_match_torch():
+    import torch
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 6, 7).astype(np.float32)
+    theta = np.stack([np.array([[0.8, 0.1, 0.1], [-0.1, 0.9, -0.2]],
+                               np.float32)] * 2)
+    for align in (True, False):
+        grid = F.affine_grid(Tensor(theta), (2, 3, 5, 6),
+                             align_corners=align)
+        tg = torch.nn.functional.affine_grid(
+            _t(theta), (2, 3, 5, 6), align_corners=align)
+        np.testing.assert_allclose(np.asarray(grid.numpy()),
+                                   tg.numpy(), rtol=1e-4, atol=1e-5)
+        for mode in ("bilinear", "nearest"):
+            for pad in ("zeros", "border"):
+                # sample with torch's grid on BOTH sides: ulp-level
+                # grid differences flip nearest-rounding at exact
+                # half-pixel coordinates
+                got = F.grid_sample(Tensor(x), Tensor(tg.numpy()),
+                                    mode=mode, padding_mode=pad,
+                                    align_corners=align)
+                exp = torch.nn.functional.grid_sample(
+                    _t(x), tg, mode=mode, padding_mode=pad,
+                    align_corners=align)
+                np.testing.assert_allclose(
+                    np.asarray(got.numpy()), exp.numpy(),
+                    rtol=1e-4, atol=1e-4,
+                    err_msg=f"{mode}/{pad}/align={align}")
+
+
+def test_bilinear_matches_torch():
+    import torch
+    rng = np.random.RandomState(8)
+    x1 = rng.randn(4, 5).astype(np.float32)
+    x2 = rng.randn(4, 6).astype(np.float32)
+    w = rng.randn(3, 5, 6).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    got = F.bilinear(Tensor(x1), Tensor(x2), Tensor(w), Tensor(b))
+    exp = torch.nn.functional.bilinear(_t(x1), _t(x2), _t(w), _t(b))
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_instance_norm_1d_3d():
+    import torch
+    rng = np.random.RandomState(9)
+    x1 = rng.randn(2, 3, 7).astype(np.float32)
+    got = nn.InstanceNorm1D(3)(Tensor(x1))
+    exp = torch.nn.functional.instance_norm(_t(x1))
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-4)
+    x3 = rng.randn(2, 3, 4, 5, 6).astype(np.float32)
+    got3 = nn.InstanceNorm3D(3)(Tensor(x3))
+    exp3 = torch.nn.functional.instance_norm(_t(x3))
+    np.testing.assert_allclose(np.asarray(got3.numpy()), exp3.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_misc_layers_shapes_and_semantics():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 12, 4, 4).astype(np.float32)
+    assert nn.Unflatten(1, [3, 4])(Tensor(x)).shape == [2, 3, 4, 4, 4]
+    assert nn.ZeroPad2D([1, 2, 3, 4])(Tensor(x)).shape == [2, 12, 11, 7]
+    assert nn.PixelUnshuffle(2)(Tensor(x)).shape == [2, 48, 2, 2]
+    cs = nn.ChannelShuffle(3)(Tensor(x))
+    assert cs.shape == [2, 12, 4, 4]
+    up = nn.UpsamplingNearest2D(scale_factor=2)(Tensor(x))
+    assert up.shape == [2, 12, 8, 8]
+    ub = nn.UpsamplingBilinear2D(size=(6, 6))(Tensor(x))
+    assert ub.shape == [2, 12, 6, 6]
+    sm = nn.Softmax2D()(Tensor(x))
+    s = np.asarray(sm.numpy()).sum(axis=1)
+    np.testing.assert_allclose(s, 1.0, rtol=1e-5)
+    # fold/unfold layer round trip (non-overlapping → identity)
+    cols = nn.Unfold(2, strides=2)(Tensor(x))
+    back = nn.Fold((4, 4), 2, strides=2)(cols)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-6)
+
+
+def test_rrelu_train_eval():
+    paddle.seed(0)
+    layer = nn.RReLU(0.1, 0.3)
+    x = Tensor(np.full((4, 100), -1.0, np.float32))
+    layer.train()
+    y = np.asarray(layer(x).numpy())
+    assert (y <= -0.1 + 1e-6).all() and (y >= -0.3 - 1e-6).all()
+    assert np.unique(y).size > 10          # actually random per elem
+    layer.eval()
+    ye = np.asarray(layer(x).numpy())
+    np.testing.assert_allclose(ye, -0.2, rtol=1e-5)
+
+
+def test_maxpool3d_layer_and_adaptive_layers():
+    rng = np.random.RandomState(11)
+    x = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+    assert nn.MaxPool3D(2)(Tensor(x)).shape == [1, 2, 3, 3, 3]
+    assert nn.AvgPool3D(2)(Tensor(x)).shape == [1, 2, 3, 3, 3]
+    assert nn.AdaptiveAvgPool3D(2)(Tensor(x)).shape == [1, 2, 2, 2, 2]
+    assert nn.AdaptiveMaxPool3D(3)(Tensor(x)).shape == [1, 2, 3, 3, 3]
+    x1 = rng.randn(1, 2, 9).astype(np.float32)
+    assert nn.AdaptiveMaxPool1D(3)(Tensor(x1)).shape == [1, 2, 3]
+
+
+def test_conv_transpose_output_size():
+    """output_size resolves the stride ambiguity (review finding: the
+    argument was silently dropped)."""
+    import torch
+    rng = np.random.RandomState(12)
+    x = rng.randn(1, 2, 7).astype(np.float32)
+    w = rng.randn(2, 3, 4).astype(np.float32)
+    # stride 2 admits output lengths {16, 17}
+    for L in (16, 17):
+        got = F.conv1d_transpose(Tensor(x), Tensor(w), stride=2,
+                                 padding=0, output_size=[L])
+        assert got.shape[2] == L
+    with pytest.raises(ValueError, match="output_size"):
+        F.conv1d_transpose(Tensor(x), Tensor(w), stride=2,
+                           output_size=[40])
+    x2 = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w2 = rng.randn(2, 3, 3, 3).astype(np.float32)
+    # base size is 11; output_size=12 must behave as output_padding=1
+    got2 = F.conv2d_transpose(Tensor(x2), Tensor(w2), stride=2,
+                              output_size=(12, 12))
+    exp2 = torch.conv_transpose2d(_t(x2), _t(w2), stride=2,
+                                  output_padding=1)
+    np.testing.assert_allclose(np.asarray(got2.numpy()), exp2.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_avg_pool_divisor_override_with_padding():
+    """divisor_override divides the RAW window sum (review finding:
+    it was rescaling the count-normalised output)."""
+    import torch
+    rng = np.random.RandomState(13)
+    x = rng.randn(1, 2, 6, 6).astype(np.float32)
+    got = F.avg_pool2d(Tensor(x), 3, stride=2, padding=1,
+                       divisor_override=4)
+    exp = torch.nn.functional.avg_pool2d(_t(x), 3, stride=2, padding=1,
+                                         divisor_override=4)
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    x3 = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+    got3 = F.avg_pool3d(Tensor(x3), 2, stride=2, padding=1,
+                        divisor_override=5)
+    exp3 = torch.nn.functional.avg_pool3d(_t(x3), 2, stride=2,
+                                          padding=1,
+                                          divisor_override=5)
+    np.testing.assert_allclose(np.asarray(got3.numpy()), exp3.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_custom_cell_rnn_masks_sequence_length():
+    """The python-loop fallback must mask like the fused path (review
+    finding: sequence_length was silently ignored for custom cells)."""
+    paddle.seed(14)
+
+    class MyCell(nn.RNNCellBase):
+        def __init__(self, i, h):
+            super().__init__()
+            self.hidden_size = h
+            self.fc = nn.Linear(i + h, h)
+
+        def forward(self, x, states=None):
+            if states is None:
+                states = self.get_initial_states(x)
+            from paddle_tpu import ops as O
+            h = O.tanh(self.fc(O.concat([x, states], axis=-1)))
+            return h, h
+
+    B, T, I, H = 2, 6, 3, 4
+    rnn = nn.RNN(MyCell(I, H))
+    rng = np.random.RandomState(14)
+    x = rng.randn(B, T, I).astype(np.float32)
+    lens = np.array([3, 6], np.int64)
+    out, h = rnn(Tensor(x), sequence_length=Tensor(lens))
+    o = np.asarray(out.numpy())
+    np.testing.assert_allclose(o[0, 3:], 0.0, atol=1e-7)
+    assert np.abs(o[1, 3:]).sum() > 0
+    out2, h2 = rnn(Tensor(x[:1, :3]))
+    np.testing.assert_allclose(np.asarray(h.numpy())[0],
+                               np.asarray(h2.numpy())[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_cell_bias_false_drops_both():
+    cell = nn.LSTMCell(3, 4, bias_hh_attr=False)
+    assert cell.bias_ih is None and cell.bias_hh is None
+    assert len(list(cell.parameters())) == 2
