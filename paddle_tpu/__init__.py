@@ -69,6 +69,9 @@ from . import device  # noqa
 from . import quantization  # noqa
 from . import sparse  # noqa
 from . import linalg as _linalg_ns  # noqa
+from . import fft  # noqa
+from . import signal  # noqa
+from . import distribution  # noqa
 
 from .framework.io import save, load  # noqa
 from .hapi.model import Model  # noqa

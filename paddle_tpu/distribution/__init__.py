@@ -1,0 +1,597 @@
+"""paddle.distribution (parity: python/paddle/distribution/ — the
+probability-distribution API: sample/rsample/log_prob/entropy/kl).
+
+TPU-native: sampling draws explicit jax PRNG keys from the framework
+generator (deterministic under paddle.seed), log-probs/entropies are
+pure jnp compositions so they trace, jit, and differentiate; rsample
+uses reparameterisation where it exists (the same split upstream
+makes).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..framework import random as _random
+from ..ops._primitive import unwrap
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
+    "LogNormal", "Gumbel", "Multinomial", "kl_divergence",
+    "register_kl",
+]
+
+
+def _t(x):
+    """Lift a parameter to a Tensor (keeps user Tensors ON the tape so
+    rsample/log_prob gradients flow back to distribution params)."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32), stop_gradient=True)
+
+
+def _v(x):
+    if x is None:
+        return None
+    return jnp.asarray(unwrap(x), jnp.float32) \
+        if not isinstance(unwrap(x), jnp.ndarray) else unwrap(x)
+
+
+def _op(fn, *tensors, name="dist_op"):
+    """Tape-recorded closure over Tensor params (jnp math inside)."""
+    from ..ops._primitive import apply_closure
+    return apply_closure(fn, [(_t(t)) for t in tensors], name=name)
+
+
+def _key():
+    return _random.next_key()
+
+
+def _shape(sample_shape, base):
+    return tuple(int(s) for s in sample_shape) + tuple(base)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def prob(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError(
+            f"{type(self).__name__} has no reparameterised sampler")
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = _t(loc)
+        self._scale_t = _t(scale)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2,
+                                       self._batch_shape))
+
+    def rsample(self, shape=()):
+        eps = jax.random.normal(_key(),
+                                _shape(shape, self._batch_shape))
+        return _op(lambda l, s: l + s * eps,
+                   self._loc_t, self._scale_t, name="normal_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return _op(
+            lambda l, s, v: -((v - l) ** 2) / (2 * s ** 2)
+            - jnp.log(s) - 0.5 * math.log(2 * math.pi),
+            self._loc_t, self._scale_t, _t(value),
+            name="normal_log_prob")
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op(lambda s: jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s), shp),
+            self._scale_t, name="normal_entropy")
+
+
+class LogNormal(Normal):
+    def rsample(self, shape=()):
+        from .. import ops
+        return ops.exp(Normal.rsample(self, shape))
+
+    sample = rsample
+
+    def log_prob(self, value):
+        from .. import ops
+        v = _t(value)
+        return Normal.log_prob(self, ops.log(v)) - ops.log(v)
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return Tensor((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def entropy(self):
+        return Normal.entropy(self) + self._loc_t
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self._low_t = _t(low)
+        self._high_t = _t(high)
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(),
+                               _shape(shape, self._batch_shape))
+        return _op(lambda lo, hi: lo + (hi - lo) * u,
+                   self._low_t, self._high_t, name="uniform_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return _op(lambda lo, hi, v: jnp.where(
+            (v >= lo) & (v < hi), -jnp.log(hi - lo), -jnp.inf),
+            self._low_t, self._high_t, _t(value),
+            name="uniform_log_prob")
+
+    def entropy(self):
+        shp = self._batch_shape
+        return _op(lambda lo, hi: jnp.broadcast_to(jnp.log(hi - lo),
+                                                   shp),
+                   self._low_t, self._high_t, name="uniform_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self._probs_t = _t(probs)
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(_key(),
+                               _shape(shape, self._batch_shape))
+        return Tensor((u < self.probs).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _f(pr, v):
+            p = jnp.clip(pr, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return _op(_f, self._probs_t, _t(value),
+                   name="bernoulli_log_prob")
+
+    def entropy(self):
+        def _f(pr):
+            p = jnp.clip(pr, 1e-7, 1 - 1e-7)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return _op(_f, self._probs_t, name="bernoulli_entropy")
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self._logits_t = _t(logits)
+        self.logits = _v(logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def _log_pmf(self):
+        return jax.nn.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _key(), self.logits,
+            shape=_shape(shape, self._batch_shape))
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        idx = jnp.asarray(unwrap(value), jnp.int32)
+        return _op(lambda lg: jnp.take_along_axis(
+            jax.nn.log_softmax(lg, axis=-1), idx[..., None],
+            axis=-1)[..., 0], self._logits_t,
+            name="categorical_log_prob")
+
+    def probs(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        def _f(lg):
+            lp = jax.nn.log_softmax(lg, axis=-1)
+            return -jnp.sum(jnp.exp(lp) * lp, axis=-1)
+        return _op(_f, self._logits_t, name="categorical_entropy")
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self._alpha_t = _t(alpha)
+        self._beta_t = _t(beta)
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        ga = jax.random.gamma(_key(), jnp.broadcast_to(self.alpha, shp))
+        gb = jax.random.gamma(_key(), jnp.broadcast_to(self.beta, shp))
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        def _f(a, b, v):
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - lbeta)
+        return _op(_f, self._alpha_t, self._beta_t, _t(value),
+                   name="beta_log_prob")
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def entropy(self):
+        def _f(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a)
+                     + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+        return _op(_f, self._alpha_t, self._beta_t,
+                   name="beta_entropy")
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self._conc_t = _t(concentration)
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape + self._event_shape)
+        g = jax.random.gamma(_key(),
+                             jnp.broadcast_to(self.concentration, shp))
+        return Tensor(g / jnp.sum(g, axis=-1, keepdims=True))
+
+    def log_prob(self, value):
+        def _f(a, v):
+            return (jnp.sum((a - 1) * jnp.log(v), axis=-1)
+                    + jax.scipy.special.gammaln(jnp.sum(a, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(a), -1))
+        return _op(_f, self._conc_t, _t(value),
+                   name="dirichlet_log_prob")
+
+    def entropy(self):
+        k = self.concentration.shape[-1]
+
+        def _f(a):
+            a0 = jnp.sum(a, -1)
+            dg = jax.scipy.special.digamma
+            lnB = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(a0))
+            return (lnB + (a0 - k) * dg(a0)
+                    - jnp.sum((a - 1) * dg(a), -1))
+        return _op(_f, self._conc_t, name="dirichlet_entropy")
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self._rate_t = _t(rate)
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(),
+                               _shape(shape, self._batch_shape),
+                               minval=1e-7, maxval=1.0)
+        return _op(lambda r: -jnp.log(u) / r, self._rate_t,
+                   name="exponential_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return _op(lambda r, v: jnp.log(r) - r * v,
+                   self._rate_t, _t(value), name="exponential_log_prob")
+
+    def entropy(self):
+        return _op(lambda r: 1.0 - jnp.log(r), self._rate_t,
+                   name="exponential_entropy")
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self._conc_t = _t(concentration)
+        self._rate_t = _t(rate)
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        g = jax.random.gamma(_key(),
+                             jnp.broadcast_to(self.concentration, shp))
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        return _op(lambda a, r, v: a * jnp.log(r)
+                   + (a - 1) * jnp.log(v) - r * v
+                   - jax.scipy.special.gammaln(a),
+                   self._conc_t, self._rate_t, _t(value),
+                   name="gamma_log_prob")
+
+    def entropy(self):
+        def _f(a, r):
+            dg = jax.scipy.special.digamma
+            return (a - jnp.log(r) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * dg(a))
+        return _op(_f, self._conc_t, self._rate_t,
+                   name="gamma_entropy")
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = _t(loc)
+        self._scale_t = _t(scale)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        u = jax.random.uniform(_key(),
+                               _shape(shape, self._batch_shape),
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return _op(lambda l, s: l - s * jnp.sign(u)
+                   * jnp.log1p(-2 * jnp.abs(u)),
+                   self._loc_t, self._scale_t, name="laplace_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return _op(lambda l, s, v: -jnp.abs(v - l) / s
+                   - jnp.log(2 * s), self._loc_t, self._scale_t,
+                   _t(value), name="laplace_log_prob")
+
+    def entropy(self):
+        return _op(lambda s: 1.0 + jnp.log(2 * s), self._scale_t,
+                   name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._loc_t = _t(loc)
+        self._scale_t = _t(scale)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(_key(),
+                              _shape(shape, self._batch_shape))
+        return _op(lambda l, s: l + s * g,
+                   self._loc_t, self._scale_t, name="gumbel_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def _f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+        return _op(_f, self._loc_t, self._scale_t, _t(value),
+                   name="gumbel_log_prob")
+
+    def entropy(self):
+        # Euler–Mascheroni
+        return _op(lambda s: jnp.log(s) + 1.0 + 0.57721566490153286,
+                   self._scale_t, name="gumbel_entropy")
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self._probs_t = _t(probs)
+        self.probs = _v(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        k = self.probs.shape[-1]
+        logits = jnp.log(jnp.clip(self.probs, 1e-12, None))
+        draws = jax.random.categorical(
+            _key(), logits,
+            shape=_shape(shape, self._batch_shape)
+            + (self.total_count,))
+        onehot = jax.nn.one_hot(draws, k)
+        return Tensor(jnp.sum(onehot, axis=-2))
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def _f(pr, v):
+            logp = jnp.log(jnp.clip(pr, 1e-12, None))
+            gl = jax.scipy.special.gammaln
+            return (gl(jnp.asarray(n + 1.0))
+                    - jnp.sum(gl(v + 1.0), -1)
+                    + jnp.sum(v * logp, -1))
+        return _op(_f, self._probs_t, _t(value),
+                   name="multinomial_log_prob")
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (upstream register_kl / kl_divergence)
+# ---------------------------------------------------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None and type(p) is type(q):
+        # subclass pairs may share the parent formula when KL is
+        # invariant under the subclass's bijection (e.g. LogNormal
+        # pairs reduce to their underlying Normals); mixed-type pairs
+        # must NOT fall back this way
+        for (pc, qc), f in _KL_REGISTRY.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    def _f(pl, ps, ql, qs):
+        var_ratio = (ps / qs) ** 2
+        t1 = ((pl - ql) / qs) ** 2
+        return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+    return _op(_f, p._loc_t, p._scale_t, q._loc_t, q._scale_t,
+               name="kl_normal")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    def _f(pl, ph, ql, qh):
+        result = jnp.log((qh - ql) / (ph - pl))
+        return jnp.where((pl < ql) | (ph > qh), jnp.inf, result)
+    return _op(_f, p._low_t, p._high_t, q._low_t, q._high_t,
+               name="kl_uniform")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def _f(a, b):
+        lp = jax.nn.log_softmax(a, -1)
+        lq = jax.nn.log_softmax(b, -1)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+    return _op(_f, p._logits_t, q._logits_t, name="kl_categorical")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def _f(a, b):
+        pp = jnp.clip(a, 1e-7, 1 - 1e-7)
+        qp = jnp.clip(b, 1e-7, 1 - 1e-7)
+        return (pp * (jnp.log(pp) - jnp.log(qp))
+                + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+    return _op(_f, p._probs_t, q._probs_t, name="kl_bernoulli")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    gl = jax.scipy.special.gammaln
+    dg = jax.scipy.special.digamma
+
+    def lbeta(a, b):
+        return gl(a) + gl(b) - gl(a + b)
+
+    def _f(pa, pb, qa, qb):
+        return (lbeta(qa, qb) - lbeta(pa, pb)
+                + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+                + (qa - pa + qb - pb) * dg(pa + pb))
+    return _op(_f, p._alpha_t, p._beta_t, q._alpha_t, q._beta_t,
+               name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def _f(pa, qa):
+        gl = jax.scipy.special.gammaln
+        dg = jax.scipy.special.digamma
+        pa0 = jnp.sum(pa, -1)
+        return (gl(pa0) - jnp.sum(gl(pa), -1)
+                - gl(jnp.sum(qa, -1)) + jnp.sum(gl(qa), -1)
+                + jnp.sum((pa - qa)
+                          * (dg(pa) - dg(pa0)[..., None]), -1))
+    return _op(_f, p._conc_t, q._conc_t, name="kl_dirichlet")
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _op(lambda pr, qr: jnp.log(pr) - jnp.log(qr)
+               + qr / pr - 1.0, p._rate_t, q._rate_t,
+               name="kl_exponential")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def _f(pl, ps, ql, qs):
+        scale_ratio = ps / qs
+        loc_abs = jnp.abs(pl - ql) / qs
+        return (-jnp.log(scale_ratio) - 1.0
+                + scale_ratio * jnp.exp(-loc_abs / scale_ratio)
+                + loc_abs)
+    return _op(_f, p._loc_t, p._scale_t, q._loc_t, q._scale_t,
+               name="kl_laplace")

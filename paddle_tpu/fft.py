@@ -1,0 +1,122 @@
+"""paddle.fft (parity: python/paddle/fft.py — the cuFFT-backed spectral
+ops).  TPU-native: jnp.fft lowers to XLA's FFT HLO, which the TPU
+backend executes natively — no library to wrap, and every transform is
+differentiable through jax.
+
+paddle signature notes: ``n``/``s`` pad-or-trim sizes, ``axis``/``axes``
+placement, and norm ∈ {"backward", "ortho", "forward"} all match
+upstream; inputs may be real or complex Tensors.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .ops._primitive import primitive
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    if norm not in ("backward", "ortho", "forward"):
+        raise ValueError(f"norm must be backward/ortho/forward, "
+                         f"got {norm!r}")
+    return norm
+
+
+@primitive
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@primitive
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@primitive
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@primitive
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@primitive
+def fftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.fftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.ifftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@primitive
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@primitive
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.rfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@primitive
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.irfft2(x, s=s, axes=tuple(axes), norm=_norm(norm))
+
+
+@primitive
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.rfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=_norm(norm))
+
+
+@primitive
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.hfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+@primitive
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ihfft(x, n=n, axis=axis, norm=_norm(norm))
+
+
+def fftfreq(n, d=1.0, dtype="float32"):
+    from .tensor import Tensor
+    from .framework import dtype as dtypes
+    return Tensor(jnp.fft.fftfreq(int(n), d=float(d)).astype(
+        dtypes.to_jax_dtype(dtype)))
+
+
+def rfftfreq(n, d=1.0, dtype="float32"):
+    from .tensor import Tensor
+    from .framework import dtype as dtypes
+    return Tensor(jnp.fft.rfftfreq(int(n), d=float(d)).astype(
+        dtypes.to_jax_dtype(dtype)))
+
+
+@primitive
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@primitive
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)

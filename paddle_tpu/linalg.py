@@ -4,4 +4,6 @@ from .ops.linalg import (  # noqa
     matmul, bmm, mm, dot, mv, cross, trace, norm, dist, cholesky,
     cholesky_solve, qr, svd, eig, eigh, eigvals, eigvalsh, inverse, inv,
     pinv, solve, triangular_solve, lstsq, matrix_power, matrix_rank, det,
-    slogdet, cond, lu, multi_dot, corrcoef, cov, householder_product)
+    slogdet, cond, lu, multi_dot, corrcoef, cov, householder_product,
+    matrix_exp, lu_unpack, vector_norm, matrix_norm, svd_lowrank,
+    pca_lowrank)

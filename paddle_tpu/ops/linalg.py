@@ -246,3 +246,104 @@ def cdist(x, y, p=2.0):
     if p == 0.0:
         return jnp.sum((diff != 0).astype(x.dtype), axis=-1)
     return jnp.sum(diff ** p, axis=-1) ** (1.0 / p)
+
+
+@primitive
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+@primitive
+def lu_unpack(lu_data, pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack jax's LU factorization into (P, L, U) (upstream
+    paddle.linalg.lu_unpack over paddle.linalg.lu results)."""
+    n = lu_data.shape[-2]
+    m = lu_data.shape[-1]
+    k = min(n, m)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(n, k,
+                                                   dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    # pivots (1-based sequential row swaps) → permutation matrix
+    piv = pivots.astype(jnp.int32) - 1
+
+    def perm_of(pv):
+        perm = jnp.arange(n)
+
+        def body(i, p):
+            j = pv[i]
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        return jax.lax.fori_loop(0, pv.shape[0], body, perm)
+
+    if piv.ndim == 1:
+        perm = perm_of(piv)
+        P = jnp.eye(n, dtype=lu_data.dtype)[perm].T
+    else:
+        perms = jax.vmap(perm_of)(piv.reshape(-1, piv.shape[-1]))
+        eye = jnp.eye(n, dtype=lu_data.dtype)
+        P = jnp.swapaxes(eye[perms], -1, -2).reshape(
+            lu_data.shape[:-2] + (n, n))
+    # upstream returns None placeholders for un-requested parts
+    if not unpack_ludata:
+        L = U = None
+    if not unpack_pivots:
+        P = None
+    return P, L, U
+
+
+@primitive
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    a = jnp.abs(x)
+    if p == float("inf"):
+        return jnp.max(a, axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(a, axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis,
+                       keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(a, p), axis=axis,
+                             keepdims=keepdim), 1.0 / p)
+
+
+@primitive
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis),
+                           keepdims=keepdim)
+
+
+def _lowrank_svd(x, q, niter=2, rng_key=None):
+    """Randomized range finder + small SVD (Halko et al.) — the
+    algorithm behind upstream svd_lowrank/pca_lowrank."""
+    m, n = x.shape[-2], x.shape[-1]
+    if rng_key is None:
+        from ..framework import random as _random
+        rng_key = _random.next_key()
+    key = rng_key
+    import jax.random as jrandom
+    omega = jrandom.normal(key, x.shape[:-2] + (n, q), dtype=x.dtype)
+    y = x @ omega
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = jnp.swapaxes(x, -1, -2) @ qmat
+        qz, _ = jnp.linalg.qr(z)
+        y = x @ qz
+        qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ x
+    u_b, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return qmat @ u_b, s, jnp.swapaxes(vh, -1, -2)
+
+
+@primitive
+def svd_lowrank(x, q=6, niter=2, M=None):
+    xc = x if M is None else x - M
+    return _lowrank_svd(xc, q, niter)
+
+
+@primitive
+def pca_lowrank(x, q=None, center=True, niter=2):
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    xc = x - jnp.mean(x, axis=-2, keepdims=True) if center else x
+    return _lowrank_svd(xc, q, niter)

@@ -122,7 +122,7 @@ def _transpose_opad(in_sizes, k_sizes, stride, dilation, pad, opad,
         base = (in_sizes[i] - 1) * stride[i] - pad[i][0] - pad[i][1] \
             + eff_k
         extra = want - base
-        if not 0 <= extra < stride[i] + max(dilation[i] - 1, 0) + 1:
+        if not 0 <= extra < stride[i]:
             raise ValueError(
                 f"output_size[{i}]={want} invalid: must be in "
                 f"[{base}, {base + stride[i] - 1}]")

@@ -829,6 +829,17 @@ EXEMPTIONS = {
     "lstm_layer": "nn-oracle",
     "gru_layer": "nn-oracle",
     "simple_rnn_layer": "nn-oracle",
+    # spectral / linalg-tail batch: numpy/torch-oracle tested
+    "fft": "spectral", "ifft": "spectral", "fft2": "spectral",
+    "ifft2": "spectral", "fftn": "spectral", "ifftn": "spectral",
+    "rfft": "spectral", "irfft": "spectral", "rfft2": "spectral",
+    "irfft2": "spectral", "rfftn": "spectral", "irfftn": "spectral",
+    "hfft": "spectral", "ihfft": "spectral", "fftshift": "spectral",
+    "ifftshift": "spectral", "frame": "spectral",
+    "overlap_add": "spectral",
+    "matrix_exp": "linalg", "lu_unpack": "linalg",
+    "vector_norm": "linalg", "matrix_norm": "linalg",
+    "svd_lowrank": "linalg", "pca_lowrank": "linalg",
 }
 
 EXEMPT_REASONS = {
@@ -850,6 +861,10 @@ EXEMPT_REASONS = {
     "nn-oracle": (
         "torch-oracle tested in test_losses_extra/test_nn_coverage/"
         "test_rnn (fwd + bwd through real layers)"),
+    "spectral": (
+        "complex-dtype fft/framing ops, numpy/torch-oracle tested in "
+        "test_fft_signal_distribution (the generic bf16 sweep does "
+        "not apply to complex outputs)"),
 }
 
 

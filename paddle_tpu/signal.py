@@ -1,0 +1,145 @@
+"""paddle.signal (parity: python/paddle/signal.py — frame/overlap_add/
+stft/istft).  Pure composition of reshape + jnp.fft; the framing is a
+static strided gather so the whole pipeline jits and differentiates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .ops._primitive import primitive, unwrap
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+@primitive
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice ``x`` into overlapping frames along ``axis`` → a new
+    trailing (paddle: axis=-1 → [..., frame_length, num_frames])."""
+    if axis not in (-1, x.ndim - 1, 0):
+        raise NotImplementedError("frame supports axis -1 or 0")
+    if axis == 0 and x.ndim > 1:
+        raise NotImplementedError("axis=0 framing expects 1D input")
+    n = x.shape[-1]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) exceeds the signal "
+            f"length ({n})")
+    num = 1 + (n - frame_length) // hop_length
+    idx = (np.arange(frame_length)[:, None]
+           + hop_length * np.arange(num)[None, :])
+    out = x[..., idx]                    # [..., frame_length, num]
+    return out
+
+
+@primitive
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame: [..., frame_length, num_frames] → signal."""
+    if axis not in (-1, x.ndim - 1):
+        raise NotImplementedError("overlap_add supports axis=-1 only")
+    fl = x.shape[-2]
+    num = x.shape[-1]
+    n = fl + hop_length * (num - 1)
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    for f in range(num):                 # static unroll (num is small)
+        out = out.at[..., f * hop_length:f * hop_length + fl].add(
+            x[..., :, f])
+    return out
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """Short-time Fourier transform (upstream paddle.signal.stft):
+    returns [..., n_fft//2+1 (or n_fft), num_frames] complex."""
+    from . import fft as _fft
+    from .ops._primitive import apply_closure
+    from .tensor import Tensor
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    xv = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    wv = None if window is None else unwrap(window)
+
+    def _f(v, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)],
+                        mode=pad_mode)
+        num = 1 + (v.shape[-1] - n_fft) // hop_length
+        idx = (np.arange(n_fft)[:, None]
+               + hop_length * np.arange(num)[None, :])
+        frames = v[..., idx]             # [..., n_fft, num]
+        if w is not None:
+            wfull = w
+            if win_length != n_fft:
+                lpad = (n_fft - win_length) // 2
+                wfull = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+            frames = frames * wfull[..., :, None]
+        frames = jnp.moveaxis(frames, -2, -1)   # [..., num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.moveaxis(spec, -1, -2)        # [..., freq, num]
+
+    args = [xv] + ([Tensor(wv)] if wv is not None else [])
+    return apply_closure(_f, args, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope-normalised overlap-add."""
+    if return_complex and onesided:
+        raise ValueError(
+            "return_complex=True requires onesided=False (a onesided "
+            "spectrum reconstructs a real signal)")
+    from .ops._primitive import apply_closure
+    from .tensor import Tensor
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xv = x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+    wv = None if window is None else unwrap(window)
+
+    def _f(v, *maybe_w):
+        w = maybe_w[0] if maybe_w else None
+        spec = jnp.moveaxis(v, -2, -1)           # [..., num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        if w is not None:
+            wfull = w
+            if win_length != n_fft:
+                lpad = (n_fft - win_length) // 2
+                wfull = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+        else:
+            wfull = jnp.ones((n_fft,), frames.dtype)
+        frames = frames * wfull
+        num = frames.shape[-2]
+        n = n_fft + hop_length * (num - 1)
+        sig = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        env = jnp.zeros((n,), frames.dtype)
+        for f in range(num):
+            sl = slice(f * hop_length, f * hop_length + n_fft)
+            sig = sig.at[..., sl].add(frames[..., f, :])
+            env = env.at[sl].add(wfull * wfull)
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad:n - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    args = [xv] + ([Tensor(wv)] if wv is not None else [])
+    return apply_closure(_f, args, name="istft")

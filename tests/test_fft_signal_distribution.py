@@ -1,0 +1,297 @@
+"""paddle.fft / paddle.signal / paddle.distribution / linalg-tail
+coverage — numpy and torch as oracles."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# fft
+# ---------------------------------------------------------------------------
+def test_fft_family_matches_numpy():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 16).astype(np.float32)
+    xc = (rng.randn(4, 16) + 1j * rng.randn(4, 16)).astype(np.complex64)
+    cases = [
+        (paddle.fft.fft, np.fft.fft, Tensor(xc), {}),
+        (paddle.fft.ifft, np.fft.ifft, Tensor(xc), {}),
+        (paddle.fft.rfft, np.fft.rfft, Tensor(x), {}),
+        (paddle.fft.hfft, np.fft.hfft, Tensor(xc), {}),
+        (paddle.fft.ihfft, np.fft.ihfft, Tensor(x), {}),
+        (paddle.fft.fft2, np.fft.fft2, Tensor(xc), {}),
+        (paddle.fft.fftn, np.fft.fftn, Tensor(xc), {}),
+        (paddle.fft.rfft2, np.fft.rfft2, Tensor(x), {}),
+    ]
+    for ours, ref, arg, kw in cases:
+        got = np.asarray(ours(arg, **kw).numpy())
+        want = ref(np.asarray(arg.numpy()), **kw)
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # norm + n/axis parameters and round trips
+    got = np.asarray(paddle.fft.rfft(Tensor(x), n=32,
+                                     norm="ortho").numpy())
+    np.testing.assert_allclose(got, np.fft.rfft(x, n=32, norm="ortho"),
+                               rtol=1e-4, atol=1e-4)
+    back = paddle.fft.irfft(paddle.fft.rfft(Tensor(x)), n=16)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-4,
+                               atol=1e-4)
+    fr = np.asarray(paddle.fft.fftfreq(8, d=0.5).numpy())
+    np.testing.assert_allclose(fr, np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    sh = np.asarray(paddle.fft.fftshift(Tensor(x)).numpy())
+    np.testing.assert_allclose(sh, np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_fft_differentiable():
+    x = Tensor(np.random.RandomState(1).randn(8).astype(np.float32))
+    x.stop_gradient = False
+    # |rfft(x)|^2 summed — real scalar of a complex pipeline
+    y = paddle.fft.rfft(x)
+    loss = (paddle.real(y) ** 2.0 + paddle.imag(y) ** 2.0).sum()
+    loss.backward()
+    g = np.asarray(x.grad.numpy())
+    # Parseval: d/dx sum|X|^2 ≈ 2N x (with rfft's one-sided weighting)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+# ---------------------------------------------------------------------------
+# signal
+# ---------------------------------------------------------------------------
+def test_stft_istft_roundtrip_and_torch():
+    import torch
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 256).astype(np.float32)
+    win = np.hanning(64).astype(np.float32)
+    got = paddle.signal.stft(Tensor(x), n_fft=64, hop_length=16,
+                             window=Tensor(win))
+    exp = torch.stft(torch.tensor(x), n_fft=64, hop_length=16,
+                     window=torch.tensor(win), center=True,
+                     pad_mode="reflect", return_complex=True)
+    np.testing.assert_allclose(np.asarray(got.numpy()), exp.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    back = paddle.signal.istft(got, n_fft=64, hop_length=16,
+                               window=Tensor(win), length=256)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-3,
+                               atol=1e-4)
+
+
+def test_frame_overlap_add():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 32).astype(np.float32)
+    f = paddle.signal.frame(Tensor(x), frame_length=8, hop_length=8)
+    assert f.shape == [2, 8, 4]
+    back = paddle.signal.overlap_add(f, hop_length=8)
+    np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# distribution
+# ---------------------------------------------------------------------------
+def test_normal_logprob_entropy_kl_match_torch():
+    import torch
+    import torch.distributions as td
+    p = paddle.distribution.Normal(0.5, 1.5)
+    tp = td.Normal(0.5, 1.5)
+    v = np.array([0.1, -1.0, 2.5], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(p.log_prob(Tensor(v)).numpy()),
+        tp.log_prob(torch.tensor(v)).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(float(p.entropy().numpy()),
+                               float(tp.entropy()), rtol=1e-5)
+    q = paddle.distribution.Normal(-0.3, 0.7)
+    tq = td.Normal(-0.3, 0.7)
+    np.testing.assert_allclose(
+        float(paddle.distribution.kl_divergence(p, q).numpy()),
+        float(td.kl_divergence(tp, tq)), rtol=1e-5)
+
+
+@pytest.mark.parametrize("name,args,tname", [
+    ("Uniform", (0.0, 2.0), "Uniform"),
+    ("Exponential", (1.7,), "Exponential"),
+    ("Laplace", (0.3, 1.2), "Laplace"),
+    ("Gumbel", (0.1, 0.9), "Gumbel"),
+])
+def test_scalar_distributions_match_torch(name, args, tname):
+    import torch
+    import torch.distributions as td
+    p = getattr(paddle.distribution, name)(*args)
+    tp = getattr(td, tname)(*[torch.tensor(a) for a in args])
+    v = np.array([0.2, 0.9, 1.5], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(p.log_prob(Tensor(v)).numpy()),
+        tp.log_prob(torch.tensor(v)).numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(p.entropy().numpy())
+                                     .reshape(-1)[0]),
+                               float(tp.entropy().reshape(-1)[0]),
+                               rtol=1e-4)
+
+
+def test_categorical_beta_dirichlet_gamma_match_torch():
+    import torch
+    import torch.distributions as td
+    logits = np.array([[0.5, -0.2, 1.0], [0.0, 0.0, 0.0]], np.float32)
+    c = paddle.distribution.Categorical(logits)
+    tc = td.Categorical(logits=torch.tensor(logits))
+    v = np.array([2, 0], np.int64)
+    np.testing.assert_allclose(
+        np.asarray(c.log_prob(Tensor(v)).numpy()),
+        tc.log_prob(torch.tensor(v)).numpy(), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.entropy().numpy()),
+                               tc.entropy().numpy(), rtol=1e-5)
+
+    b = paddle.distribution.Beta(2.0, 3.0)
+    tb = td.Beta(2.0, 3.0)
+    bv = np.array([0.3, 0.7], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(b.log_prob(Tensor(bv)).numpy()),
+        tb.log_prob(torch.tensor(bv)).numpy(), rtol=1e-4)
+
+    conc = np.array([1.5, 2.5, 3.0], np.float32)
+    d = paddle.distribution.Dirichlet(conc)
+    tdd = td.Dirichlet(torch.tensor(conc))
+    dv = np.array([0.2, 0.3, 0.5], np.float32)
+    np.testing.assert_allclose(
+        float(d.log_prob(Tensor(dv)).numpy()),
+        float(tdd.log_prob(torch.tensor(dv))), rtol=1e-4)
+    np.testing.assert_allclose(float(d.entropy().numpy()),
+                               float(tdd.entropy()), rtol=1e-4)
+
+    g = paddle.distribution.Gamma(2.0, 1.5)
+    tg = td.Gamma(2.0, 1.5)
+    gv = np.array([0.5, 2.0], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(g.log_prob(Tensor(gv)).numpy()),
+        tg.log_prob(torch.tensor(gv)).numpy(), rtol=1e-4)
+
+
+def test_kl_registry_and_sampling_statistics():
+    import torch.distributions as td
+    import torch
+    paddle.seed(0)
+    # sampling statistics sanity for the reparameterised families
+    n = paddle.distribution.Normal(1.0, 2.0)
+    s = np.asarray(n.rsample([20000]).numpy())
+    assert abs(s.mean() - 1.0) < 0.1 and abs(s.std() - 2.0) < 0.1
+    c = paddle.distribution.Categorical(
+        np.log(np.array([0.2, 0.8], np.float32)))
+    cs = np.asarray(c.sample([10000]).numpy())
+    assert abs(cs.mean() - 0.8) < 0.05
+    # KL pairs vs torch
+    pairs = [
+        (paddle.distribution.Beta(2.0, 3.0),
+         paddle.distribution.Beta(1.0, 1.0),
+         td.Beta(2.0, 3.0), td.Beta(1.0, 1.0)),
+        (paddle.distribution.Exponential(2.0),
+         paddle.distribution.Exponential(0.5),
+         td.Exponential(2.0), td.Exponential(0.5)),
+        (paddle.distribution.Laplace(0.0, 1.0),
+         paddle.distribution.Laplace(1.0, 2.0),
+         td.Laplace(0.0, 1.0), td.Laplace(1.0, 2.0)),
+    ]
+    for p, q, tp, tq in pairs:
+        np.testing.assert_allclose(
+            float(np.asarray(
+                paddle.distribution.kl_divergence(p, q).numpy())),
+            float(td.kl_divergence(tp, tq)), rtol=1e-4)
+    # mixed-type pairs must refuse, not silently use the parent formula
+    with pytest.raises(NotImplementedError):
+        paddle.distribution.kl_divergence(
+            paddle.distribution.Normal(0.0, 1.0),
+            paddle.distribution.LogNormal(0.0, 1.0))
+    # LogNormal pairs legitimately reduce to their underlying Normals
+    ln1 = paddle.distribution.LogNormal(0.0, 1.0)
+    ln2 = paddle.distribution.LogNormal(0.5, 2.0)
+    np.testing.assert_allclose(
+        float(np.asarray(paddle.distribution.kl_divergence(
+            ln1, ln2).numpy())),
+        float(td.kl_divergence(td.LogNormal(0.0, 1.0),
+                               td.LogNormal(0.5, 2.0))), rtol=1e-5)
+
+
+def test_reparameterised_gradients():
+    mu = Tensor(np.array(0.5, np.float32))
+    mu.stop_gradient = False
+    paddle.seed(3)
+    d = paddle.distribution.Normal(mu, 1.0)
+    loss = (d.rsample([64]) ** 2.0).mean()
+    loss.backward()
+    assert mu.grad is not None and np.isfinite(
+        np.asarray(mu.grad.numpy())).all()
+
+
+# ---------------------------------------------------------------------------
+# linalg tail
+# ---------------------------------------------------------------------------
+def test_linalg_tail():
+    import torch
+    rng = np.random.RandomState(5)
+    a = rng.randn(4, 4).astype(np.float32) * 0.3
+    got = np.asarray(paddle.linalg.matrix_exp(Tensor(a)).numpy())
+    exp = torch.matrix_exp(torch.tensor(a)).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+    v = rng.randn(3, 5).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.vector_norm(
+            Tensor(v), p=3.0, axis=1).numpy()),
+        np.sum(np.abs(v) ** 3, 1) ** (1 / 3), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(paddle.linalg.matrix_norm(Tensor(v)).numpy()),
+        np.linalg.norm(v), rtol=1e-5)
+
+    m = rng.randn(6, 6).astype(np.float32)
+    lu_data, pivots = (paddle.linalg.lu(Tensor(m))[:2]
+                       if isinstance(paddle.linalg.lu(Tensor(m)), tuple)
+                       else (None, None))
+    if lu_data is not None:
+        P, L, U = paddle.linalg.lu_unpack(lu_data, pivots)
+        rec = (np.asarray(P.numpy()) @ np.asarray(L.numpy())
+               @ np.asarray(U.numpy()))
+        np.testing.assert_allclose(rec, m, rtol=1e-4, atol=1e-4)
+
+    big = rng.randn(20, 8).astype(np.float32)
+    u, s, v_ = paddle.linalg.svd_lowrank(Tensor(big), q=8)
+    rec = (np.asarray(u.numpy()) * np.asarray(s.numpy())
+           ) @ np.asarray(v_.numpy()).T
+    np.testing.assert_allclose(rec, big, rtol=1e-3, atol=1e-3)
+
+
+def test_distribution_gradients_through_params():
+    """log_prob/entropy/kl must carry gradients back to Tensor params
+    (review finding: most formulas bypassed the tape — the policy
+    gradient / VAE use case)."""
+    logits = Tensor(np.array([[0.2, -0.1, 0.4]], np.float32))
+    logits.stop_gradient = False
+    c = paddle.distribution.Categorical(logits)
+    lp = c.log_prob(Tensor(np.array([2], np.int64)))
+    (-lp.sum()).backward()
+    g = np.asarray(logits.grad.numpy())
+    assert np.isfinite(g).all() and np.abs(g).sum() > 1e-4
+    # softmax grad rows sum to ~0
+    np.testing.assert_allclose(g.sum(-1), 0.0, atol=1e-6)
+
+    mu = Tensor(np.array(0.3, np.float32))
+    mu.stop_gradient = False
+    p = paddle.distribution.Normal(mu, 1.0)
+    q = paddle.distribution.Normal(0.0, 1.0)
+    kl = paddle.distribution.kl_divergence(p, q)
+    kl.backward()
+    # d/dmu 0.5*mu^2 = mu
+    np.testing.assert_allclose(float(mu.grad.numpy()), 0.3, rtol=1e-5)
+
+
+def test_signal_and_transpose_validation():
+    with pytest.raises(ValueError, match="frame_length"):
+        paddle.signal.frame(Tensor(np.zeros((10,), np.float32)),
+                            frame_length=16, hop_length=4)
+    with pytest.raises(ValueError, match="onesided"):
+        paddle.signal.istft(
+            Tensor(np.zeros((3, 4), np.complex64)), n_fft=4,
+            onesided=True, return_complex=True)
+    import paddle_tpu.nn.functional as F
+    x = Tensor(np.zeros((1, 2, 7), np.float32))
+    w = Tensor(np.zeros((2, 3, 4), np.float32))
+    # base 16, stride 2 → 18 must be rejected (output_padding < stride)
+    with pytest.raises(ValueError, match="output_size"):
+        F.conv1d_transpose(x, w, stride=2, output_size=[18])
