@@ -1,0 +1,44 @@
+"""Shared building blocks for the vision model zoo."""
+
+from __future__ import annotations
+
+from ... import nn
+
+
+def make_divisible(v, divisor=8, min_value=None):
+    """Round channel counts to hardware-friendly multiples (the
+    MobileNet paper rule, shared by v2/v3/shufflenet)."""
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+_ACTS = {
+    None: nn.Identity,
+    "relu": nn.ReLU,
+    "relu6": nn.ReLU6,
+    "hardswish": nn.Hardswish,
+    "swish": nn.Swish,
+}
+
+
+class ConvNormAct(nn.Layer):
+    """Conv2D + BatchNorm + activation — the block every zoo family
+    re-implemented privately; one definition, parameterised."""
+
+    def __init__(self, in_c, out_c, k, stride=1, padding=None, groups=1,
+                 act="relu"):
+        super().__init__()
+        if padding is None:
+            padding = (k - 1) // 2 if isinstance(k, int) else \
+                tuple((kk - 1) // 2 for kk in k)
+        self.conv = nn.Conv2D(in_c, out_c, k, stride=stride,
+                              padding=padding, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.act = _ACTS[act]()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))

@@ -3,14 +3,9 @@
 from __future__ import annotations
 
 from ... import nn
+from ._utils import make_divisible as _make_divisible
 
 
-def _make_divisible(v, divisor=8, min_value=None):
-    min_value = min_value or divisor
-    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
 
 
 class ConvBNReLU(nn.Sequential):

@@ -1,0 +1,71 @@
+"""Model-zoo construction + forward smoke tests (upstream
+test_vision_models.py analog): every family builds and produces
+[N, num_classes] logits; grouped/depthwise/SE/shuffle paths execute."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models as M
+from paddle_tpu.tensor import Tensor
+
+
+def _fwd(net, size=64, train=False):
+    net.train() if train else net.eval()
+    x = Tensor(np.random.RandomState(0).rand(1, 3, size, size).astype(
+        np.float32))
+    return net(x)
+
+
+@pytest.mark.parametrize("ctor,kw,size", [
+    (M.resnext50_32x4d, {"num_classes": 10}, 64),
+    (M.wide_resnet50_2, {"num_classes": 10}, 64),
+    (M.mobilenet_v1, {"num_classes": 10}, 64),
+    (M.mobilenet_v3_small, {"num_classes": 10}, 64),
+    (M.mobilenet_v3_large, {"num_classes": 10}, 64),
+    (M.shufflenet_v2_x0_25, {"num_classes": 10}, 64),
+    (M.shufflenet_v2_swish, {"num_classes": 10}, 64),
+    (M.squeezenet1_0, {"num_classes": 10}, 96),
+    (M.squeezenet1_1, {"num_classes": 10}, 96),
+    (M.densenet121, {"num_classes": 10}, 64),
+    (M.inception_v3, {"num_classes": 10}, 96),
+])
+def test_model_zoo_forward(ctor, kw, size):
+    paddle.seed(0)
+    net = ctor(**kw)
+    out = _fwd(net, size=size)
+    assert out.shape == [1, 10]
+    assert np.isfinite(np.asarray(out.numpy())).all()
+    assert len(list(net.parameters())) > 10
+
+
+def test_googlenet_aux_heads_both_modes():
+    """Upstream returns (out, aux1, aux2) in BOTH train and eval."""
+    paddle.seed(0)
+    net = M.googlenet(num_classes=10)
+    for train in (True, False):
+        out = _fwd(net, size=96, train=train)
+        assert isinstance(out, tuple) and len(out) == 3
+        assert all(o.shape == [1, 10] for o in out)
+
+
+def test_basic_block_rejects_groups():
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="BasicBlock"):
+        M.resnet18(groups=32, width=4)
+
+
+def test_resnext152_64x4d_exists():
+    assert callable(M.resnext152_64x4d)
+
+
+def test_pretrained_refuses_offline():
+    with pytest.raises(RuntimeError, match="pretrained"):
+        M.densenet121(pretrained=True)
+
+
+def test_densenet_variant_channels():
+    # densenet161 switches to growth 48 / 96-channel stem
+    net = M.densenet161(num_classes=4)
+    out = _fwd(net, size=64)
+    assert out.shape == [1, 4]
