@@ -1109,13 +1109,24 @@ _flash_core_packed.defvjp(_flash_packed_fwd, _flash_packed_bwd)
 
 
 def _packed_healthy() -> bool:
-    """Eager self-test of the packed kernel (see _pallas_healthy)."""
+    """Eager self-test of the packed kernel (see _pallas_healthy) —
+    numerics verified against the composed form, not just execution."""
     if "packed_ok" not in _PALLAS_HEALTH:
         try:
-            z = jnp.zeros((1, 256, 256), jnp.bfloat16)   # h=4, d=64
-            out, _ = _pallas_flash_packed(z, z, z, 4, 64, causal=True,
+            h, d = 4, 64
+            rng = np.random.RandomState(0)
+            z = jnp.asarray(rng.randn(1, 256, h * d), jnp.bfloat16)
+            out, _ = _pallas_flash_packed(z, z, z, h, d, causal=True,
                                           block_q=128, block_k=128)
-            jax.block_until_ready(out)
+            bh = _to_bh(z, h, d)
+            ref = _from_bh(_flash_reference(bh, bh, bh, True), 1, h)
+            err = float(jnp.max(jnp.abs(
+                out.astype(jnp.float32) - ref.astype(jnp.float32))))
+            mag = float(jnp.max(jnp.abs(ref.astype(jnp.float32))))
+            if not err < 5e-2 * max(mag, 1.0):
+                raise AssertionError(
+                    f"packed kernel self-test numerics off by {err} "
+                    f"(output magnitude {mag})")
             _PALLAS_HEALTH["packed_ok"] = True
         except Exception as e:
             _warn_once(
@@ -1176,10 +1187,24 @@ def _pallas_healthy() -> bool:
     warning instead of a hard compile error in the user's step."""
     if "ok" not in _PALLAS_HEALTH:
         try:
-            z = jnp.zeros((1, 256, 128), jnp.bfloat16)
+            rng = np.random.RandomState(0)
+            z = jnp.asarray(rng.randn(1, 256, 128),
+                            jnp.bfloat16)
             out, _ = _pallas_flash_bh(z, z, z, causal=True,
                                       block_q=128, block_k=128)
-            jax.block_until_ready(out)
+            ref = _flash_reference(z, z, z, True)
+            # numeric check, not just run-to-completion: a Mosaic
+            # layout bug can execute fine and still compute garbage.
+            # Tolerance is RELATIVE to the output magnitude (both
+            # sides are bf16-quantized; a couple of ulps at |v|~4 is
+            # benign and must not disable the kernel).
+            err = float(jnp.max(jnp.abs(
+                out.astype(jnp.float32) - ref.astype(jnp.float32))))
+            mag = float(jnp.max(jnp.abs(ref.astype(jnp.float32))))
+            if not err < 5e-2 * max(mag, 1.0):
+                raise AssertionError(
+                    f"kernel self-test numerics off by {err} "
+                    f"(output magnitude {mag})")
             _PALLAS_HEALTH["ok"] = True
         except Exception as e:
             _warn_once(

@@ -86,6 +86,9 @@ class MobileNetV3(nn.Layer):
             in_c = out_c
         self.blocks = nn.Sequential(*blocks)
         last_conv = _make_divisible(6 * in_c)
+        # classifier hidden width scales with the model (upstream
+        # parity: make_divisible(last_channel * scale))
+        last_channel = _make_divisible(last_channel * scale)
         self.conv2 = ConvBNAct(in_c, last_conv, 1, act="hardswish")
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D(1)

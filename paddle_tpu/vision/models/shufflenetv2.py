@@ -41,9 +41,9 @@ class InvertedResidual(nn.Layer):
 
 
 _STAGE_OUT = {
-    "0.25": (24, 24, 48, 96, 512), "0.33": (24, 32, 64, 128, 512),
-    "0.5": (24, 48, 96, 192, 1024), "1.0": (24, 116, 232, 464, 1024),
-    "1.5": (24, 176, 352, 704, 1024), "2.0": (24, 244, 488, 976, 2048)}
+    0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+    0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
 _REPEATS = (4, 8, 4)
 
 
@@ -53,9 +53,11 @@ class ShuffleNetV2(nn.Layer):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
-        key = {0.25: "0.25", 0.33: "0.33", 0.5: "0.5", 1.0: "1.0",
-               1.5: "1.5", 2.0: "2.0"}[float(scale)]
-        c0, c1, c2, c3, c_last = _STAGE_OUT[key]
+        if float(scale) not in _STAGE_OUT:
+            raise NotImplementedError(
+                f"ShuffleNetV2 scale {scale} unsupported; choose from "
+                f"{sorted(_STAGE_OUT)}")
+        c0, c1, c2, c3, c_last = _STAGE_OUT[float(scale)]
         self.conv1 = ConvBN(3, c0, 3, stride=2, act=act)
         self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
