@@ -8,7 +8,7 @@ ops lower through XLA, parallelism is sharding over a
 ``jax.sharding.Mesh`` instead of NCCL process groups.
 """
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # noqa
 
 import os as _os
 
@@ -38,6 +38,41 @@ from .places import (  # noqa
     CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace, XPUPlace, CustomPlace,
     set_device, get_device, is_compiled_with_cuda, is_compiled_with_rocm,
     is_compiled_with_xpu, is_compiled_with_tpu, device_count)
+from . import version  # noqa
+
+
+def is_compiled_with_cinn():
+    """CINN is replaced wholesale by XLA (SURVEY.md §2.1)."""
+    return False
+
+
+def is_compiled_with_distribute():
+    """Distributed support is always built in (XLA collectives)."""
+    return True
+
+
+def disable_signal_handler():
+    """Upstream detaches its C++ signal handlers; we install none
+    beyond the launch watchdog, so this is a compatible no-op."""
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy paddle.batch reader decorator (upstream python/paddle/
+    batch.py): group a sample reader into batches."""
+    if int(batch_size) <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
 
 from .tensor import Tensor, Parameter, to_tensor, is_tensor  # noqa
 
@@ -103,6 +138,12 @@ def is_grad_enabled():
 
 def set_grad_enabled(mode):
     return _tape_mod.set_grad_enabled(mode)
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    from .hapi.summary import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail)
 
 
 def summary(net, input_size=None, dtypes=None, input=None):

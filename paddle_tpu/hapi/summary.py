@@ -29,3 +29,60 @@ def summary(net: Layer, input_size=None, dtypes=None, input=None):
     print(f"Trainable params: {trainable:,}")
     print(f"Non-trainable params: {total_params - trainable:,}")
     return {"total_params": total_params, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """paddle.flops (upstream hapi dynamic_flops): rough multiply-add
+    count for the common layer types, via a forward hook walk.
+    ``custom_ops`` maps Layer classes to ``fn(layer, inputs, output)
+    -> flops`` counters, as upstream."""
+    import numpy as np
+    from ..tensor import Tensor
+    from .. import nn
+
+    custom_ops = custom_ops or {}
+    counts = {"total": 0}
+    hooks = []
+
+    def conv_hook(layer, inputs, output):
+        w = layer.weight
+        out_elems = int(np.prod(output.shape[2:])) * output.shape[0]
+        counts["total"] += int(np.prod(w.shape)) * out_elems
+
+    def linear_hook(layer, inputs, output):
+        batch = int(np.prod(output.shape[:-1]))
+        counts["total"] += int(np.prod(layer.weight.shape)) * batch
+
+    def make_custom_hook(fn):
+        def hook(layer, inputs, output):
+            counts["total"] += int(fn(layer, inputs, output))
+        return hook
+
+    for layer in net.sublayers(include_self=True):
+        matched = None
+        for cls, fn in custom_ops.items():
+            if isinstance(layer, cls):
+                matched = fn
+                break
+        if matched is not None:
+            hooks.append(layer.register_forward_post_hook(
+                make_custom_hook(matched)))
+        elif isinstance(layer, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+            hooks.append(layer.register_forward_post_hook(conv_hook))
+        elif isinstance(layer, nn.Linear):
+            hooks.append(layer.register_forward_post_hook(linear_hook))
+    was_training = net.training
+    net.eval()
+    try:
+        x = Tensor(np.zeros(input_size, np.float32))
+        net(x)
+    finally:
+        # eval() recursed into children; restore the whole tree
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    total = counts["total"]
+    if print_detail:
+        print(f"Total FLOPs: {total}")
+    return total

@@ -1,0 +1,34 @@
+"""paddle.version (parity: the generated python/paddle/version/
+__init__.py): version metadata + capability strings."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"      # upstream reports the cuda toolkit; TPU
+cudnn_version = "False"     # builds report False for both
+xpu_version = "False"
+istaged = True
+commit = "tpu-native"
+
+with_pip_cuda_libraries = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print(f"cuda: {cuda_version}")
+    print(f"cudnn: {cudnn_version}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return xpu_version

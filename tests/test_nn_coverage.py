@@ -288,3 +288,19 @@ def test_rnn_cell_bias_false_drops_both():
     cell = nn.LSTMCell(3, 4, bias_hh_attr=False)
     assert cell.bias_ih is None and cell.bias_hh is None
     assert len(list(cell.parameters())) == 2
+
+
+def test_top_level_compat_shims():
+    import paddle_tpu as paddle
+    assert paddle.version.full_version == paddle.__version__
+    assert paddle.is_compiled_with_cinn() is False
+    assert paddle.is_compiled_with_distribute() is True
+    paddle.disable_signal_handler()   # no-op, must not raise
+    batches = list(paddle.batch(lambda: iter(range(5)), 2)())
+    assert batches == [[0, 1], [2, 3], [4]]
+    assert list(paddle.batch(lambda: iter(range(5)), 2,
+                             drop_last=True)()) == [[0, 1], [2, 3]]
+    # flops: conv2d [1,1,4,4] k3 pad0 -> out 2x2: 9*1*1 weights * 4 * 1
+    from paddle_tpu import nn
+    f = paddle.flops(nn.Conv2D(1, 1, 3, bias_attr=False), [1, 1, 4, 4])
+    assert f == 9 * 4, f
