@@ -1,0 +1,625 @@
+"""dygraph→static control-flow conversion (dy2static).
+
+Parity: upstream `python/paddle/jit/dy2static/` — the AST transformer
+suite (IfElseTransformer, LoopTransformer, LogicalTransformer) that lets
+a dygraph function with *tensor-dependent* Python control flow run under
+`@to_static`.  Upstream rewrites to its own cond/while ops; here the
+targets are the XLA structured-control-flow primitives `lax.cond` /
+`lax.while_loop`, which is the only legal way to branch on traced values
+under `jax.jit`.
+
+Design — runtime-dispatched AST rewrite:
+
+Every `if` / `while` / `for ... in range(...)` statement is rewritten
+into a *dual-path* form.  At execution time the evaluated condition is
+probed with `is_traced`:
+
+- probe concrete (eager call, or branching on Python values inside a
+  traced function): the ORIGINAL Python statement runs — identical
+  dygraph semantics;
+- probe traced (under `jax.jit` via `@to_static`): the bodies run inside
+  generated functions handed to `lax.cond` / `lax.while_loop`.  Names
+  the block ASSIGNS are threaded explicitly — as parameters in and a
+  returned tuple out — because Python rebinding inside a nested function
+  neither sees nor updates the enclosing frame (parameters-in also
+  avoids the closure read-before-assign UnboundLocalError on patterns
+  like `x = x + 1`).  Names the block only READS resolve by closure
+  capture.  A name assigned in a branch but unbound before the
+  statement enters as an `UNDEF` sentinel; it is fine as long as every
+  consumer path assigns it first (mirrors upstream's UndefinedVar).
+
+The rewrite is observable via `to_static(fn).code` (transformed source).
+
+Converted constructs:
+- `if`/`elif`/`else` on tensor conditions → `lax.cond`
+  (including branches that BOTH terminate in `return`);
+- `while` on tensor conditions → `lax.while_loop`;
+- `for <name> in range(a[, b[, c]])` with traced bounds →
+  `lax.while_loop` over (index, carry);
+- `and`/`or`/`not` inside converted tests → `logical_and/or/not`
+  (short-circuit is preserved on the concrete path; the traced path
+  evaluates both operands, like upstream's LogicalTransformer).
+
+Deliberately NOT converted (loud `Dy2StaticError` when reached on the
+traced path; untouched Python semantics otherwise): `break`/`continue`
+under a tensor loop, early-`return` from only one branch of a tensor
+`if`, iterating a Tensor directly (use `range` over its length).
+Branch outputs must be tensors of matching shape/dtype on both paths —
+the XLA structured-control-flow contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Dy2StaticError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------------
+# runtime — the `__d2s__` object generated code calls into
+# --------------------------------------------------------------------------
+
+class _Undef:
+    """Sentinel for a name unbound at statement entry."""
+
+    def __repr__(self):
+        return "<dy2static: variable undefined before this statement>"
+
+
+UNDEF = _Undef()
+
+
+def _unwrap(x):
+    from ..tensor import Tensor
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap(x):
+    from ..tensor import Tensor
+    if isinstance(x, Tensor):
+        return x
+    if isinstance(x, (jax.Array, jax.core.Tracer)):
+        return Tensor(x)
+    return x
+
+
+def is_traced(x) -> bool:
+    """True iff `x` carries a jax tracer — i.e. the value is
+    data-dependent under `jax.jit`, so Python branching on it would
+    raise.  Concrete values keep dygraph Python semantics."""
+    return isinstance(_unwrap(x), jax.core.Tracer)
+
+
+def _pred_value(x):
+    v = _unwrap(x)
+    v = jnp.squeeze(jnp.asarray(v))
+    if v.ndim != 0:
+        raise Dy2StaticError(
+            "to_static: condition must reduce to a scalar, got shape "
+            f"{v.shape}; reduce it with paddle.any/paddle.all first")
+    return v.astype(bool)
+
+
+def env(pairs) -> Tuple[Any, ...]:
+    """Evaluate (name, thunk) pairs; unbound names become UNDEF."""
+    out = []
+    for _name, thunk in pairs:
+        try:
+            out.append(thunk())
+        except NameError:
+            out.append(UNDEF)
+    return tuple(out)
+
+
+def _tree_out(x):
+    return jax.tree_util.tree_map(_unwrap, x)
+
+
+def _tree_in(x):
+    return jax.tree_util.tree_map(_wrap, x)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, ops: Tuple):
+    """lax.cond; `ops` (current values of the assigned names, possibly
+    UNDEF) reach the branches by closure capture, not as lax operands,
+    so sentinels never have to materialize as arrays."""
+    return _tree_in(jax.lax.cond(
+        _pred_value(pred),
+        lambda: _tree_out(true_fn(*ops)),
+        lambda: _tree_out(false_fn(*ops))))
+
+
+def _check_init(names: Sequence[str], init: Tuple, what: str):
+    for n, v in zip(names, init):
+        if v is UNDEF:
+            raise Dy2StaticError(
+                f"to_static: loop variable '{n}' of a tensor-dependent "
+                f"{what} is not initialized before the loop; XLA loops "
+                "need a fixed-type carry — assign it first")
+
+
+def while_loop(cond_fn, body_fn, names: Sequence[str], init: Tuple):
+    """lax.while_loop threading the loop's assigned names as carry."""
+    _check_init(names, init, "`while`")
+    out = jax.lax.while_loop(
+        lambda u: _pred_value(cond_fn(_tree_in(u))),
+        lambda u: _tree_out(body_fn(_tree_in(u))),
+        _tree_out(init))
+    return _tree_in(out)
+
+
+def fori(start, stop, step, body_fn, names: Sequence[str], init: Tuple):
+    """`for i in range(...)` with traced bounds: lax.while_loop over
+    (index, carry); body_fn(i, carry) -> carry."""
+    _check_init(names, init, "`for`")
+    s0 = jnp.asarray(_unwrap(start))
+    s1 = jnp.asarray(_unwrap(stop))
+    st = jnp.asarray(_unwrap(step))
+    _, out = jax.lax.while_loop(
+        lambda iu: jnp.where(st > 0, iu[0] < s1, iu[0] > s1),
+        lambda iu: (iu[0] + st,
+                    _tree_out(body_fn(_wrap(iu[0]), _tree_in(iu[1])))),
+        (s0, _tree_out(init)))
+    return _tree_in(out)
+
+
+def and_(fa: Callable, fb: Callable):
+    a = fa()
+    if is_traced(a):
+        return _wrap(jnp.logical_and(_pred_value(a), _pred_value(fb())))
+    return a and fb()
+
+
+def or_(fa: Callable, fb: Callable):
+    a = fa()
+    if is_traced(a):
+        return _wrap(jnp.logical_or(_pred_value(a), _pred_value(fb())))
+    return a or fb()
+
+
+def not_(a):
+    if is_traced(a):
+        return _wrap(jnp.logical_not(_pred_value(a)))
+    return not a
+
+
+def unsupported(what: str):
+    raise Dy2StaticError(
+        f"to_static: {what} is not convertible to XLA control flow; "
+        "restructure the code (see paddle_tpu/jit/dy2static.py for the "
+        "supported subset)")
+
+
+class _Runtime:
+    UNDEF = UNDEF
+    is_traced = staticmethod(is_traced)
+    env = staticmethod(env)
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)
+    fori = staticmethod(fori)
+    and_ = staticmethod(and_)
+    or_ = staticmethod(or_)
+    not_ = staticmethod(not_)
+    unsupported = staticmethod(unsupported)
+
+
+_RT = _Runtime()
+
+
+# --------------------------------------------------------------------------
+# AST analysis
+# --------------------------------------------------------------------------
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names (re)bound by a statement block, shallow — nested
+    function/class/lambda scopes don't leak bindings out.  Generated
+    `__d2s_*` internals are excluded (probe vars and helper defs from
+    already-transformed inner statements must not enter carries)."""
+
+    def __init__(self):
+        self.names: List[str] = []
+
+    def _add(self, name):
+        if not name.startswith("__d2s_") and name not in self.names:
+            self.names.append(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._add(node.id)
+
+    def visit_FunctionDef(self, node):
+        self._add(node.name)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self._add(node.target.id)
+        else:
+            self.generic_visit(node)
+
+
+def _assigned(stmts: Sequence[ast.stmt]) -> List[str]:
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return sorted(v.names)
+
+
+def _contains(stmts, kinds, stop_at=()) -> bool:
+    barrier = stop_at + (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+    found = False
+
+    def walk(node):
+        nonlocal found
+        if found:
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, kinds):
+                found = True
+                return
+            if not isinstance(child, barrier):
+                walk(child)
+
+    root = ast.Module(body=list(stmts), type_ignores=[])
+    walk(root)
+    return found
+
+
+def _has_return(stmts) -> bool:
+    return _contains(stmts, (ast.Return,))
+
+
+def _ends_in_return(stmts) -> bool:
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _ends_in_return(last.body) and _ends_in_return(last.orelse)
+    return False
+
+
+def _has_break_continue(body) -> bool:
+    """break/continue binding to THIS loop (nested loops own theirs)."""
+    return _contains(body, (ast.Break, ast.Continue),
+                     stop_at=(ast.For, ast.While, ast.AsyncFor))
+
+
+class _LogicalInTest(ast.NodeTransformer):
+    """and/or/not → lazy __d2s__ helpers.  Operands are wrapped in
+    thunks so the concrete path keeps Python's short-circuit."""
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "and_" if isinstance(node.op, ast.And) else "or_"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = ast.Call(
+                func=ast.Attribute(value=ast.Name("__d2s__", ast.Load()),
+                                   attr=fn, ctx=ast.Load()),
+                args=[_thunk(out), _thunk(v)], keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(
+                func=ast.Attribute(value=ast.Name("__d2s__", ast.Load()),
+                                   attr="not_", ctx=ast.Load()),
+                args=[node.operand], keywords=[])
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+
+def _thunk(expr: ast.expr) -> ast.Lambda:
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                           kw_defaults=[], defaults=[]),
+        body=expr)
+
+
+def _logical(test: ast.expr) -> ast.expr:
+    new = _LogicalInTest().visit(
+        ast.parse(ast.unparse(test), mode="eval").body)
+    return ast.fix_missing_locations(new)
+
+
+def _stmt(src: str) -> List[ast.stmt]:
+    return ast.parse(textwrap.dedent(src)).body
+
+
+def _env_call(names: Sequence[str]) -> str:
+    pairs = ", ".join(f"('{n}', lambda: {n})" for n in names)
+    return f"__d2s__.env(({pairs},))" if names else "()"
+
+
+# --------------------------------------------------------------------------
+# the statement transformer
+# --------------------------------------------------------------------------
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self) -> str:
+        self._n += 1
+        return str(self._n)
+
+    # nested scopes are separate functions — not part of this trace
+    def visit_FunctionDef(self, node):
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    # ---------------- if ----------------
+    def visit_If(self, node: ast.If):
+        # `break`/`continue` cannot live inside a generated function
+        # (SyntaxError); leave the `if` untouched — the enclosing loop's
+        # own scan flags it loudly on the traced path.
+        if _contains([node], (ast.Break, ast.Continue),
+                     stop_at=(ast.For, ast.While, ast.AsyncFor)):
+            return node
+        self.generic_visit(node)
+        uid = self._uid()
+        probe = f"__d2s_c{uid}"
+        body, orelse = node.body, list(node.orelse)
+
+        has_ret = _has_return(body) or _has_return(orelse)
+        if has_ret and not (_ends_in_return(body)
+                            and _ends_in_return(orelse)):
+            return self._dual(probe, node, _stmt(
+                "__d2s__.unsupported('early `return` from only one "
+                "branch of a tensor-dependent `if`')"))
+
+        assigned = sorted(set(_assigned(body)) | set(_assigned(orelse)))
+        tname, fname = f"__d2s_t{uid}", f"__d2s_f{uid}"
+
+        def _branch_fn(name, stmts):
+            fbody = list(stmts) or [ast.Pass()]
+            if not has_ret:
+                fbody = list(stmts) + _stmt(
+                    f"return ({', '.join(assigned)},)" if assigned
+                    else "return ()")
+            return ast.FunctionDef(
+                name=name,
+                args=ast.arguments(
+                    posonlyargs=[],
+                    args=[ast.arg(arg=n) for n in assigned],
+                    kwonlyargs=[], kw_defaults=[], defaults=[]),
+                body=fbody, decorator_list=[], type_params=[])
+
+        call_src = (f"__d2s__.cond({probe}, {tname}, {fname}, "
+                    f"{_env_call(assigned)})")
+        traced_arm: List[ast.stmt] = [
+            ast.fix_missing_locations(_branch_fn(tname, body)),
+            ast.fix_missing_locations(_branch_fn(fname, orelse))]
+        if has_ret:
+            traced_arm += _stmt(f"return {call_src}")
+        elif assigned:
+            traced_arm += _stmt(
+                f"({', '.join(assigned)},) = {call_src}")
+        else:
+            traced_arm += _stmt(call_src)
+        return self._dual(probe, node, traced_arm)
+
+    def _dual(self, probe, orig_if: ast.If, traced_arm):
+        assign = ast.Assign(targets=[ast.Name(probe, ast.Store())],
+                            value=_logical(orig_if.test))
+        py_if = ast.If(test=ast.Name(probe, ast.Load()),
+                       body=orig_if.body, orelse=orig_if.orelse)
+        dispatch = ast.If(
+            test=_stmt(f"__d2s__.is_traced({probe})")[0].value,
+            body=traced_arm, orelse=[py_if])
+        return [ast.fix_missing_locations(assign),
+                ast.fix_missing_locations(dispatch)]
+
+    # ---------------- while ----------------
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        uid = self._uid()
+        probe = f"__d2s_c{uid}"
+        carry = f"__d2s_k{uid}"
+        cname, bname = f"__d2s_wc{uid}", f"__d2s_wb{uid}"
+
+        if _has_return(node.body):
+            traced_arm = _stmt(
+                "__d2s__.unsupported('`return` inside a "
+                "tensor-dependent `while` loop')")
+        elif _has_break_continue(node.body):
+            traced_arm = _stmt(
+                "__d2s__.unsupported('`break`/`continue` inside a "
+                "tensor-dependent `while` loop')")
+        else:
+            names = _assigned(node.body)
+            unpack = (f"({', '.join(names)},) = {carry}" if names
+                      else "pass")
+            cond_fn = _stmt(f"""
+                def {cname}({carry}):
+                    {unpack}
+                    return __d2s_TEST__
+            """)[0]
+            cond_fn.body[-1] = ast.Return(value=_logical(node.test))
+            body_fn = _stmt(f"""
+                def {bname}({carry}):
+                    {unpack}
+                    return ({', '.join(names)},) if True else ()
+            """)[0]
+            body_fn.body[-1] = ast.Return(value=_stmt(
+                f"({', '.join(names)},)" if names else "()")[0].value)
+            body_fn.body[-1:-1] = node.body
+            names_lit = "(" + "".join(f"'{n}', " for n in names) + ")"
+            lhs = (f"({', '.join(names)},) = " if names else "")
+            traced_arm = [ast.fix_missing_locations(cond_fn),
+                          ast.fix_missing_locations(body_fn)]
+            traced_arm += _stmt(
+                f"{lhs}__d2s__.while_loop({cname}, {bname}, "
+                f"{names_lit}, {_env_call(names)})")
+
+        assign = ast.Assign(targets=[ast.Name(probe, ast.Store())],
+                            value=_logical(node.test))
+        dispatch = ast.If(
+            test=_stmt(f"__d2s__.is_traced({probe})")[0].value,
+            body=traced_arm,
+            orelse=[ast.While(test=node.test, body=node.body,
+                              orelse=node.orelse)])
+        return [ast.fix_missing_locations(assign),
+                ast.fix_missing_locations(dispatch)]
+
+    # ---------------- for ... in range(...) ----------------
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and isinstance(node.target, ast.Name)):
+            return node  # non-range for: Python-only semantics
+        uid = self._uid()
+        tgt = node.target.id
+        carry = f"__d2s_k{uid}"
+        bname = f"__d2s_fb{uid}"
+        a = [ast.unparse(x) for x in it.args]
+        if len(a) == 1:
+            start, stop, step = "0", a[0], "1"
+        elif len(a) == 2:
+            start, stop, step = a[0], a[1], "1"
+        else:
+            start, stop, step = a[0], a[1], a[2]
+
+        if _has_return(node.body):
+            traced_arm = _stmt(
+                "__d2s__.unsupported('`return` inside a tensor-bounded "
+                "`for` loop')")
+        elif _has_break_continue(node.body):
+            traced_arm = _stmt(
+                "__d2s__.unsupported('`break`/`continue` inside a "
+                "tensor-bounded `for` loop')")
+        else:
+            names = [n for n in _assigned(node.body) if n != tgt]
+            unpack = (f"({', '.join(names)},) = {carry}" if names
+                      else "pass")
+            body_fn = _stmt(f"""
+                def {bname}({tgt}, {carry}):
+                    {unpack}
+                    return ()
+            """)[0]
+            body_fn.body[-1] = ast.Return(value=_stmt(
+                f"({', '.join(names)},)" if names else "()")[0].value)
+            body_fn.body[-1:-1] = node.body
+            names_lit = "(" + "".join(f"'{n}', " for n in names) + ")"
+            lhs = (f"({', '.join(names)},) = " if names else "")
+            traced_arm = [ast.fix_missing_locations(body_fn)]
+            traced_arm += _stmt(
+                f"{lhs}__d2s__.fori({start}, {stop}, {step}, {bname}, "
+                f"{names_lit}, {_env_call(names)})")
+
+        probes = " or ".join(
+            f"__d2s__.is_traced({s})" for s in (start, stop, step))
+        dispatch = _stmt(f"if {probes}:\n    pass\nelse:\n    pass")[0]
+        dispatch.body = traced_arm
+        dispatch.orelse = [ast.For(target=node.target, iter=node.iter,
+                                   body=node.body, orelse=node.orelse)]
+        return [ast.fix_missing_locations(dispatch)]
+
+
+# --------------------------------------------------------------------------
+# function conversion
+# --------------------------------------------------------------------------
+
+_CONVERTED: dict = {}
+
+
+def convert_function(fn: Callable) -> Tuple[Callable, Optional[str]]:
+    """Rewrite `fn`'s control flow.  Returns (converted_fn, source);
+    (fn, None) when there is nothing to convert or the source is
+    unavailable (the unconverted function still handles trace-safe
+    code).  Bound methods stay bound."""
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    cached = _CONVERTED.get(raw)
+    if cached is None:
+        cached = _CONVERTED[raw] = _convert_raw(raw)
+    new_fn, src = cached
+    if new_fn is raw:
+        return fn, src
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_fn, fn.__self__), src
+    return new_fn, src
+
+
+def _convert_raw(fn):
+    import os
+    if os.environ.get("PADDLE_TPU_NO_DY2STATIC"):
+        return fn, None
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn, None
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn, None
+    fdef.decorator_list = []  # already applied on the live object
+    tr = _ControlFlowTransformer()
+    new_body: List[ast.stmt] = []
+    for s in fdef.body:
+        out = tr.visit(s)
+        new_body.extend(out if isinstance(out, list) else [out])
+    if tr._n == 0:
+        return fn, None  # no control flow — nothing to rewrite
+    fdef.body = new_body
+
+    freevars = fn.__code__.co_freevars
+    if freevars:
+        # rebuild the closure: factory takes the freevars as args
+        factory = _stmt(f"""
+            def __d2s_factory__({', '.join(freevars)}):
+                return None
+        """)[0]
+        factory.body = [fdef, ast.Return(
+            value=ast.Name(fdef.name, ast.Load()))]
+        module = ast.Module(body=[factory], type_ignores=[])
+    else:
+        module = ast.Module(body=[fdef], type_ignores=[])
+    ast.fix_missing_locations(module)
+    new_src = ast.unparse(module)
+
+    glb = dict(fn.__globals__)
+    glb["__d2s__"] = _RT
+    try:
+        code = compile(new_src, f"<dy2static {fn.__qualname__}>", "exec")
+        exec(code, glb)
+        if freevars:
+            cells = [c.cell_contents for c in fn.__closure__]
+            new_fn = glb["__d2s_factory__"](*cells)
+        else:
+            new_fn = glb[fdef.name]
+    except Exception:
+        return fn, None
+    functools.update_wrapper(new_fn, fn)
+    return new_fn, new_src

@@ -1,4 +1,11 @@
-"""@to_static → jax.jit of the functional form."""
+"""@to_static → jax.jit of the functional form, with dy2static
+control-flow conversion (see dy2static.py) applied to the wrapped
+function so tensor-dependent Python `if`/`while`/`for range` lower to
+`lax.cond`/`lax.while_loop` instead of failing at trace time.
+
+Parity: upstream `python/paddle/jit/api.py` (to_static / StaticFunction)
++ `python/paddle/jit/dy2static/program_translator.py` (the conversion +
+per-input-signature program cache)."""
 
 from __future__ import annotations
 
@@ -12,20 +19,101 @@ from ..tensor import Tensor
 from ..nn.layer import Layer
 from ..nn import functional_call as F
 from ..framework import random as _random
+from . import dy2static
+
+
+def _check_one_spec(a, spec, where):
+    if not isinstance(a, Tensor):
+        return a
+    shape = list(getattr(spec, "shape", []))
+    if shape and len(a.shape) != len(shape):
+        raise ValueError(
+            f"to_static input {where}: rank {len(a.shape)} does not "
+            f"match input_spec {spec}")
+    for d, (got, want) in enumerate(zip(a.shape, shape)):
+        if want not in (None, -1) and got != want:
+            raise ValueError(
+                f"to_static input {where}: dim {d} is {got}, "
+                f"input_spec fixes it to {want}")
+    dt = getattr(spec, "dtype", None)
+    if dt is not None and a.dtype != dt:   # DType.__eq__ normalizes str
+        a = a.astype(dt)
+    return a
+
+
+def _normalize_call(fn, args, kwargs):
+    """Move keyword arguments that name positional parameters of `fn`
+    into positional slots, so input_spec (positional by contract, like
+    upstream) applies no matter how the user spelled the call."""
+    import inspect
+    try:
+        sig = inspect.signature(fn)
+    except (ValueError, TypeError):
+        return args, kwargs
+    pos = list(args)
+    kw = dict(kwargs)
+    n = 0
+    for p in sig.parameters.values():
+        if p.kind not in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            break
+        if n < len(args):
+            n += 1
+            continue
+        if p.name in kw:
+            pos.append(kw.pop(p.name))
+            n += 1
+        else:
+            break
+    return pos, kw
+
+
+def _apply_input_spec(spec_list, call_args, kwargs):
+    """Honor `input_spec` in the CALL path (upstream checks/casts each
+    call): dtype-cast tensor args to the spec dtype and validate rank /
+    fixed dims.  Specs match positional args in order; a tensor passed
+    by keyword matches the spec whose `.name` equals the keyword.
+    `None` dims are dynamic — any size is accepted (each distinct
+    concrete shape still compiles once, cached by jax.jit)."""
+    if not spec_list:
+        return call_args, kwargs
+    out = []
+    for i, a in enumerate(call_args):
+        out.append(_check_one_spec(a, spec_list[i], str(i))
+                   if i < len(spec_list) else a)
+    by_name = {getattr(s, "name", None): s for s in spec_list}
+    kw = {k: (_check_one_spec(v, by_name[k], repr(k)) if k in by_name
+              else v)
+          for k, v in kwargs.items()}
+    return out, kw
 
 
 class StaticFunction:
     """Callable wrapper: caches one compiled XLA program per input
     signature (shape/dtype), like upstream's program cache keyed on
-    input spec."""
+    input spec.  The wrapped function is dy2static-converted once."""
 
     def __init__(self, fn: Callable, layer: Optional[Layer] = None,
                  input_spec=None, full_graph=True):
         self._fn = fn
+        self._converted_fn, self._code = dy2static.convert_function(fn)
         self._layer = layer
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}
         functools.update_wrapper(self, fn)
+
+    @property
+    def code(self):
+        """Transformed source (upstream StaticFunction.code); the
+        original source when no control flow needed conversion."""
+        if self._code is not None:
+            return self._code
+        import inspect
+        try:
+            return inspect.getsource(
+                self._fn.__func__ if hasattr(self._fn, "__func__")
+                else self._fn)
+        except (OSError, TypeError):
+            return None
 
     def _get_layer(self, args):
         if self._layer is not None:
@@ -34,35 +122,86 @@ class StaticFunction:
             return args[0], args[1:]
         return None, args
 
+    @staticmethod
+    def _static_key(v):
+        """Value-identity key for a static kwarg (jax static_argnums
+        semantics: hashable-by-value when possible, ndarray by content,
+        object identity as last resort — never repr, which collides on
+        truncated arrays)."""
+        if isinstance(v, np.ndarray):
+            return ("nd", v.shape, str(v.dtype), v.tobytes())
+        if isinstance(v, (list, tuple)):
+            return ("seq", type(v).__name__,
+                    tuple(StaticFunction._static_key(x) for x in v))
+        if isinstance(v, dict):
+            return ("map", tuple(sorted(
+                (k, StaticFunction._static_key(x))
+                for k, x in v.items())))
+        try:
+            hash(v)
+            return ("h", v)
+        except TypeError:
+            return ("id", id(v))
+
+    @staticmethod
+    def _split_kwargs(kwargs):
+        """Tensor kwargs become traced jit inputs (a dict pytree);
+        non-tensor kwargs are compile-time static and therefore part of
+        the cache key — a changed static kwarg recompiles instead of
+        silently reusing the first call's value."""
+        tkw = {k: v._value for k, v in kwargs.items()
+               if isinstance(v, Tensor)}
+        skw = {k: v for k, v in kwargs.items()
+               if not isinstance(v, Tensor)}
+        skey = tuple(sorted(
+            (k, StaticFunction._static_key(v)) for k, v in skw.items()))
+        return tkw, skw, (tuple(sorted(tkw)), skey)
+
     def __call__(self, *args, **kwargs):
         layer, call_args = self._get_layer(args)
+        if self._input_spec:
+            call_args, kwargs = _normalize_call(
+                self._fn, call_args, kwargs)
+        call_args, kwargs = _apply_input_spec(
+            self._input_spec, list(call_args), kwargs)
         arg_vals = tuple(a._value if isinstance(a, Tensor) else a
                          for a in call_args)
+        tkw, skw, kw_key = self._split_kwargs(kwargs)
         if layer is None:
-            jitted = self._cache.get("fn")
+            key = ("fn",) + kw_key
+            jitted = self._cache.get(key)
             if jitted is None:
-                def pure(*vals):
-                    wrapped = [Tensor(v) for v in vals]
-                    out = self._fn(*wrapped, **kwargs)
+                fn = self._converted_fn
+
+                def pure(kwvals, *vals):
+                    wrapped = [Tensor(v) if v is not None else None
+                               for v in vals]
+                    kw = dict(skw)
+                    kw.update({k: Tensor(v) for k, v in kwvals.items()})
+                    out = fn(*wrapped, **kw)
                     return F.unwrap_structure(out)
                 jitted = jax.jit(pure)
-                self._cache["fn"] = jitted
-            out_vals = jitted(*arg_vals)
+                self._cache[key] = jitted
+            out_vals = jitted(tkw, *arg_vals)
             return jax.tree_util.tree_map(Tensor, out_vals)
 
         # Layer-bound: params/buffers become traced inputs
-        key = "layer"
+        key = ("layer",) + kw_key
         jitted = self._cache.get(key)
         if jitted is None:
-            fn = self._fn
+            fn = self._converted_fn
 
-            def pure(params, frozen, buffers, rng_key, *vals):
+            def pure(params, frozen, buffers, rng_key, kwvals, *vals):
                 with F.bind(layer, params, buffers, frozen) as holder:
                     from ..autograd import tape as _tape
                     with _random.key_provider(
                             _random.make_split_provider(rng_key)):
-                        wrapped = [Tensor(v) for v in vals]
-                        out = fn(*wrapped, **kwargs)
+                        wrapped = [Tensor(v) if v is not None else None
+                                   for v in vals]
+                        kw = dict(skw)
+                        kw.update({k: Tensor(v)
+                                   for k, v in kwvals.items()})
+                        out = fn(*wrapped, **kw)
                 return F.unwrap_structure(out), holder.get("buffers", {})
 
             jitted = jax.jit(pure)
@@ -72,7 +211,7 @@ class StaticFunction:
         buffers = F.buffer_dict(layer)
         rng_key = _random.default_generator().draw_key()
         out_vals, new_buffers = jitted(params, frozen, buffers, rng_key,
-                                       *arg_vals)
+                                       tkw, *arg_vals)
         # commit buffer updates (BN running stats)
         name_to_buf = dict(layer.named_buffers())
         for n, v in new_buffers.items():

@@ -8,8 +8,15 @@ import os
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# Tests compile thousands of tiny per-shape XLA programs (deep zoo
+# forwards alone hit ~500 compiles); LLVM optimization effort dominates
+# wall time, not execution.  Drop to O0 for tests — semantics unchanged,
+# execution of 64x64 shapes is negligible either way.
+if "xla_backend_optimization_level" not in flags:
+    flags = (flags + " --xla_backend_optimization_level=0"
+             " --xla_llvm_disable_expensive_passes=true").strip()
+os.environ["XLA_FLAGS"] = flags
 # keep synthetic datasets small in tests
 os.environ.setdefault("PADDLE_TPU_SYNTH_N", "512")
 
@@ -18,6 +25,14 @@ os.environ.setdefault("PADDLE_TPU_SYNTH_N", "512")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: repeat suite runs skip XLA compiles
+# entirely (measured: densenet121 forward 15s cold -> 4.8s warm).
+# Repo-local and gitignored; delete the dir to force cold compiles.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_compile_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 

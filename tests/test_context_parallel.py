@@ -16,6 +16,8 @@ from paddle_tpu.distributed.fleet.meta_parallel.context_parallel import (
 from paddle_tpu.ops.nn_ops import _sdpa
 from paddle_tpu.distributed.runner import DistributedRunner
 
+pytestmark = pytest.mark.dist
+
 
 def _need_devices(n):
     if len(jax.devices()) < n:

@@ -2,11 +2,15 @@
 class of workloads composed from this round's RNN + CTC components.
 Mirrors upstream's OCR recognition example (PaddleOCR CRNN head)."""
 
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.slow
 
 
 class CRNN(nn.Layer):

@@ -14,6 +14,8 @@ from paddle_tpu.distributed import fleet, collective
 from paddle_tpu.distributed.fleet import DistributedStrategy
 from paddle_tpu.distributed.runner import DistributedRunner
 
+pytestmark = pytest.mark.dist
+
 
 def _need_devices(n):
     if len(jax.devices()) < n:

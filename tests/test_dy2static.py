@@ -1,0 +1,351 @@
+"""dy2static control-flow conversion tests (upstream
+`test/dygraph_to_static/test_ifelse.py`, `test_loop.py`,
+`test_logical.py` analogs): tensor-dependent Python control flow in a
+`@to_static` function must compile to XLA structured control flow and
+match the eager (dygraph) result."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.jit.to_static import to_static
+from paddle_tpu.jit.dy2static import Dy2StaticError
+
+
+def T(x, dtype=np.float32):
+    return Tensor(np.asarray(x, dtype))
+
+
+# ----------------------------- if / elif / else ---------------------------
+
+def test_if_on_tensor_both_branches():
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(T([1., 2.])).numpy(), [2., 4.])
+    np.testing.assert_allclose(sf(T([-1., -2.])).numpy(), [-2., -3.])
+    # eager semantics unchanged
+    np.testing.assert_allclose(f(T([1., 2.])).numpy(), [2., 4.])
+
+
+def test_if_read_modify_write():
+    """`x = x + 1` in a branch: read-before-assign of a carried name."""
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            x = x + 1
+        else:
+            x = x - 1
+        return x
+
+    np.testing.assert_allclose(f(T([1.])).numpy(), [2.])
+    np.testing.assert_allclose(f(T([-1.])).numpy(), [-2.])
+
+
+def test_elif_chain():
+    @to_static
+    def f(x):
+        if x.sum() > 10:
+            y = x * 10
+        elif x.sum() > 0:
+            y = x * 2
+        else:
+            y = -x
+        return y
+
+    np.testing.assert_allclose(f(T([20.])).numpy(), [200.])
+    np.testing.assert_allclose(f(T([1.])).numpy(), [2.])
+    np.testing.assert_allclose(f(T([-3.])).numpy(), [3.])
+
+
+def test_if_both_branches_return():
+    @to_static
+    def f(x):
+        if x.max() > 5:
+            return x * 2
+        else:
+            return x * 3
+
+    np.testing.assert_allclose(f(T([6.])).numpy(), [12.])
+    np.testing.assert_allclose(f(T([1.])).numpy(), [3.])
+
+
+def test_if_var_defined_in_both_branches_only():
+    """y unbound before the if; both branches assign it (UndefinedVar
+    pattern)."""
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x + 10
+        else:
+            y = x - 10
+        return y
+
+    np.testing.assert_allclose(f(T([1.])).numpy(), [11.])
+    np.testing.assert_allclose(f(T([-1.])).numpy(), [-11.])
+
+
+def test_python_if_inside_jit_untouched():
+    """Branching on a Python value inside to_static stays Python."""
+    @to_static
+    def f(x, flag=True):
+        if flag:
+            return x * 2
+        return x
+
+    np.testing.assert_allclose(f(T([3.])).numpy(), [6.])
+
+
+# ----------------------------- while ---------------------------------------
+
+def test_while_on_tensor():
+    def f(x):
+        s = x * 0
+        i = 0
+        while s.sum() < 10:
+            s = s + x
+            i = i + 1
+        return s, i
+
+    sf = to_static(f)
+    s, i = sf(T([1., 1.]))
+    np.testing.assert_allclose(s.numpy(), [5., 5.])
+    assert int(np.asarray(i.numpy() if hasattr(i, "numpy") else i)) == 5
+    # dygraph path agrees
+    s2, i2 = f(T([1., 1.]))
+    np.testing.assert_allclose(s2.numpy(), [5., 5.])
+
+
+def test_while_condition_with_and():
+    @to_static
+    def f(x):
+        i = x * 0 + 0.0
+        while (i.sum() < 5) and (i.sum() >= 0):
+            i = i + 1
+        return i
+
+    np.testing.assert_allclose(f(T([0.])).numpy(), [5.])
+
+
+def test_nested_if_in_while():
+    @to_static
+    def f(x):
+        s = x * 0
+        while s.sum() < 6:
+            if s.sum() < 3:
+                s = s + 1
+            else:
+                s = s + 2
+        return s
+
+    out = f(T([0.]))
+    # 0→1→2→3→5→7 : stops at 7
+    np.testing.assert_allclose(out.numpy(), [7.])
+
+
+# ----------------------------- for range -----------------------------------
+
+def test_for_range_tensor_bound():
+    @to_static
+    def f(x, n):
+        acc = x * 0
+        for k in range(n):
+            acc = acc + x * k
+        return acc
+
+    np.testing.assert_allclose(
+        f(T([1., 1.]), T(4, np.int32)).numpy(), [6., 6.])
+
+
+def test_for_range_start_stop_step_tensor():
+    @to_static
+    def f(x, a, b):
+        acc = x * 0
+        for k in range(a, b, 2):
+            acc = acc + k
+        return acc
+
+    np.testing.assert_allclose(
+        f(T([0.]), T(1, np.int32), T(8, np.int32)).numpy(), [16.])
+
+
+def test_for_range_python_bound_untouched():
+    @to_static
+    def f(x):
+        acc = x * 0
+        for k in range(3):
+            acc = acc + x
+        return acc
+
+    np.testing.assert_allclose(f(T([2.])).numpy(), [6.])
+
+
+# ----------------------------- logical ops ---------------------------------
+
+def test_logical_not_on_tensor_condition():
+    @to_static
+    def f(x):
+        if not (x.sum() > 0):
+            y = x * 0
+        else:
+            y = x
+        return y
+
+    np.testing.assert_allclose(f(T([-2.])).numpy(), [0.])
+    np.testing.assert_allclose(f(T([2.])).numpy(), [2.])
+
+
+def test_short_circuit_preserved_eagerly():
+    """`x is not None and ...` must not evaluate the RHS when x is None
+    on the concrete path (upstream convert_logical_and laziness)."""
+    @to_static
+    def f(x, y):
+        if y is not None and y.sum() > 0:
+            return x + 1
+        else:
+            return x
+
+    np.testing.assert_allclose(f(T([1.]), None).numpy(), [1.])
+    np.testing.assert_allclose(f(T([1.]), T([5.])).numpy(), [2.])
+
+
+# ----------------------------- unsupported → loud --------------------------
+
+def test_early_return_single_branch_raises():
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return x * 3
+
+    with pytest.raises(Dy2StaticError, match="early `return`"):
+        f(T([1.]))
+
+
+def test_break_in_tensor_loop_raises():
+    @to_static
+    def f(x):
+        s = x * 0
+        while s.sum() < 10:
+            s = s + 1
+            if True:
+                break
+        return s
+
+    with pytest.raises(Dy2StaticError, match="break"):
+        f(T([0.]))
+
+
+def test_uninitialized_loop_var_raises():
+    @to_static
+    def f(x):
+        while x.sum() < 10:
+            q = x * 2  # q not bound before the loop
+            x = x + q
+        return x
+
+    with pytest.raises(Dy2StaticError, match="not initialized"):
+        f(T([1.]))
+
+
+# ----------------------------- layer-bound ---------------------------------
+
+def test_layer_forward_with_tensor_if():
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                return h * 2
+            else:
+                return h * -1
+
+    paddle.seed(0)
+    net = Net()
+    x = T(np.random.RandomState(0).randn(2, 4))
+    eager = net(x).numpy()
+    snet = to_static(Net())
+    snet.set_state_dict(net.state_dict()) if hasattr(snet, "set_state_dict") \
+        else None
+    out = snet(x)
+    assert out.numpy().shape == (2, 4)
+    assert np.isfinite(out.numpy()).all()
+
+
+# ----------------------------- .code / input_spec --------------------------
+
+def test_code_property_shows_transform():
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x
+        else:
+            y = -x
+        return y
+
+    code = f.code
+    assert code is not None and "__d2s__" in code and "cond" in code
+
+
+def test_input_spec_dtype_cast_and_dim_check():
+    from paddle_tpu.static import InputSpec
+    spec = [InputSpec(shape=[None, 4], dtype="float32")]
+
+    @to_static(input_spec=spec)
+    def f(x):
+        return x * 2
+
+    # float64 input is cast per spec; None dim accepts any batch
+    out = f(Tensor(np.ones((3, 4), np.float64)))
+    assert str(out.dtype).endswith("float32")
+    out = f(Tensor(np.ones((7, 4), np.float32)))
+    assert out.shape == [7, 4]
+    with pytest.raises(ValueError, match="dim 1"):
+        f(Tensor(np.ones((3, 5), np.float32)))
+    with pytest.raises(ValueError, match="rank"):
+        f(Tensor(np.ones((3,), np.float32)))
+
+
+def test_no_control_flow_fn_unconverted():
+    @to_static
+    def f(x):
+        return x + 1
+
+    np.testing.assert_allclose(f(T([1.])).numpy(), [2.])
+
+
+def test_kwargs_not_baked_into_cache():
+    """Different kwarg values must not reuse the first compilation
+    (upstream recompiles per input spec; kwargs are part of the key)."""
+    @to_static
+    def f(x, scale=1.0):
+        return x * scale
+
+    np.testing.assert_allclose(f(T([1.]), scale=2.0).numpy(), [2.])
+    np.testing.assert_allclose(f(T([1.]), scale=5.0).numpy(), [5.])
+    # tensor kwarg is traced, not baked as a constant
+    np.testing.assert_allclose(f(T([1.]), scale=T([3.])).numpy(), [3.])
+    np.testing.assert_allclose(f(T([1.]), scale=T([7.])).numpy(), [7.])
+
+
+def test_input_spec_applies_to_keyword_tensor():
+    from paddle_tpu.static import InputSpec
+    spec = [InputSpec(shape=[None, 4], dtype="float32", name="x")]
+
+    @to_static(input_spec=spec)
+    def f(x):
+        return x * 2
+
+    out = f(x=Tensor(np.ones((3, 4), np.float64)))
+    assert str(out.dtype).endswith("float32")
+    with pytest.raises(ValueError, match="rank"):
+        f(x=Tensor(np.ones((3,), np.float32)))

@@ -11,6 +11,8 @@ from paddle_tpu.vision.models import LeNet
 from paddle_tpu.vision.datasets import MNIST
 from paddle_tpu.io import DataLoader
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture
 def mnist_loader():

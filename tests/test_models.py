@@ -2,6 +2,8 @@
 (config 4), SURVEY.md §4: every model family gets a train-step
 convergence test and a semantics test."""
 
+import pytest
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -9,6 +11,8 @@ from paddle_tpu import nn, optimizer
 from paddle_tpu.tensor import Tensor
 from paddle_tpu.distributed import collective
 from paddle_tpu.distributed.runner import DistributedRunner
+
+pytestmark = pytest.mark.slow
 
 
 def _tiny_bert_cfg(Cls):

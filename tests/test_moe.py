@@ -13,6 +13,8 @@ from paddle_tpu.incubate.distributed.models.moe import (
     ExpertLayer, GShardGate, GroupedExpertsFFN, MoELayer, NaiveGate,
     SwitchGate, global_gather, global_scatter)
 
+pytestmark = pytest.mark.dist
+
 
 def _need_devices(n):
     if len(jax.devices()) < n:

@@ -9,6 +9,8 @@ import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 from paddle_tpu.tensor import Tensor
 
+pytestmark = pytest.mark.slow
+
 
 def _fwd(net, size=64, train=False):
     net.train() if train else net.eval()
