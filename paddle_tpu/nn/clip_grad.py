@@ -37,6 +37,12 @@ class ClipGradByValue(ClipGradBase):
             out.append((p, Tensor(jnp.clip(g._value, self.min, self.max))))
         return out
 
+    def pure_clip(self, grads):
+        """Pure tree form for the jitted engines / static Executor."""
+        import jax
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
 
 class ClipGradByNorm(ClipGradBase):
     def __init__(self, clip_norm):
@@ -61,6 +67,17 @@ class ClipGradByNorm(ClipGradBase):
                                 1.0)
             out.append((p, Tensor(g._value * scale)))
         return out
+
+    def pure_clip(self, grads):
+        """Pure tree form: per-tensor norm clip."""
+        import jax
+
+        def one(g):
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            return g * jnp.minimum(
+                self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+
+        return jax.tree_util.tree_map(one, grads)
 
 
 class ClipGradByGlobalNorm(ClipGradBase):

@@ -170,6 +170,12 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static import _static_mode_enabled, record_minimize
+        if _static_mode_enabled():
+            # static world: record the train step into the Program; the
+            # Executor compiles fwd+bwd+update as one XLA program
+            record_minimize(self, loss, parameters)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()
@@ -248,7 +254,76 @@ class Optimizer:
         out["global_step"] = self._global_step
         return out
 
+    # upstream .pdopt accumulator keys: "<param>_<slot>_<ordinal>"
+    # (paddle/fluid Optimizer._add_accumulator naming, e.g.
+    # "linear_0.w_0_moment1_0"); ours are "<param>.<slot>".  The map
+    # translates the slot vocabulary.
+    _UPSTREAM_SLOT_MAP = {
+        "moment1": "moment1", "moment2": "moment2",
+        "moment2_max": "moment2_max",
+        "beta1_pow_acc": "beta1_pow", "beta2_pow_acc": "beta2_pow",
+        "velocity": "velocity",
+        "mean_square": "mean_square", "mean_grad": "mean_grad",
+        "momentum": "momentum_acc",   # upstream rmsprop momentum slot
+        "moment": "moment",
+    }
+
+    def _maybe_import_upstream(self, sd: Dict[str, Any]) -> Dict[str, Any]:
+        """Detect a REAL-Paddle ``.pdopt`` state dict (upstream
+        accumulator key grammar) and translate it into this build's
+        format.  Upstream internal param names (``linear_0.w_0``) never
+        match this process's names, but their first-appearance order IS
+        parameter creation order — the stable identity — so groups map
+        positionally onto ``_parameter_list`` (SURVEY.md §5.4)."""
+        import re
+        import warnings
+        pat = re.compile(
+            r"^(?P<p>.+)_(?P<slot>moment1|moment2|moment2_max|"
+            r"beta1_pow_acc|beta2_pow_acc|velocity|mean_square|"
+            r"mean_grad|momentum|moment)_(?P<i>\d+)$")
+        if not any(isinstance(k, str) and pat.match(k) for k in sd):
+            return sd
+        groups: Dict[str, Dict[str, Any]] = {}
+        order: List[str] = []
+        for k, v in sd.items():
+            m = pat.match(k) if isinstance(k, str) else None
+            if m is None:
+                continue
+            pname = m.group("p")
+            slot = self._UPSTREAM_SLOT_MAP[m.group("slot")]
+            arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+            # upstream stores beta-pow accumulators as shape-[1]
+            # tensors; ours are scalars
+            if slot.endswith("_pow") and arr.size == 1:
+                arr = arr.reshape(())
+            if pname not in groups:
+                groups[pname] = {}
+                order.append(pname)
+            groups[pname][slot] = arr
+        params = self._parameter_list or []
+        if len(order) != len(params):
+            warnings.warn(
+                "optimizer.set_state_dict: upstream checkpoint has "
+                f"{len(order)} slot groups, this optimizer has "
+                f"{len(params)} parameters; importing the common "
+                "prefix by position")
+        out: Dict[str, Any] = {}
+        mw = sd.get("master_weights")
+        for upname, p in zip(order, params):
+            for slot, arr in groups[upname].items():
+                out[f"{p.name}.{slot}"] = arr
+            if isinstance(mw, dict) and upname in mw:
+                w = mw[upname]
+                out[f"{p.name}.master_weight"] = (
+                    w.numpy() if isinstance(w, Tensor)
+                    else np.asarray(w))
+        for k in ("LR_Scheduler", "global_step"):
+            if k in sd:
+                out[k] = sd[k]
+        return out
+
     def set_state_dict(self, state_dict: Dict[str, Any]):
+        state_dict = self._maybe_import_upstream(state_dict)
         self._global_step = int(state_dict.get("global_step", 0))
         if "LR_Scheduler" in state_dict and isinstance(
                 self._learning_rate, LRScheduler):

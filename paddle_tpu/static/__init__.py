@@ -14,9 +14,15 @@ Program/IR/Pass/Executor stack collapses to ~200 lines here.
 
 Execute-or-refuse contract (VERDICT.md r2 weak #5): a fetch without a
 recorded lineage raises instead of returning a stale placeholder value.
-Static *training* programs (optimizer.minimize inside the Program) are
-out of scope — use the dygraph path, which compiles the whole step
-anyway.
+
+Static *training*: ``optimizer.minimize(loss)`` under static mode
+records a train spec (``record_minimize``); ``Executor.run`` then
+compiles value_and_grad over the replayed forward plus the optimizer's
+pure update kernels into ONE XLA program per feed signature, committing
+updated parameters back to the live ``Parameter`` objects (upstream
+scope write-back semantics).  Upstream's append-backward + per-op
+optimizer graph passes collapse into jax autodiff over the recorded
+trace — same contract, TPU-native mechanism.
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..tensor import Tensor, Parameter
 from ..framework import dtype as dtypes
@@ -77,12 +84,25 @@ class Program:
         self._sym_ids: set = set()               # ids produced here
         self._compiled: Dict[Any, Any] = {}
         self._version = 0
+        self._train = None     # set by optimizer.minimize under static
 
     def global_block(self):
         return self
 
     def clone(self, for_test=False):
-        return self
+        """Snapshot copy.  ``for_test=True`` strips the recorded train
+        spec (upstream: prunes backward + optimizer ops), so running the
+        clone never updates parameters — the standard
+        train-program/eval-program pattern."""
+        cl = Program.__new__(Program)
+        cl._feed_specs = dict(self._feed_specs)
+        cl._feed_ids = dict(self._feed_ids)
+        cl._nodes = list(self._nodes)
+        cl._sym_ids = set(self._sym_ids)
+        cl._compiled = {}
+        cl._version = self._version
+        cl._train = None if for_test else self._train
+        return cl
 
     # -- recording -----------------------------------------------------------
     def _record(self, f, args, vals, kwargs, outs):
@@ -139,6 +159,31 @@ def record_op(f, args, vals, kwargs, outs):
     default_main_program()._record(f, args, vals, kwargs, outs)
 
 
+def record_minimize(optimizer, loss, parameters=None):
+    """Record ``optimizer.minimize(loss)`` into the current Program
+    (parity: upstream appends backward + optimizer ops to the block;
+    here the Executor compiles value_and_grad over the recorded forward
+    plus the optimizer's pure update kernels into ONE XLA program —
+    SURVEY.md §3.5, VERDICT r3 next #5)."""
+    prog = default_main_program()
+    sid = getattr(loss, "_sym_id", None)
+    if sid is None or sid not in prog._sym_ids:
+        raise RuntimeError(
+            "optimizer.minimize(loss): loss was not recorded in the "
+            "current Program — build it from static.data feeds under "
+            "paddle.enable_static() with this program current")
+    params = [p for p in (parameters or optimizer._parameter_list)
+              if getattr(p, "trainable", True)
+              and not getattr(p, "stop_gradient", False)]
+    if not params:
+        raise RuntimeError(
+            "optimizer.minimize: no trainable parameters to update")
+    prog._train = {"opt": optimizer, "loss_sid": sid,
+                   "params": params, "state": None}
+    prog._compiled.clear()
+    prog._version += 1
+
+
 def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
     """Declare a feed placeholder.  The returned Tensor carries a sym id
     that Executor.run substitutes with the fed value."""
@@ -182,10 +227,7 @@ class Executor:
                     "Executor.run: fetch target was not recorded in this "
                     "Program (no sym id). Only outputs of ops executed "
                     "under paddle.enable_static() with the program "
-                    "current can be fetched; static training graphs "
-                    "(optimizer.minimize inside a Program) are not "
-                    "supported on the TPU build — use dygraph, which "
-                    "compiles the whole step anyway (SURVEY.md §3.5).")
+                    "current can be fetched (SURVEY.md §3.5).")
 
         missing = [n for n in program._feed_ids if n not in feed]
         # only feeds the fetch subgraph needs are strictly required;
@@ -225,40 +267,131 @@ class Executor:
                 seen.add(id(ref))
                 param_objs.append(ref)
 
+        nodes = list(program._nodes)
+        feed_id_list = [program._feed_ids[n] for n in feed_names]
+
+        def _replay_env(fvals, pmap):
+            """Topological replay of the recorded nodes; returns the
+            full sym environment."""
+            env = dict(zip(feed_id_list, fvals))
+
+            def resolve(spec):
+                kind, ref = spec
+                if kind == "sym":
+                    return env[ref]
+                if kind == "param":
+                    return pmap[id(ref)]
+                return ref    # "raw" and "const" both pass through
+
+            for f, arg_specs, kw, out_ids in nodes:
+                vals = [resolve(s) for s in arg_specs]
+                out = f(*vals, **kw)
+                outs = out if isinstance(out, tuple) else (out,)
+                for sid, v in zip(out_ids, outs):
+                    env[sid] = v
+            return env
+
+        train = program._train
+        if train is None:
+            fn = program._compiled.get(sig)
+            if fn is None:
+                def replay(fvals, pvals):
+                    pmap = {id(p): v
+                            for p, v in zip(param_objs, pvals)}
+                    env = _replay_env(fvals, pmap)
+                    return [env[ref] if kind == "sym" else pmap[id(ref)]
+                            for kind, ref in fetch_ids]
+
+                fn = jax.jit(replay)
+                program._compiled[sig] = fn
+            results = fn(feed_vals, [p._value for p in param_objs])
+            if return_numpy:
+                return [np.asarray(jax.device_get(r)) for r in results]
+            return [Tensor(r) for r in results]
+
+        # ---- training program: one compiled fwd+bwd+update step ------
+        opt = train["opt"]
+        t_params = train["params"]
+        t_ids = {id(p) for p in t_params}
+        frozen_objs = [p for p in param_objs if id(p) not in t_ids]
+        names, used = [], set()
+        for i, p in enumerate(t_params):
+            n = getattr(p, "name", None) or f"param_{i}"
+            if n in used:
+                n = f"{n}__{i}"
+            used.add(n)
+            names.append(n)
+        if train["state"] is None:
+            base = opt.init_state_tree(
+                {n: p._value for n, p in zip(names, t_params)})
+            # honor a checkpoint restored via opt.set_state_dict BEFORE
+            # the first static step (resume: moments must not restart
+            # from zero)
+            for n in names:
+                if n in opt._state:
+                    base[n].update({k: jnp.asarray(
+                        v.numpy() if isinstance(v, Tensor) else v)
+                        for k, v in opt._state[n].items()})
+            train["state"] = base
+        loss_sid = train["loss_sid"]
+        if opt._grad_clip is not None and not hasattr(
+                opt._grad_clip, "pure_clip"):
+            raise RuntimeError(
+                "static training needs a jit-safe grad_clip "
+                "(pure_clip); ClipGradByValue/ByNorm/ByGlobalNorm all "
+                "provide one")
+        # per-param ParamAttr learning_rate / regularizer parity with
+        # the eager step()
+        decay_coeffs = {n: opt._param_decay(p)
+                        for n, p in zip(names, t_params)}
+        lr_scales = {n: p.optimize_attr.get("learning_rate", 1.0)
+                     for n, p in zip(names, t_params)}
+
         fn = program._compiled.get(sig)
         if fn is None:
-            nodes = list(program._nodes)
-            feed_id_list = [program._feed_ids[n] for n in feed_names]
+            def train_step(fvals, tvals, fzvals, state, lr):
+                def loss_fn(tv):
+                    pmap = {id(p): v for p, v in zip(t_params, tv)}
+                    pmap.update({id(p): v
+                                 for p, v in zip(frozen_objs, fzvals)})
+                    env = _replay_env(fvals, pmap)
+                    return jnp.squeeze(jnp.asarray(env[loss_sid])), env
 
-            def replay(fvals, pvals):
-                env = dict(zip(feed_id_list, fvals))
-                pmap = {id(p): v for p, v in zip(param_objs, pvals)}
-
-                def resolve(spec):
-                    kind, ref = spec
-                    if kind == "sym":
-                        return env[ref]
-                    if kind == "param":
-                        return pmap[id(ref)]
-                    return ref    # "raw" and "const" both pass through
-
-                for f, arg_specs, kw, out_ids in nodes:
-                    vals = [resolve(s) for s in arg_specs]
-                    out = f(*vals, **kw)
-                    outs = out if isinstance(out, tuple) else (out,)
-                    for sid, v in zip(out_ids, outs):
-                        env[sid] = v
+                (loss_v, env), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(tvals)
+                pdict = dict(zip(names, tvals))
+                gdict = dict(zip(names, grads))
+                new_p, new_s = opt.apply_gradients_tree(
+                    pdict, gdict, state, lr,
+                    decay_coeffs=decay_coeffs, lr_scales=lr_scales)
+                new_tvals = [new_p[n] for n in names]
+                upd = {id(p): v for p, v in zip(t_params, new_tvals)}
+                fz = {id(p): v for p, v in zip(frozen_objs, fzvals)}
                 results = []
                 for kind, ref in fetch_ids:
-                    results.append(env[ref] if kind == "sym"
-                                   else pmap[id(ref)])
-                return results
+                    if kind == "sym":
+                        results.append(env[ref])
+                    else:   # param fetch returns the POST-update value
+                        results.append(upd.get(id(ref), fz.get(id(ref))))
+                return results, new_tvals, new_s
 
-            fn = jax.jit(replay)
+            fn = jax.jit(train_step)
             program._compiled[sig] = fn
 
-        pvals = [p._value for p in param_objs]
-        results = fn(feed_vals, pvals)
+        results, new_tvals, new_state = fn(
+            feed_vals, [p._value for p in t_params],
+            [p._value for p in frozen_objs], train["state"],
+            jnp.asarray(opt.get_lr(), jnp.float32))
+        # commit: updated params become visible to the eager world and
+        # to the next run (upstream scope variable write-back)
+        for p, v in zip(t_params, new_tvals):
+            p._value = v
+        train["state"] = new_state
+        # mirror moments into the engine tree so opt.state_dict()
+        # checkpoints the live static-training state
+        opt._opt_state_tree = {n: dict(st)
+                               for n, st in new_state.items()}
+        opt._global_step += 1
         if return_numpy:
             return [np.asarray(jax.device_get(r)) for r in results]
         return [Tensor(r) for r in results]

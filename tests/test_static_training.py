@@ -1,0 +1,215 @@
+"""Static-graph TRAINING (upstream Executor.run on a Program containing
+optimizer.minimize — test/legacy/test_optimizer.py style; VERDICT r3
+next #5): a classic enable_static() train loop must converge, with the
+whole fwd+bwd+update step compiled as one XLA program."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _linreg_program(opt_factory):
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        fc = nn.Linear(4, 1)
+        pred = fc(x)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = opt_factory(fc.parameters())
+        opt.minimize(loss)
+    return main, startup, loss, fc
+
+
+def _make_data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    w = np.array([[1.0], [-2.0], [3.0], [0.5]], np.float32)
+    X = rng.randn(n, 4).astype(np.float32)
+    Y = X @ w + 0.1
+    return X, Y
+
+
+def test_static_sgd_linear_regression_converges():
+    main, startup, loss, fc = _linreg_program(
+        lambda ps: optimizer.SGD(learning_rate=0.1, parameters=ps))
+    exe = static.Executor()
+    exe.run(startup)
+    X, Y = _make_data()
+    first = None
+    for i in range(60):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+    assert first > 0.5
+    assert float(lv) < 0.02, f"did not converge: {float(lv)}"
+
+
+def test_static_adam_train_and_param_fetch():
+    main, startup, loss, fc = _linreg_program(
+        lambda ps: optimizer.Adam(learning_rate=0.1, parameters=ps))
+    exe = static.Executor()
+    X, Y = _make_data()
+    w0 = fc.weight.numpy().copy()
+    for i in range(150):
+        lv, w = exe.run(main, feed={"x": X, "y": Y},
+                        fetch_list=[loss, fc.weight])
+    # param fetch returns the post-update value, and the live Parameter
+    # was committed (visible to the eager world)
+    assert not np.allclose(w, w0)
+    np.testing.assert_allclose(w, fc.weight.numpy(), rtol=1e-6)
+    assert float(lv) < 0.05
+
+
+def test_static_train_loss_is_pre_update():
+    """Fetched loss is this step's loss (computed with pre-update
+    params), so two identical runs show strictly decreasing loss."""
+    main, startup, loss, fc = _linreg_program(
+        lambda ps: optimizer.SGD(learning_rate=0.1, parameters=ps))
+    exe = static.Executor()
+    X, Y = _make_data()
+    (l1,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    (l2,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    assert float(l2) < float(l1)
+
+
+def test_minimize_unrecorded_loss_refuses():
+    from paddle_tpu.tensor import Tensor
+    main = static.Program()
+    with static.program_guard(main):
+        fc = nn.Linear(2, 1)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=fc.parameters())
+        loose = Tensor(np.zeros((1,), np.float32))
+        with pytest.raises(RuntimeError, match="not recorded"):
+            opt.minimize(loose)
+
+
+def test_static_mlp_classification_converges():
+    """LeNet-class check scaled down: a 2-layer MLP on separable blobs
+    under enable_static() (upstream static LeNet loop analog)."""
+    rng = np.random.RandomState(1)
+    X = np.concatenate([rng.randn(32, 8) + 2, rng.randn(32, 8) - 2]) \
+        .astype(np.float32)
+    Y = np.concatenate([np.zeros(32), np.ones(32)]).astype(np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None], "int64")
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        logits = net(x)
+        loss = nn.functional.cross_entropy(logits, y)
+        opt = optimizer.Adam(learning_rate=0.05,
+                             parameters=net.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    for i in range(30):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    assert float(lv) < 0.1, f"did not converge: {float(lv)}"
+
+
+def test_clone_for_test_does_not_train():
+    """Upstream eval pattern: clone(for_test=True) must never update
+    parameters or optimizer state."""
+    main, startup, loss, fc = _linreg_program(
+        lambda ps: optimizer.SGD(learning_rate=0.1, parameters=ps))
+    exe = static.Executor()
+    X, Y = _make_data()
+    test_prog = main.clone(for_test=True)
+    w0 = fc.weight.numpy().copy()
+    (l1,) = exe.run(test_prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+    (l2,) = exe.run(test_prog, feed={"x": X, "y": Y}, fetch_list=[loss])
+    np.testing.assert_array_equal(fc.weight.numpy(), w0)
+    np.testing.assert_allclose(float(l1), float(l2))
+    # the original program still trains
+    (l3,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    assert not np.allclose(fc.weight.numpy(), w0)
+
+
+def test_static_training_optimizer_state_checkpoints():
+    """state_dict after static steps carries live Adam moments, and a
+    restored checkpoint seeds the next static run (resume contract)."""
+    main, startup, loss, fc = _linreg_program(
+        lambda ps: optimizer.Adam(learning_rate=0.05, parameters=ps))
+    exe = static.Executor()
+    X, Y = _make_data()
+    for _ in range(3):
+        exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    opt = main._train["opt"]
+    sd = opt.state_dict()
+    moment_keys = [k for k in sd if k.endswith(".moment1")]
+    assert moment_keys, f"no moments in state_dict: {list(sd)[:6]}"
+    assert any(np.abs(np.asarray(sd[k].numpy())).sum() > 0
+               for k in moment_keys), "moments are all zero"
+
+    # resume into a fresh program/optimizer
+    paddle.disable_static()
+    paddle.enable_static()
+    main2, startup2, loss2, fc2 = _linreg_program(
+        lambda ps: optimizer.Adam(learning_rate=0.05, parameters=ps))
+    fc2.set_state_dict(fc.state_dict())
+    opt2 = main2._train["opt"]
+    opt2.set_state_dict(sd)
+    # restored moments visible before any step
+    assert any(
+        float(np.abs(np.asarray(v)).sum()) > 0
+        for stt in opt2._state.values() for k, v in stt.items()
+        if k == "moment1"), "set_state_dict did not restore moments"
+    exe2 = static.Executor()
+    (lv,) = exe2.run(main2, feed={"x": X, "y": Y}, fetch_list=[loss2])
+    st = main2._train["state"]
+
+    # a fresh (no-restore) single step for comparison
+    paddle.disable_static()
+    paddle.enable_static()
+    main3, _, loss3, fc3 = _linreg_program(
+        lambda ps: optimizer.Adam(learning_rate=0.05, parameters=ps))
+    fc3.set_state_dict(fc.state_dict())
+    static.Executor().run(main3, feed={"x": X, "y": Y},
+                          fetch_list=[loss3])
+    st3 = main3._train["state"]
+    # same params, same data, same step count since restore — the only
+    # difference is the seeded moments, which must change the state
+    diffs = [float(np.abs(np.asarray(st[n]["moment1"]) -
+                          np.asarray(st3[m]["moment1"])).sum())
+             for n, m in zip(st, st3)]
+    assert max(diffs) > 1e-6, "restored moments had no effect"
+
+
+def test_static_training_honors_param_lr_and_clip():
+    """ParamAttr learning_rate=0 freezes a param; global-norm clip is
+    applied inside the compiled step."""
+    from paddle_tpu import nn as pnn
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        fc = pnn.Linear(4, 1)
+        fc.weight.optimize_attr["learning_rate"] = 0.0   # frozen lr
+        pred = fc(x)
+        loss = paddle.mean((pred - y) ** 2)
+        opt = optimizer.SGD(
+            learning_rate=0.1, parameters=fc.parameters(),
+            grad_clip=pnn.ClipGradByGlobalNorm(1e-8))
+        opt.minimize(loss)
+    exe = static.Executor()
+    X, Y = _make_data()
+    w0 = fc.weight.numpy().copy()
+    b0 = fc.bias.numpy().copy()
+    exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+    # weight frozen by per-param lr=0; bias moved by at most the tiny
+    # clipped norm
+    np.testing.assert_array_equal(fc.weight.numpy(), w0)
+    assert np.abs(fc.bias.numpy() - b0).max() < 1e-6
+    assert np.abs(fc.bias.numpy() - b0).max() > 0
