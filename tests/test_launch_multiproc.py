@@ -1,0 +1,102 @@
+"""Two-process launch integration (upstream collective tests spawn real
+subprocess pods — SURVEY.md §4; VERDICT r3 next #6): launch/main.py
+spawns 2 local ranks, they rendezvous through
+``jax.distributed.initialize`` (CPU backend) via the paddle env
+contract, run one cross-process collective, and the watchdog tears the
+pod down cleanly with workerlog.N files in place."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+pytestmark = pytest.mark.dist
+
+WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import init_parallel_env
+    from paddle_tpu.distributed.parallel import ParallelEnv
+
+    env = init_parallel_env()          # jax.distributed.initialize
+    assert jax.process_count() == 2, jax.process_count()
+    rank = env.rank
+
+    # one real cross-process collective: global sum over a mesh that
+    # spans both processes
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    local = jax.device_put(np.array([float(rank + 1)], np.float32),
+                           jax.local_devices()[0])
+    arr = jax.make_array_from_single_device_arrays(
+        (2,), NamedSharding(mesh, P("x")), [local])
+    total = jax.jit(jnp.sum,
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    val = float(total)
+    assert val == 3.0, val
+    print(f"RANK-{rank}-COLLECTIVE-OK sum={val}", flush=True)
+""")
+
+
+def test_launch_two_ranks_rendezvous_and_collective(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    log_dir = tmp_path / "log"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # the workers must see exactly ONE local CPU device each so the
+    # global mesh is 2 devices = 2 processes
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         "--job_id", "it2p", str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=240)
+    logs = {}
+    for r in (0, 1):
+        p = log_dir / f"workerlog.{r}"
+        assert p.exists(), (
+            f"missing workerlog.{r}; launcher stderr:\n{proc.stderr}")
+        logs[r] = p.read_text()
+    assert proc.returncode == 0, (
+        f"launcher rc={proc.returncode}\nstderr:\n{proc.stderr}\n"
+        f"workerlog.0:\n{logs[0]}\nworkerlog.1:\n{logs[1]}")
+    assert "finished OK" in proc.stdout
+    assert "RANK-0-COLLECTIVE-OK sum=3.0" in logs[0]
+    assert "RANK-1-COLLECTIVE-OK sum=3.0" in logs[1]
+
+
+def test_launch_watchdog_kills_pod_on_rank_death(tmp_path):
+    """One rank exits nonzero → watchdog kills the survivor and the
+    launcher reports failure (retries exhausted)."""
+    script = tmp_path / "crash.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        if rank == 1:
+            sys.exit(7)
+        time.sleep(120)   # rank 0 would hang forever without the watchdog
+    """))
+    log_dir = tmp_path / "log"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--max_restart", "0",
+         "--log_dir", str(log_dir), str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=120)
+    assert proc.returncode != 0
+    assert (log_dir / "workerlog.0").exists()
+    assert (log_dir / "workerlog.1").exists()
