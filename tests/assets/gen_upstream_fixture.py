@@ -1,0 +1,71 @@
+"""Generate byte-accurate REAL-Paddle checkpoint fixtures.
+
+Upstream wire format (paddle/python/paddle/framework/io.py paddle.save):
+a single ``pickle.dump(obj, f, protocol=2)`` where every tensor has been
+converted to a plain numpy ndarray.
+
+- ``mlp.pdparams``: Layer.state_dict — structured names → ndarray
+  (creation order preserved by dict insertion order).
+- ``mlp.pdopt``: Adam optimizer state_dict — accumulator keys in the
+  upstream ``<internal_param_name>_<slot>_<ordinal>`` grammar
+  (``linear_0.w_0_moment1_0`` …), beta-pow accumulators as shape-[1]
+  arrays, plus the ``LR_Scheduler`` sub-dict.
+
+Run once to (re)generate the committed binaries:
+    python tests/assets/gen_upstream_fixture.py
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+IN_F, HID, OUT_F = 4, 8, 2
+
+
+def params(rng):
+    # paddle Linear weight layout: [in_features, out_features]
+    return {
+        "fc1.weight": rng.randn(IN_F, HID).astype(np.float32) * 0.1,
+        "fc1.bias": rng.randn(HID).astype(np.float32) * 0.1,
+        "fc2.weight": rng.randn(HID, OUT_F).astype(np.float32) * 0.1,
+        "fc2.bias": rng.randn(OUT_F).astype(np.float32) * 0.1,
+    }
+
+
+def opt_state(rng, p):
+    # internal (framework-assigned) names in creation order; these never
+    # match another process's names — importers must map positionally
+    internal = ["linear_0.w_0", "linear_0.b_0",
+                "linear_1.w_0", "linear_1.b_0"]
+    structured = ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    steps = 3
+    sd = {}
+    for iname, sname in zip(internal, structured):
+        shape = p[sname].shape
+        sd[f"{iname}_moment1_0"] = \
+            rng.randn(*shape).astype(np.float32) * 0.01
+        sd[f"{iname}_moment2_0"] = \
+            (rng.rand(*shape).astype(np.float32) * 1e-4)
+        sd[f"{iname}_beta1_pow_acc_0"] = \
+            np.array([0.9 ** steps], np.float32)
+        sd[f"{iname}_beta2_pow_acc_0"] = \
+            np.array([0.999 ** steps], np.float32)
+    sd["LR_Scheduler"] = {"last_epoch": steps, "last_lr": 0.001}
+    return sd
+
+
+def main():
+    rng = np.random.RandomState(20260730)
+    p = params(rng)
+    with open(os.path.join(HERE, "mlp.pdparams"), "wb") as f:
+        pickle.dump(p, f, protocol=2)
+    with open(os.path.join(HERE, "mlp.pdopt"), "wb") as f:
+        pickle.dump(opt_state(rng, p), f, protocol=2)
+    print("wrote mlp.pdparams / mlp.pdopt")
+
+
+if __name__ == "__main__":
+    main()
