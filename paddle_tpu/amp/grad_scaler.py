@@ -31,6 +31,7 @@ class GradScaler:
         self._bad_steps = 0
         self._found_inf = False
         self._unscaled = False
+        self._step_called = False
 
     def is_enable(self):
         return self._enable
@@ -67,20 +68,30 @@ class GradScaler:
         self._found_inf = found
 
     def step(self, optimizer):
+        """Unscale + conditional optimizer.step.  Does NOT advance the
+        loss scale — call ``update()`` after, like upstream (paddle's
+        scaler.step/scaler.update are separate so users can interleave
+        grad clipping)."""
         if not self._enable:
             optimizer.step()
             return
+        if self._step_called:
+            raise RuntimeError(
+                "scaler.step() has already been called since the last "
+                "update(); call scaler.update() after each step")
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._step_called = True
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
         self.step(optimizer)
+        self.update()
 
     def update(self):
         self._unscaled = False
+        self._step_called = False
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

@@ -207,10 +207,63 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization (parity: python/paddle/nn/layer/norm.py
+    SpectralNorm; paper Miyato et al. 2018): ``forward(weight)`` returns
+    ``weight / sigma_max(weight)`` with the leading singular value
+    estimated by ``power_iters`` rounds of power iteration.  ``u``/``v``
+    live as buffers and advance on every TRAIN-mode forward (matching
+    upstream, whose CUDA kernel updates them in place); eval mode reuses
+    the frozen estimates."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
                  name=None):
         super().__init__()
-        raise NotImplementedError("SpectralNorm pending")
+        import numpy as np
+        self._dim = int(dim)
+        self._power_iters = int(power_iters)
+        self._epsilon = float(epsilon)
+        self._shape = list(weight_shape)
+        if not self._shape:
+            raise ValueError("SpectralNorm needs a non-scalar weight")
+        h = int(self._shape[self._dim])
+        w = int(np.prod(self._shape)) // h
+        self._h, self._w = h, w
+        from ..framework import random as _random
+        import jax
+        k1, k2 = jax.random.split(_random.default_generator().draw_key())
+        u = jax.random.normal(k1, (h,), dtype=jax.numpy.float32)
+        v = jax.random.normal(k2, (w,), dtype=jax.numpy.float32)
+        eps = self._epsilon
+        import jax.numpy as jnp
+        self.register_buffer(
+            "weight_u", Tensor(u / (jnp.linalg.norm(u) + eps)))
+        self.register_buffer(
+            "weight_v", Tensor(v / (jnp.linalg.norm(v) + eps)))
+
+    def forward(self, x):
+        import jax.numpy as jnp
+        eps = self._epsilon
+        dim = self._dim
+        val = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        perm = [dim] + [i for i in range(len(self._shape)) if i != dim]
+        mat = jnp.transpose(val, perm).reshape(self._h, self._w)
+        u = self.weight_u._value
+        v = self.weight_v._value
+        for _ in range(self._power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ mat @ v
+        if self.training:
+            # buffer swap (same mechanism as BatchNorm running stats:
+            # committed eagerly, threaded functionally under jit)
+            self.weight_u._value = u
+            self.weight_v._value = v
+        out = mat / (sigma + eps)
+        inv = [perm.index(i) for i in range(len(self._shape))]
+        return Tensor(jnp.transpose(
+            out.reshape([self._shape[i] for i in perm]), inv))
 
 
 class InstanceNorm1D(InstanceNorm2D):

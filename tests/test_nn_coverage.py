@@ -304,3 +304,119 @@ def test_top_level_compat_shims():
     from paddle_tpu import nn
     f = paddle.flops(nn.Conv2D(1, 1, 3, bias_attr=False), [1, 1, 4, 4])
     assert f == 9 * 4, f
+
+
+def test_spectral_norm_matches_svd():
+    """SpectralNorm divides by the leading singular value (power
+    iteration converges on a well-separated spectrum)."""
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(7)
+    rng = np.random.RandomState(3)
+    W = rng.randn(6, 10).astype(np.float32)
+    sn = nn.SpectralNorm(W.shape, dim=0, power_iters=50)
+    sn.train()
+    out = sn(Tensor(W))
+    sigma = np.linalg.svd(W, compute_uv=False)[0]
+    np.testing.assert_allclose(out.numpy(), W / sigma, rtol=2e-3,
+                               atol=2e-4)
+    # eval mode freezes u/v buffers
+    sn.eval()
+    u_before = sn.weight_u.numpy().copy()
+    sn(Tensor(W))
+    np.testing.assert_array_equal(sn.weight_u.numpy(), u_before)
+
+
+def test_spectral_norm_conv_weight_dim0():
+    import numpy as np
+    from paddle_tpu import nn
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    W = np.random.RandomState(0).randn(8, 3, 3, 3).astype(np.float32)
+    sn = nn.SpectralNorm(W.shape, dim=0, power_iters=30)
+    out = sn(Tensor(W))
+    assert out.shape == [8, 3, 3, 3]
+    mat = W.reshape(8, -1)
+    sigma = np.linalg.svd(mat, compute_uv=False)[0]
+    np.testing.assert_allclose(out.numpy(), W / sigma, rtol=5e-3,
+                               atol=5e-4)
+
+
+
+def test_grad_scaler_skips_step_on_inf(scaler_cls=None):
+    """found_inf contract (upstream update_loss_scaling op): an inf/nan
+    grad skips optimizer.step and decays the scale; params untouched."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, amp
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    fc = nn.Linear(4, 4)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=fc.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0,
+                            decr_every_n_nan_or_inf=1, decr_ratio=0.5)
+    w0 = fc.weight.numpy().copy()
+
+    x = Tensor(np.full((2, 4), 1e30, np.float32))
+    loss = scaler.scale(paddle.mean(fc(x) ** 2))   # overflows to inf
+    loss.backward()
+    scaler.step(opt)       # must skip
+    scaler.update()
+    opt.clear_grad()
+    np.testing.assert_array_equal(fc.weight.numpy(), w0)
+    assert float(scaler.get_loss_scaling().numpy()) == 512.0
+
+    # a finite step then proceeds and updates params
+    x = Tensor(np.ones((2, 4), np.float32))
+    loss = scaler.scale(paddle.mean(fc(x) ** 2))
+    loss.backward()
+    scaler.step(opt)
+    scaler.update()
+    assert not np.allclose(fc.weight.numpy(), w0)
+
+
+def test_grad_scaler_growth_after_good_steps():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, amp
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    fc = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.0, parameters=fc.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=8.0,
+                            incr_every_n_steps=2, incr_ratio=2.0)
+    x = Tensor(np.ones((1, 2), np.float32))
+    for i in range(4):
+        loss = scaler.scale(paddle.mean(fc(x)))
+        loss.backward()
+        scaler.step(opt)
+        scaler.update()
+        opt.clear_grad()
+    # 4 good steps / incr_every_n=2 -> two doublings
+    assert float(scaler.get_loss_scaling().numpy()) == 32.0
+
+
+def test_grad_scaler_double_step_raises():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, amp
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = nn.Linear(2, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=fc.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=8.0)
+    loss = scaler.scale(paddle.mean(fc(Tensor(np.ones((1, 2),
+                                                      np.float32)))))
+    loss.backward()
+    scaler.step(opt)
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="already been called"):
+        scaler.step(opt)
+    scaler.update()   # clears the guard
+    loss = scaler.scale(paddle.mean(fc(Tensor(np.ones((1, 2),
+                                                      np.float32)))))
+    loss.backward()
+    scaler.step(opt)
