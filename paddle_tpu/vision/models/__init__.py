@@ -24,3 +24,6 @@ from .inceptionv3 import InceptionV3, inception_v3  # noqa
 from .vision_transformer import (  # noqa
     VisionTransformer, vit_b_16, vit_l_16)
 from .alexnet import AlexNet, alexnet  # noqa
+from .ppyoloe import (  # noqa
+    PPYOLOE, CSPResNet, CSPPAN, PPYOLOEHead, ppyoloe_crn_s,
+    ppyoloe_tiny)

@@ -1,0 +1,154 @@
+"""PP-YOLOE-class detector end-to-end (VERDICT r3 next #3 /
+BASELINE.json config 5): assemble backbone+neck+head, train on bucketed
+dynamic-shape batches with padded gt boxes, loss must decrease; eval
+path produces NMS'd detections."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.vision.models.ppyoloe import (
+    PPYOLOE, ppyoloe_tiny, ppyoloe_crn_s, task_aligned_assign,
+    _make_anchors, _pairwise_iou, _giou)
+
+pytestmark = pytest.mark.slow
+
+
+def _synth_batch(rng, B, size, num_classes=4, gmax=3):
+    """Images with colored rectangles; gt = the rectangles."""
+    imgs = np.zeros((B, 3, size, size), np.float32)
+    boxes = np.zeros((B, gmax, 4), np.float32)
+    labels = np.zeros((B, gmax), np.int64)
+    mask = np.zeros((B, gmax), np.float32)
+    for b in range(B):
+        n = rng.randint(1, gmax + 1)
+        for g in range(n):
+            w, h = rng.randint(size // 4, size // 2, 2)
+            x1 = rng.randint(0, size - w)
+            y1 = rng.randint(0, size - h)
+            c = rng.randint(0, num_classes)
+            imgs[b, c % 3, y1:y1 + h, x1:x1 + w] = 1.0
+            boxes[b, g] = [x1, y1, x1 + w, y1 + h]
+            labels[b, g] = c
+            mask[b, g] = 1.0
+    return imgs, boxes, labels, mask
+
+
+def test_tal_assigner_dense_contract():
+    """Dense TAL: positives only inside valid gt boxes; padded gts
+    never assigned."""
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    points, stride = _make_anchors([(8, 8), (4, 4)], [8, 16])
+    A = points.shape[0]
+    B, G, C = 2, 3, 4
+    scores = jnp.asarray(rng.rand(B, A, C).astype(np.float32)) * 0.5
+    pred = jnp.concatenate([points - 8.0, points + 8.0], -1)[None] \
+        .repeat(B, 0)
+    gt = jnp.asarray([[[0, 0, 32, 32], [40, 40, 64, 64], [0, 0, 0, 0]],
+                      [[8, 8, 56, 56], [0, 0, 0, 0], [0, 0, 0, 0]]],
+                     jnp.float32)
+    lbl = jnp.asarray([[1, 2, 0], [3, 0, 0]], jnp.int32)
+    msk = jnp.asarray([[1, 1, 0], [1, 0, 0]], jnp.float32)
+    pos, agt, ascore, _ = task_aligned_assign(
+        scores, pred, points, gt, lbl, msk)
+    pos = np.asarray(pos)
+    agt = np.asarray(agt)
+    assert pos.any(), "no positives assigned"
+    # a positive anchor's center must lie inside its assigned gt
+    pts = np.asarray(points)
+    for b in range(B):
+        for a in np.where(pos[b])[0]:
+            g = agt[b, a]
+            assert msk[b, g] == 1.0, "padded gt assigned"
+            x, y = pts[a]
+            x1, y1, x2, y2 = np.asarray(gt)[b, g]
+            assert x1 <= x <= x2 and y1 <= y <= y2
+    assert (np.asarray(ascore) >= 0).all()
+    assert np.asarray(ascore)[~pos.astype(bool)].max() == 0.0
+
+
+def test_detector_builds_and_eval_shapes():
+    paddle.seed(0)
+    net = ppyoloe_tiny(num_classes=4)
+    net.eval()
+    x = Tensor(np.random.RandomState(0).rand(1, 3, 64, 64)
+               .astype(np.float32))
+    scores, boxes = net(x)
+    A = 8 * 8 + 4 * 4 + 2 * 2
+    assert scores.shape == [1, A, 4]
+    assert boxes.shape == [1, A, 4]
+    outs = net.postprocess(scores, boxes, score_threshold=0.0,
+                           keep_top_k=10)
+    assert len(outs) == 1 and outs[0].shape[1] == 6
+
+
+def test_detector_trains_loss_decreases_bucketed():
+    """One compiled program per image-size bucket (64 and 96); loss
+    decreases >40% over a short schedule."""
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    net = ppyoloe_tiny(num_classes=4)
+    net.train()
+    opt = optimizer.Adam(learning_rate=5e-3,
+                         parameters=net.parameters())
+    batches = {64: _synth_batch(rng, 2, 64),
+               96: _synth_batch(rng, 2, 96)}
+    first_by_bucket, last_by_bucket = {}, {}
+    for step in range(30):
+        size = (64, 96)[step % 2]   # bucketed dynamic shapes
+        imgs, boxes, labels, mask = batches[size]
+        out = net(Tensor(imgs), gt_boxes=Tensor(boxes),
+                  gt_labels=Tensor(labels), gt_mask=Tensor(mask))
+        loss = out["loss"]
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lv = float(loss.numpy())
+        assert np.isfinite(lv), f"loss blew up at step {step}"
+        first_by_bucket.setdefault(size, lv)
+        last_by_bucket[size] = lv
+    for size in (64, 96):
+        assert last_by_bucket[size] < 0.5 * first_by_bucket[size], (
+            f"bucket {size}: {first_by_bucket[size]} -> "
+            f"{last_by_bucket[size]}")
+
+
+def test_detector_jit_train_step_compiles_once_per_bucket():
+    """The whole train step (assignment + losses included) is
+    jittable — the TPU-first design claim of the module header."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn import functional_call as F
+
+    paddle.seed(1)
+    net = ppyoloe_tiny(num_classes=4)
+    net.train()
+    rng = np.random.RandomState(1)
+
+    compiles = []
+
+    @jax.jit
+    def loss_only(params, frozen, buffers, imgs, boxes, labels, mask):
+        compiles.append(1)
+        with F.bind(net, params, buffers, frozen):
+            out = net(Tensor(imgs), gt_boxes=Tensor(boxes),
+                      gt_labels=Tensor(labels), gt_mask=Tensor(mask))
+        return out["loss"]._value
+
+    params = F.param_dict(net)
+    frozen = F.frozen_dict(net)
+    buffers = F.buffer_dict(net)
+    for step in range(4):
+        imgs, boxes, labels, mask = _synth_batch(rng, 2, 64)
+        lv = loss_only(params, frozen, buffers, imgs, boxes, labels,
+                       mask)
+    assert np.isfinite(float(lv))
+    assert len(compiles) == 1, "train step retraced per call"
+
+
+def test_ppyoloe_s_factory():
+    net = ppyoloe_crn_s(num_classes=10)
+    assert len(list(net.parameters())) > 50
