@@ -46,14 +46,17 @@ def _maybe_force_cpu():
         jax.config.update("jax_platforms", "cpu")
 
 
-def _timed_bench(build, steps):
+def _timed_bench(build, steps, pipeline_steps=0, batch_gen=None,
+                 runner_kwargs=None):
     """Shared scaffold: build (model, opt, loss, data) then time steps.
 
     `build` returns (net, opt, loss_fn, inputs, labels, units_per_step).
-    Returns (units/sec, step_ms) over `steps` timed steps after
-    compile + warmup.  Inputs are staged to the device once up front
-    (an input pipeline overlaps this transfer in real training).
-    """
+    Returns (units/sec, step_ms[, pipeline_units/sec]) over `steps`
+    timed steps after compile + warmup.  The base measurement stages
+    inputs once; when `batch_gen` is given, a second loop feeds FRESH
+    host batches through the DataLoader's device double-buffer
+    (_DevicePrefetcher) so the number includes real input-pipeline
+    overlap (VERDICT r3 next #8)."""
     _maybe_force_cpu()
     import jax
     import paddle_tpu as paddle
@@ -66,7 +69,8 @@ def _timed_bench(build, steps):
     net, opt, loss_fn, inputs, labels, units = build()
     mesh = collective.build_mesh({})
     collective.set_mesh(mesh)
-    runner = DistributedRunner(net, opt, loss_fn, mesh=mesh)
+    runner = DistributedRunner(net, opt, loss_fn, mesh=mesh,
+                               **(runner_kwargs or {}))
     inputs = [Tensor(jax.device_put(v)) for v in inputs]
     labels = [Tensor(jax.device_put(v)) for v in labels]
 
@@ -80,7 +84,32 @@ def _timed_bench(build, steps):
     jax.block_until_ready(runner._opt_state)
     float(loss)
     dt = time.perf_counter() - t0
-    return units * steps / dt, dt / steps * 1000.0
+    if not (pipeline_steps and batch_gen):
+        return units * steps / dt, dt / steps * 1000.0
+
+    # input-pipeline overlap: fresh batches, host gen + H2D double
+    # buffered ahead of the consuming step
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+
+    def gen():
+        for i in range(pipeline_steps):
+            xs, ys = batch_gen(i)
+            yield ([Tensor(v) for v in xs], [Tensor(v) for v in ys])
+
+    it = _DevicePrefetcher(gen(), depth=2)
+    first = next(it)
+    runner.train_step(*first)   # same shapes — no recompile
+    jax.block_until_ready(runner._opt_state)   # sync before timing
+    t0 = time.perf_counter()
+    n = 0
+    for batch_in, batch_lb in it:
+        loss = runner.train_step(batch_in, batch_lb)
+        n += 1
+    jax.block_until_ready(runner._opt_state)
+    float(loss)
+    dt2 = time.perf_counter() - t0
+    return (units * steps / dt, dt / steps * 1000.0,
+            units * n / dt2 if n else 0.0)
 
 
 def bench_gpt():
@@ -121,7 +150,18 @@ def bench_gpt():
         y = np.roll(x, -1, axis=1)
         return (net, opt, GPTPretrainingCriterion(), [x], [y], batch * seq)
 
-    tps, step_ms = _timed_bench(build, steps=2 if tiny else 15)
+    def batch_gen(i):
+        rng = np.random.RandomState(1000 + i)
+        vocab = 1024 if tiny else 50304
+        b, s = (2, 64) if tiny else (8, 1024)
+        x = rng.randint(0, vocab, (b, s)).astype(np.int64)
+        return [x], [np.roll(x, -1, axis=1)]
+
+    res = _timed_bench(build, steps=2 if tiny else 15,
+                       pipeline_steps=3 if tiny else 10,
+                       batch_gen=batch_gen)
+    tps, step_ms = res[0], res[1]
+    tps_pipe = res[2] if len(res) > 2 else None
     # model flops per token (matmul-only, PaLM-style accounting):
     # 6*N for the dense/embedding matmuls + 6*L*d*S for causal
     # attention (12*L*d*S non-causal halved)
@@ -133,6 +173,9 @@ def bench_gpt():
         L, d, S = 12, 768, 1024
         flops_tok = 6.0 * n_params + 6.0 * L * d * S
     out = {"tokens_per_sec": tps, "step_ms": round(step_ms, 2)}
+    if tps_pipe:
+        out["tokens_per_sec_pipeline"] = round(tps_pipe, 1)
+        out["pipeline_overlap_ratio"] = round(tps_pipe / tps, 3)
     if flops_tok:
         peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
         out["model_tflops_per_sec"] = round(tps * flops_tok / 1e12, 2)
@@ -146,20 +189,27 @@ def bench_resnet():
     from paddle_tpu import amp, nn, optimizer
     from paddle_tpu.vision import models as vmodels
 
-    batch = 64
+    tiny = bool(os.environ.get("GRAFT_BENCH_TINY"))  # mechanics smoke
+    batch, size, classes = (4, 32, 10) if tiny else (64, 224, 1000)
 
     def build():
-        net = vmodels.resnet50()
+        net = vmodels.resnet18(num_classes=classes) if tiny \
+            else vmodels.resnet50()
         opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                  parameters=net.parameters(),
                                  multi_precision=True)
         amp.decorate(net, opt, level="O2", dtype="bfloat16")
         rng = np.random.RandomState(0)
-        x = rng.rand(batch, 3, 224, 224).astype(np.float32)
-        y = rng.randint(0, 1000, (batch,)).astype(np.int64)
+        x = rng.rand(batch, 3, size, size).astype(np.float32)
+        y = rng.randint(0, classes, (batch,)).astype(np.int64)
         return (net, opt, nn.CrossEntropyLoss(), [x], [y], batch)
 
-    ips, step_ms = _timed_bench(build, steps=10)
+    # conv needs the auto_cast hook under O2: BN outputs stay fp32,
+    # the hook casts conv inputs back to bf16 (upstream O2 forward
+    # runs inside auto_cast)
+    ips, step_ms = _timed_bench(
+        build, steps=2 if tiny else 10,
+        runner_kwargs={"amp_level": "O2", "amp_dtype": "bfloat16"})
     # ResNet-50 fwd flops ~4.1 GFLOP/image at 224x224; train ~3x
     flops_img = 3.0 * 4.1e9
     peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
@@ -304,13 +354,16 @@ def main():
         out["value"] = round(tps, 1)
         out["vs_baseline"] = round(tps / BASELINE_TOKENS_PER_SEC, 3)
         for k in ("step_ms", "mfu", "model_tflops_per_sec",
-                  "flops_per_token_m"):
+                  "flops_per_token_m", "tokens_per_sec_pipeline",
+                  "pipeline_overlap_ratio"):
             if k in gpt:
                 out["gpt_" + k] = gpt[k]
     else:
         out["error"] = err[-2000:]
 
-    if (gpt is not None and remaining() > 120
+    # ResNet-50 gets its slot whenever budget remains — even after a
+    # GPT failure (VERDICT r3: images/s never landed in 3 rounds)
+    if (remaining() > 120
             and not os.environ.get("GRAFT_BENCH_GPT_ONLY")):
         resnet, _rerr = _run_child("resnet", remaining())
         if resnet is not None:

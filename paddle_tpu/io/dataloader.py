@@ -100,6 +100,74 @@ class _PrefetchIterator:
         self._stop.set()
 
 
+class _DevicePrefetcher:
+    """Host→HBM double buffering (SURVEY.md §2.1 DataLoader row;
+    upstream's use_buffer_reader / CUDA double-buffer reader).
+
+    Keeps ``depth`` batches in flight on the device: each batch is
+    ``jax.device_put`` as soon as the host thread produces it, so the
+    H2D transfer of batch N+1 overlaps the compute of batch N (jax
+    transfers are async; dispatching the put is enough to start it).
+    On CPU the put is a no-op alias — safe everywhere."""
+
+    def __init__(self, inner, depth: int = 2):
+        import collections
+        self._inner = inner
+        self._it = iter(inner)
+        self._buf = collections.deque()
+        self._depth = max(1, depth)
+        self._exhausted = False
+        self._pending_err = None
+
+    def __getattr__(self, name):
+        # transparent wrapper: the inner iterator's surface (native
+        # reader close()/stats()/_threads, prefetch _stop, ...) stays
+        # reachable
+        return getattr(self.__dict__["_inner"], name)
+
+    @staticmethod
+    def _stage(item):
+        import jax
+
+        def put(x):
+            if isinstance(x, Tensor):
+                return Tensor(jax.device_put(x._value))
+            if isinstance(x, (list, tuple)):
+                return type(x)(put(v) for v in x)
+            if isinstance(x, dict):
+                return {k: put(v) for k, v in x.items()}
+            return x
+        return put(item)
+
+    def _fill(self):
+        while not self._exhausted and len(self._buf) < self._depth:
+            try:
+                self._buf.append(self._stage(next(self._it)))
+            except StopIteration:
+                self._exhausted = True
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._pending_err is not None and not self._buf:
+            # drain buffered good batches first; the error surfaces at
+            # the position of the batch that caused it
+            err, self._pending_err = self._pending_err, None
+            raise err
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        out = self._buf.popleft()
+        try:
+            self._fill()   # start the next H2D now
+        except BaseException as e:
+            # don't lose the good batch already popped: surface the
+            # producer's error at ITS position, on the next call
+            self._pending_err = e
+        return out
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -154,12 +222,15 @@ class DataLoader:
             from .. import native
             if native.available():
                 from .native_reader import NativeMapIterator
-                return NativeMapIterator(
+                it = NativeMapIterator(
                     self.dataset, [list(b) for b in self.batch_sampler],
                     self.collate_fn, self.num_workers,
                     self.prefetch_factor, self.worker_init_fn)
+                return _DevicePrefetcher(it) if self.use_buffer_reader \
+                    else it
         if self.use_buffer_reader:
-            return _PrefetchIterator(self._generate, self.prefetch_factor)
+            return _DevicePrefetcher(
+                _PrefetchIterator(self._generate, self.prefetch_factor))
         return self._generate()
 
     def __len__(self):

@@ -58,13 +58,18 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     else:
         dn = jax.lax.conv_dimension_numbers(x.shape, weight.shape,
                                             ("NHWC", "OIHW", "NHWC"))
+    # bf16 convs: the MXU always accumulates in fp32 internally; asking
+    # for an fp32 OUTPUT (preferred_element_type) and casting back is
+    # numerically identical AND breaks jax's conv transpose rule (the
+    # weight-grad conv gets an fp32 cotangent against bf16 inputs) — so
+    # keep the native output dtype.
     out = jax.lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
-        feature_group_count=groups,
-        preferred_element_type=(jnp.float32 if x.dtype == jnp.bfloat16
-                                else None))
+        feature_group_count=groups)
     if out.dtype != x.dtype:
+        # mixed-dtype inputs (manual mixed precision): output follows
+        # the ACTIVATION dtype, the paddle contract
         out = out.astype(x.dtype)
     if bias is not None:
         b = bias.reshape((1, -1, 1, 1) if data_format == "NCHW"
@@ -85,6 +90,10 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups)
+    if out.dtype != x.dtype:
+        # mixed-dtype inputs (manual mixed precision): output follows
+        # the ACTIVATION dtype, the paddle contract
+        out = out.astype(x.dtype)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1)
     return out
@@ -102,6 +111,10 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         x, weight, window_strides=stride, padding=pad,
         rhs_dilation=dilation, dimension_numbers=dn,
         feature_group_count=groups)
+    if out.dtype != x.dtype:
+        # mixed-dtype inputs (manual mixed precision): output follows
+        # the ACTIVATION dtype, the paddle contract
+        out = out.astype(x.dtype)
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1, 1)
     return out

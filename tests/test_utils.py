@@ -19,3 +19,39 @@ def test_try_import():
 
 def test_flatten():
     assert paddle.utils.flatten([1, [2, (3, 4)], 5]) == [1, 2, 3, 4, 5]
+
+
+def test_device_prefetcher_double_buffers():
+    """use_buffer_reader=True stages batches to device ahead of
+    consumption; order and values are preserved, buffers live on
+    device (committed jax arrays)."""
+    import numpy as np
+    import jax
+    from paddle_tpu.io import DataLoader, Dataset
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+    from paddle_tpu.tensor import Tensor
+
+    class DS(Dataset):
+        def __len__(self):
+            return 10
+
+        def __getitem__(self, i):
+            return (np.full((3,), i, np.float32), np.int64(i))
+
+    dl = DataLoader(DS(), batch_size=2, shuffle=False,
+                    use_buffer_reader=True)
+    it = iter(dl)
+    assert isinstance(it, _DevicePrefetcher)
+    seen = []
+    for xb, yb in it:
+        assert isinstance(xb._value, jax.Array)
+        assert xb._value.is_fully_addressable
+        seen.append(int(yb.numpy()[0]))
+    assert seen == [0, 2, 4, 6, 8]
+
+    # depth batches are staged ahead of the first __next__
+    src = iter(dl._generate())
+    pf = _DevicePrefetcher(src, depth=2)
+    first = next(pf)
+    assert len(pf._buf) == 2   # refilled right after the pop
+    assert int(first[1].numpy()[0]) == 0
