@@ -268,6 +268,10 @@ class PipelineParallel:
         cfg = strategy.pipeline_configs if strategy else {}
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
         self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        # remat each stage's forward during bwd (GPipe-with-remat:
+        # the memory trade that recovers 1F1B's advantage — module
+        # header); expose the knob so the trade is measurable
+        self.remat_stage = bool(cfg.get("remat_stage", True))
         self._train_fn = None          # pipelined (pp>1) compiled step
         self._inline_fn = None         # pp=1 compiled step (distinct sig)
         self._plan = None
@@ -491,7 +495,8 @@ class PipelineParallel:
                     sp = {(j, local): pa[stack_name(j, local)]
                           for (j, local) in stack_index}
 
-                    fn = jax.checkpoint(stage_fn)
+                    fn = jax.checkpoint(stage_fn) \
+                        if self.remat_stage else stage_fn
                     T = M + P_deg - 1
                     pad = jnp.zeros((P_deg - 1,) + h.shape[1:], h.dtype)
                     h_pad = jnp.concatenate([h, pad], 0)

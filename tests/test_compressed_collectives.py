@@ -1,0 +1,151 @@
+"""Quantized DCN all-reduce (EQuARX-style) on the virtual CPU mesh
+(SURVEY.md §5.8 / M6; VERDICT r3 missing #8 CPU-mesh simulation)."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from paddle_tpu.distributed.compressed import (
+    quantized_all_reduce, bf16_all_reduce, compressed_psum_tree)
+
+pytestmark = pytest.mark.dist
+
+
+def _need(n):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("x",))
+
+
+def _run_allreduce(fn, per_rank, n):
+    """per_rank: [n, ...] — row r is rank r's local shard."""
+    mesh = _mesh(n)
+    f = shard_map(fn, mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+    return np.asarray(f(per_rank))
+
+
+def test_int8_allreduce_matches_exact_sum():
+    n = 4
+    _need(n)
+    rng = np.random.RandomState(0)
+    per_rank = rng.randn(n, 8192).astype(np.float32)
+    want = per_rank.sum(0)
+
+    got = _run_allreduce(
+        lambda x: quantized_all_reduce(x[0], "x")[None], per_rank, n)
+    # noise floor: ONE direct block quantization of the exact sum
+    from paddle_tpu.distributed.compressed import (_block_quant,
+                                                   _block_dequant)
+    q, s = _block_quant(jnp.asarray(want), 256, 8,
+                        jax.random.PRNGKey(0))
+    floor = np.abs(np.asarray(_block_dequant(q, s)) - want).mean()
+    # the W-hop ring re-quantizes partials; error must stay within a
+    # small multiple of the single-quantization floor (measured ~1.5x)
+    for r in range(n):
+        err = np.abs(got[r] - want).mean()
+        assert err < 3 * floor, (r, err, floor)
+    assert np.abs(got[0] - got[1]).max() < \
+        0.1 * np.abs(want).max() + 1e-3
+
+
+def test_int8_allreduce_error_is_small_and_zero_mean():
+    """Stochastic rounding: bias across many trials ~0, per-element
+    noise bounded by a few quantization steps."""
+    n = 8
+    _need(n)
+    rng = np.random.RandomState(1)
+    per_rank = rng.randn(n, 4096).astype(np.float32)
+    want = per_rank.sum(0)
+    mesh = _mesh(n)
+
+    errs = []
+    for trial in range(5):
+        f = shard_map(
+            lambda x, t=trial: quantized_all_reduce(
+                x[0], "x", key=jax.random.PRNGKey(100 + t))[None],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+        got = np.asarray(f(per_rank)).reshape(n, -1)[0]
+        errs.append(got - want)
+    errs = np.stack(errs)
+    # block=256 over ~±4σ sums: scale ≈ max/127; noise ≤ ~few steps
+    step = np.abs(per_rank).max() * n / 127
+    assert np.abs(errs).max() < 4 * step
+    assert abs(errs.mean()) < 0.05 * step
+
+
+def test_bf16_allreduce_close():
+    n = 4
+    _need(n)
+    rng = np.random.RandomState(2)
+    per_rank = rng.randn(n, 1024).astype(np.float32)
+    want = per_rank.sum(0)
+    mesh = _mesh(n)
+    f = shard_map(lambda x: bf16_all_reduce(x[0], "x")[None],
+                  mesh=mesh, in_specs=P("x"), out_specs=P("x"))
+    got = np.asarray(f(per_rank)).reshape(n, -1)[0]
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)
+
+
+def test_compressed_tree_modes():
+    n = 4
+    _need(n)
+    rng = np.random.RandomState(3)
+    g1 = rng.randn(n, 512).astype(np.float32)
+    g2 = rng.randn(n, 16, 33).astype(np.float32)   # non-multiple size
+    mesh = _mesh(n)
+    for mode in ("none", "bf16", "int8"):
+        f = shard_map(
+            lambda a, b, m=mode: jax.tree_util.tree_map(
+                lambda v: v[None],
+                compressed_psum_tree({"a": a[0], "b": b[0]}, "x",
+                                     mode=m)),
+            mesh=mesh, in_specs=(P("x"), P("x")),
+            out_specs={"a": P("x"), "b": P("x")})
+        out = f(g1, g2)
+        tol = 0.0 if mode == "none" else 0.05
+        np.testing.assert_allclose(
+            np.asarray(out["a"]).reshape(n, -1)[0], g1.sum(0),
+            rtol=tol + 1e-6, atol=tol * np.abs(g1.sum(0)).max() + 1e-5)
+        np.testing.assert_allclose(
+            np.asarray(out["b"]).reshape(n, 16, 33)[0], g2.sum(0),
+            rtol=tol + 1e-6, atol=tol * np.abs(g2.sum(0)).max() + 1e-5)
+
+
+def test_dp_training_step_with_compressed_grads():
+    """Integration: a dp=4 data-parallel SGD step whose gradient
+    all-reduce runs int8-quantized converges like the exact one."""
+    n = 4
+    _need(n)
+    mesh = _mesh(n)
+    rng = np.random.RandomState(4)
+    W0 = rng.randn(16, 1).astype(np.float32) * 0.1
+    Wtrue = rng.randn(16, 1).astype(np.float32)
+    X = rng.randn(n * 8, 16).astype(np.float32)
+    Y = X @ Wtrue
+
+    def step(w, x, y, mode):
+        def loss(w_):
+            return jnp.mean((x @ w_ - y) ** 2)
+        g = jax.grad(loss)(w)
+        g = compressed_psum_tree({"w": g}, "x", mode=mode)["w"] / n
+        return w - 0.1 * g
+
+    for mode in ("none", "int8"):
+        w = W0
+        for i in range(60):
+            # out_specs P("x") then take rank 0: the result IS
+            # replicated mathematically, but jax can't statically
+            # prove it through ppermute
+            f2 = shard_map(
+                lambda x, y, w_=w, m=mode: step(w_, x, y, m)[None],
+                mesh=mesh, in_specs=(P("x"), P("x")),
+                out_specs=P("x"))
+            w = np.asarray(f2(X, Y))[0]
+        final = float(np.mean((X @ w - Y) ** 2))
+        assert final < 0.05, f"mode {mode} did not converge: {final}"
