@@ -26,7 +26,8 @@ from typing import List, Tuple
 import numpy as np
 
 from ...nn.layer import Layer
-from .cost_model import MeshCostInfo, all_reduce_cost
+from .cost_model import (MeshCostInfo, all_gather_cost,
+                         all_reduce_cost)
 
 # practical bf16 matmul throughput to price FLOP savings against
 # (v5e-class; ranking-only, same caveat as the comm numbers)
@@ -51,11 +52,18 @@ def _linear_chains(model: Layer) -> List[Tuple[Layer, Layer]]:
     pairs = []
     from ...nn.common import Linear
 
+    def _ours(lin):
+        # unannotated, or annotated BY A PREVIOUS PLANNER RUN (marked
+        # _auto_planned) — keeps re-planning idempotent while never
+        # touching user-placed weights
+        spec = getattr(lin.weight, "dist_spec", None)
+        return spec is None or getattr(lin.weight, "_auto_planned",
+                                       False)
+
     def walk(layer):
         lins = []
         for child in layer.children():
-            if isinstance(child, Linear) and \
-                    getattr(child.weight, "dist_spec", None) is None:
+            if isinstance(child, Linear) and _ours(child):
                 lins.append(child)
             elif not list(child.parameters()):
                 continue   # activation/dropout: chain-transparent
@@ -120,11 +128,105 @@ def plan_tensor_parallel(model: Layer, mesh: MeshCostInfo,
                     float(tokens_per_step) * k_in * itemsize,
                     mp_axis, mesh))
         e = PlanEntry(a, b, saved, comm)
-        if saved > comm:
+        already = (getattr(a.weight, "dist_spec", None) is not None
+                   and getattr(b.weight, "dist_spec", None) is not None)
+        if saved > comm or already:
             a.weight.dist_spec = (None, mp_axis)
+            a.weight._auto_planned = True
             if getattr(a, "bias", None) is not None:
                 a.bias.dist_spec = (mp_axis,)
+                a.bias._auto_planned = True
             b.weight.dist_spec = (mp_axis, None)
+            b.weight._auto_planned = True
             e.applied = True
         entries.append(e)
     return entries
+
+
+# ---------------------------------------------------------------------------
+# whole-model planning (dp + ZeRO stage by memory + tp where priced in)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ModelPlan:
+    """Decisions for an arbitrary model (upstream parallel-tuner
+    output, reduced to the load-bearing choices)."""
+
+    dp_degree: int
+    sharding_stage: int              # 0..3 (0 = pure dp)
+    sharding_degree: int
+    tp_entries: List[PlanEntry]
+    param_bytes: float               # per-replica, after tp
+    mem_bytes: float                 # est. per-device params+grads+opt
+    extra_comm_us: float             # stage-3 per-step all-gather price
+    reason: str = ""
+
+
+def _model_param_bytes(model: Layer, mp: int, dtype) -> float:
+    """Per-replica parameter bytes with tp-sharded weights divided."""
+    itemsize = np.dtype(dtype).itemsize if dtype != "bfloat16" else 2
+    total = 0.0
+    for p in model.parameters():
+        n = float(np.prod(p.shape)) * itemsize
+        spec = getattr(p, "dist_spec", None)
+        if spec is not None and mp > 1:
+            # divide only when the spec actually shards on the mp axis
+            axes = set()
+            for d in spec:
+                if isinstance(d, (list, tuple)):
+                    axes.update(d)
+                elif d is not None:
+                    axes.add(d)
+            if "mp" in axes:
+                n /= mp
+        total += n
+    return total
+
+
+def plan_model(model: Layer, mesh: MeshCostInfo, tokens_per_step: int,
+               hbm_bytes: float = 16e9, dp_axis: str = "dp",
+               sharding_axis: str = "sharding", mp_axis: str = "mp",
+               dtype="bfloat16",
+               optimizer_bytes_per_param: float = 12.0) -> ModelPlan:
+    """Plan ANY model: tp where the cost model prices it in (transformer
+    matmul chains; conv nets simply get no profitable pairs), dp on the
+    batch, and the LOWEST ZeRO stage whose per-device footprint fits
+    ``hbm_bytes`` (upstream sharding-stage selection logic; stage 3's
+    per-step parameter all-gather is priced and reported).
+
+    ``optimizer_bytes_per_param``: 12 = Adam-class fp32 master + two
+    moments per bf16 param."""
+    tp_entries = plan_tensor_parallel(model, mesh, tokens_per_step,
+                                      mp_axis, dtype=dtype)
+    mp = mesh.size(mp_axis)
+    P = _model_param_bytes(model, mp, dtype)
+    S = max(mesh.size(sharding_axis), 1)
+    grad_b = P                       # grads in param dtype
+    opt_b = (P / 2.0) * optimizer_bytes_per_param \
+        if dtype == "bfloat16" else P * 3.0
+    stages = {
+        0: P + grad_b + opt_b,
+        1: P + grad_b + opt_b / S,
+        2: P + grad_b / S + opt_b / S,
+        3: P / S + grad_b / S + opt_b / S,
+    }
+    stage = 0
+    for st in (0, 1, 2, 3):
+        stage = st
+        if stages[st] <= hbm_bytes:
+            break
+    if S <= 1:
+        stage = 0
+    extra = 0.0
+    if stage == 3:
+        # stage-3 re-gathers the sharded params every step (fwd+bwd)
+        extra = 2.0 * all_gather_cost(P, sharding_axis, mesh)
+    reason = (f"stage {stage}: per-device "
+              f"{stages[stage] / 1e9:.2f} GB vs budget "
+              f"{hbm_bytes / 1e9:.2f} GB"
+              + ("; WARNING: stage 3 still over budget"
+                 if stages[stage] > hbm_bytes else ""))
+    return ModelPlan(
+        dp_degree=mesh.size(dp_axis), sharding_stage=stage,
+        sharding_degree=S, tp_entries=tp_entries, param_bytes=P,
+        mem_bytes=stages[stage], extra_comm_us=extra, reason=reason)

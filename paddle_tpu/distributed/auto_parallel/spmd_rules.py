@@ -35,7 +35,8 @@ __all__ = [
     "nonlinear_reduction_rule", "reshape_rule",
     "transpose_rule", "embedding_rule", "softmax_rule", "layer_norm_rule",
     "concat_rule", "split_rule", "flash_attention_rule",
-    "cross_entropy_rule",
+    "cross_entropy_rule", "conv2d_rule", "pool2d_rule",
+    "batch_norm_rule",
 ]
 
 
@@ -438,8 +439,72 @@ def cross_entropy_rule(logits: DistSpec, label: DistSpec) -> RuleResult:
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
+def conv2d_rule(x: DistSpec, w: DistSpec,
+                data_format: str = "NCHW") -> RuleResult:
+    """x [N, Cin, H, W], w [Cout, Cin, kh, kw] (NCHW).
+
+    Shardable dims: batch (data parallel) and the channel pair —
+    w sharded on Cout → output channel-sharded; x-Cin and w-Cin sharded
+    on the SAME axis → output partial (the conv contracts over Cin,
+    exactly matmul's k-dim rule).  Spatial dims must be replicated
+    (halo exchange is not modeled — upstream reshards them too)."""
+    n_ax = 0
+    c_ax = 1 if data_format == "NCHW" else 3
+    batch = x.axes_of(n_ax) or None
+    cin_x, cin_w = x.axes_of(c_ax), w.axes_of(1)
+    cout = w.axes_of(0) or None
+    contracted = tuple(a for a in cin_x if a in cin_w)
+
+    def _members(a):
+        if not a:
+            return ()
+        return a if isinstance(a, tuple) else (a,)
+
+    # one mesh axis cannot shard two output dims: batch wins over Cout
+    # (same priority scheme as matmul_rule)
+    if cout is not None and set(_members(cout)) & set(_members(batch)):
+        cout = None
+    x_in = DistSpec([batch if i == n_ax else
+                     (contracted or None if i == c_ax else None)
+                     for i in range(4)])
+    w_in = DistSpec([cout, contracted or None, None, None])
+    out_dims = [None] * 4
+    out_dims[n_ax] = batch
+    out_dims[c_ax] = cout
+    out = DistSpec(out_dims, partial=contracted)
+    return RuleResult([x_in, w_in], [out])
+
+
+def pool2d_rule(x: DistSpec,
+                data_format: str = "NCHW") -> RuleResult:
+    """Pooling / spatial resampling: batch + channel pass through,
+    spatial dims replicated."""
+    keep = (0, 1) if data_format == "NCHW" else (0, x.ndim - 1)
+    x_in = DistSpec([(x.axes_of(i) or None) if i in keep else None
+                     for i in range(x.ndim)])
+    return RuleResult([x_in], [x_in])
+
+
+def batch_norm_rule(x: DistSpec,
+                    data_format: str = "NCHW") -> RuleResult:
+    """BatchNorm: batch + channel shardings pass through the
+    ACTIVATION unchanged.  The 2*C batch statistics are what become
+    partial over the batch axes — a tiny psum the op performs
+    internally (sync-BN), deliberately NOT marked on the activation
+    spec: the activation itself is never a pending sum, and pricing a
+    full-tensor settle here would overcharge every dp conv plan."""
+    c_ax = 1 if data_format == "NCHW" else x.ndim - 1
+    x_in = DistSpec([(x.axes_of(i) or None) if i in (0, c_ax) else None
+                     for i in range(x.ndim)])
+    return RuleResult([x_in], [x_in])
+
+
 _RULES = {
     "matmul": matmul_rule,
+    "conv2d": conv2d_rule,
+    "pool2d": pool2d_rule,
+    "interpolate": pool2d_rule,
+    "batch_norm": batch_norm_rule,
     "elementwise": elementwise_rule,
     "add": elementwise_rule,
     "multiply": multiply_rule,

@@ -303,6 +303,35 @@ def _has_break_continue(body) -> bool:
                      stop_at=(ast.For, ast.While, ast.AsyncFor))
 
 
+def _absorb_continuations(stmts: List[ast.stmt]) -> List[ast.stmt]:
+    """Normalize the ubiquitous early-return shape
+
+        if cond:
+            return a
+        <rest ending in return>
+
+    into ``if cond: return a else: <rest>`` so the If transformer can
+    lower it to lax.cond (upstream's ReturnTransformer continuation
+    capture, restricted to the sound case: the absorbed continuation
+    itself terminates in a return on every path)."""
+    tail: List[ast.stmt] = []
+    for s in reversed(stmts):
+        if isinstance(s, ast.If):
+            s.body = _absorb_continuations(s.body)
+            s.orelse = _absorb_continuations(s.orelse)
+            if (_ends_in_return(s.body) and not s.orelse and tail
+                    and _ends_in_return(tail)):
+                s.orelse = tail
+                tail = []
+        elif isinstance(s, (ast.While, ast.For)):
+            s.body = _absorb_continuations(s.body)
+            s.orelse = _absorb_continuations(s.orelse)
+        elif isinstance(s, ast.With):
+            s.body = _absorb_continuations(s.body)
+        tail.insert(0, s)
+    return tail
+
+
 class _LogicalInTest(ast.NodeTransformer):
     """and/or/not → lazy __d2s__ helpers.  Operands are wrapped in
     thunks so the concrete path keeps Python's short-circuit."""
@@ -585,6 +614,7 @@ def _convert_raw(fn):
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn, None
     fdef.decorator_list = []  # already applied on the live object
+    fdef.body = _absorb_continuations(fdef.body)
     tr = _ControlFlowTransformer()
     new_body: List[ast.stmt] = []
     for s in fdef.body:

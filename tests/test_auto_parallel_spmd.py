@@ -451,3 +451,137 @@ def test_reshard_cost_prices_local_bytes():
     assert got == pytest.approx(all_reduce_cost(1 << 20, "pp", m))
     # pricing at full size would be ~4x this
     assert got < 0.5 * all_reduce_cost(4 << 20, "pp", m)
+
+
+# ----------------------- conv/pool/bn rules (round 4) ----------------------
+
+def test_conv2d_rule_batch_and_channel():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        conv2d_rule, DistSpec)
+    # dp batch + Megatron-style channel sharding of the filters
+    x = DistSpec(["dp", None, None, None])
+    w = DistSpec(["mp", None, None, None])
+    r = conv2d_rule(x, w)
+    assert r.out_spec.dims[0] == "dp"
+    assert r.out_spec.dims[1] == "mp"
+    assert not r.out_spec.partial
+
+
+def test_conv2d_rule_contracted_channel_is_partial():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        conv2d_rule, DistSpec)
+    x = DistSpec([None, "mp", None, None])
+    w = DistSpec([None, "mp", None, None])   # Cin sharded both sides
+    r = conv2d_rule(x, w)
+    assert "mp" in r.out_spec.partial        # row-parallel conv
+    assert r.out_spec.dims[1] is None
+
+
+def test_conv2d_rule_spatial_sharding_resharded():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        conv2d_rule, DistSpec, replicated)
+    x = DistSpec(["dp", None, "mp", None])   # illegal spatial shard
+    r = conv2d_rule(x, replicated(4))
+    assert r.in_specs[0].dims[2] is None     # forced replicated
+    assert r.reshards([x, replicated(4)]) == [0]
+
+
+def test_batch_norm_rule_activation_not_partial():
+    """The 2*C statistics psum is internal (sync-BN); the ACTIVATION
+    passes through batch-sharded and is never a pending sum."""
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        batch_norm_rule, DistSpec)
+    x = DistSpec(["dp", None, None, None])
+    r = batch_norm_rule(x)
+    assert not r.out_spec.partial
+    assert r.out_spec.dims[0] == "dp"
+
+
+def test_infer_forward_knows_conv_family():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        infer_forward, replicated)
+    for op in ("conv2d",):
+        r = infer_forward(op, replicated(4), replicated(4))
+        assert r.out_spec.ndim == 4
+    r = infer_forward("pool2d", replicated(4))
+    assert r.out_spec.ndim == 4
+
+
+# ----------------------- whole-model planner (round 4) ---------------------
+
+def _mesh_info(**axes):
+    from paddle_tpu.distributed.auto_parallel.cost_model import (
+        MeshCostInfo)
+    return MeshCostInfo(axes)
+
+
+def test_plan_model_resnet_dp_only():
+    """A conv net: no profitable tp pairs; plan is dp + stage by
+    memory."""
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu.distributed.auto_parallel.planner import plan_model
+    paddle.seed(0)
+    net = resnet18(num_classes=10)
+    mesh = _mesh_info(dp=4, sharding=2, mp=2)
+    plan = plan_model(net, mesh, tokens_per_step=64,
+                      hbm_bytes=16e9)
+    assert plan.tp_entries == [] or not any(
+        e.applied for e in plan.tp_entries)
+    assert plan.sharding_stage == 0          # 11M params fit easily
+    assert plan.dp_degree == 4
+    assert plan.param_bytes > 0
+
+
+def test_plan_model_memory_forces_zero3():
+    """Tiny HBM budget → the planner escalates to stage 3 and prices
+    the per-step parameter all-gather."""
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.auto_parallel.planner import plan_model
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(1024, 4096), nn.ReLU(),
+                        nn.Linear(4096, 1024))
+    mesh = _mesh_info(dp=2, sharding=4, mp=1)
+    # ~8.4M params bf16 ≈ 17MB; params+grads+opt ≈ 84MB
+    plan3 = plan_model(net, mesh, tokens_per_step=1024,
+                       hbm_bytes=30e6)
+    assert plan3.sharding_stage == 3, plan3.reason
+    assert plan3.extra_comm_us > 0
+    plan0 = plan_model(net, mesh, tokens_per_step=1024,
+                       hbm_bytes=16e9)
+    assert plan0.sharding_stage == 0
+    assert plan0.extra_comm_us == 0
+
+
+def test_plan_model_is_idempotent():
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.auto_parallel.planner import plan_model
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(512, 2048), nn.GELU(),
+                        nn.Linear(2048, 512))
+    mesh = _mesh_info(dp=2, mp=4, sharding=1)
+    p1 = plan_model(net, mesh, tokens_per_step=8 * 1024)
+    p2 = plan_model(net, mesh, tokens_per_step=8 * 1024)
+    assert [e.applied for e in p1.tp_entries] == \
+        [e.applied for e in p2.tp_entries]
+    assert p1.param_bytes == p2.param_bytes
+
+
+def test_plan_model_transformer_gets_tp():
+    """An MLP-chain model on an mp mesh: the priced Megatron pairs
+    apply, and per-replica param bytes shrink accordingly."""
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.auto_parallel.planner import plan_model
+    paddle.seed(0)
+    blocks = []
+    for _ in range(2):
+        blocks += [nn.Linear(512, 2048), nn.GELU(),
+                   nn.Linear(2048, 512)]
+    net = nn.Sequential(*blocks)
+    mesh = _mesh_info(dp=2, mp=4, sharding=1)
+    plan = plan_model(net, mesh, tokens_per_step=8 * 1024,
+                      hbm_bytes=16e9)
+    assert any(e.applied for e in plan.tp_entries), \
+        [(e.saved_us, e.comm_us) for e in plan.tp_entries]
+    # applied pairs divide their bytes by mp in the per-replica count
+    full = sum(float(np.prod(p.shape)) * 2 for p in net.parameters())
+    assert plan.param_bytes < full

@@ -217,12 +217,43 @@ def test_short_circuit_preserved_eagerly():
 
 # ----------------------------- unsupported → loud --------------------------
 
-def test_early_return_single_branch_raises():
+def test_early_return_with_continuation_converts():
+    """`if c: return a` + fall-through-return: the continuation is
+    absorbed into the else branch and lowers to lax.cond."""
     @to_static
     def f(x):
         if x.sum() > 0:
             return x * 2
         return x * 3
+
+    np.testing.assert_allclose(f(T([1.])).numpy(), [2.])
+    np.testing.assert_allclose(f(T([-1.])).numpy(), [-3.])
+
+
+def test_early_return_chain_converts():
+    """Guard-clause chains — the most common Paddle user shape."""
+    @to_static
+    def f(x):
+        if x.sum() > 100:
+            return x * 0
+        if x.sum() > 0:
+            y = x + 1
+            return y * 2
+        return -x
+
+    np.testing.assert_allclose(f(T([200.])).numpy(), [0.])
+    np.testing.assert_allclose(f(T([3.])).numpy(), [8.])
+    np.testing.assert_allclose(f(T([-3.])).numpy(), [3.])
+
+
+def test_early_return_without_final_return_still_raises():
+    """No absorbable continuation (function falls off the end):
+    stays a loud error on the traced path."""
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        x = x - 1   # falls through without returning
 
     with pytest.raises(Dy2StaticError, match="early `return`"):
         f(T([1.]))
