@@ -145,10 +145,17 @@ def bench_gpt():
                               multi_precision=True)
         # O2: bf16 params + fp32 master weights in the optimizer
         amp.decorate(net, opt, level="O2", dtype="bfloat16")
+        crit = GPTPretrainingCriterion()
+        if os.environ.get("PADDLE_TPU_FUSED_LMCE"):
+            # A/B knob: fold the lm-head matmul into the Pallas
+            # streaming-CE kernel (logits never hit HBM); enable by
+            # default once hardware numbers confirm the win
+            from paddle_tpu.models import enable_fused_lmce
+            enable_fused_lmce(net, crit)
         rng = np.random.RandomState(0)
         x = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
         y = np.roll(x, -1, axis=1)
-        return (net, opt, GPTPretrainingCriterion(), [x], [y], batch * seq)
+        return (net, opt, crit, [x], [y], batch * seq)
 
     def batch_gen(i):
         rng = np.random.RandomState(1000 + i)

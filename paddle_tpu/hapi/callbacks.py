@@ -246,3 +246,79 @@ def config_callbacks(callbacks=None, model=None, batch_size=None,
                    "steps": steps, "verbose": verbose,
                    "metrics": metrics or ["loss"]})
     return cl
+
+
+class VisualDL(Callback):
+    """Scalar-logging callback (parity: paddle.callbacks.VisualDL).
+
+    The visualdl package is not available on this build, so scalars are
+    written as JSON-lines under ``log_dir`` (``vdlrecords.*.jsonl`` —
+    one record per logged scalar: {tag, step, value, wall_time}).  The
+    logged TAGS and cadence match upstream (train/<metric> per
+    ``log_freq`` batches, eval/<metric> per epoch end), so scripts that
+    attach the callback run unchanged and the scalars stay greppable /
+    plottable without the viewer."""
+
+    def __init__(self, log_dir="./log", log_freq: int = 1):
+        super().__init__()
+        self.log_dir = log_dir
+        self.log_freq = max(int(log_freq), 1)
+        self._f = None
+        self._epoch = 0
+        self._steps_seen = 0
+        self._eval_count = 0
+        self._in_fit = False
+
+    def _writer(self):
+        if self._f is None:
+            import os
+            import time
+            os.makedirs(self.log_dir, exist_ok=True)
+            path = os.path.join(
+                self.log_dir, f"vdlrecords.{int(time.time())}.jsonl")
+            self._f = open(path, "a")
+        return self._f
+
+    def _add_scalars(self, prefix, logs, step):
+        import json
+        import time
+        if not logs:
+            return
+        w = self._writer()
+        for k, v in logs.items():
+            if k in ("batch_size", "num_steps"):
+                continue
+            try:
+                val = float(v[0] if isinstance(v, (list, tuple)) else v)
+            except (TypeError, ValueError):
+                continue
+            w.write(json.dumps({"tag": f"{prefix}/{k}", "step": step,
+                                "value": val,
+                                "wall_time": time.time()}) + "\n")
+        w.flush()
+
+    def on_train_begin(self, logs=None):
+        self._in_fit = True
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+
+    def on_train_batch_end(self, step, logs=None):
+        self._steps_seen += 1
+        if self._steps_seen % self.log_freq == 0:
+            self._add_scalars("train", logs, self._steps_seen)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._add_scalars("train", logs, self._steps_seen)
+
+    def on_eval_end(self, logs=None):
+        # inside fit: x-axis is the epoch; standalone evaluate() calls
+        # get their own monotonically increasing counter
+        step = self._epoch if self._in_fit else self._eval_count
+        self._eval_count += 1
+        self._add_scalars("eval", logs, step)
+
+    def on_train_end(self, logs=None):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
