@@ -81,6 +81,26 @@ class Engine:
         return plan_tensor_parallel(self._model, info, tokens_per_step,
                                     mp_axis=mp_axis)
 
+    def plan_auto(self, tokens_per_step: int, hbm_bytes: float = 16e9,
+                  dcn_axes=(), mesh_info=None):
+        """Whole-model planning (upstream parallel-tuner entry): tp
+        where priced in, plus the lowest ZeRO stage whose per-device
+        footprint fits ``hbm_bytes``.  The chosen stage feeds the
+        runner built by the next fit/evaluate/predict call.  Returns
+        the ModelPlan for inspection."""
+        if self._runner is not None:
+            raise RuntimeError(
+                "Engine.plan_auto must run before the step is "
+                "compiled; create a fresh Engine to re-plan")
+        from .cost_model import MeshCostInfo
+        from .planner import plan_model
+        jmesh = self._resolve_mesh()
+        info = mesh_info or MeshCostInfo(axis_sizes=dict(jmesh.shape),
+                                         dcn_axes=tuple(dcn_axes))
+        self._planned = plan_model(self._model, info, tokens_per_step,
+                                   hbm_bytes=hbm_bytes)
+        return self._planned
+
     def _ensure_runner(self):
         if self._runner is not None:
             return
@@ -90,6 +110,8 @@ class Engine:
                 getattr(self._strategy, "sharding", False):
             sharding_stage = (getattr(self._strategy, "sharding_configs",
                                       None) or {}).get("stage", 2)
+        elif getattr(self, "_planned", None) is not None:
+            sharding_stage = self._planned.sharding_stage
         self._runner = DistributedRunner(
             self._model, self._optimizer, self._loss, mesh=jmesh,
             sharding_stage=sharding_stage)

@@ -319,6 +319,7 @@ class VisualDL(Callback):
         self._add_scalars("eval", logs, step)
 
     def on_train_end(self, logs=None):
+        self._in_fit = False
         if self._f is not None:
             self._f.close()
             self._f = None

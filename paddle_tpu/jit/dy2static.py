@@ -173,6 +173,17 @@ def fori(start, stop, step, body_fn, names: Sequence[str], init: Tuple):
     return _tree_in(out)
 
 
+def scan_iter(xs, body_fn, names: Sequence[str], init: Tuple):
+    """`for x in tensor:` — lax.scan over the leading axis;
+    body_fn(x_t, carry) -> carry."""
+    _check_init(names, init, "`for`")
+    out, _ = jax.lax.scan(
+        lambda u, x_t: (_tree_out(body_fn(_wrap(x_t), _tree_in(u))),
+                        None),
+        _tree_out(init), _unwrap(xs))
+    return _tree_in(out)
+
+
 def and_(fa: Callable, fb: Callable):
     a = fa()
     if is_traced(a):
@@ -207,6 +218,7 @@ class _Runtime:
     cond = staticmethod(cond)
     while_loop = staticmethod(while_loop)
     fori = staticmethod(fori)
+    scan_iter = staticmethod(scan_iter)
     and_ = staticmethod(and_)
     or_ = staticmethod(or_)
     not_ = staticmethod(not_)
@@ -509,6 +521,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             traced_arm += _stmt(
                 f"{lhs}__d2s__.while_loop({cname}, {bname}, "
                 f"{names_lit}, {_env_call(names)})")
+            # `while ... else`: no break on the traced path, so the
+            # else clause always runs after the loop
+            traced_arm += list(node.orelse)
 
         assign = ast.Assign(targets=[ast.Name(probe, ast.Store())],
                             value=_logical(node.test))
@@ -520,7 +535,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return [ast.fix_missing_locations(assign),
                 ast.fix_missing_locations(dispatch)]
 
-    # ---------------- for ... in range(...) ----------------
+    # ---------------- for ... in range(...) / tensor ----------------
     def visit_For(self, node: ast.For):
         self.generic_visit(node)
         it = node.iter
@@ -528,7 +543,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 and it.func.id == "range" and not it.keywords
                 and 1 <= len(it.args) <= 3
                 and isinstance(node.target, ast.Name)):
-            return node  # non-range for: Python-only semantics
+            if isinstance(node.target, ast.Name):
+                return self._for_iterable(node)
+            return node  # tuple targets: Python-only semantics
         uid = self._uid()
         tgt = node.target.id
         carry = f"__d2s_k{uid}"
@@ -567,6 +584,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             traced_arm += _stmt(
                 f"{lhs}__d2s__.fori({start}, {stop}, {step}, {bname}, "
                 f"{names_lit}, {_env_call(names)})")
+            traced_arm += list(node.orelse)   # for...else (no break)
 
         probes = " or ".join(
             f"__d2s__.is_traced({s})" for s in (start, stop, step))
@@ -575,6 +593,56 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         dispatch.orelse = [ast.For(target=node.target, iter=node.iter,
                                    body=node.body, orelse=node.orelse)]
         return [ast.fix_missing_locations(dispatch)]
+
+    def _for_iterable(self, node: ast.For):
+        """`for x in <expr>:` with a traced iterable → lax.scan over
+        the leading axis (upstream converts tensor iteration the same
+        way); Python iterables keep Python semantics."""
+        uid = self._uid()
+        tgt = node.target.id
+        carry = f"__d2s_k{uid}"
+        bname = f"__d2s_sb{uid}"
+        itname = f"__d2s_i{uid}"
+        it_src = ast.unparse(node.iter)
+
+        if _has_return(node.body):
+            traced_arm = _stmt(
+                "__d2s__.unsupported('`return` inside a tensor-iterated "
+                "`for` loop')")
+        elif _has_break_continue(node.body):
+            traced_arm = _stmt(
+                "__d2s__.unsupported('`break`/`continue` inside a "
+                "tensor-iterated `for` loop')")
+        else:
+            names = [n for n in _assigned(node.body) if n != tgt]
+            unpack = (f"({', '.join(names)},) = {carry}" if names
+                      else "pass")
+            body_fn = _stmt(f"""
+                def {bname}({tgt}, {carry}):
+                    {unpack}
+                    return ()
+            """)[0]
+            body_fn.body[-1] = ast.Return(value=_stmt(
+                f"({', '.join(names)},)" if names else "()")[0].value)
+            body_fn.body[-1:-1] = node.body
+            names_lit = "(" + "".join(f"'{n}', " for n in names) + ")"
+            lhs = (f"({', '.join(names)},) = " if names else "")
+            traced_arm = [ast.fix_missing_locations(body_fn)]
+            traced_arm += _stmt(
+                f"{lhs}__d2s__.scan_iter({itname}, {bname}, "
+                f"{names_lit}, {_env_call(names)})")
+            traced_arm += list(node.orelse)   # for...else (no break)
+
+        out = _stmt(f"{itname} = {it_src}")
+        dispatch = _stmt(
+            f"if __d2s__.is_traced({itname}):\n    pass\n"
+            f"else:\n    pass")[0]
+        dispatch.body = traced_arm
+        dispatch.orelse = [ast.For(
+            target=node.target, iter=ast.Name(itname, ast.Load()),
+            body=node.body, orelse=node.orelse)]
+        return [ast.fix_missing_locations(s)
+                for s in out + [dispatch]]
 
 
 # --------------------------------------------------------------------------

@@ -182,7 +182,12 @@ class StaticFunction:
                     return F.unwrap_structure(out)
                 jitted = jax.jit(pure)
                 self._cache[key] = jitted
+                self._cache[key + ("raw",)] = pure
             out_vals = jitted(tkw, *arg_vals)
+            raw = self._cache.get(key + ("raw",))
+            if raw is not None:
+                self._record_trace(raw, (tkw,) + arg_vals, arg_vals,
+                                   out_vals)
             return jax.tree_util.tree_map(Tensor, out_vals)
 
         # Layer-bound: params/buffers become traced inputs
@@ -206,12 +211,18 @@ class StaticFunction:
 
             jitted = jax.jit(pure)
             self._cache[key] = jitted
+            self._cache[key + ("raw",)] = pure
         params = F.param_dict(layer)
         frozen = F.frozen_dict(layer)
         buffers = F.buffer_dict(layer)
         rng_key = _random.default_generator().draw_key()
         out_vals, new_buffers = jitted(params, frozen, buffers, rng_key,
                                        tkw, *arg_vals)
+        raw = self._cache.get(key + ("raw",))
+        if raw is not None:
+            self._record_trace(
+                raw, (params, frozen, buffers, rng_key, tkw) + arg_vals,
+                arg_vals, out_vals)
         # commit buffer updates (BN running stats)
         name_to_buf = dict(layer.named_buffers())
         for n, v in new_buffers.items():
@@ -219,9 +230,57 @@ class StaticFunction:
                 name_to_buf[n]._value = v
         return jax.tree_util.tree_map(Tensor, out_vals)
 
+    @staticmethod
+    def _sds(tree):
+        """Shape/dtype skeleton — never pins device buffers."""
+        return jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)
+            if hasattr(v, "shape") and hasattr(v, "dtype") else v, tree)
+
+    def _record_trace(self, raw, sample, user_args, out_vals):
+        self._last_trace = (raw, self._sds(sample),
+                            [self._sds(v) for v in user_args
+                             if hasattr(v, "shape")],
+                            jax.tree_util.tree_leaves(
+                                self._sds(out_vals)))
+
     @property
     def concrete_program(self):
-        return None
+        """Introspection view of the traced program (upstream
+        ConcreteProgram): ``inputs``/``outputs`` as InputSpecs of the
+        LAST call and ``main_program`` printing the jaxpr (this
+        build's IR).  None until the function has been called once."""
+        trace = getattr(self, "_last_trace", None)
+        if trace is None:
+            return None
+        from ..static import InputSpec as _Spec
+
+        pure, sample, user_args, outs = trace
+
+        class _Prog:
+            def __init__(self, thunk):
+                self._thunk = thunk
+                self._text = None
+
+            def __str__(self):
+                if self._text is None:
+                    self._text = self._thunk()
+                return self._text
+
+            __repr__ = __str__
+
+        def _spec(v):
+            return _Spec(list(getattr(v, "shape", [])),
+                         str(getattr(v, "dtype", "float32"))
+                         .replace("paddle.", ""))
+
+        class _Concrete:
+            inputs = [_spec(v) for v in user_args]
+            outputs = [_spec(v) for v in outs]
+            main_program = _Prog(
+                lambda: str(jax.make_jaxpr(pure)(*sample)))
+
+        return _Concrete()
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -37,18 +37,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .pallas_ops import (_LANES, _fit_block, _interpret, _on_tpu,
-                         _warn_once)
+from .pallas_ops import (_LANES, _block_default, _fit_block,
+                         _interpret, _on_tpu, _warn_once)
 
 _NEG = -1e30
 
 
 def _block_rows(n):
-    return _fit_block(n, int(os.environ.get("PADDLE_TPU_LMCE_BN", 256)))
+    return _fit_block(n, _block_default("PADDLE_TPU_LMCE_BN", 256))
 
 
 def _block_vocab(vp):
-    return _fit_block(vp, int(os.environ.get("PADDLE_TPU_LMCE_BV", 512)))
+    return _fit_block(vp, _block_default("PADDLE_TPU_LMCE_BV", 512))
 
 
 # --------------------------------------------------------------------------
@@ -332,7 +332,8 @@ def _vjp_bwd(res, g):
         p = jax.nn.softmax(logits, axis=-1)
         onehot = jax.nn.one_hot(labels.astype(jnp.int32), w.shape[0],
                                 dtype=jnp.float32)
-        dl = (p - onehot) * g[:, None]
+        gv = jnp.where(labels >= 0, g, 0.0)    # ignore_index, same as
+        dl = (p - onehot) * gv[:, None]        # the Pallas path
         dh = (dl.astype(w.dtype) @ w).astype(h.dtype)
         dw = (dl.T.astype(h.dtype) @ h).astype(w.dtype)
     zero_lab = np.zeros(labels.shape, jax.dtypes.float0)

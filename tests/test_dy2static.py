@@ -380,3 +380,97 @@ def test_input_spec_applies_to_keyword_tensor():
     assert str(out.dtype).endswith("float32")
     with pytest.raises(ValueError, match="rank"):
         f(x=Tensor(np.ones((3,), np.float32)))
+
+
+def test_concrete_program_introspection():
+    """concrete_program (upstream ConcreteProgram): input/output specs
+    + a printable main_program (the jaxpr IR), available after the
+    first call."""
+    @to_static
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        return x * 3
+
+    assert f.concrete_program is None
+    f(T([1., 2.]))
+    cp = f.concrete_program
+    assert cp is not None
+    assert [s.shape for s in cp.inputs if s.shape] == [[2]]
+    assert [s.shape for s in cp.outputs] == [[2]]
+    text = str(cp.main_program)
+    assert "cond" in text          # the converted control flow is IN the IR
+    assert "lambda" in text or "let" in text
+
+
+def test_for_over_tensor_scans_leading_axis():
+    """`for row in tensor:` lowers to lax.scan (upstream tensor
+    iteration); Python lists keep Python semantics."""
+    @to_static
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            acc = acc + row * row
+        return acc
+
+    xs = np.arange(6, dtype=np.float32).reshape(3, 2)
+    np.testing.assert_allclose(f(T(xs)).numpy(), (xs * xs).sum(0))
+    # python list path unchanged
+    @to_static
+    def g(x, items=(1.0, 2.0, 3.0)):
+        acc = x * 0
+        for v in items:
+            acc = acc + v
+        return acc
+
+    np.testing.assert_allclose(g(T([0.])).numpy(), [6.])
+
+
+def test_for_over_tensor_with_nested_if():
+    @to_static
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            if row.sum() > 0:
+                acc = acc + row
+            else:
+                acc = acc - row
+        return acc
+
+    xs = np.array([[1., 1.], [-2., -2.], [3., 3.]], np.float32)
+    np.testing.assert_allclose(f(T(xs)).numpy(), [6., 6.])
+
+
+def test_for_else_runs_on_traced_path():
+    @to_static
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            acc = acc + row
+        else:
+            acc = acc * 10
+        return acc
+
+    xs = np.ones((3, 2), np.float32)
+    np.testing.assert_allclose(f(T(xs)).numpy(), [30., 30.])
+
+
+def test_concrete_program_layer_bound():
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(4, 2)
+
+        def forward(self, x):
+            if x.sum() > 0:
+                return self.fc(x)
+            return self.fc(x) * 0
+
+    paddle.seed(0)
+    snet = to_static(Net())
+    assert snet.forward.concrete_program is None
+    snet(T(np.ones((3, 4), np.float32)))
+    cp = snet.forward.concrete_program
+    assert cp is not None
+    assert [s.shape for s in cp.inputs] == [[3, 4]]
+    assert "cond" in str(cp.main_program)

@@ -100,3 +100,74 @@ def test_launch_watchdog_kills_pod_on_rank_death(tmp_path):
     assert proc.returncode != 0
     assert (log_dir / "workerlog.0").exists()
     assert (log_dir / "workerlog.1").exists()
+
+
+TRAIN_WORKER = textwrap.dedent("""
+    import os
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed import init_parallel_env, collective
+    from paddle_tpu.distributed.runner import DistributedRunner
+    from paddle_tpu.models import (gpt_tiny, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    env = init_parallel_env()
+    rank = env.rank
+    assert jax.process_count() == 2
+    assert jax.device_count() == 2      # global view: 1 CPU dev/proc
+
+    # global dp=2 mesh spanning both processes
+    mesh = collective.build_mesh({"dp": 2})
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    cfg = gpt_tiny()
+    net = GPTForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=net.parameters())
+    runner = DistributedRunner(net, opt, GPTPretrainingCriterion(),
+                               mesh=mesh)
+    rng = np.random.RandomState(0)      # same data on both ranks;
+    x = rng.randint(0, cfg.vocab_size, (8, 32)).astype(np.int64)
+    y = np.roll(x, -1, axis=1)
+    l1 = float(runner.train_step([x], [y]))
+    l2 = float(runner.train_step([x], [y]))
+    assert np.isfinite(l1) and np.isfinite(l2), (l1, l2)
+    assert l2 < l1, (l1, l2)
+    print(f"RANK-{rank}-TRAIN-OK {l1:.6f} {l2:.6f}", flush=True)
+""")
+
+
+def test_launch_two_process_training_step(tmp_path):
+    """Multi-HOST control plane end-to-end: 2 launch-spawned processes
+    rendezvous, build one global dp=2 mesh (1 local device each), and
+    run a COMPILED GPT train step whose gradient all-reduce crosses
+    the process boundary; losses agree bit-for-bit across ranks."""
+    script = tmp_path / "train_worker.py"
+    script.write_text(TRAIN_WORKER)
+    log_dir = tmp_path / "log"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir),
+         str(script)],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=420)
+    logs = {r: (log_dir / f"workerlog.{r}").read_text()
+            for r in (0, 1)
+            if (log_dir / f"workerlog.{r}").exists()}
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstderr:\n{proc.stderr}\n"
+        + "\n".join(f"log{r}:\n{t}" for r, t in logs.items()))
+    lines = {r: [l for l in t.splitlines()
+                 if l.startswith(f"RANK-{r}-TRAIN-OK")]
+             for r, t in logs.items()}
+    assert lines[0] and lines[1], logs
+    # identical program + identical global batch → identical losses
+    assert lines[0][0].split()[1:] == lines[1][0].split()[1:]
