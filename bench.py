@@ -428,11 +428,13 @@ def main():
     # ERNIE-3.0 MLM pretrain (north-star names both metrics)
     if (remaining() > 150
             and not os.environ.get("GRAFT_BENCH_GPT_ONLY")):
-        ernie, _eerr = _run_child("ernie", remaining() - 60)
+        ernie, eerr = _run_child("ernie", remaining() - 60)
         if ernie is not None:
             out["ernie3_base_tokens_per_sec"] = round(
                 ernie.get("tokens_per_sec", 0.0), 1)
             out["ernie3_base_step_ms"] = ernie.get("step_ms")
+        else:
+            out["ernie3_base_error"] = eerr[-500:]
     if (gpt is not None and remaining() > 90
             and not os.environ.get("GRAFT_BENCH_GPT_ONLY")):
         flash, ferr = _run_child("flash", remaining())

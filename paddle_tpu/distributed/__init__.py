@@ -20,6 +20,9 @@ from . import fleet  # noqa
 from . import sharding  # noqa
 from .collective import split, get_mesh, set_mesh  # noqa
 from .runner import DistributedRunner  # noqa
+from .spawn import spawn  # noqa
+from .compressed import (  # noqa
+    quantized_all_reduce, bf16_all_reduce, compressed_psum_tree)
 from .fleet.recompute import recompute  # noqa
 from . import checkpoint  # noqa
 from . import passes  # noqa

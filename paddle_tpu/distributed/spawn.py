@@ -1,0 +1,76 @@
+"""paddle.distributed.spawn (parity: python/paddle/distributed/spawn.py).
+
+Launches ``nprocs`` worker processes from Python (the programmatic
+alternative to ``python -m paddle_tpu.distributed.launch``), sets the
+paddle env contract (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / PADDLE_CURRENT_ENDPOINT / PADDLE_MASTER) in
+each child BEFORE the user function runs, and joins.
+
+Uses the multiprocessing ``spawn`` start method — fork is unsafe once
+jax has initialized a backend (upstream forbids fork after CUDA init
+for the same reason)."""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Sequence
+
+
+class ProcessContext:
+    def __init__(self, procs):
+        self.processes = procs
+
+    def join(self, timeout=None):
+        for p in self.processes:
+            p.join(timeout)
+        bad = [(p.name, p.exitcode) for p in self.processes
+               if p.exitcode not in (0, None)]
+        if bad:
+            raise RuntimeError(
+                f"distributed.spawn: worker(s) failed: {bad}")
+        return all(p.exitcode == 0 for p in self.processes)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, args, env):
+    # env BEFORE any jax backend init in this fresh process
+    os.environ.update(env)
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = -1, join: bool = True,
+          daemon: bool = False, **options):
+    """Run ``func(*args)`` in ``nprocs`` rank processes.  Rank identity
+    arrives via the paddle env contract (read it with
+    ``paddle.distributed.get_rank()`` / ``init_parallel_env()``)."""
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    master = f"127.0.0.1:{_free_port()}"
+    base = _free_port()
+    endpoints = [f"127.0.0.1:{base + i}" for i in range(nprocs)]
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_MASTER": master,
+        }
+        env.update(options.get("env", {}))
+        p = ctx.Process(target=_worker, args=(func, tuple(args), env),
+                        daemon=daemon, name=f"spawn-rank{rank}")
+        p.start()
+        procs.append(p)
+    context = ProcessContext(procs)
+    if join:
+        context.join()
+    return context

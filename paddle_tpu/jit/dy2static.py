@@ -204,6 +204,10 @@ def not_(a):
     return not a
 
 
+def any_undef(vals) -> bool:
+    return any(v is UNDEF for v in vals)
+
+
 def unsupported(what: str):
     raise Dy2StaticError(
         f"to_static: {what} is not convertible to XLA control flow; "
@@ -219,6 +223,7 @@ class _Runtime:
     while_loop = staticmethod(while_loop)
     fori = staticmethod(fori)
     scan_iter = staticmethod(scan_iter)
+    any_undef = staticmethod(any_undef)
     and_ = staticmethod(and_)
     or_ = staticmethod(or_)
     not_ = staticmethod(not_)
@@ -313,6 +318,43 @@ def _has_break_continue(body) -> bool:
     """break/continue binding to THIS loop (nested loops own theirs)."""
     return _contains(body, (ast.Break, ast.Continue),
                      stop_at=(ast.For, ast.While, ast.AsyncFor))
+
+
+def _scan_safe(stmts) -> bool:
+    """Is a loop body expressible as a lax.scan carry?  Only plain
+    Name (re)assignments and (already-converted) nested control flow
+    qualify — side effects like list.append, attribute/subscript
+    writes, or bare expression statements must NOT be rerouted to scan
+    (the body would trace once instead of executing per row).  Unsafe
+    bodies keep Python semantics: under jit, iterating a
+    concrete-shaped traced tensor unrolls correctly."""
+    ok = True
+
+    def walk(ss):
+        nonlocal ok
+        for s in ss:
+            if isinstance(s, (ast.Assign, ast.AnnAssign)):
+                tgts = s.targets if isinstance(s, ast.Assign)                     else [s.target]
+                if not all(isinstance(t, ast.Name) for t in tgts):
+                    ok = False
+            elif isinstance(s, ast.AugAssign):
+                if not isinstance(s.target, ast.Name):
+                    ok = False
+            elif isinstance(s, ast.If):
+                walk(s.body)
+                walk(s.orelse)
+            elif isinstance(s, (ast.For, ast.While)):
+                walk(s.body)
+                walk(s.orelse)
+            elif isinstance(s, ast.Pass):
+                pass
+            else:
+                # Expr (call for side effect), With, Try, Raise,
+                # Delete, Import, Global, Nonlocal, Return, Break, ...
+                ok = False
+
+    walk(stmts)
+    return ok
 
 
 def _absorb_continuations(stmts: List[ast.stmt]) -> List[ast.stmt]:
@@ -605,33 +647,45 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         itname = f"__d2s_i{uid}"
         it_src = ast.unparse(node.iter)
 
-        if _has_return(node.body):
-            traced_arm = _stmt(
-                "__d2s__.unsupported('`return` inside a tensor-iterated "
-                "`for` loop')")
-        elif _has_break_continue(node.body):
-            traced_arm = _stmt(
-                "__d2s__.unsupported('`break`/`continue` inside a "
-                "tensor-iterated `for` loop')")
-        else:
-            names = [n for n in _assigned(node.body) if n != tgt]
-            unpack = (f"({', '.join(names)},) = {carry}" if names
-                      else "pass")
-            body_fn = _stmt(f"""
-                def {bname}({tgt}, {carry}):
-                    {unpack}
-                    return ()
-            """)[0]
-            body_fn.body[-1] = ast.Return(value=_stmt(
-                f"({', '.join(names)},)" if names else "()")[0].value)
-            body_fn.body[-1:-1] = node.body
-            names_lit = "(" + "".join(f"'{n}', " for n in names) + ")"
-            lhs = (f"({', '.join(names)},) = " if names else "")
-            traced_arm = [ast.fix_missing_locations(body_fn)]
-            traced_arm += _stmt(
-                f"{lhs}__d2s__.scan_iter({itname}, {bname}, "
-                f"{names_lit}, {_env_call(names)})")
-            traced_arm += list(node.orelse)   # for...else (no break)
+        if (_has_return(node.body) or _has_break_continue(node.body)
+                or not _scan_safe(node.body)):
+            # side-effecting / early-exit bodies keep Python semantics:
+            # iterating a concrete-shaped traced tensor UNROLLS
+            # correctly (Tensor.__iter__ over the static leading dim) —
+            # scan would trace the body once and corrupt the effects
+            return node
+
+        names = [n for n in _assigned(node.body) if n != tgt]
+        unpack = (f"({', '.join(names)},) = {carry}" if names
+                  else "pass")
+        body_fn = _stmt(f"""
+            def {bname}({tgt}, {carry}):
+                {unpack}
+                return ()
+        """)[0]
+        body_fn.body[-1] = ast.Return(value=_stmt(
+            f"({', '.join(names)},)" if names else "()")[0].value)
+        body_fn.body[-1:-1] = node.body
+        names_lit = "(" + "".join(f"'{n}', " for n in names) + ")"
+        lhs = (f"({', '.join(names)},) = " if names else "")
+        env_name = f"__d2s_e{uid}"
+        traced_arm = [ast.fix_missing_locations(body_fn)]
+        traced_arm += _stmt(f"{env_name} = {_env_call(names)}")
+        # a carry var first bound INSIDE the loop body has no initial
+        # value for scan — unroll via the Python loop instead (it
+        # binds on the first iteration, the dygraph semantics)
+        inner = _stmt(
+            f"if __d2s__.any_undef({env_name}):\n    pass\n"
+            f"else:\n    pass")[0]
+        inner.body = [ast.For(
+            target=ast.Name(tgt, ast.Store()),
+            iter=ast.Name(itname, ast.Load()),
+            body=node.body, orelse=[])]
+        inner.orelse = _stmt(
+            f"{lhs}__d2s__.scan_iter({itname}, {bname}, "
+            f"{names_lit}, {env_name})")
+        traced_arm.append(inner)
+        traced_arm += list(node.orelse)   # for...else (no break)
 
         out = _stmt(f"{itname} = {it_src}")
         dispatch = _stmt(

@@ -99,6 +99,7 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         self._cache: Dict[Any, Any] = {}
+        self._traced_keys: set = set()
         functools.update_wrapper(self, fn)
 
     @property
@@ -184,9 +185,10 @@ class StaticFunction:
                 self._cache[key] = jitted
                 self._cache[key + ("raw",)] = pure
             out_vals = jitted(tkw, *arg_vals)
-            raw = self._cache.get(key + ("raw",))
-            if raw is not None:
-                self._record_trace(raw, (tkw,) + arg_vals, arg_vals,
+            if key not in self._traced_keys:   # compile-time only:
+                self._traced_keys.add(key)     # no per-step tree_maps
+                self._record_trace(self._cache[key + ("raw",)],
+                                   (tkw,) + arg_vals, arg_vals,
                                    out_vals)
             return jax.tree_util.tree_map(Tensor, out_vals)
 
@@ -218,10 +220,11 @@ class StaticFunction:
         rng_key = _random.default_generator().draw_key()
         out_vals, new_buffers = jitted(params, frozen, buffers, rng_key,
                                        tkw, *arg_vals)
-        raw = self._cache.get(key + ("raw",))
-        if raw is not None:
+        if key not in self._traced_keys:
+            self._traced_keys.add(key)
             self._record_trace(
-                raw, (params, frozen, buffers, rng_key, tkw) + arg_vals,
+                self._cache[key + ("raw",)],
+                (params, frozen, buffers, rng_key, tkw) + arg_vals,
                 arg_vals, out_vals)
         # commit buffer updates (BN running stats)
         name_to_buf = dict(layer.named_buffers())

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from ..tensor import Tensor, Parameter
+from . import nn  # noqa  (paddle.static.nn builders)
 from ..framework import dtype as dtypes
 
 _static_mode = [False]

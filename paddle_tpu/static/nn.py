@@ -1,0 +1,107 @@
+"""paddle.static.nn — the static-graph layer builders (parity:
+python/paddle/static/nn/common.py: fc, conv2d, batch_norm, embedding,
+...).  Upstream's builders append ops + create persistable variables in
+the current Program; here each call instantiates the corresponding
+``paddle.nn`` Layer ONCE per call site (parameters register eagerly,
+exactly like upstream's create_parameter into the startup program) and
+applies it — the op recording into the current Program happens through
+the primitive static hook, so ``Executor.run`` replays and
+``optimizer.minimize`` trains these layers like any other."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import nn as _nn
+from .. import ops as _ops
+
+
+def _act(out, act: Optional[str]):
+    if act is None:
+        return out
+    fn = getattr(_ops, act, None)
+    if fn is None:
+        raise ValueError(f"static.nn: unknown activation {act!r}")
+    return fn(out)
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation=None, name=None):
+    """paddle.static.nn.fc: flatten trailing dims, Linear, activation."""
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    if len(x.shape) > num_flatten_dims + 1:
+        # leading (batch) dim stays dynamic: recorded programs replay
+        # with real batch sizes, so bake -1 instead of the trace-time
+        # placeholder size
+        lead = [-1] + [int(d) for d in x.shape[1:num_flatten_dims]]
+        x = _ops.reshape(x, lead + [in_dim])
+    layer = _nn.Linear(in_dim, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    return _act(layer(x), activation)
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    cin = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _nn.Conv2D(cin, num_filters, filter_size, stride=stride,
+                       padding=padding, dilation=dilation, groups=groups,
+                       weight_attr=param_attr, bias_attr=bias_attr,
+                       data_format=data_format)
+    return _act(layer(input), act)
+
+
+def conv2d_transpose(input, num_filters: int, filter_size, stride=1,
+                     padding=0, groups=1, param_attr=None,
+                     bias_attr=None, act=None, data_format="NCHW",
+                     name=None, output_size=None):
+    cin = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _nn.Conv2DTranspose(
+        cin, num_filters, filter_size, stride=stride, padding=padding,
+        groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
+        data_format=data_format)
+    return _act(layer(input), act)
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = _nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
+                            weight_attr=param_attr, bias_attr=bias_attr)
+    if is_test:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    layer = _nn.Embedding(int(size[0]), int(size[1]),
+                          padding_idx=padding_idx,
+                          weight_attr=param_attr, sparse=is_sparse)
+    return layer(input)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    layer = _nn.Dropout(dropout_prob)
+    if is_test:
+        layer.eval()
+    return layer(x)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, name=None):
+    shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    layer = _nn.LayerNorm(shape, epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    num = 1 if mode == "all" else int(x.shape[1])
+    layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr)
+    return layer(x)

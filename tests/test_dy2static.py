@@ -474,3 +474,44 @@ def test_concrete_program_layer_bound():
     assert cp is not None
     assert [s.shape for s in cp.inputs] == [[3, 4]]
     assert "cond" in str(cp.main_program)
+
+
+def test_for_over_tensor_side_effect_body_unrolls():
+    """list.append in the body is NOT scan-safe — it must keep Python
+    unrolling (which is correct under trace) instead of scanning."""
+    @to_static
+    def f(xs):
+        out = []
+        for v in xs:
+            out.append(v * 2)
+        return out[0] + out[2]
+
+    xs = np.array([[1.], [2.], [3.]], np.float32)
+    np.testing.assert_allclose(f(T(xs)).numpy(), [8.])
+
+
+def test_for_over_tensor_loop_initialized_var_unrolls():
+    """A carry var first bound inside the body has no scan init; the
+    runtime falls back to unrolling (dygraph semantics)."""
+    @to_static
+    def f(xs):
+        for row in xs:
+            last = row          # bound only inside the loop
+        return last
+
+    xs = np.array([[1., 1.], [5., 7.]], np.float32)
+    np.testing.assert_allclose(f(T(xs)).numpy(), [5., 7.])
+
+
+def test_for_over_tensor_break_unrolls():
+    @to_static
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            acc = acc + row
+            if True:
+                break           # python semantics preserved
+        return acc
+
+    xs = np.array([[2., 2.], [5., 5.]], np.float32)
+    np.testing.assert_allclose(f(T(xs)).numpy(), [2., 2.])

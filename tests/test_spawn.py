@@ -1,0 +1,51 @@
+"""paddle.distributed.spawn (upstream spawn.py parity): programmatic
+multi-process launch with the env contract, rendezvous, and one
+cross-process collective."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.dist
+
+
+def _worker(tag):
+    import os
+    import numpy as np
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import init_parallel_env
+
+    env = init_parallel_env()
+    assert jax.process_count() == 2
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    mesh = Mesh(np.array(jax.devices()), ("x",))
+    local = jax.device_put(
+        np.array([float(env.rank + 1)], np.float32),
+        jax.local_devices()[0])
+    arr = jax.make_array_from_single_device_arrays(
+        (2,), NamedSharding(mesh, P("x")), [local])
+    total = float(jax.jit(jnp.sum,
+                          out_shardings=NamedSharding(mesh, P()))(arr))
+    assert total == 3.0, (tag, total)
+
+
+def test_spawn_two_ranks_collective():
+    from paddle_tpu.distributed import spawn
+    ctx = spawn(_worker, args=("t1",), nprocs=2, join=True)
+    assert all(p.exitcode == 0 for p in ctx.processes)
+
+
+def test_spawn_propagates_worker_failure():
+    from paddle_tpu.distributed import spawn
+
+    with pytest.raises(RuntimeError, match="failed"):
+        spawn(_crasher, nprocs=2, join=True)
+
+
+def _crasher():
+    import os
+    if os.environ["PADDLE_TRAINER_ID"] == "1":
+        raise SystemExit(3)

@@ -213,3 +213,47 @@ def test_static_training_honors_param_lr_and_clip():
     np.testing.assert_array_equal(fc.weight.numpy(), w0)
     assert np.abs(fc.bias.numpy() - b0).max() < 1e-6
     assert np.abs(fc.bias.numpy() - b0).max() > 0
+
+
+def test_static_nn_builders_train():
+    """Classic static script style: static.nn.fc/batch_norm/conv2d
+    builders + minimize under program_guard (upstream
+    static/nn/common.py surface)."""
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 1, 8, 8).astype(np.float32)
+    Y = (X.mean((1, 2, 3)) > 0.5).astype(np.int64)
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 1, 8, 8], "float32")
+        y = static.data("y", [None], "int64")
+        h = static.nn.conv2d(x, num_filters=4, filter_size=3,
+                             padding=1, act="relu")
+        h = static.nn.batch_norm(h)
+        h = nn.functional.adaptive_avg_pool2d(h, 1)
+        h = static.nn.fc(h, size=2)
+        loss = nn.functional.cross_entropy(h, y)
+        opt = optimizer.Adam(learning_rate=0.05,
+                             parameters=_collect_params(main))
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    first = None
+    for _ in range(25):
+        (lv,) = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+        if first is None:
+            first = float(lv)
+    assert float(lv) < first, (first, float(lv))
+
+
+def _collect_params(program):
+    """Gather the Parameters the recorded graph references (static
+    builders create layers inline, so the user has no layer handles —
+    upstream's minimize walks the program the same way)."""
+    seen, out = set(), []
+    for _, arg_specs, _, _ in program._nodes:
+        for kind, ref in arg_specs:
+            if kind == "param" and id(ref) not in seen:
+                seen.add(id(ref))
+                out.append(ref)
+    return out
