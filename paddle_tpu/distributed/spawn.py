@@ -23,14 +23,28 @@ class ProcessContext:
         self.processes = procs
 
     def join(self, timeout=None):
-        for p in self.processes:
-            p.join(timeout)
-        bad = [(p.name, p.exitcode) for p in self.processes
-               if p.exitcode not in (0, None)]
-        if bad:
-            raise RuntimeError(
-                f"distributed.spawn: worker(s) failed: {bad}")
-        return all(p.exitcode == 0 for p in self.processes)
+        """Join with failure monitoring: one crashed rank terminates
+        the survivors (which would otherwise hang in rendezvous /
+        collectives waiting for their dead peer) and raises."""
+        import time as _time
+        deadline = None if timeout is None else _time.time() + timeout
+        while True:
+            codes = [p.exitcode for p in self.processes]
+            bad = [(p.name, c) for p, c in zip(self.processes, codes)
+                   if c not in (0, None)]
+            if bad:
+                for p in self.processes:
+                    if p.exitcode is None:
+                        p.terminate()
+                for p in self.processes:
+                    p.join(10)
+                raise RuntimeError(
+                    f"distributed.spawn: worker(s) failed: {bad}")
+            if all(c == 0 for c in codes):
+                return True
+            if deadline is not None and _time.time() > deadline:
+                return False
+            _time.sleep(0.2)
 
 
 def _free_port() -> int:

@@ -185,8 +185,11 @@ class StaticFunction:
                 self._cache[key] = jitted
                 self._cache[key + ("raw",)] = pure
             out_vals = jitted(tkw, *arg_vals)
-            if key not in self._traced_keys:   # compile-time only:
-                self._traced_keys.add(key)     # no per-step tree_maps
+            sig = key + tuple(
+                (getattr(v, "shape", None), str(getattr(v, "dtype", "")))
+                for v in arg_vals)             # cheap: few user args
+            if sig not in self._traced_keys:   # refresh per signature,
+                self._traced_keys.add(sig)     # not per step
                 self._record_trace(self._cache[key + ("raw",)],
                                    (tkw,) + arg_vals, arg_vals,
                                    out_vals)
@@ -220,8 +223,11 @@ class StaticFunction:
         rng_key = _random.default_generator().draw_key()
         out_vals, new_buffers = jitted(params, frozen, buffers, rng_key,
                                        tkw, *arg_vals)
-        if key not in self._traced_keys:
-            self._traced_keys.add(key)
+        sig = key + tuple(
+            (getattr(v, "shape", None), str(getattr(v, "dtype", "")))
+            for v in arg_vals)
+        if sig not in self._traced_keys:
+            self._traced_keys.add(sig)
             self._record_trace(
                 self._cache[key + ("raw",)],
                 (params, frozen, buffers, rng_key, tkw) + arg_vals,

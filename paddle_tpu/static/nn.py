@@ -62,7 +62,7 @@ def conv2d_transpose(input, num_filters: int, filter_size, stride=1,
         cin, num_filters, filter_size, stride=stride, padding=padding,
         groups=groups, weight_attr=param_attr, bias_attr=bias_attr,
         data_format=data_format)
-    return _act(layer(input), act)
+    return _act(layer(input, output_size=output_size), act)
 
 
 def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
@@ -70,7 +70,8 @@ def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
                is_test=False, name=None):
     ch = int(input.shape[1 if data_layout == "NCHW" else -1])
     layer = _nn.BatchNorm2D(ch, momentum=momentum, epsilon=epsilon,
-                            weight_attr=param_attr, bias_attr=bias_attr)
+                            weight_attr=param_attr, bias_attr=bias_attr,
+                            data_format=data_layout)
     if is_test:
         layer.eval()
     return _act(layer(input), act)

@@ -1,0 +1,113 @@
+"""TPU perf A/B matrix — run the moment the axon tunnel returns.
+
+Runs the GPT-2-small bench across the kernel-variant matrix, prints a
+table + JSON, and names the winning default:
+
+    variants = baseline (packed flash, no fused CE)
+             x PADDLE_TPU_FLASH_NO_PACKED=1
+             x PADDLE_TPU_FUSED_LMCE=1
+             x both
+
+Usage:  python scripts/tpu_ab.py [--timeout 480] [--also-resnet]
+
+Each variant runs bench.py's GPT child in a fresh subprocess (the
+backend-init watchdog applies).  Results append to AB_RESULTS.jsonl so
+partial progress survives a mid-run tunnel outage.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VARIANTS = [
+    ("baseline", {}),
+    ("no_packed", {"PADDLE_TPU_FLASH_NO_PACKED": "1"}),
+    ("fused_lmce", {"PADDLE_TPU_FUSED_LMCE": "1"}),
+    ("no_packed+fused_lmce", {"PADDLE_TPU_FLASH_NO_PACKED": "1",
+                              "PADDLE_TPU_FUSED_LMCE": "1"}),
+]
+
+
+def run_variant(name, env_extra, timeout):
+    env = dict(os.environ)
+    env.update(env_extra)
+    env["_GRAFT_BENCH_CHILD"] = "gpt"
+    t0 = time.time()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(HERE, "bench.py")],
+            env=env, cwd=HERE, capture_output=True, text=True,
+            timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"variant": name, "error": f"timeout {timeout}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            r = json.loads(line[len("RESULT "):])
+            r["variant"] = name
+            r["wall_s"] = round(time.time() - t0, 1)
+            return r
+    return {"variant": name,
+            "error": (proc.stdout + proc.stderr)[-800:]}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=480)
+    ap.add_argument("--also-resnet", action="store_true")
+    args = ap.parse_args()
+
+    out_path = os.path.join(HERE, "AB_RESULTS.jsonl")
+    results = []
+    for name, extra in VARIANTS:
+        print(f"--- {name} ({extra}) ---", flush=True)
+        r = run_variant(name, extra, args.timeout)
+        results.append(r)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(r) + "\n")
+        print(json.dumps(r), flush=True)
+
+    if args.also_resnet:
+        env = dict(os.environ)
+        env["_GRAFT_BENCH_CHILD"] = "resnet"
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(HERE, "bench.py")],
+                env=env, cwd=HERE, capture_output=True, text=True,
+                timeout=args.timeout)
+        except subprocess.TimeoutExpired:
+            proc = None
+            print(json.dumps({"variant": "resnet50",
+                              "error": f"timeout {args.timeout}s"}),
+                  flush=True)
+        for line in (proc.stdout.splitlines() if proc else []):
+            if line.startswith("RESULT "):
+                r = json.loads(line[len("RESULT "):])
+                r["variant"] = "resnet50"
+                results.append(r)
+                with open(out_path, "a") as f:
+                    f.write(json.dumps(r) + "\n")
+                print(json.dumps(r), flush=True)
+
+    ok = [r for r in results if "tokens_per_sec" in r]
+    if ok:
+        print(f"\n{'variant':<22} {'tok/s':>10} {'ms/step':>9} "
+              f"{'mfu':>7}")
+        for r in ok:
+            print(f"{r['variant']:<22} {r['tokens_per_sec']:>10.0f} "
+                  f"{r.get('step_ms', 0):>9.2f} "
+                  f"{r.get('mfu', 0):>7.4f}")
+        best = max(ok, key=lambda r: r["tokens_per_sec"])
+        print(f"\nWINNER: {best['variant']} "
+              f"({best['tokens_per_sec']:.0f} tok/s). Defaults to flip "
+              "if not baseline: packed -> ops/pallas_ops.py "
+              "_packed_eligible; fused lmce -> bench_gpt/"
+              "enable_fused_lmce.")
+
+
+if __name__ == "__main__":
+    main()

@@ -6,7 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from paddle_tpu.distributed.compressed import (
     quantized_all_reduce, bf16_all_reduce, compressed_psum_tree)
@@ -137,15 +137,17 @@ def test_dp_training_step_with_compressed_grads():
         return w - 0.1 * g
 
     for mode in ("none", "int8"):
-        w = W0
+        # w as an ARG (replicated in_spec) so the loop reuses ONE
+        # compiled program; out_specs P("x") then take rank 0 — the
+        # result IS replicated mathematically, but jax can't statically
+        # prove it through ppermute
+        f2 = jax.jit(shard_map(
+            lambda w_, x, y, m=mode: step(w_, x, y, m)[None],
+            mesh=mesh, in_specs=(P(), P("x"), P("x")),
+            out_specs=P("x")))
+        w = jnp.asarray(W0)
         for i in range(60):
-            # out_specs P("x") then take rank 0: the result IS
-            # replicated mathematically, but jax can't statically
-            # prove it through ppermute
-            f2 = shard_map(
-                lambda x, y, w_=w, m=mode: step(w_, x, y, m)[None],
-                mesh=mesh, in_specs=(P("x"), P("x")),
-                out_specs=P("x"))
-            w = np.asarray(f2(X, Y))[0]
+            w = f2(w, X, Y)[0]
+        w = np.asarray(w)
         final = float(np.mean((X @ w - Y) ** 2))
         assert final < 0.05, f"mode {mode} did not converge: {final}"
