@@ -164,11 +164,43 @@ def bench_gpt():
         x = rng.randint(0, vocab, (b, s)).astype(np.int64)
         return [x], [np.roll(x, -1, axis=1)]
 
+    t_child0 = time.time()
     res = _timed_bench(build, steps=2 if tiny else 15,
                        pipeline_steps=3 if tiny else 10,
                        batch_gen=batch_gen)
     tps, step_ms = res[0], res[1]
     tps_pipe = res[2] if len(res) > 2 else None
+
+    # In-process kernel-variant A/B (VERDICT r3 next #1/#2): the
+    # packed-heads flash layout ships default-ON but was never
+    # perf-measured on hardware, and the fused lm-head CE kernel is
+    # new.  Measure both as extra fields so the driver's round-end
+    # bench captures the comparison even without interactive TPU
+    # access.  Each variant costs one fresh compile; skip when the
+    # main run already burned most of the child budget.
+    variants = {}
+    if not os.environ.get("GRAFT_BENCH_NO_VARIANTS"):
+        plan = [("fused_lmce", {"PADDLE_TPU_FUSED_LMCE": "1"})]
+        if not tiny:
+            plan = [("nopacked",
+                     {"PADDLE_TPU_FLASH_NO_PACKED": "1"})] + plan
+        for vname, venv in plan:
+            if time.time() - t_child0 > (60 if tiny else 240):
+                variants[vname] = "skipped: out of child budget"
+                continue   # mark EVERY remaining variant, don't vanish
+            saved = {k: os.environ.get(k) for k in venv}
+            os.environ.update(venv)
+            try:
+                vres = _timed_bench(build, steps=2 if tiny else 8)
+                variants[vname] = round(vres[0], 1)
+            except Exception as e:   # variant failure must not kill
+                variants[vname] = f"error: {e}"[:300]
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
     # model flops per token (matmul-only, PaLM-style accounting):
     # 6*N for the dense/embedding matmuls + 6*L*d*S for causal
     # attention (12*L*d*S non-causal halved)
@@ -183,6 +215,8 @@ def bench_gpt():
     if tps_pipe:
         out["tokens_per_sec_pipeline"] = round(tps_pipe, 1)
         out["pipeline_overlap_ratio"] = round(tps_pipe / tps, 3)
+    for vname, v in variants.items():
+        out[f"tokens_per_sec_{vname}"] = v
     if flops_tok:
         peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
         out["model_tflops_per_sec"] = round(tps * flops_tok / 1e12, 2)
@@ -404,10 +438,11 @@ def main():
         tps = gpt.get("tokens_per_sec", 0.0)
         out["value"] = round(tps, 1)
         out["vs_baseline"] = round(tps / BASELINE_TOKENS_PER_SEC, 3)
-        for k in ("step_ms", "mfu", "model_tflops_per_sec",
-                  "flops_per_token_m", "tokens_per_sec_pipeline",
-                  "pipeline_overlap_ratio"):
-            if k in gpt:
+        for k in gpt:
+            if k != "tokens_per_sec" and (
+                    k.startswith("tokens_per_sec_") or k in
+                    ("step_ms", "mfu", "model_tflops_per_sec",
+                     "flops_per_token_m", "pipeline_overlap_ratio")):
                 out["gpt_" + k] = gpt[k]
     else:
         out["error"] = err[-2000:]

@@ -145,6 +145,16 @@ class StaticFunction:
             return ("id", id(v))
 
     @staticmethod
+    def _arg_sig(key, arg_vals, tkw):
+        """Cheap trace-refresh gate: cache key + shape/dtype of every
+        traced input (positional AND tensor-kwarg)."""
+        return key + tuple(
+            (getattr(v, "shape", None), str(getattr(v, "dtype", "")))
+            for v in arg_vals) + tuple(
+            (k, tuple(v.shape), str(v.dtype))
+            for k, v in sorted(tkw.items()))
+
+    @staticmethod
     def _split_kwargs(kwargs):
         """Tensor kwargs become traced jit inputs (a dict pytree);
         non-tensor kwargs are compile-time static and therefore part of
@@ -185,9 +195,7 @@ class StaticFunction:
                 self._cache[key] = jitted
                 self._cache[key + ("raw",)] = pure
             out_vals = jitted(tkw, *arg_vals)
-            sig = key + tuple(
-                (getattr(v, "shape", None), str(getattr(v, "dtype", "")))
-                for v in arg_vals)             # cheap: few user args
+            sig = self._arg_sig(key, arg_vals, tkw)
             if sig not in self._traced_keys:   # refresh per signature,
                 self._traced_keys.add(sig)     # not per step
                 self._record_trace(self._cache[key + ("raw",)],
@@ -223,9 +231,7 @@ class StaticFunction:
         rng_key = _random.default_generator().draw_key()
         out_vals, new_buffers = jitted(params, frozen, buffers, rng_key,
                                        tkw, *arg_vals)
-        sig = key + tuple(
-            (getattr(v, "shape", None), str(getattr(v, "dtype", "")))
-            for v in arg_vals)
+        sig = self._arg_sig(key, arg_vals, tkw)
         if sig not in self._traced_keys:
             self._traced_keys.add(sig)
             self._record_trace(

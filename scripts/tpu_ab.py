@@ -37,6 +37,10 @@ def run_variant(name, env_extra, timeout):
     env = dict(os.environ)
     env.update(env_extra)
     env["_GRAFT_BENCH_CHILD"] = "gpt"
+    # each cell IS one variant — suppress bench_gpt's own in-process
+    # variant sweep (it would nest extra compiles and mislabel
+    # combinations under the cell's env)
+    env["GRAFT_BENCH_NO_VARIANTS"] = "1"
     t0 = time.time()
     try:
         proc = subprocess.run(
@@ -81,9 +85,12 @@ def main():
                 timeout=args.timeout)
         except subprocess.TimeoutExpired:
             proc = None
-            print(json.dumps({"variant": "resnet50",
-                              "error": f"timeout {args.timeout}s"}),
-                  flush=True)
+            r = {"variant": "resnet50",
+                 "error": f"timeout {args.timeout}s"}
+            results.append(r)
+            with open(out_path, "a") as f:
+                f.write(json.dumps(r) + "\n")
+            print(json.dumps(r), flush=True)
         for line in (proc.stdout.splitlines() if proc else []):
             if line.startswith("RESULT "):
                 r = json.loads(line[len("RESULT "):])
