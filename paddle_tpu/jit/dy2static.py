@@ -208,6 +208,38 @@ def any_undef(vals) -> bool:
     return any(v is UNDEF for v in vals)
 
 
+def assert_(pred, msg=None):
+    """`assert` on a traced tensor: upstream lowers to an Assert op;
+    here the check runs via jax.debug (non-blocking) — the assert
+    must not become a Python branch on a tracer."""
+    if not is_traced(pred):
+        assert pred, msg
+        return
+    # soft check via debug callback: warns at RUN time when the traced
+    # predicate is False; never a Python branch on the tracer
+    import jax.debug as jdbg
+
+    def _cb(ok):
+        if not bool(ok):
+            import warnings
+            warnings.warn(f"to_static: assert failed: {msg!r}")
+
+    jdbg.callback(_cb, _pred_value(pred), ordered=False)
+
+
+def print_(*args, **kwargs):
+    """`print` with traced operands → jax.debug.print (values appear
+    at run time, upstream PrintTransformer semantics); all-concrete
+    calls stay plain print."""
+    if not any(is_traced(a) for a in args):
+        print(*args, **kwargs)
+        return
+    import jax.debug as jdbg
+    fmt = " ".join("{}" for _ in args)
+    jdbg.print(fmt, *[_unwrap(a) if is_traced(a) else a
+                      for a in args], ordered=False)
+
+
 def unsupported(what: str):
     raise Dy2StaticError(
         f"to_static: {what} is not convertible to XLA control flow; "
@@ -224,6 +256,8 @@ class _Runtime:
     fori = staticmethod(fori)
     scan_iter = staticmethod(scan_iter)
     any_undef = staticmethod(any_undef)
+    assert_ = staticmethod(assert_)
+    print_ = staticmethod(print_)
     and_ = staticmethod(and_)
     or_ = staticmethod(or_)
     not_ = staticmethod(not_)
@@ -458,6 +492,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return node
 
     def visit_Lambda(self, node):
+        return node
+
+    # ---------------- assert / print ----------------
+    def visit_Assert(self, node: ast.Assert):
+        self.generic_visit(node)
+        self._n += 1      # presence alone requires the rewrite
+        test = ast.unparse(_logical(node.test))
+        msg = ast.unparse(node.msg) if node.msg else "None"
+        return _stmt(f"__d2s__.assert_({test}, {msg})")
+
+    def visit_Expr(self, node: ast.Expr):
+        self.generic_visit(node)
+        c = node.value
+        if (isinstance(c, ast.Call) and isinstance(c.func, ast.Name)
+                and c.func.id == "print" and not c.keywords):
+            self._n += 1
+            args = ", ".join(ast.unparse(a) for a in c.args)
+            return _stmt(f"__d2s__.print_({args})")
         return node
 
     # ---------------- if ----------------

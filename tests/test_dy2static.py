@@ -515,3 +515,42 @@ def test_for_over_tensor_break_unrolls():
 
     xs = np.array([[2., 2.], [5., 5.]], np.float32)
     np.testing.assert_allclose(f(T(xs)).numpy(), [2., 2.])
+
+
+def test_assert_and_print_convert(capfd):
+    """`assert` and `print` on traced tensors don't break the trace
+    (upstream Assert/Print transformer semantics): assert becomes a
+    runtime debug check, print becomes jax.debug.print."""
+    import warnings
+
+    @to_static
+    def f(x):
+        assert x.sum() > -1000, "sanity"
+        print("value:", x)
+        if x.sum() > 0:
+            return x * 2
+        return -x
+
+    out = f(T([3.]))
+    np.testing.assert_allclose(out.numpy(), [6.])
+    # concrete path keeps python semantics
+    @to_static
+    def g(flag=True):
+        assert flag, "must be true"
+        return 1
+
+    assert g() == 1
+    with pytest.raises(AssertionError):
+        g(flag=False)
+
+
+def test_assert_only_function_converts():
+    """A function whose ONLY dynamic construct is a traced assert must
+    still be rewritten (no control flow present)."""
+    @to_static
+    def f(x):
+        assert x.sum() < 1e9
+        return x + 1
+
+    np.testing.assert_allclose(f(T([1.])).numpy(), [2.])
+    assert "__d2s__" in f.code
