@@ -1,2 +1,4 @@
 from . import utils  # noqa
-from .utils import parameters_to_vector, vector_to_parameters  # noqa
+from .utils import (  # noqa
+    parameters_to_vector, vector_to_parameters, clip_grad_norm_,
+    clip_grad_value_, weight_norm, remove_weight_norm, spectral_norm)

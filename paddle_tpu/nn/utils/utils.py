@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ...tensor import Tensor
 
@@ -21,3 +22,200 @@ def vector_to_parameters(vec, parameters, name=None):
         p._value = v[offset:offset + n].reshape(p._value.shape).astype(
             p._value.dtype)
         offset += n
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """In-place global-norm gradient clip over ``p.grad`` (parity:
+    paddle.nn.utils.clip_grad_norm_).  Returns the total norm."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    parameters = list(parameters)   # accept any Iterable (generator!)
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.asarray(0.0, jnp.float32))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._value.astype(jnp.float32))
+                     ** norm_type) for g in grads])) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            "clip_grad_norm_: total norm is non-finite; set "
+            "error_if_nonfinite=False to skip this check")
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor((p.grad._value.astype(jnp.float32) * scale
+                             ).astype(p.grad._value.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """In-place elementwise gradient clip (parity:
+    paddle.nn.utils.clip_grad_value_)."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    parameters = list(parameters)
+    cv = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad = Tensor(jnp.clip(p.grad._value, -cv, cv))
+    return None
+
+
+def _norm_except(v, dim):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)),
+                            axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparametrize ``layer.<name>`` as g * v/||v|| (parity:
+    paddle.nn.utils.weight_norm).  ``<name>_g``/``<name>_v`` become the
+    trainable Parameters; the effective weight is recomputed in a
+    forward pre-hook, so it works in eager AND inside the compiled
+    functional step (the hook runs during the traced forward over the
+    bound parameters)."""
+    from ...tensor import Parameter
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1
+    if dim < 0:
+        dim += w._value.ndim if dim != -1 else 0
+    v0 = w._value
+    if dim == -1:
+        g0 = jnp.sqrt(jnp.sum(jnp.square(v0.astype(jnp.float32))))
+        g0 = g0.reshape([1] * v0.ndim)
+    else:
+        g0 = _norm_except(v0, dim)
+    from ...tensor import Parameter as _P
+    gp = _P(g0.astype(v0.dtype), trainable=w.trainable)
+    vp = _P(v0, trainable=w.trainable)
+    for p_ in (gp, vp):     # keep the original optimization attrs
+        p_.optimize_attr = dict(w.optimize_attr)
+        p_.regularizer = w.regularizer
+    layer._parameters[f"{name}_g"] = gp
+    layer._parameters[f"{name}_v"] = vp
+    # the original weight is no longer a parameter
+    del layer._parameters[name]
+
+    def _compute(lyr, inputs):
+        from ...ops._primitive import apply_closure
+
+        def _wn(g, v):
+            if dim == -1:
+                nrm = jnp.sqrt(jnp.sum(jnp.square(
+                    v.astype(jnp.float32))))
+            else:
+                nrm = _norm_except(v, dim)
+            return (g.astype(jnp.float32) * v.astype(jnp.float32)
+                    / jnp.maximum(nrm, 1e-12)).astype(v.dtype)
+
+        # TAPED closure: eager backward() reaches g and v through the
+        # materialized weight (raw jnp here would freeze them)
+        wt = apply_closure(_wn, [lyr._parameters[f"{name}_g"],
+                                 lyr._parameters[f"{name}_v"]],
+                           name="weight_norm")
+        setattr(lyr, name, wt)
+        return None
+
+    helper = layer.register_forward_pre_hook(_compute)
+    layer._weight_norm_hook = (helper, name, dim)
+    _compute(layer, None)   # materialize once for shape users
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g*v/||v|| back into a plain Parameter and drop the hook."""
+    from ...tensor import Parameter
+    helper, hname, dim = layer._weight_norm_hook
+    assert hname == name, (hname, name)
+    helper.remove()
+    # fold from the CURRENT g/v (the materialized attr may be stale if
+    # g or v changed since the last forward)
+    g = layer._parameters[f"{name}_g"]._value
+    v = layer._parameters[f"{name}_v"]._value
+    if dim == -1:
+        nrm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+    else:
+        nrm = _norm_except(v, dim)
+    w_val = (g.astype(jnp.float32) * v.astype(jnp.float32)
+             / jnp.maximum(nrm, 1e-12)).astype(v.dtype)
+    p = Parameter(w_val)
+    p.stop_gradient = False
+    del layer._parameters[f"{name}_g"]
+    del layer._parameters[f"{name}_v"]
+    # the hook materialized `name` as an INSTANCE attribute each
+    # forward; drop it so the restored Parameter is visible again
+    layer.__dict__.pop(name, None)
+    layer._parameters[name] = p
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Spectral normalization of a layer weight via forward pre-hook
+    (parity: paddle.nn.utils.spectral_norm; the standalone
+    nn.SpectralNorm layer shares the math)."""
+    w = getattr(layer, name)
+    val = w._value
+    if dim is None:
+        dim = 0
+    if dim < 0:
+        dim += val.ndim
+    h = int(val.shape[dim])
+    wmat_size = int(np.prod(val.shape)) // h
+    import jax as _jax
+    from ...framework import random as _random
+    k1, k2 = _jax.random.split(_random.default_generator().draw_key())
+    u = _jax.random.normal(k1, (h,), jnp.float32)
+    v = _jax.random.normal(k2, (wmat_size,), jnp.float32)
+    layer.register_buffer(f"{name}_u",
+                          Tensor(u / (jnp.linalg.norm(u) + eps)))
+    layer.register_buffer(f"{name}_v",
+                          Tensor(v / (jnp.linalg.norm(v) + eps)))
+    orig = layer._parameters[name]
+    layer._parameters[f"{name}_orig"] = orig
+    del layer._parameters[name]
+
+    def _compute(lyr, inputs):
+        from ...ops._primitive import apply_closure
+        import jax.lax as _lax
+
+        wv = lyr._parameters[f"{name}_orig"]._value
+        perm = [dim] + [i for i in range(wv.ndim) if i != dim]
+        # power iteration on stop-gradient values (standard SN: u/v are
+        # constants for the gradient; sigma = u^T W v still carries
+        # grad through W below)
+        mat = jnp.transpose(wv, perm).reshape(h, wmat_size) \
+            .astype(jnp.float32)
+        uu = lyr._buffers[f"{name}_u"]._value
+        vv = lyr._buffers[f"{name}_v"]._value
+        for _ in range(n_power_iterations):
+            vv = mat.T @ uu
+            vv = vv / (jnp.linalg.norm(vv) + eps)
+            uu = mat @ vv
+            uu = uu / (jnp.linalg.norm(uu) + eps)
+        if lyr.training:
+            lyr._buffers[f"{name}_u"]._value = uu
+            lyr._buffers[f"{name}_v"]._value = vv
+
+        def _sn(worig):
+            m = jnp.transpose(worig, perm).reshape(h, wmat_size) \
+                .astype(jnp.float32)
+            sigma = uu @ m @ vv
+            return (worig.astype(jnp.float32)
+                    / jnp.maximum(sigma, eps)).astype(worig.dtype)
+
+        wt = apply_closure(_sn, [lyr._parameters[f"{name}_orig"]],
+                           name="spectral_norm")
+        setattr(lyr, name, wt)
+        return None
+
+    layer.register_forward_pre_hook(_compute)
+    _compute(layer, None)
+    return layer

@@ -454,3 +454,140 @@ def test_amp_o2_conv_train_step_compiles():
     l1 = float(r.train_step([x], [y]))
     assert np.isfinite(l0) and np.isfinite(l1)
     assert l1 < l0   # params actually updated through the bf16 path
+
+
+def test_nn_utils_clip_grad_norm_():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import clip_grad_norm_, clip_grad_value_
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = nn.Linear(4, 4)
+    loss = paddle.mean(fc(Tensor(np.ones((2, 4), np.float32) * 100)) ** 2)
+    loss.backward()
+    total = clip_grad_norm_(list(fc.parameters()), max_norm=1.0)
+    gn = np.sqrt(sum(float((np.asarray(p.grad.numpy()) ** 2).sum())
+                     for p in fc.parameters()))
+    assert gn < 1.0 + 1e-4, gn
+    assert float(total.numpy()) > 1.0     # pre-clip norm was large
+    clip_grad_value_(list(fc.parameters()), 0.01)
+    for p in fc.parameters():
+        assert np.abs(p.grad.numpy()).max() <= 0.01 + 1e-7
+
+
+def test_nn_utils_weight_norm_roundtrip():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import weight_norm, remove_weight_norm
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = nn.Linear(4, 3)
+    x = Tensor(np.random.RandomState(0).randn(2, 4).astype(np.float32))
+    before = fc(x).numpy()
+    weight_norm(fc, name="weight", dim=0)
+    names = dict(fc.named_parameters())
+    assert any(n.endswith("weight_g") for n in names)
+    assert any(n.endswith("weight_v") for n in names)
+    np.testing.assert_allclose(fc(x).numpy(), before, rtol=1e-5,
+                               atol=1e-5)
+    # g scales the output: doubling g doubles the weight contribution
+    fc.weight_g._value = fc.weight_g._value * 2.0
+    out2 = fc(x).numpy()
+    bias = fc.bias.numpy()
+    np.testing.assert_allclose(out2 - bias, (before - bias) * 2,
+                               rtol=1e-4, atol=1e-4)
+    fc.weight_g._value = fc.weight_g._value / 2.0
+    remove_weight_norm(fc)
+    names = dict(fc.named_parameters())
+    assert not any(n.endswith("weight_g") for n in names)
+    np.testing.assert_allclose(fc(x).numpy(), before, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_nn_utils_weight_norm_trains():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.nn.utils import weight_norm
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = weight_norm(nn.Linear(4, 1))
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=fc.parameters())
+    X = Tensor(np.random.RandomState(0).randn(16, 4).astype(np.float32))
+    Y = Tensor(np.random.RandomState(1).randn(16, 1).astype(np.float32))
+    losses = []
+    for _ in range(15):
+        loss = paddle.mean((fc(X) - Y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_nn_utils_spectral_norm_hook():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import spectral_norm
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(3)
+    fc = spectral_norm(nn.Linear(6, 8), n_power_iterations=30)
+    fc.train()
+    x = Tensor(np.eye(6, dtype=np.float32))
+    _ = fc(x)
+    w = np.asarray(fc.weight.numpy())
+    sigma = np.linalg.svd(w.T, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=5e-3)
+
+
+def test_nn_utils_weight_norm_eager_grads_flow():
+    """Eager backward() must reach weight_g/weight_v through the
+    hooked reparametrization (review finding: raw-jnp hook froze
+    them)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import weight_norm
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = weight_norm(nn.Linear(4, 2))
+    x = Tensor(np.ones((3, 4), np.float32))
+    loss = paddle.mean(fc(x) ** 2)
+    loss.backward()
+    assert fc._parameters["weight_g"].grad is not None
+    assert fc._parameters["weight_v"].grad is not None
+    assert np.abs(fc._parameters["weight_v"].grad.numpy()).sum() > 0
+
+
+def test_nn_utils_spectral_norm_eager_grads_and_defaults():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import spectral_norm
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = spectral_norm(nn.Linear(4, 2), dim=None)   # paddle default
+    loss = paddle.mean(fc(Tensor(np.ones((3, 4), np.float32))) ** 2)
+    loss.backward()
+    g = fc._parameters["weight_orig"].grad
+    assert g is not None and np.abs(g.numpy()).sum() > 0
+
+
+def test_clip_grad_norm_accepts_generator():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.nn.utils import clip_grad_norm_
+    from paddle_tpu.tensor import Tensor
+    paddle.seed(0)
+    fc = nn.Linear(4, 4)
+    loss = paddle.mean(fc(Tensor(np.ones((2, 4), np.float32) * 100)) ** 2)
+    loss.backward()
+    clip_grad_norm_((p for p in fc.parameters()), 1.0)   # generator!
+    gn = np.sqrt(sum(float((np.asarray(p.grad.numpy()) ** 2).sum())
+                     for p in fc.parameters()))
+    assert gn < 1.0 + 1e-4, gn
