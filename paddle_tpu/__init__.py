@@ -111,6 +111,8 @@ from . import distribution  # noqa
 from .framework.io import save, load  # noqa
 from .hapi.model import Model  # noqa
 from .hapi import callbacks  # noqa
+from . import audio  # noqa
+from . import text  # noqa
 from .jit import to_static  # noqa
 from .distributed.parallel import DataParallel  # noqa
 

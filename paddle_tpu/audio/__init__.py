@@ -1,0 +1,15 @@
+"""paddle.audio (parity: python/paddle/audio/ — functional features +
+feature Layers over the signal/stft stack).
+
+TPU-first: every feature is a pure jnp pipeline over the framework's
+stft (one rfft matmul-class op XLA handles well), so Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC run inside compiled train
+steps (speech frontends train on-device instead of on the host)."""
+
+from . import functional  # noqa
+from . import features  # noqa
+from .functional import (  # noqa
+    get_window, hz_to_mel, mel_to_hz, mel_frequencies, fft_frequencies,
+    compute_fbank_matrix, power_to_db, create_dct)
+from .features import (  # noqa
+    Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC)

@@ -1,0 +1,123 @@
+"""paddle.audio.features (parity: python/paddle/audio/features/layers.py):
+Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC as nn.Layers —
+pure jnp pipelines over the framework stft, usable inside compiled
+training steps."""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from ..nn import Layer
+from ..tensor import Tensor
+from .. import signal as _signal
+from . import functional as F
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length, fftbins=True,
+                         dtype=dtype)
+        self.register_buffer("window", w)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+        v = spec._value if isinstance(spec, Tensor) else spec
+        mag = jnp.abs(v)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        fb = F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                    htk, norm, dtype)
+        self.register_buffer("fbank_matrix", fb)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)._value      # [..., freq, time]
+        mel = jnp.matmul(self.fbank_matrix._value.astype(spec.dtype),
+                         spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin,
+                             self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40,
+                 n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None,
+                 window: Union[str, tuple] = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: str = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+        self.register_buffer("dct_matrix", dct)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)._value  # [..., mel, time]
+        mfcc = jnp.einsum("...mt,mk->...kt", logmel,
+                          self.dct_matrix._value.astype(logmel.dtype))
+        return Tensor(mfcc)

@@ -1,0 +1,152 @@
+"""paddle.audio.functional (parity: python/paddle/audio/functional/):
+windows, mel scale, filterbanks, dB conversion, DCT basis."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def _wrap(v, dtype):
+    return Tensor(jnp.asarray(v, dtype=dtype))
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float64"):
+    """Hann/Hamming/Blackman/... windows (upstream get_window subset).
+    ``fftbins=True`` gives the periodic variant (DFT-even)."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + 1 if fftbins else win_length
+    k = np.arange(n)
+    if name in ("hann", "hanning"):
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (n - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (n - 1)))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * k / (n - 1) - 1)
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(n)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((k - (n - 1) / 2) / std) ** 2)
+    elif name == "exponential":
+        tau = args[0] if args else 1.0
+        w = np.exp(-np.abs(k - (n - 1) / 2) / tau)
+    elif name == "triang":
+        w = 1.0 - np.abs((k - (n - 1) / 2) / (n / 2))
+    else:
+        raise ValueError(f"get_window: unknown window {name!r}")
+    if fftbins:
+        w = w[:-1]
+    return _wrap(w, dtype)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Hz → mel (Slaney by default, HTK optional — upstream parity)."""
+    f = jnp.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                    jnp.float64)
+    scalar = f.ndim == 0 and not isinstance(freq, Tensor)
+    if htk:
+        m = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        m = jnp.where(f >= min_log_hz,
+                      min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                      mels)
+    return float(m) if scalar else Tensor(m)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = jnp.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                    jnp.float64)
+    scalar = m.ndim == 0 and not isinstance(mel, Tensor)
+    if htk:
+        f = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        f = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return float(f) if scalar else Tensor(f)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float64"):
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels, dtype=jnp.float64)
+    return _wrap(mel_to_hz(Tensor(mels), htk)._value, dtype)
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float64"):
+    return _wrap(jnp.linspace(0, sr / 2.0, 1 + n_fft // 2,
+                              dtype=jnp.float64), dtype)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0,
+                         f_max: Optional[float] = None,
+                         htk: bool = False, norm: str = "slaney",
+                         dtype: str = "float64"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fft_f = fft_frequencies(sr, n_fft)._value
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._value
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return _wrap(weights, dtype)
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10*log10(S/ref) with optional dynamic-range clamp."""
+    s = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float64"):
+    """DCT-II basis [n_mels, n_mfcc] (upstream create_dct)."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    basis = np.cos(np.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(n_mels)
+        basis[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return _wrap(basis, dtype)

@@ -1,0 +1,10 @@
+"""paddle.text (parity: python/paddle/text/datasets/) — the core-paddle
+text datasets, backed by deterministic synthetic corpora (this build is
+offline; the real downloads are unavailable, same policy as
+paddle_tpu.vision.datasets).  Shapes/dtypes/field layouts match
+upstream so input pipelines port unchanged; set PADDLE_TPU_SYNTH_N to
+resize."""
+
+from .datasets import (  # noqa
+    Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16, ViterbiDecoder,
+    viterbi_decode)

@@ -1,0 +1,233 @@
+"""Synthetic-backed text datasets + viterbi decode (upstream
+python/paddle/text/{datasets,viterbi_decode}).
+
+Each dataset is a map-style ``io.Dataset`` with the upstream field
+layout.  Data is generated from a seeded RNG per (mode, size): stable
+across runs, no network."""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..io.dataset import Dataset
+from ..tensor import Tensor
+
+
+def _n(default=512):
+    return int(os.environ.get("PADDLE_TPU_SYNTH_N", default))
+
+
+class Imdb(Dataset):
+    """Movie-review sentiment: (ids int64 [seq], label int64)."""
+
+    def __init__(self, mode: str = "train", cutoff: int = 150,
+                 seq_len: int = 128, vocab_size: int = 5147):
+        self.mode = mode
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self._seed = {"train": 1, "test": 2}.get(mode, 3)
+        self._n = _n()
+        self.word_idx = {f"w{i}": i for i in range(vocab_size)}
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        # per-INDEX seed: same index always returns the same sample
+        # (map-style Dataset contract)
+        rng = np.random.RandomState(self._seed * 1000003 + i)
+        label = np.int64(i % 2)
+        # sentiment-correlated token distribution so models can learn
+        lo, hi = (0, self.vocab_size // 2) if label == 0 else \
+            (self.vocab_size // 2, self.vocab_size)
+        ids = rng.randint(lo, hi, self.seq_len).astype(np.int64)
+        return ids, label
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM: tuple of n int64 ids."""
+
+    def __init__(self, mode: str = "train", data_type: str = "NGRAM",
+                 window_size: int = 5, min_word_freq: int = 50):
+        if data_type not in ("NGRAM",):
+            raise NotImplementedError(
+                f"Imikolov data_type={data_type!r}: only 'NGRAM' is "
+                "implemented on this build (SEQ pending)")
+        self.window_size = window_size
+        self.vocab_size = 2074
+        self._n = _n()
+        self._seed = {"train": 11, "test": 12}.get(mode, 13)
+        self.word_idx = {f"w{i}": i for i in range(self.vocab_size)}
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(self._seed * 1000003 + i)
+        return tuple(rng.randint(0, self.vocab_size,
+                                 self.window_size).astype(np.int64))
+
+
+class Movielens(Dataset):
+    """Rating prediction: (user_id, gender, age, job, movie_id,
+    category, title, rating)."""
+
+    def __init__(self, mode: str = "train", test_ratio: float = 0.1,
+                 rand_seed: int = 0):
+        self._n = _n()
+        self._seed = {"train": 21, "test": 22}.get(mode, 23)
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(self._seed * 1000003 + i)
+        return (np.int64(rng.randint(1, 6041)),
+                np.int64(rng.randint(0, 2)),
+                np.int64(rng.randint(0, 7)),
+                np.int64(rng.randint(0, 21)),
+                np.int64(rng.randint(1, 3953)),
+                rng.randint(0, 19, 3).astype(np.int64),
+                rng.randint(0, 5215, 4).astype(np.int64),
+                np.float32(rng.randint(1, 6)))
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression: (features f32[13], price f32[1])."""
+
+    def __init__(self, mode: str = "train"):
+        self._n = _n(404 if mode == "train" else 102)
+        rng = np.random.RandomState(31 if mode == "train" else 32)
+        self._x = rng.randn(self._n, 13).astype(np.float32)
+        w = np.linspace(-1, 1, 13).astype(np.float32)
+        self._y = (self._x @ w + 22.5
+                   + rng.randn(self._n).astype(np.float32) * 0.5)
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        return self._x[i], self._y[i:i + 1]
+
+
+class _WMTBase(Dataset):
+    def __init__(self, mode, src_dict_size, trg_dict_size, seq_len=32):
+        self._n = _n()
+        self._seed = {"train": 41, "test": 42, "dev": 43,
+                      "val": 43}.get(mode, 44)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        self.seq_len = seq_len
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(self._seed * 1000003 + i)
+        src = rng.randint(3, self.src_dict_size,
+                          self.seq_len).astype(np.int64)
+        trg = rng.randint(3, self.trg_dict_size,
+                          self.seq_len).astype(np.int64)
+        trg_next = np.roll(trg, -1)
+        return src, trg, trg_next
+
+
+class WMT14(_WMTBase):
+    def __init__(self, mode: str = "train", dict_size: int = 30000):
+        super().__init__(mode, dict_size, dict_size)
+
+
+class WMT16(_WMTBase):
+    def __init__(self, mode: str = "train", src_dict_size: int = 30000,
+                 trg_dict_size: int = 30000, lang: str = "en"):
+        super().__init__(mode, src_dict_size, trg_dict_size)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True):
+    """CRF viterbi decode (parity: paddle.text.viterbi_decode).
+
+    potentials: [B, T, N] emission scores; transition_params: [N, N];
+    lengths: [B] int64.  Returns (scores [B], paths [B, T] int64).
+    ``include_bos_eos_tag=True`` (upstream default): the LAST TWO tag
+    columns are BOS/EOS — start scores come from trans[BOS, :] and
+    stop scores from trans[:, EOS], and neither pseudo-tag is emitted
+    in the decoded path.  Pure lax.scan — jit/TPU friendly."""
+    import jax
+    from jax import lax
+
+    pot = potentials._value if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._value \
+        if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    lens = lengths._value if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+    B, T, N = pot.shape
+    if include_bos_eos_tag:
+        if N < 3:
+            raise ValueError(
+                "include_bos_eos_tag=True needs at least 3 tags "
+                "(real tags + BOS + EOS)")
+        bos, eos = N - 2, N - 1
+        real = N - 2
+        # start: BOS -> tag transition added to the first emission;
+        # stop: tag -> EOS added after the last frame.  The pseudo
+        # tags never appear in the path: decode over the real tags.
+        start = trans[bos, :real]
+        stop = trans[:real, eos]
+        pot = pot[:, :, :real].at[:, 0, :].add(start[None])
+        # add stop score at each sequence's LAST valid frame
+        t_idx = jnp.arange(T)[None, :, None]
+        last = (lens - 1)[:, None, None]
+        pot = pot + jnp.where(t_idx == last, stop[None, None, :], 0.0)
+        trans = trans[:real, :real]
+        N = real
+
+    def step(carry, t):
+        alpha = carry                       # [B, N]
+        emit = pot[:, t]                    # [B, N]
+        scores = alpha[:, :, None] + trans[None]     # [B, N, N]
+        best_prev = jnp.argmax(scores, axis=1)       # [B, N]
+        alpha_new = jnp.max(scores, axis=1) + emit
+        # frozen past the sequence end
+        active = (t < lens)[:, None]
+        alpha_new = jnp.where(active, alpha_new, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.broadcast_to(jnp.arange(N)[None],
+                                               (B, N)))
+        return alpha_new, best_prev
+
+    alpha0 = pot[:, 0]
+    alpha, backptrs = lax.scan(step, alpha0, jnp.arange(1, T))
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1)            # [B]
+
+    def backtrack(carry, bp_t):
+        tag = carry                                  # [B]
+        prev = jnp.take_along_axis(bp_t, tag[:, None], 1)[:, 0]
+        return prev, tag
+
+    first_tag, path_rev = lax.scan(backtrack, last_tag, backptrs,
+                                   reverse=True)
+    # reverse scan emits tags 1..T-1 in order; the final carry is tag 0
+    paths = jnp.concatenate([first_tag[None], path_rev], 0)
+    paths = jnp.transpose(paths, (1, 0))             # [B, T]
+    return Tensor(scores), Tensor(paths.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (upstream paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

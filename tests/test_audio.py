@@ -1,0 +1,85 @@
+"""paddle.audio features (upstream python/paddle/audio parity):
+windows/mel scale vs closed forms, features vs a direct numpy
+reference, MFCC orthogonal DCT."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+from paddle_tpu.tensor import Tensor
+
+
+def test_get_window_matches_numpy():
+    w = audio.get_window("hann", 16, fftbins=True).numpy()
+    np.testing.assert_allclose(w, np.hanning(17)[:-1], atol=1e-12)
+    w2 = audio.get_window("hamming", 12, fftbins=False).numpy()
+    np.testing.assert_allclose(w2, np.hamming(12), atol=1e-12)
+
+
+def test_mel_scale_roundtrip_and_knots():
+    for htk in (False, True):
+        f = np.array([0.0, 440.0, 1000.0, 4000.0, 11025.0])
+        m = audio.hz_to_mel(Tensor(f), htk=htk).numpy()
+        back = audio.mel_to_hz(Tensor(m), htk=htk).numpy()
+        np.testing.assert_allclose(back, f, rtol=1e-6, atol=1e-3)
+    # slaney scale is linear below 1 kHz
+    assert abs(audio.hz_to_mel(500.0) - 7.5) < 1e-6
+
+
+def test_fbank_matrix_shape_and_partition():
+    fb = audio.compute_fbank_matrix(sr=16000, n_fft=512, n_mels=40,
+                                    norm=None).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # each filter is a triangle: single max, zero at edges
+    assert fb[0, 0] == 0.0
+    assert (fb.sum(1) > 0).all()
+
+
+def test_spectrogram_matches_numpy_reference():
+    sr, n_fft, hop = 8000, 256, 64
+    t = np.arange(sr, dtype=np.float32) / sr
+    x = np.sin(2 * np.pi * 440 * t).astype(np.float32)[None]
+    spec = audio.Spectrogram(n_fft=n_fft, hop_length=hop,
+                             power=2.0)(Tensor(x)).numpy()[0]
+    # energy concentrates at the 440 Hz bin
+    peak_bin = spec.mean(-1).argmax()
+    expect = round(440 * n_fft / sr)
+    assert abs(int(peak_bin) - expect) <= 1, (peak_bin, expect)
+
+
+def test_mel_and_logmel_and_mfcc_shapes():
+    paddle.seed(0)
+    x = Tensor(np.random.RandomState(0).randn(2, 4000)
+               .astype(np.float32))
+    mel = audio.MelSpectrogram(sr=8000, n_fft=256, n_mels=32,
+                               hop_length=128)(x)
+    assert mel.shape[0] == 2 and mel.shape[1] == 32
+    logmel = audio.LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32,
+                                     hop_length=128, top_db=80.0)(x)
+    lm = logmel.numpy()
+    assert np.isfinite(lm).all()
+    assert lm.max() - lm.min() <= 80.0 + 1e-3
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32,
+                      hop_length=128)(x)
+    assert mfcc.shape[1] == 13
+
+
+def test_create_dct_orthonormal():
+    d = audio.create_dct(8, 8, norm="ortho").numpy()
+    np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-10)
+
+
+def test_power_to_db_clamp():
+    s = Tensor(np.array([1e-12, 1.0, 100.0], np.float64))
+    db = audio.power_to_db(s, top_db=30.0).numpy()
+    assert db.max() == pytest.approx(20.0)
+    assert db.min() >= db.max() - 30.0 - 1e-9
+
+
+def test_mel_converters_accept_lists():
+    m = audio.hz_to_mel([440.0, 1000.0])
+    assert m.shape == [2]
+    f = audio.mel_to_hz([10.0, 25.0])
+    assert f.shape == [2]

@@ -1,0 +1,124 @@
+"""paddle.text datasets + viterbi decode (upstream python/paddle/text
+parity; datasets synthetic-backed like vision's)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import text
+from paddle_tpu.tensor import Tensor
+
+
+def test_datasets_shapes_and_determinism():
+    ds = text.Imdb(mode="train", seq_len=64)
+    ids, label = ds[5]
+    assert ids.shape == (64,) and ids.dtype == np.int64
+    assert label in (0, 1)
+    ids2, label2 = text.Imdb(mode="train", seq_len=64)[5]
+    np.testing.assert_array_equal(ids, ids2)
+
+    g = text.Imikolov(window_size=5)[0]
+    assert len(g) == 5
+
+    u = text.UCIHousing(mode="train")
+    x, y = u[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+    s, t, tn = text.WMT14(mode="train")[3]
+    assert s.dtype == np.int64 and t.shape == tn.shape
+
+    m = text.Movielens()[7]
+    assert len(m) == 8
+
+
+def test_uci_housing_learnable():
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.io import DataLoader
+    paddle.seed(0)
+    net = nn.Linear(13, 1)
+    opt = optimizer.Adam(0.5, parameters=net.parameters())
+    dl = DataLoader(text.UCIHousing("train"), batch_size=64,
+                    shuffle=True)
+    losses = []
+    for epoch in range(10):
+        for xb, yb in dl:
+            loss = paddle.mean((net(xb) - yb) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def _brute_viterbi(pot, trans, L):
+    import itertools
+    N = pot.shape[-1]
+    best, path = -1e30, None
+    for tags in itertools.product(range(N), repeat=L):
+        s = pot[0, tags[0]]
+        for t in range(1, L):
+            s += trans[tags[t - 1], tags[t]] + pot[t, tags[t]]
+        if s > best:
+            best, path = s, tags
+    return best, list(path)
+
+
+def test_viterbi_decode_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.full((B,), T, np.int64)
+    scores, paths = text.viterbi_decode(
+        Tensor(pot), Tensor(trans), Tensor(lens),
+        include_bos_eos_tag=False)
+    for b in range(B):
+        bs, bp = _brute_viterbi(pot[b], trans, T)
+        assert abs(float(scores.numpy()[b]) - bs) < 1e-4
+        assert list(paths.numpy()[b]) == bp, (b, paths.numpy()[b], bp)
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(1)
+    trans = Tensor(rng.randn(3, 3).astype(np.float32))
+    dec = text.ViterbiDecoder(trans)
+    pot = Tensor(rng.randn(2, 4, 3).astype(np.float32))
+    scores, paths = dec(pot, Tensor(np.array([4, 4], np.int64)))
+    assert paths.shape == [2, 4]
+
+
+def test_dataset_same_index_same_sample():
+    ds = text.Imdb(mode="train", seq_len=32)
+    a1, l1 = ds[5]
+    a2, l2 = ds[5]
+    np.testing.assert_array_equal(a1, a2)
+    with pytest.raises(NotImplementedError):
+        text.Imikolov(data_type="SEQ")
+
+
+def test_viterbi_bos_eos_semantics():
+    """BOS/EOS pseudo tags shape start/stop scores and never appear in
+    the decoded path."""
+    rng = np.random.RandomState(2)
+    B, T, real = 2, 4, 3
+    N = real + 2
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lens = np.full((B,), T, np.int64)
+    scores, paths = text.viterbi_decode(
+        Tensor(pot), Tensor(trans), Tensor(lens),
+        include_bos_eos_tag=True)
+    assert (paths.numpy() < real).all()
+    # brute force over real tags with start/stop adjustments
+    import itertools
+    for b in range(B):
+        best, bestp = -1e30, None
+        for tags in itertools.product(range(real), repeat=T):
+            s = trans[real, tags[0]] + pot[b, 0, tags[0]]
+            for t in range(1, T):
+                s += trans[tags[t - 1], tags[t]] + pot[b, t, tags[t]]
+            s += trans[tags[-1], real + 1]
+            if s > best:
+                best, bestp = s, list(tags)
+        assert abs(float(scores.numpy()[b]) - best) < 1e-4
+        assert list(paths.numpy()[b]) == bestp
