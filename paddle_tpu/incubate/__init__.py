@@ -6,3 +6,5 @@ from . import distributed  # noqa
 from . import nn  # noqa
 from . import asp  # noqa
 from . import autograd  # noqa
+from . import optimizer  # noqa
+from .optimizer import LookAhead, ModelAverage  # noqa

@@ -1,0 +1,189 @@
+"""paddle.incubate.optimizer (parity: python/paddle/incubate/optimizer/
+— LookAhead and ModelAverage, the two dygraph wrapper optimizers).
+
+Both wrap an inner optimizer and keep auxiliary parameter copies; the
+copies live as jnp arrays and the update math is pure, so the wrappers
+compose with the compiled engines the same way the inner optimizers
+do."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor
+from ..optimizer.optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead (Zhang et al. 2019): every ``k`` inner steps,
+    slow weights move ``alpha`` toward the fast weights and the fast
+    weights reset to the slow ones."""
+
+    def __init__(self, inner_optimizer: Optimizer, alpha: float = 0.5,
+                 k: int = 5, name: Optional[str] = None):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        self._slow: Dict[int, jnp.ndarray] = {}
+        self._step_count = 0
+        # base-class state the inherited Optimizer API dereferences
+        self._state: Dict[str, Dict] = {}
+        self._learning_rate = inner_optimizer._learning_rate
+        self._global_step = 0
+        self._grad_clip = None
+        self._opt_state_tree = None
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        self.inner_optimizer.set_lr(value)
+        self._learning_rate = self.inner_optimizer._learning_rate
+
+    def step(self):
+        if not self._slow:
+            for p in self._parameter_list:
+                self._slow[id(p)] = p._value
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            for p in self._parameter_list:
+                slow = self._slow[id(p)]
+                new_slow = slow + self.alpha * (p._value - slow)
+                self._slow[id(p)] = new_slow
+                p._value = new_slow
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@LookAhead.step_count"] = self._step_count
+        for i, p in enumerate(self._parameter_list):
+            if id(p) in self._slow:
+                sd[f"@LookAhead.slow_{i}"] = Tensor(
+                    np.asarray(self._slow[id(p)]))
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(
+            state_dict.pop("@LookAhead.step_count", 0))
+        for i, p in enumerate(self._parameter_list):
+            key = f"@LookAhead.slow_{i}"
+            if key in state_dict:
+                v = state_dict.pop(key)
+                self._slow[id(p)] = jnp.asarray(
+                    v.numpy() if isinstance(v, Tensor) else v)
+        self.inner_optimizer.set_state_dict(state_dict)
+
+
+class ModelAverage(Optimizer):
+    """Running average of parameters (upstream ModelAverage): keeps
+    sum_1/sum_2/sum_3 style accumulation reduced to one running sum +
+    count; ``apply()`` swaps averaged weights in (context manager),
+    ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise ValueError("parameters is required in dygraph mode")
+        self._parameter_list = list(parameters)
+        self.avg_rate = float(average_window_rate)
+        self.min_window = int(min_average_window)
+        self.max_window = int(max_average_window)
+        self._sum: Dict[int, jnp.ndarray] = {}
+        self._count = 0
+        self._backup: Dict[int, jnp.ndarray] = {}
+        self._state: Dict[str, Dict] = {}
+        self._learning_rate = 0.0
+        self._global_step = 0
+        self._grad_clip = None
+        self._opt_state_tree = None
+
+    def get_lr(self):
+        return 0.0
+
+    def state_dict(self):
+        out = {"@ModelAverage.count": self._count}
+        for i, p in enumerate(self._parameter_list):
+            if id(p) in self._sum:
+                out[f"@ModelAverage.sum_{i}"] = Tensor(
+                    np.asarray(self._sum[id(p)]))
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._count = int(state_dict.get("@ModelAverage.count", 0))
+        for i, p in enumerate(self._parameter_list):
+            key = f"@ModelAverage.sum_{i}"
+            if key in state_dict:
+                v = state_dict[key]
+                self._sum[id(p)] = jnp.asarray(
+                    v.numpy() if isinstance(v, Tensor) else v)
+
+    def step(self):
+        """Accumulate the current weights into the running average
+        (call after the inner optimizer's step)."""
+        window = max(self.min_window,
+                     min(self.max_window,
+                         int(self._count * self.avg_rate) + 1))
+        for p in self._parameter_list:
+            s = self._sum.get(id(p))
+            self._sum[id(p)] = p._value if s is None else s + p._value
+        self._count += 1
+        if self._count > window:
+            # slide: decay the sum so the window stays bounded
+            scale = window / self._count
+            for k in self._sum:
+                self._sum[k] = self._sum[k] * scale
+            self._count = window
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap averaged weights in; use as a context manager."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._backup = {id(p): p._value
+                            for p in self._parameter_list}
+            n = max(self._count, 1)
+            for p in self._parameter_list:
+                if id(p) in self._sum:
+                    p._value = (self._sum[id(p)] / n).astype(
+                        p._value.dtype)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return _ctx()
+
+    def restore(self, executor=None):
+        for p in self._parameter_list:
+            if id(p) in self._backup:
+                p._value = self._backup[id(p)]
+        self._backup = {}
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.grad = None
+
+    clear_gradients = clear_grad

@@ -173,7 +173,9 @@ def viterbi_decode(potentials, transition_params, lengths,
             raise ValueError(
                 "include_bos_eos_tag=True needs at least 3 tags "
                 "(real tags + BOS + EOS)")
-        bos, eos = N - 2, N - 1
+        # upstream convention: the LAST tag is the start (BOS) tag,
+        # the second-to-last is the stop (EOS) tag
+        bos, eos = N - 1, N - 2
         real = N - 2
         # start: BOS -> tag transition added to the first emission;
         # stop: tag -> EOS added after the last frame.  The pseudo
@@ -220,14 +222,22 @@ def viterbi_decode(potentials, transition_params, lengths,
     return Tensor(scores), Tensor(paths.astype(jnp.int64))
 
 
-class ViterbiDecoder:
-    """Layer-style wrapper (upstream paddle.text.ViterbiDecoder)."""
+from ..nn import Layer as _Layer
+
+
+class ViterbiDecoder(_Layer):
+    """nn.Layer wrapper (upstream paddle.text.ViterbiDecoder): the
+    transitions register as a buffer so state_dict / sublayer walks /
+    dtype moves see them."""
 
     def __init__(self, transitions, include_bos_eos_tag: bool = True,
                  name=None):
-        self.transitions = transitions
+        super().__init__()
+        t = transitions if isinstance(transitions, Tensor) \
+            else Tensor(np.asarray(transitions))
+        self.register_buffer("transitions", t)
         self.include_bos_eos_tag = include_bos_eos_tag
 
-    def __call__(self, potentials, lengths):
+    def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)

@@ -116,3 +116,79 @@ def test_fused_layer_classes_train():
         losses.append(float(loss.numpy()))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_lookahead_optimizer():
+    """LookAhead (incubate): slow weights sync every k steps and
+    training converges."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.incubate import LookAhead
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    fc = nn.Linear(4, 1)
+    inner = optimizer.SGD(learning_rate=0.1,
+                          parameters=fc.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=3)
+    rng = np.random.RandomState(0)
+    X = Tensor(rng.randn(32, 4).astype(np.float32))
+    Y = Tensor((rng.randn(32, 1) * 0.1 + 2.0).astype(np.float32))
+    losses = []
+    for i in range(30):
+        loss = paddle.mean((fc(X) - Y) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.2
+    sd = opt.state_dict()
+    assert "@LookAhead.step_count" in sd
+    opt2 = LookAhead(optimizer.SGD(learning_rate=0.1,
+                                   parameters=fc.parameters()), k=3)
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 30
+
+
+def test_model_average_apply_restore():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.incubate import ModelAverage
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    fc = nn.Linear(2, 1)
+    sgd = optimizer.SGD(learning_rate=0.5,
+                        parameters=fc.parameters())
+    avg = ModelAverage(0.15, parameters=fc.parameters(),
+                       min_average_window=2, max_average_window=10)
+    X = Tensor(np.ones((4, 2), np.float32))
+    Y = Tensor(np.zeros((4, 1), np.float32))
+    weights = []
+    for _ in range(6):
+        loss = paddle.mean((fc(X) - Y) ** 2)
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        avg.step()
+        weights.append(fc.weight.numpy().copy())
+    current = fc.weight.numpy().copy()
+    # reference recomputation of the documented algorithm: running sum
+    # with sliding-window decay, applied = sum / count
+    ref_sum, ref_count = None, 0
+    for w in weights:
+        ref_sum = w if ref_sum is None else ref_sum + w
+        ref_count += 1
+        window = max(avg.min_window,
+                     min(avg.max_window,
+                         int((ref_count - 1) * avg.avg_rate) + 1))
+        if ref_count > window:
+            ref_sum = ref_sum * (window / ref_count)
+            ref_count = window
+    with avg.apply():
+        applied = fc.weight.numpy().copy()
+        np.testing.assert_allclose(applied, ref_sum / ref_count,
+                                   rtol=1e-5)
+    np.testing.assert_array_equal(fc.weight.numpy(), current)

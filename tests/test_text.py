@@ -114,10 +114,12 @@ def test_viterbi_bos_eos_semantics():
     for b in range(B):
         best, bestp = -1e30, None
         for tags in itertools.product(range(real), repeat=T):
-            s = trans[real, tags[0]] + pot[b, 0, tags[0]]
+            # upstream: LAST tag = BOS (start row), second-to-last =
+            # EOS (stop column)
+            s = trans[real + 1, tags[0]] + pot[b, 0, tags[0]]
             for t in range(1, T):
                 s += trans[tags[t - 1], tags[t]] + pot[b, t, tags[t]]
-            s += trans[tags[-1], real + 1]
+            s += trans[tags[-1], real]
             if s > best:
                 best, bestp = s, list(tags)
         assert abs(float(scores.numpy()[b]) - best) < 1e-4
