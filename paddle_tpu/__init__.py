@@ -113,6 +113,7 @@ from .hapi.model import Model  # noqa
 from .hapi import callbacks  # noqa
 from . import audio  # noqa
 from . import text  # noqa
+from . import geometric  # noqa
 from .jit import to_static  # noqa
 from .distributed.parallel import DataParallel  # noqa
 

@@ -1,0 +1,142 @@
+"""paddle.geometric (parity: python/paddle/geometric/) — graph segment
+reductions and message passing.
+
+TPU-first: everything lowers to jax segment reductions (sorted or not,
+XLA scatter-based) with STATIC output sizes — pass ``num_segments`` /
+rely on ``out_size`` the way upstream's dynamic-shape kernels cannot be
+expressed under jit.  All ops are taped (differentiable in eager)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from ..ops._primitive import primitive, unwrap
+
+
+@primitive
+def segment_sum(data, segment_ids):
+    n = int(jnp.max(segment_ids)) + 1 if not isinstance(
+        segment_ids, jax.core.Tracer) else None
+    if n is None:
+        raise ValueError(
+            "segment_sum: segment_ids must be concrete (or use "
+            "paddle.geometric.segment_* inside jit with num_segments "
+            "via send_u_recv(out_size=...))")
+    return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32),
+                               num_segments=n)
+
+
+@primitive
+def segment_mean(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    n = int(jnp.max(ids)) + 1
+    s = jax.ops.segment_sum(data, ids, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                              ids, num_segments=n)
+    shape = (-1,) + (1,) * (data.ndim - 1)
+    return s / jnp.maximum(cnt.reshape(shape), 1)
+
+
+@primitive
+def segment_min(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    n = int(jnp.max(ids)) + 1
+    return jax.ops.segment_min(data, ids, num_segments=n)
+
+
+@primitive
+def segment_max(data, segment_ids):
+    ids = segment_ids.astype(jnp.int32)
+    n = int(jnp.max(ids)) + 1
+    return jax.ops.segment_max(data, ids, num_segments=n)
+
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "mean": None,   # sum/count below
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+@primitive(nondiff=(1, 2))
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None):
+    """Gather x[src] and segment-reduce onto dst (upstream
+    geometric.send_u_recv).  ``out_size`` fixes the output row count
+    (static shape — REQUIRED under jit)."""
+    if reduce_op not in _REDUCERS:
+        raise ValueError(f"send_u_recv: bad reduce_op {reduce_op!r}")
+    src = src_index.astype(jnp.int32)
+    dst = dst_index.astype(jnp.int32)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+    msgs = x[src]
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((msgs.shape[0],), x.dtype), dst, num_segments=n)
+        shape = (-1,) + (1,) * (x.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    out = _REDUCERS[reduce_op](msgs, dst, num_segments=n)
+    if reduce_op in ("min", "max"):
+        # empty segments come back +/-inf from jax; upstream zeros them
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+@primitive(nondiff=(2, 3))
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum",
+                 out_size: Optional[int] = None):
+    """Message = combine(x[src], y[edge]) then reduce onto dst
+    (upstream geometric.send_ue_recv)."""
+    src = src_index.astype(jnp.int32)
+    dst = dst_index.astype(jnp.int32)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+    xs = x[src]
+    if message_op == "add":
+        msgs = xs + y
+    elif message_op == "sub":
+        msgs = xs - y
+    elif message_op == "mul":
+        msgs = xs * y
+    elif message_op == "div":
+        msgs = xs / y
+    else:
+        raise ValueError(f"send_ue_recv: bad message_op {message_op!r}")
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((msgs.shape[0],), msgs.dtype), dst,
+            num_segments=n)
+        shape = (-1,) + (1,) * (msgs.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    if reduce_op not in _REDUCERS or _REDUCERS[reduce_op] is None:
+        raise ValueError(f"send_ue_recv: bad reduce_op {reduce_op!r}")
+    out = _REDUCERS[reduce_op](msgs, dst, num_segments=n)
+    if reduce_op in ("min", "max"):
+        out = jnp.where(jnp.isfinite(out), out, 0.0)
+    return out
+
+
+@primitive(nondiff=(1, 2))
+def send_uv(x, src_index, dst_index, message_op: str = "add"):
+    """Edge messages combine(x[src], x[dst]) with NO reduction
+    (upstream geometric.send_uv)."""
+    src = src_index.astype(jnp.int32)
+    dst = dst_index.astype(jnp.int32)
+    a, b = x[src], x[dst]
+    if message_op == "add":
+        return a + b
+    if message_op == "sub":
+        return a - b
+    if message_op == "mul":
+        return a * b
+    if message_op == "div":
+        return a / b
+    raise ValueError(f"send_uv: bad message_op {message_op!r}")
