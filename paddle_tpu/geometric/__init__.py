@@ -13,55 +13,90 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..tensor import Tensor
-from ..ops._primitive import primitive, unwrap
+from ..ops._primitive import primitive
+
+
+def _static_num_segments(ids, what):
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            f"{what}: segment_ids must be concrete to infer the "
+            "segment count; under jit use send_u_recv(out_size=...) "
+            "(static output shapes are the XLA contract)")
+    return int(jnp.max(ids)) + 1
+
+
+def _segment_reduce(msgs, ids, n, op, sorted_ids=False):
+    """One implementation for every reducer: counts accumulate in
+    int32 (bf16 ones saturate at 256 — degree-257 nodes would divide
+    wrong), min/max empty segments are zeroed BY COUNT (dtype
+    preserved; legitimate inf values survive)."""
+    kw = dict(num_segments=n, indices_are_sorted=sorted_ids)
+    if op in ("sum", "add"):
+        return jax.ops.segment_sum(msgs, ids, **kw)
+    cnt = jax.ops.segment_sum(
+        jnp.ones((msgs.shape[0],), jnp.int32), ids, **kw)
+    shape = (-1,) + (1,) * (msgs.ndim - 1)
+    if op == "mean":
+        denom = jnp.maximum(cnt, 1).reshape(shape)
+        if jnp.issubdtype(msgs.dtype, jnp.inexact):
+            # accumulate in f32: a bf16 sum of >=257 ones saturates
+            acc = jax.ops.segment_sum(
+                msgs.astype(jnp.float32), ids, **kw)
+            return (acc / denom.astype(jnp.float32)).astype(msgs.dtype)
+        return jax.ops.segment_sum(msgs, ids, **kw) // \
+            denom.astype(msgs.dtype)
+    if op == "min":
+        out = jax.ops.segment_min(msgs, ids, **kw)
+    elif op == "max":
+        out = jax.ops.segment_max(msgs, ids, **kw)
+    else:
+        raise ValueError(f"bad reduce_op {op!r}")
+    empty = (cnt == 0).reshape(shape)
+    return jnp.where(empty, jnp.zeros((), out.dtype), out)
 
 
 @primitive
 def segment_sum(data, segment_ids):
-    n = int(jnp.max(segment_ids)) + 1 if not isinstance(
-        segment_ids, jax.core.Tracer) else None
-    if n is None:
-        raise ValueError(
-            "segment_sum: segment_ids must be concrete (or use "
-            "paddle.geometric.segment_* inside jit with num_segments "
-            "via send_u_recv(out_size=...))")
-    return jax.ops.segment_sum(data, segment_ids.astype(jnp.int32),
-                               num_segments=n)
+    ids = segment_ids.astype(jnp.int32)
+    n = _static_num_segments(ids, "segment_sum")
+    return _segment_reduce(data, ids, n, "sum", sorted_ids=True)
 
 
 @primitive
 def segment_mean(data, segment_ids):
     ids = segment_ids.astype(jnp.int32)
-    n = int(jnp.max(ids)) + 1
-    s = jax.ops.segment_sum(data, ids, num_segments=n)
-    cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
-                              ids, num_segments=n)
-    shape = (-1,) + (1,) * (data.ndim - 1)
-    return s / jnp.maximum(cnt.reshape(shape), 1)
+    n = _static_num_segments(ids, "segment_mean")
+    return _segment_reduce(data, ids, n, "mean", sorted_ids=True)
 
 
 @primitive
 def segment_min(data, segment_ids):
     ids = segment_ids.astype(jnp.int32)
-    n = int(jnp.max(ids)) + 1
-    return jax.ops.segment_min(data, ids, num_segments=n)
+    n = _static_num_segments(ids, "segment_min")
+    return _segment_reduce(data, ids, n, "min", sorted_ids=True)
 
 
 @primitive
 def segment_max(data, segment_ids):
     ids = segment_ids.astype(jnp.int32)
-    n = int(jnp.max(ids)) + 1
-    return jax.ops.segment_max(data, ids, num_segments=n)
+    n = _static_num_segments(ids, "segment_max")
+    return _segment_reduce(data, ids, n, "max", sorted_ids=True)
 
 
-_REDUCERS = {
-    "sum": jax.ops.segment_sum,
-    "add": jax.ops.segment_sum,
-    "mean": None,   # sum/count below
-    "min": jax.ops.segment_min,
-    "max": jax.ops.segment_max,
-}
+_MESSAGE_OPS = ("add", "sub", "mul", "div")
+
+
+def _combine(a, b, message_op):
+    if message_op == "add":
+        return a + b
+    if message_op == "sub":
+        return a - b
+    if message_op == "mul":
+        return a * b
+    if message_op == "div":
+        return a / b
+    raise ValueError(f"bad message_op {message_op!r}; "
+                     f"one of {_MESSAGE_OPS}")
 
 
 @primitive(nondiff=(1, 2))
@@ -70,23 +105,10 @@ def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
     """Gather x[src] and segment-reduce onto dst (upstream
     geometric.send_u_recv).  ``out_size`` fixes the output row count
     (static shape — REQUIRED under jit)."""
-    if reduce_op not in _REDUCERS:
-        raise ValueError(f"send_u_recv: bad reduce_op {reduce_op!r}")
     src = src_index.astype(jnp.int32)
     dst = dst_index.astype(jnp.int32)
     n = int(out_size) if out_size is not None else int(x.shape[0])
-    msgs = x[src]
-    if reduce_op == "mean":
-        s = jax.ops.segment_sum(msgs, dst, num_segments=n)
-        cnt = jax.ops.segment_sum(
-            jnp.ones((msgs.shape[0],), x.dtype), dst, num_segments=n)
-        shape = (-1,) + (1,) * (x.ndim - 1)
-        return s / jnp.maximum(cnt.reshape(shape), 1)
-    out = _REDUCERS[reduce_op](msgs, dst, num_segments=n)
-    if reduce_op in ("min", "max"):
-        # empty segments come back +/-inf from jax; upstream zeros them
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
-    return out
+    return _segment_reduce(x[src], dst, n, reduce_op)
 
 
 @primitive(nondiff=(2, 3))
@@ -98,30 +120,8 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
     src = src_index.astype(jnp.int32)
     dst = dst_index.astype(jnp.int32)
     n = int(out_size) if out_size is not None else int(x.shape[0])
-    xs = x[src]
-    if message_op == "add":
-        msgs = xs + y
-    elif message_op == "sub":
-        msgs = xs - y
-    elif message_op == "mul":
-        msgs = xs * y
-    elif message_op == "div":
-        msgs = xs / y
-    else:
-        raise ValueError(f"send_ue_recv: bad message_op {message_op!r}")
-    if reduce_op == "mean":
-        s = jax.ops.segment_sum(msgs, dst, num_segments=n)
-        cnt = jax.ops.segment_sum(
-            jnp.ones((msgs.shape[0],), msgs.dtype), dst,
-            num_segments=n)
-        shape = (-1,) + (1,) * (msgs.ndim - 1)
-        return s / jnp.maximum(cnt.reshape(shape), 1)
-    if reduce_op not in _REDUCERS or _REDUCERS[reduce_op] is None:
-        raise ValueError(f"send_ue_recv: bad reduce_op {reduce_op!r}")
-    out = _REDUCERS[reduce_op](msgs, dst, num_segments=n)
-    if reduce_op in ("min", "max"):
-        out = jnp.where(jnp.isfinite(out), out, 0.0)
-    return out
+    return _segment_reduce(_combine(x[src], y, message_op), dst, n,
+                           reduce_op)
 
 
 @primitive(nondiff=(1, 2))
@@ -130,13 +130,4 @@ def send_uv(x, src_index, dst_index, message_op: str = "add"):
     (upstream geometric.send_uv)."""
     src = src_index.astype(jnp.int32)
     dst = dst_index.astype(jnp.int32)
-    a, b = x[src], x[dst]
-    if message_op == "add":
-        return a + b
-    if message_op == "sub":
-        return a - b
-    if message_op == "mul":
-        return a * b
-    if message_op == "div":
-        return a / b
-    raise ValueError(f"send_uv: bad message_op {message_op!r}")
+    return _combine(x[src], x[dst], message_op)

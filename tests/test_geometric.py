@@ -90,3 +90,42 @@ def test_gcn_layer_trains():
         opt.step()
         opt.clear_grad()
     assert float(loss.numpy()) < 0.1
+
+
+def test_int_and_inf_semantics():
+    """Review findings: int inputs keep dtype (empty segments -> 0,
+    not intmax); legitimate inf values survive min/max."""
+    xi = Tensor(np.array([[5], [7], [9]], np.int32))
+    src = Tensor(np.array([0, 1, 2]))
+    dst = Tensor(np.array([0, 0, 2]))
+    out = G.send_u_recv(xi, src, dst, reduce_op="max", out_size=4)
+    assert str(out.dtype).endswith("int32")
+    np.testing.assert_array_equal(out.numpy(),
+                                  [[7], [0], [9], [0]])
+    xf = T([[np.inf], [1.], [2.]])
+    out = G.send_u_recv(xf, src, dst, reduce_op="max", out_size=3)
+    assert np.isinf(out.numpy()[0, 0])       # real inf survives
+    assert out.numpy()[1, 0] == 0.0          # empty segment zeroed
+
+
+def test_bf16_mean_counts_do_not_saturate():
+    import jax.numpy as jnp
+    n_edges = 300                             # > bf16's 256 integer cap
+    x = Tensor(jnp.ones((n_edges, 1), jnp.bfloat16))
+    src = Tensor(np.arange(n_edges) % n_edges)
+    dst = Tensor(np.zeros(n_edges, np.int64))
+    out = G.send_u_recv(x, src, dst, reduce_op="mean", out_size=1)
+    val = float(np.asarray(out.numpy(), np.float32)[0, 0])
+    assert abs(val - 1.0) < 0.05, val
+
+
+def test_segment_ops_under_jit_raise_guided_error():
+    import jax
+
+    for fn in (G.segment_mean, G.segment_min, G.segment_max):
+        def traced(ids_v, fn=fn):
+            return fn(T([[1.], [2.]]),
+                      Tensor(ids_v))._value
+
+        with pytest.raises(Exception, match="out_size"):
+            jax.jit(traced)(np.array([0, 1]))
