@@ -51,3 +51,58 @@ class cuda:
     def synchronize(device=None):
         import jax
         (jax.device_put(0) + 0).block_until_ready()
+
+
+def synchronize(device=None):
+    """paddle.device.synchronize: block until all dispatched device
+    work completes (XLA async dispatch barrier)."""
+    return cuda.synchronize(device)
+
+
+class Stream:
+    """paddle.device.Stream shim: XLA owns stream scheduling; the shim
+    preserves the API (record/wait collapse to dispatch order, query
+    is always True after synchronize)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+
+class Event:
+    """paddle.device.Event shim (record/synchronize/query)."""
+
+    def __init__(self, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time
+        synchronize()
+        self._t = time.perf_counter()
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self):
+        return True
+
+    def elapsed_time(self, end: "Event") -> float:
+        if self._t is None or end._t is None:
+            raise RuntimeError("Event.elapsed_time: record() both "
+                               "events first")
+        return (end._t - self._t) * 1000.0
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def stream_guard(stream):
+    import contextlib
+    return contextlib.nullcontext()

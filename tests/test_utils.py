@@ -91,3 +91,24 @@ def test_visualdl_callback_writes_scalars(tmp_path):
     assert any(t.startswith("train/loss") for t in tags), tags
     assert any(t.startswith("eval/") for t in tags), tags
     assert all(np.isfinite(r["value"]) for r in records)
+
+
+def test_device_synchronize_and_stream_event():
+    """paddle.device.synchronize/Stream/Event shims (XLA owns streams;
+    the API contract survives for ported timing code)."""
+    import paddle_tpu as paddle
+    paddle.device.synchronize()
+    s = paddle.device.current_stream()
+    assert s.query()
+    s.synchronize()
+    e1, e2 = paddle.device.Event(), paddle.device.Event()
+    e1.record()
+    import numpy as np
+    from paddle_tpu.tensor import Tensor
+    x = Tensor(np.ones((64, 64), np.float32))
+    for _ in range(3):
+        x = x @ x * 0.01
+    e2.record()
+    assert e1.elapsed_time(e2) >= 0.0
+    with paddle.device.stream_guard(s):
+        pass
