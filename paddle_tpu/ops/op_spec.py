@@ -657,6 +657,13 @@ def build_specs():
 # everything else is spec'd.
 EXEMPTIONS = {
     "all": "structural",
+    "segment_sum": "geometric",
+    "segment_mean": "geometric",
+    "segment_min": "geometric",
+    "segment_max": "geometric",
+    "send_u_recv": "geometric",
+    "send_ue_recv": "geometric",
+    "send_uv": "geometric",
     "angle": "structural",
     "any": "structural",
     "argmax": "structural",
@@ -857,6 +864,9 @@ EXEMPT_REASONS = {
     "quant": "fake-quant ops tested in test_quantization",
     "vision": "vision/detection ops oracle-tested in test_vision_ops",
     "sparse": "SelectedRows/sparse ops tested in test_sparse",
+    "geometric": (
+        "graph segment/message-passing ops numpy-oracle-tested incl. "
+        "gradients in test_geometric"),
     "distributed": "collective ops need a mesh; tested in distributed suites",
     "nn-oracle": (
         "torch-oracle tested in test_losses_extra/test_nn_coverage/"
