@@ -164,3 +164,206 @@ def normalize(img, mean, std, data_format="CHW", to_rgb=False):
 
 def resize(img, size, interpolation="bilinear"):
     return Resize(size)(img)
+
+
+_LUMA_WEIGHTS = np.array([0.299, 0.587, 0.114], np.float32)
+_T_YIQ = np.array([[0.299, 0.587, 0.114],
+                   [0.596, -0.274, -0.321],
+                   [0.211, -0.523, 0.311]], np.float32)
+_T_YIQ_INV = np.linalg.inv(_T_YIQ)
+
+
+def _rgb_to_gray(arr):
+    """CHW luma; 1-channel input passes through (already gray)."""
+    if arr.shape[0] == 1:
+        return arr[:1]
+    return np.tensordot(_LUMA_WEIGHTS, arr[:3], axes=1)[None]
+
+
+def _jitter_alpha(value):
+    """Upstream factor range: uniform(max(0, 1-v), 1+v) — never
+    negative, so value > 1 is valid and never inverts the image."""
+    return np.random.uniform(max(0.0, 1.0 - value), 1.0 + value)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() < self.prob:
+            return np.ascontiguousarray(arr[..., ::-1, :])
+        return arr
+
+
+class Pad(BaseTransform):
+    """Pad CHW image (int, (pad_w, pad_h), or 4-tuple l/t/r/b)."""
+
+    def __init__(self, padding, fill=0, padding_mode="constant",
+                 keys=None):
+        if isinstance(padding, numbers.Number):
+            padding = (padding,) * 4
+        elif len(padding) == 2:
+            padding = (padding[0], padding[1], padding[0], padding[1])
+        self.padding = tuple(int(p) for p in padding)
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        l, t, r, b = self.padding
+        cfg = [(0, 0)] * (arr.ndim - 2) + [(t, b), (l, r)]
+        if self.padding_mode == "constant":
+            return np.pad(arr, cfg, mode="constant",
+                          constant_values=self.fill)
+        mode = {"reflect": "reflect", "edge": "edge",
+                "symmetric": "symmetric"}[self.padding_mode]
+        return np.pad(arr, cfg, mode=mode)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, dtype=np.float32)
+        gray = _rgb_to_gray(arr)
+        if self.num_output_channels == 3:
+            gray = np.repeat(gray, 3, axis=0)
+        return gray
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = _jitter_alpha(self.value)
+        return np.clip(np.asarray(img, np.float32) * alpha,
+                       0, None)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        alpha = _jitter_alpha(self.value)
+        mean = arr.mean()
+        return np.clip(mean + alpha * (arr - mean), 0, None)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        gray = _rgb_to_gray(arr)
+        alpha = _jitter_alpha(self.value)
+        return np.clip(gray + alpha * (arr - gray), 0, None)
+
+
+class HueTransform(BaseTransform):
+    """Approximate hue rotation via the YIQ color rotation matrix."""
+
+    def __init__(self, value, keys=None):
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value should be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        arr = np.asarray(img, np.float32)
+        if arr.shape[0] == 1:
+            return arr            # gray input: hue is a no-op
+        theta = np.random.uniform(-self.value, self.value) * 2 * np.pi
+        c, s = np.cos(theta), np.sin(theta)
+        rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+        t_rgb = _T_YIQ_INV @ rot @ _T_YIQ
+        out = np.einsum("ij,jhw->ihw", t_rgb, arr[:3])
+        return np.clip(out, 0, None)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self._ts = [BrightnessTransform(brightness),
+                    ContrastTransform(contrast),
+                    SaturationTransform(saturation),
+                    HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self._ts))
+        for i in order:
+            img = self._ts[i]._apply_image(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    """Nearest-neighbor rotation by a random angle in degrees."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        c, s = np.cos(angle), np.sin(angle)
+        h, w = arr.shape[-2:]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w),
+                             indexing="ij")
+        ys = c * (yy - cy) - s * (xx - cx) + cy
+        xs = s * (yy - cy) + c * (xx - cx) + cx
+        yi = np.round(ys).astype(np.int64)
+        xi = np.round(xs).astype(np.int64)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yi = np.clip(yi, 0, h - 1)
+        xi = np.clip(xi, 0, w - 1)
+        out = arr[..., yi, xi]
+        return np.where(valid, out, self.fill).astype(arr.dtype)
+
+
+class RandomErasing(BaseTransform):
+    """Cutout-style random rectangle erase (upstream RandomErasing)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.array(img, copy=True)   # dtype preserved
+        h, w = arr.shape[-2:]
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                y = np.random.randint(0, h - eh + 1)   # edge-inclusive
+                x = np.random.randint(0, w - ew + 1)
+                arr[..., y:y + eh, x:x + ew] = np.asarray(
+                    self.value).astype(arr.dtype)
+                return arr
+        return arr

@@ -112,3 +112,68 @@ def test_device_synchronize_and_stream_event():
     assert e1.elapsed_time(e2) >= 0.0
     with paddle.device.stream_guard(s):
         pass
+
+
+def test_vision_transforms_extended():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import transforms as T
+
+    paddle.seed(0)
+    np.random.seed(0)
+    img = np.random.rand(3, 32, 32).astype(np.float32)
+
+    flipped = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_allclose(flipped, img[:, ::-1, :])
+
+    padded = T.Pad(2)(img)
+    assert padded.shape == (3, 36, 36)
+    assert padded[0, 0, 0] == 0
+
+    gray = T.Grayscale()(img)
+    assert gray.shape == (1, 32, 32)
+    w = np.array([0.299, 0.587, 0.114], np.float32)
+    np.testing.assert_allclose(gray[0], np.tensordot(w, img, 1),
+                               rtol=1e-5)
+    assert T.Grayscale(3)(img).shape == (3, 32, 32)
+
+    jit = T.ColorJitter(0.4, 0.4, 0.4, 0.2)(img)
+    assert jit.shape == img.shape and np.isfinite(jit).all()
+
+    rot = T.RandomRotation(30)(img)
+    assert rot.shape == img.shape
+
+    erased = T.RandomErasing(prob=1.0, value=7.0)(img)
+    assert (erased == 7.0).any()
+    # zero-degree rotation is identity
+    ident = T.RandomRotation((0, 0))(img)
+    np.testing.assert_allclose(ident, img, atol=1e-6)
+
+    pipeline = T.Compose([T.RandomVerticalFlip(1.0), T.Pad(1),
+                          T.Grayscale(3)])
+    out = pipeline(img)
+    assert out.shape == (3, 34, 34)
+
+
+def test_transforms_review_regressions():
+    import numpy as np
+    from paddle_tpu.vision import transforms as T
+    np.random.seed(1)
+    # value > 1 never inverts (factor floor at 0)
+    img = np.full((3, 8, 8), 0.5, np.float32)
+    for _ in range(20):
+        out = T.BrightnessTransform(2.0)(img)
+        assert (out >= 0).all()
+    # gray input passes through Grayscale/Saturation/Hue
+    g = np.random.rand(1, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(T.Grayscale()(g), g)
+    np.testing.assert_allclose(T.HueTransform(0.3)(g), g)
+    out = T.SaturationTransform(0.4)(g)
+    assert out.shape == (1, 8, 8)
+    # RandomErasing preserves dtype
+    u8 = (np.random.rand(3, 16, 16) * 255).astype(np.uint8)
+    erased = T.RandomErasing(prob=1.0, value=0)(u8)
+    assert erased.dtype == np.uint8
+    # vertical flip accepts lists
+    out = T.RandomVerticalFlip(1.0)([[0.1, 0.2], [0.3, 0.4]])
+    np.testing.assert_allclose(out, [[0.3, 0.4], [0.1, 0.2]])
