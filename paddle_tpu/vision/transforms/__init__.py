@@ -367,3 +367,6 @@ class RandomErasing(BaseTransform):
                     self.value).astype(arr.dtype)
                 return arr
         return arr
+
+
+from . import functional  # noqa  (stateless forms)

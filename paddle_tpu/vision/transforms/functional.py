@@ -1,0 +1,78 @@
+"""vision.transforms.functional (parity:
+python/paddle/vision/transforms/functional.py) — stateless forms of
+the class transforms, numpy CHW."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import (Grayscale, Normalize, Pad, Resize, _jitter_alpha,
+               _rgb_to_gray, _T_YIQ, _T_YIQ_INV)
+from . import to_tensor, normalize, resize  # noqa  (re-export)
+
+
+def hflip(img):
+    return np.ascontiguousarray(np.asarray(img)[..., :, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(np.asarray(img)[..., ::-1, :])
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    return Pad(padding, fill, padding_mode)._apply_image(img)
+
+
+def crop(img, top, left, height, width):
+    return np.asarray(img)[..., top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = np.asarray(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = arr.shape[-2:]
+    th, tw = output_size
+    top = max((h - th) // 2, 0)
+    left = max((w - tw) // 2, 0)
+    return crop(arr, top, left, th, tw)
+
+
+def to_grayscale(img, num_output_channels=1):
+    return Grayscale(num_output_channels)._apply_image(img)
+
+
+def adjust_brightness(img, brightness_factor):
+    return np.clip(np.asarray(img, np.float32) * brightness_factor,
+                   0, None)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = np.asarray(img, np.float32)
+    mean = arr.mean()
+    return np.clip(mean + contrast_factor * (arr - mean), 0, None)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = np.asarray(img, np.float32)
+    gray = _rgb_to_gray(arr)
+    return np.clip(gray + saturation_factor * (arr - gray), 0, None)
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor is not in [-0.5, 0.5]")
+    arr = np.asarray(img, np.float32)
+    if arr.shape[0] == 1:
+        return arr
+    theta = hue_factor * 2 * np.pi
+    c, s = np.cos(theta), np.sin(theta)
+    rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+    t_rgb = _T_YIQ_INV @ rot @ _T_YIQ
+    return np.clip(np.einsum("ij,jhw->ihw", t_rgb, arr[:3]), 0, None)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = np.asarray(img) if inplace else np.array(img, copy=True)
+    arr[..., i:i + h, j:j + w] = np.asarray(v).astype(arr.dtype)
+    return arr

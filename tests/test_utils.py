@@ -177,3 +177,20 @@ def test_transforms_review_regressions():
     # vertical flip accepts lists
     out = T.RandomVerticalFlip(1.0)([[0.1, 0.2], [0.3, 0.4]])
     np.testing.assert_allclose(out, [[0.3, 0.4], [0.1, 0.2]])
+
+
+def test_transforms_functional():
+    import numpy as np
+    from paddle_tpu.vision.transforms import functional as F
+    img = np.random.RandomState(0).rand(3, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(F.vflip(F.vflip(img)), img)
+    np.testing.assert_allclose(F.hflip(img), img[..., ::-1])
+    assert F.center_crop(img, 4).shape == (3, 4, 4)
+    assert F.pad(img, 1).shape == (3, 10, 10)
+    assert F.to_grayscale(img, 3).shape == (3, 8, 8)
+    np.testing.assert_allclose(F.adjust_hue(img, 0.0), img, atol=1e-6)
+    np.testing.assert_allclose(F.adjust_contrast(img, 1.0), img,
+                               atol=1e-6)
+    out = F.erase(img, 1, 1, 2, 2, 9.0)
+    assert (out[:, 1:3, 1:3] == 9.0).all()
+    assert img[1, 1, 1] != 9.0           # not inplace by default
