@@ -215,8 +215,18 @@ class Pad(BaseTransform):
         l, t, r, b = self.padding
         cfg = [(0, 0)] * (arr.ndim - 2) + [(t, b), (l, r)]
         if self.padding_mode == "constant":
+            fill = self.fill
+            if isinstance(fill, (list, tuple)):
+                # per-channel fill: pad with 0 then paint the border
+                out = np.pad(arr, cfg, mode="constant")
+                fv = np.asarray(fill, arr.dtype).reshape(-1, 1, 1)
+                h, w = arr.shape[-2:]
+                mask = np.ones(out.shape[-2:], bool)
+                mask[t:t + h, l:l + w] = False
+                out = np.where(mask, fv, out)
+                return out.astype(arr.dtype)
             return np.pad(arr, cfg, mode="constant",
-                          constant_values=self.fill)
+                          constant_values=fill)
         mode = {"reflect": "reflect", "edge": "edge",
                 "symmetric": "symmetric"}[self.padding_mode]
         return np.pad(arr, cfg, mode=mode)
@@ -269,7 +279,10 @@ class SaturationTransform(BaseTransform):
         arr = np.asarray(img, np.float32)
         gray = _rgb_to_gray(arr)
         alpha = _jitter_alpha(self.value)
-        return np.clip(gray + alpha * (arr - gray), 0, None)
+        out = np.clip(gray + alpha * (arr[:3] - gray), 0, None)
+        if arr.shape[0] > 3:   # alpha channel untouched
+            out = np.concatenate([out, arr[3:]], axis=0)
+        return out
 
 
 class HueTransform(BaseTransform):
@@ -290,8 +303,10 @@ class HueTransform(BaseTransform):
         c, s = np.cos(theta), np.sin(theta)
         rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
         t_rgb = _T_YIQ_INV @ rot @ _T_YIQ
-        out = np.einsum("ij,jhw->ihw", t_rgb, arr[:3])
-        return np.clip(out, 0, None)
+        out = np.clip(np.einsum("ij,jhw->ihw", t_rgb, arr[:3]), 0, None)
+        if arr.shape[0] > 3:   # alpha channel untouched
+            out = np.concatenate([out, arr[3:]], axis=0)
+        return out
 
 
 class ColorJitter(BaseTransform):

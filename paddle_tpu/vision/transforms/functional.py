@@ -6,8 +6,8 @@ from __future__ import annotations
 
 import numpy as np
 
-from . import (Grayscale, Normalize, Pad, Resize, _jitter_alpha,
-               _rgb_to_gray, _T_YIQ, _T_YIQ_INV)
+from . import (CenterCrop, Grayscale, Pad, _rgb_to_gray, _T_YIQ,
+               _T_YIQ_INV)
 from . import to_tensor, normalize, resize  # noqa  (re-export)
 
 
@@ -28,14 +28,7 @@ def crop(img, top, left, height, width):
 
 
 def center_crop(img, output_size):
-    arr = np.asarray(img)
-    if isinstance(output_size, int):
-        output_size = (output_size, output_size)
-    h, w = arr.shape[-2:]
-    th, tw = output_size
-    top = max((h - th) // 2, 0)
-    left = max((w - tw) // 2, 0)
-    return crop(arr, top, left, th, tw)
+    return CenterCrop(output_size)._apply_image(np.asarray(img))
 
 
 def to_grayscale(img, num_output_channels=1):
@@ -56,7 +49,10 @@ def adjust_contrast(img, contrast_factor):
 def adjust_saturation(img, saturation_factor):
     arr = np.asarray(img, np.float32)
     gray = _rgb_to_gray(arr)
-    return np.clip(gray + saturation_factor * (arr - gray), 0, None)
+    out = np.clip(gray + saturation_factor * (arr[:3] - gray), 0, None)
+    if arr.shape[0] > 3:
+        out = np.concatenate([out, arr[3:]], axis=0)
+    return out
 
 
 def adjust_hue(img, hue_factor):
@@ -69,7 +65,10 @@ def adjust_hue(img, hue_factor):
     c, s = np.cos(theta), np.sin(theta)
     rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
     t_rgb = _T_YIQ_INV @ rot @ _T_YIQ
-    return np.clip(np.einsum("ij,jhw->ihw", t_rgb, arr[:3]), 0, None)
+    out = np.clip(np.einsum("ij,jhw->ihw", t_rgb, arr[:3]), 0, None)
+    if arr.shape[0] > 3:
+        out = np.concatenate([out, arr[3:]], axis=0)
+    return out
 
 
 def erase(img, i, j, h, w, v, inplace=False):

@@ -194,3 +194,23 @@ def test_transforms_functional():
     out = F.erase(img, 1, 1, 2, 2, 9.0)
     assert (out[:, 1:3, 1:3] == 9.0).all()
     assert img[1, 1, 1] != 9.0           # not inplace by default
+
+
+def test_transforms_alpha_and_fill_handling():
+    import numpy as np
+    from paddle_tpu.vision import transforms as T
+    from paddle_tpu.vision.transforms import functional as F
+    rgba = np.random.RandomState(2).rand(4, 6, 6).astype(np.float32)
+    out = F.adjust_hue(rgba, 0.2)
+    assert out.shape == (4, 6, 6)
+    np.testing.assert_allclose(out[3], rgba[3])       # alpha untouched
+    out = F.adjust_saturation(rgba, 0.0)
+    assert out.shape == (4, 6, 6)
+    np.testing.assert_allclose(out[3], rgba[3])
+    img = np.zeros((3, 4, 4), np.float32)
+    padded = F.pad(img, 1, fill=(1, 2, 3))
+    assert padded.shape == (3, 6, 6)
+    np.testing.assert_allclose(padded[:, 0, 0], [1, 2, 3])
+    assert (padded[:, 1:5, 1:5] == 0).all()
+    np.testing.assert_allclose(F.center_crop(img, 2),
+                               np.zeros((3, 2, 2)))
