@@ -117,3 +117,45 @@ def test_jit_save_load_multi_input_dynamic_dims():
         out = loaded(Tensor(a), Tensor(b))
         np.testing.assert_allclose(np.asarray(out.numpy()), ref,
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_save_load_dy2static_control_flow(tmp_path):
+    """jit.save exports a dy2static-converted function (lax.cond in
+    the StableHLO); load runs both branches correctly."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.static import InputSpec
+    from paddle_tpu.tensor import Tensor
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.sum() > 0:
+                return h * 2
+            return -h
+
+    paddle.seed(0)
+    net = paddle.jit.to_static(
+        Net(), input_spec=[InputSpec([None, 4], "float32")])
+    x = Tensor(np.ones((2, 4), np.float32))
+    want = net(x).numpy()
+    path = str(tmp_path / "ctrl")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 4],
+                                                     "float32")])
+    loaded = paddle.jit.load(path)
+    got = loaded(x)
+    got = got[0] if isinstance(got, (list, tuple)) else got
+    np.testing.assert_allclose(np.asarray(got.numpy()), want,
+                               rtol=1e-5)
+    # the negative branch too
+    xn = Tensor(np.full((2, 4), -5.0, np.float32))
+    want_n = net(xn).numpy()
+    got_n = loaded(xn)
+    got_n = got_n[0] if isinstance(got_n, (list, tuple)) else got_n
+    np.testing.assert_allclose(np.asarray(got_n.numpy()), want_n,
+                               rtol=1e-5)
