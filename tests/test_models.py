@@ -160,3 +160,35 @@ def test_vit_tiny_train_step():
     losses = [float(runner.train_step([x], [y])) for _ in range(5)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_ernie_finetune_on_imdb_via_hapi():
+    """Config-3-class fine-tune loop: ErnieForSequenceClassification
+    (tiny) + paddle.text.Imdb + Model.fit (the full user workflow:
+    dataset -> DataLoader -> hapi -> compiled step)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer, text
+    from paddle_tpu.models import BertConfig, BertForSequenceClassification
+
+    paddle.seed(0)
+    cfg = BertConfig(vocab_size=5147, hidden_size=32,
+                     num_hidden_layers=2, num_attention_heads=2,
+                     intermediate_size=64, max_position_embeddings=128,
+                     hidden_dropout_prob=0.0,
+                     attention_probs_dropout_prob=0.0)
+    net = BertForSequenceClassification(cfg, num_classes=2)
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(5e-3, parameters=m.parameters()),
+              nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    import os
+    os.environ["PADDLE_TPU_SYNTH_N"] = "128"
+    try:
+        ds = text.Imdb(mode="train", seq_len=32)
+        hist = m.fit(ds, epochs=10, batch_size=32, verbose=0)
+        ev = m.evaluate(text.Imdb(mode="test", seq_len=32),
+                        batch_size=32, verbose=0)
+    finally:
+        os.environ["PADDLE_TPU_SYNTH_N"] = "512"
+    # the synthetic corpus is separable by construction
+    assert ev["acc"] > 0.9, ev
