@@ -40,12 +40,27 @@ Converted constructs:
   (short-circuit is preserved on the concrete path; the traced path
   evaluates both operands, like upstream's LogicalTransformer).
 
+`break`/`continue` under tensor loops (upstream
+BreakContinueTransformer, `python/paddle/jit/dy2static/`) are desugared
+into flag-carry form before conversion: `break` → `_d2s_brkN = True`,
+`continue` → `_d2s_contN = True`, statements downstream of a potential
+interrupt are guarded by `if not (brk or cont):`, the loop test gains
+an `and not brk` conjunct, and a `for` with break/continue is lowered
+to the equivalent `while`.  The flags ride the lax loop carry like any
+user variable, so data-dependent early exit (beam search, convergence
+loops) compiles to XLA `while_loop`.
+
+The `while` dispatch RE-PROBES each iteration: a loop whose test starts
+concrete (`while True: ... if cond: break`) runs Python iterations
+until a carried value turns traced, then hands the remaining
+iterations to `lax.while_loop` seeded with the current environment.
+
 Deliberately NOT converted (loud `Dy2StaticError` when reached on the
-traced path; untouched Python semantics otherwise): `break`/`continue`
-under a tensor loop, early-`return` from only one branch of a tensor
-`if`, iterating a Tensor directly (use `range` over its length).
-Branch outputs must be tensors of matching shape/dtype on both paths —
-the XLA structured-control-flow contract.
+traced path; untouched Python semantics otherwise): `return` inside a
+tensor loop, early-`return` from only one branch of a tensor `if`,
+`break`/`continue` inside `try` blocks.  Branch outputs must be
+tensors of matching shape/dtype on both paths — the XLA
+structured-control-flow contract.
 """
 
 from __future__ import annotations
@@ -354,6 +369,98 @@ def _has_break_continue(body) -> bool:
                      stop_at=(ast.For, ast.While, ast.AsyncFor))
 
 
+class _BCInfo:
+    """What a break/continue desugar pass actually found."""
+
+    def __init__(self):
+        self.used_break = False
+        self.used_continue = False
+        self.bail = False       # bc in a position we can't rewrite (try)
+
+
+def _rewrite_bc(stmts, brk: str, cont: str, info: _BCInfo):
+    """Replace `break`/`continue` binding to the enclosing loop with
+    flag assignments, guarding every statement downstream of a possible
+    interrupt with `if not (brk or cont):` (upstream
+    BreakContinueTransformer shape).  Returns (new_stmts,
+    may_interrupt); statements after an unconditional break/continue
+    are dead code and dropped.  Non-mutating: callers may reuse the
+    original nodes if the desugar bails."""
+
+    def guard_rest(out, rest):
+        nrest, _ = _rewrite_bc(rest, brk, cont, info)
+        if nrest:
+            g = _stmt(f"if not ({brk} or {cont}):\n    pass")[0]
+            g.body = nrest
+            out.append(g)
+        return out, True
+
+    out: List[ast.stmt] = []
+    for i, s in enumerate(stmts):
+        rest = stmts[i + 1:]
+        if isinstance(s, ast.Break):
+            info.used_break = True
+            out += _stmt(f"{brk} = True")
+            return out, True
+        if isinstance(s, ast.Continue):
+            info.used_continue = True
+            out += _stmt(f"{cont} = True")
+            return out, True
+        if isinstance(s, ast.If):
+            nb, b1 = _rewrite_bc(s.body, brk, cont, info)
+            no, b2 = _rewrite_bc(s.orelse, brk, cont, info)
+            out.append(ast.copy_location(
+                ast.If(test=s.test, body=nb or [ast.Pass()], orelse=no),
+                s))
+            if b1 or b2:
+                return guard_rest(out, rest)
+            continue
+        if isinstance(s, (ast.For, ast.While, ast.AsyncFor)):
+            # breaks in the nested loop's BODY bind to it; only its
+            # `else` clause can interrupt THIS loop
+            no, b2 = _rewrite_bc(s.orelse, brk, cont, info)
+            if isinstance(s, ast.While):
+                ns: ast.stmt = ast.copy_location(
+                    ast.While(test=s.test, body=s.body, orelse=no), s)
+            elif isinstance(s, ast.For):
+                ns = ast.copy_location(
+                    ast.For(target=s.target, iter=s.iter, body=s.body,
+                            orelse=no), s)
+            else:
+                ns = ast.copy_location(
+                    ast.AsyncFor(target=s.target, iter=s.iter,
+                                 body=s.body, orelse=no), s)
+            out.append(ns)
+            if b2:
+                return guard_rest(out, rest)
+            continue
+        if isinstance(s, ast.With):
+            nb, b1 = _rewrite_bc(s.body, brk, cont, info)
+            out.append(ast.copy_location(
+                ast.With(items=s.items, body=nb or [ast.Pass()]), s))
+            if b1:
+                return guard_rest(out, rest)
+            continue
+        if isinstance(s, ast.Try):
+            if _contains([s], (ast.Break, ast.Continue),
+                         stop_at=(ast.For, ast.While, ast.AsyncFor)):
+                info.bail = True
+            out.append(s)
+            continue
+        out.append(s)
+    return out, False
+
+
+def _range_args(it: ast.Call) -> Tuple[str, str, str]:
+    """Normalize `range(...)` call args to (start, stop, step) source."""
+    a = [ast.unparse(x) for x in it.args]
+    if len(a) == 1:
+        return "0", a[0], "1"
+    if len(a) == 2:
+        return a[0], a[1], "1"
+    return a[0], a[1], a[2]
+
+
 def _scan_safe(stmts) -> bool:
     """Is a loop body expressible as a lax.scan carry?  Only plain
     Name (re)assignments and (already-converted) nested control flow
@@ -575,7 +682,82 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 ast.fix_missing_locations(dispatch)]
 
     # ---------------- while ----------------
+    def _desugar_bc_loop(self, node) -> Optional[List[ast.stmt]]:
+        """`while`/`for-range` with break/continue → flag-carry `while`
+        with no break/continue, then recursively converted.  None when
+        the loop has no bc (or bc we can't rewrite) — callers fall
+        through to their normal (or loud-unsupported) path."""
+        if not _has_break_continue(node.body) or _has_return(node.body):
+            return None
+        import copy
+        uid = self._uid()
+        brk, cont = f"_d2s_brk{uid}", f"_d2s_cont{uid}"
+        info = _BCInfo()
+        new_body, _ = _rewrite_bc(copy.deepcopy(list(node.body)),
+                                  brk, cont, info)
+        if info.bail or _contains(new_body, (ast.Break, ast.Continue),
+                                  stop_at=(ast.For, ast.While,
+                                           ast.AsyncFor)):
+            return None
+        if info.used_continue:
+            new_body = _stmt(f"{cont} = False") + new_body
+
+        was_for = isinstance(node, ast.For)
+        pre: List[ast.stmt] = []
+        if was_for:
+            # lower `for <name> in range(...)` to the while form over an
+            # INTERNAL induction counter: the user target is assigned
+            # from it at body top, so a break keeps the break-time
+            # value, a body reassignment of the target can't change the
+            # iteration count, and an empty range leaves any previous
+            # binding of the target intact (Python range semantics).
+            tgt = node.target.id
+            start, stop, step = _range_args(node.iter)
+            lo, hi, st = (f"__d2s_lo{uid}", f"__d2s_hi{uid}",
+                          f"__d2s_st{uid}")
+            ind = f"_d2s_it{uid}"
+            pre = _stmt(
+                f"{lo} = {start}\n{hi} = {stop}\n{st} = {step}\n"
+                f"{ind} = {lo}\n"
+                # seed the lax carry when the target was unbound — the
+                # first iteration overwrites it before any read
+                f"try:\n    {tgt}\nexcept NameError:\n    {tgt} = {lo}")
+            test: ast.expr = ast.parse(
+                f"(({st}) > 0 and {ind} < {hi}) or "
+                f"(({st}) <= 0 and {ind} > {hi})", mode="eval").body
+            new_body = (_stmt(f"{tgt} = {ind}") + new_body
+                        + _stmt(f"{ind} = {ind} + {st}"))
+        else:
+            test = node.test
+
+        if info.used_break:
+            test = ast.BoolOp(op=ast.And(), values=[
+                ast.UnaryOp(op=ast.Not(),
+                            operand=ast.Name(brk, ast.Load())),
+                test])
+        out_pre = pre + _stmt(f"{brk} = False\n{cont} = False")
+        new_while = ast.While(test=test, body=new_body, orelse=[])
+        out_tail: List[ast.stmt] = []
+        if node.orelse:
+            if info.used_break:
+                guard = ast.If(
+                    test=ast.UnaryOp(op=ast.Not(),
+                                     operand=ast.Name(brk, ast.Load())),
+                    body=list(node.orelse), orelse=[])
+                out_tail = [guard]
+            else:
+                out_tail = list(node.orelse)
+
+        result: List[ast.stmt] = []
+        for s in out_pre + [new_while] + out_tail:
+            v = self.visit(ast.fix_missing_locations(s))
+            result.extend(v if isinstance(v, list) else [v])
+        return result
+
     def visit_While(self, node: ast.While):
+        bc = self._desugar_bc_loop(node)
+        if bc is not None:
+            return bc
         self.generic_visit(node)
         uid = self._uid()
         probe = f"__d2s_c{uid}"
@@ -589,8 +771,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         elif _has_break_continue(node.body):
             traced_arm = _stmt(
                 "__d2s__.unsupported('`break`/`continue` inside a "
-                "tensor-dependent `while` loop')")
+                "tensor-dependent `while` loop (only supported via "
+                "flag desugar; this pattern defeated it — e.g. "
+                "break inside try)')")
         else:
+            # re-probing form: each Python iteration re-evaluates the
+            # test; the moment it turns traced (loop vars became
+            # tensors — `while True: ... if c: break` desugars here),
+            # the REMAINING iterations run as one lax.while_loop
+            # seeded with the current environment.
             names = _assigned(node.body)
             unpack = (f"({', '.join(names)},) = {carry}" if names
                       else "pass")
@@ -615,9 +804,26 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             traced_arm += _stmt(
                 f"{lhs}__d2s__.while_loop({cname}, {bname}, "
                 f"{names_lit}, {_env_call(names)})")
-            # `while ... else`: no break on the traced path, so the
-            # else clause always runs after the loop
-            traced_arm += list(node.orelse)
+            traced_arm += _stmt("break")
+
+            probe_assign = ast.Assign(
+                targets=[ast.Name(probe, ast.Store())],
+                value=_logical(node.test))
+            dispatch = ast.If(
+                test=_stmt(f"__d2s__.is_traced({probe})")[0].value,
+                body=traced_arm, orelse=[])
+            exit_if = _stmt(f"if not {probe}:\n    break")[0]
+            wrapper = ast.While(
+                test=ast.Constant(value=True),
+                body=[ast.fix_missing_locations(probe_assign),
+                      ast.fix_missing_locations(dispatch),
+                      ast.fix_missing_locations(exit_if)]
+                + list(node.body),
+                orelse=[])
+            # no user break can exist here (bc desugared above), so
+            # the `else` clause always runs after the loop
+            return [ast.fix_missing_locations(wrapper)] \
+                + list(node.orelse)
 
         assign = ast.Assign(targets=[ast.Name(probe, ast.Store())],
                             value=_logical(node.test))
@@ -631,12 +837,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     # ---------------- for ... in range(...) / tensor ----------------
     def visit_For(self, node: ast.For):
-        self.generic_visit(node)
         it = node.iter
-        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
-                and it.func.id == "range" and not it.keywords
-                and 1 <= len(it.args) <= 3
-                and isinstance(node.target, ast.Name)):
+        is_range = (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range" and not it.keywords
+                    and 1 <= len(it.args) <= 3
+                    and isinstance(node.target, ast.Name))
+        if is_range:
+            bc = self._desugar_bc_loop(node)
+            if bc is not None:
+                return bc
+        elif (isinstance(node.target, ast.Name)
+              and _has_break_continue(node.body)
+              and not _has_return(node.body)):
+            bc = self._desugar_bc_iterable(node)
+            if bc is not None:
+                return bc
+        self.generic_visit(node)
+        if not is_range:
             if isinstance(node.target, ast.Name):
                 return self._for_iterable(node)
             return node  # tuple targets: Python-only semantics
@@ -644,13 +862,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         tgt = node.target.id
         carry = f"__d2s_k{uid}"
         bname = f"__d2s_fb{uid}"
-        a = [ast.unparse(x) for x in it.args]
-        if len(a) == 1:
-            start, stop, step = "0", a[0], "1"
-        elif len(a) == 2:
-            start, stop, step = a[0], a[1], "1"
-        else:
-            start, stop, step = a[0], a[1], a[2]
+        start, stop, step = _range_args(it)
 
         if _has_return(node.body):
             traced_arm = _stmt(
@@ -687,6 +899,48 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         dispatch.orelse = [ast.For(target=node.target, iter=node.iter,
                                    body=node.body, orelse=node.orelse)]
         return [ast.fix_missing_locations(dispatch)]
+
+    def _desugar_bc_iterable(self, node: ast.For) -> Optional[List[ast.stmt]]:
+        """`for x in <traced iterable>:` with break/continue → indexed
+        `while` over the static leading dim (then the while bc-desugar
+        takes over).  The index increment precedes the body, so
+        `continue` can never skip it.  None when the body isn't
+        carry-expressible — Python semantics (unroll) stay."""
+        import copy
+        info = _BCInfo()
+        probe_rw, _ = _rewrite_bc(copy.deepcopy(node.body),
+                                  "_b", "_c", info)
+        if (info.bail or not _scan_safe(probe_rw)
+                or _contains(probe_rw, (ast.Break, ast.Continue),
+                             stop_at=(ast.For, ast.While, ast.AsyncFor))):
+            return None
+        uid = self._uid()
+        tgt = node.target.id
+        itname, hi = f"__d2s_i{uid}", f"__d2s_n{uid}"
+        idx = f"_d2s_idx{uid}"
+
+        inner = ast.While(
+            test=ast.parse(f"{idx} < {hi}", mode="eval").body,
+            body=_stmt(f"{tgt} = {itname}[{idx}]\n{idx} = {idx} + 1")
+            + copy.deepcopy(node.body),
+            orelse=copy.deepcopy(node.orelse))
+        traced_arm = _stmt(f"{idx} = 0\n{hi} = len({itname})")
+        v = self.visit(ast.fix_missing_locations(inner))
+        traced_arm += v if isinstance(v, list) else [v]
+
+        py_for = ast.For(target=node.target,
+                         iter=ast.Name(itname, ast.Load()),
+                         body=node.body, orelse=node.orelse)
+        self.generic_visit(py_for)   # convert non-bc inner ifs
+
+        out = _stmt(f"{itname} = {ast.unparse(node.iter)}")
+        dispatch = _stmt(
+            f"if __d2s__.is_traced({itname}):\n    pass\n"
+            f"else:\n    pass")[0]
+        dispatch.body = traced_arm
+        dispatch.orelse = [py_for]
+        return [ast.fix_missing_locations(s)
+                for s in out + [dispatch]]
 
     def _for_iterable(self, node: ast.For):
         """`for x in <expr>:` with a traced iterable → lax.scan over

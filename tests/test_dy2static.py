@@ -259,14 +259,20 @@ def test_early_return_without_final_return_still_raises():
         f(T([1.]))
 
 
-def test_break_in_tensor_loop_raises():
+def test_break_in_try_block_raises():
+    """break inside try defeats the flag desugar — loud error, not
+    silent wrong answer (upstream BreakContinueTransformer also skips
+    try-scoped interrupts)."""
     @to_static
     def f(x):
         s = x * 0
         while s.sum() < 10:
+            try:
+                if s.sum() > 3:
+                    break
+            finally:
+                pass
             s = s + 1
-            if True:
-                break
         return s
 
     with pytest.raises(Dy2StaticError, match="break"):
@@ -283,6 +289,260 @@ def test_uninitialized_loop_var_raises():
 
     with pytest.raises(Dy2StaticError, match="not initialized"):
         f(T([1.]))
+
+
+# ------------------- break / continue (flag desugar) -----------------------
+# upstream BreakContinueTransformer (`python/paddle/jit/dy2static/`):
+# data-dependent early exit must compile to XLA while_loop.
+
+def test_while_tensor_cond_with_break():
+    def f(x):
+        s = x * 0
+        while s.sum() < 10:
+            s = s + 1
+            if s.sum() > 3:
+                break
+        return s
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(T([0.])).numpy(), f(T([0.])).numpy())
+    np.testing.assert_allclose(sf(T([0.])).numpy(), [4.])
+
+
+def test_while_true_tensor_break_beam_search_style():
+    """`while True: ... if cond: break` — the loop test is concrete
+    forever; the re-probing dispatch must hand off to lax.while_loop
+    when the carried flag turns traced."""
+    def f(x):
+        i = x.sum() * 0
+        while True:
+            x = x * 2
+            i = i + 1
+            if x.sum() > 100:
+                break
+        return x, i
+
+    sf = to_static(f)
+    ex, ei = f(T([1.]))
+    sx, si = sf(T([1.]))
+    np.testing.assert_allclose(sx.numpy(), ex.numpy())
+    np.testing.assert_allclose(si.numpy(), ei.numpy())
+    assert float(si.numpy()) == 7.0  # 2**7 = 128 > 100
+
+
+def test_while_continue():
+    def f(x):
+        s = x * 0
+        i = x.sum() * 0
+        while i < 6:
+            i = i + 1
+            if i % 2 == 0:
+                continue
+            s = s + i  # odd i only: 1+3+5
+        return s
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(T([0.])).numpy(), f(T([0.])).numpy())
+    np.testing.assert_allclose(sf(T([0.])).numpy(), [9.])
+
+
+def test_while_break_and_continue_mixed():
+    def f(x):
+        s = x * 0
+        i = x.sum() * 0
+        while i < 100:
+            i = i + 1
+            if i % 2 == 0:
+                continue
+            if i > 7:
+                break
+            s = s + i  # 1+3+5+7
+        return s, i
+
+    sf = to_static(f)
+    es, ei = f(T([0.]))
+    ss, si = sf(T([0.]))
+    np.testing.assert_allclose(ss.numpy(), es.numpy())
+    np.testing.assert_allclose(si.numpy(), ei.numpy())
+    np.testing.assert_allclose(ss.numpy(), [16.])
+
+
+def test_for_range_tensor_bound_with_break():
+    def f(x, n):
+        s = x * 0
+        for i in range(n):
+            s = s + i
+            if s.sum() > 5:
+                break
+        return s
+
+    sf = to_static(f)
+    n = Tensor(np.int32(100))
+    np.testing.assert_allclose(sf(T([0.]), n).numpy(),
+                               f(T([0.]), n).numpy())
+    np.testing.assert_allclose(sf(T([0.]), n).numpy(), [6.])  # 0+1+2+3
+
+
+def test_for_range_continue():
+    def f(x):
+        s = x * 0
+        for i in range(x.sum().astype('int32') * 0 + 6):
+            if i % 2 == 1:
+                continue
+            s = s + i  # 0+2+4
+        return s
+
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(T([0.])).numpy(), f(T([0.])).numpy())
+    np.testing.assert_allclose(sf(T([0.])).numpy(), [6.])
+
+
+def test_while_else_with_break_skips_else():
+    def f(x, lim):
+        s = x * 0
+        while s.sum() < 10:
+            s = s + 1
+            if s.sum() > lim.sum():
+                break
+        else:
+            s = s * 100  # must NOT run when break fired
+        return s
+
+    sf = to_static(f)
+    # break path: lim=3 → exits via break, else skipped
+    np.testing.assert_allclose(sf(T([0.]), T(3.)).numpy(), [4.])
+    # no-break path: lim=1000 → loop exits normally, else runs
+    np.testing.assert_allclose(sf(T([0.]), T(1000.)).numpy(), [1000.])
+    np.testing.assert_allclose(f(T([0.]), T(3.)).numpy(), [4.])
+    np.testing.assert_allclose(f(T([0.]), T(1000.)).numpy(), [1000.])
+
+
+def test_for_over_tensor_rows_with_break():
+    """`for row in xs: ... if cond: break` lowers to an indexed
+    while over the static leading dim with dynamic row gather."""
+    def f(xs):
+        acc = xs[0] * 0
+        for row in xs:
+            acc = acc + row
+            if acc.sum() > 10:
+                break
+        return acc
+
+    xs = np.array([[1., 2.], [3., 4.], [50., 60.], [7., 8.]],
+                  np.float32)
+    sf = to_static(f)
+    np.testing.assert_allclose(sf(T(xs)).numpy(), f(T(xs)).numpy())
+    np.testing.assert_allclose(sf(T(xs)).numpy(), [54., 66.])
+
+
+def test_convergence_loop_newton():
+    """Newton iteration with tolerance break — the convergence-loop
+    shape VERDICT r4 called out (sqrt via Newton)."""
+    def f(a):
+        x = a * 0 + 1.0
+        while True:
+            nxt = 0.5 * (x + a / x)
+            if ((nxt - x) * (nxt - x)).sum() < 1e-12:
+                x = nxt
+                break
+            x = nxt
+        return x
+
+    sf = to_static(f)
+    out = sf(T(2.0))
+    np.testing.assert_allclose(out.numpy(), np.sqrt(2.0), rtol=1e-6)
+    np.testing.assert_allclose(f(T(2.0)).numpy(), np.sqrt(2.0),
+                               rtol=1e-6)
+
+
+def test_for_range_break_python_target_semantics():
+    """Python range semantics survive the while lowering: the target
+    keeps its break-time value, an empty range leaves a previous
+    binding intact, and reassigning the target inside the body can't
+    change the iteration count (eager AND traced paths)."""
+    @to_static
+    def keeps_break_value(x):
+        j = 0
+        for i in range(10):
+            j = i
+            if i == 3:
+                break
+        return x * 0 + i + j
+
+    np.testing.assert_allclose(keeps_break_value(T([0.])).numpy(), [6.])
+
+    @to_static
+    def empty_range(x):
+        i = 99
+        for i in range(0):
+            if i > 5:
+                break
+        return x * 0 + i
+
+    np.testing.assert_allclose(empty_range(T([0.])).numpy(), [99.])
+
+    @to_static
+    def target_reassigned(x):
+        out = 0
+        for i in range(5):
+            out = out + 1
+            i = 0
+            if out > 100:
+                break
+        return x * 0 + out
+
+    np.testing.assert_allclose(target_reassigned(T([0.])).numpy(), [5.])
+
+
+def test_bail_does_not_corrupt_original_loop():
+    """When the desugar bails (break inside try), the fallback must see
+    the ORIGINAL body — a nested loop's `else: break` must not have
+    been rewritten into a dead flag assignment."""
+    @to_static
+    def f(x):
+        s = 0
+        while s < 10:
+            while s < 5:
+                s = s + 1
+            else:
+                break
+            try:
+                if s > 100:
+                    break
+            finally:
+                pass
+            s = s + 100
+        return x * 0 + s
+
+    # all-concrete: pure Python semantics — outer break via while-else
+    np.testing.assert_allclose(f(T([0.])).numpy(), [5.])
+
+
+def test_break_under_jit_compiles_once():
+    """The desugared loop must be a single lax.while_loop under
+    jax.jit (the whole point): same compiled fn serves different
+    break iterations."""
+    import jax
+
+    def f(x):
+        s = x * 0
+        i = x.sum() * 0
+        while i < 1000.0:
+            i = i + 1
+            s = s + i
+            if s.sum() > x.sum():
+                break
+        return i
+
+    sf = to_static(f)
+
+    @jax.jit
+    def g(v):
+        return sf(Tensor(v))._value
+
+    # different data-dependent exit points, one trace
+    assert float(g(np.float32([5.]))) == 3.0    # 1+2+3 > 5
+    assert float(g(np.float32([100.]))) == 14.0  # sum 1..14=105 > 100
 
 
 # ----------------------------- layer-bound ---------------------------------
