@@ -49,10 +49,12 @@ class GPTConfig:
 
 
 def gpt_tiny(**kw):
-    return GPTConfig(vocab_size=256, hidden_size=64, num_hidden_layers=2,
-                     num_attention_heads=4, intermediate_size=128,
-                     max_position_embeddings=128, hidden_dropout_prob=0.0,
-                     attention_probs_dropout_prob=0.0, **kw)
+    base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128,
+                max_position_embeddings=128, hidden_dropout_prob=0.0,
+                attention_probs_dropout_prob=0.0)
+    base.update(kw)
+    return GPTConfig(**base)
 
 
 def gpt2_small(**kw):
@@ -60,10 +62,11 @@ def gpt2_small(**kw):
 
 
 def gpt3_1p3b(**kw):
-    return GPTConfig(vocab_size=50304, hidden_size=2048,
-                     num_hidden_layers=24, num_attention_heads=16,
-                     intermediate_size=8192,
-                     max_position_embeddings=2048, **kw)
+    base = dict(vocab_size=50304, hidden_size=2048,
+                num_hidden_layers=24, num_attention_heads=16,
+                intermediate_size=8192, max_position_embeddings=2048)
+    base.update(kw)
+    return GPTConfig(**base)
 
 
 class GPTEmbeddings(nn.Layer):
