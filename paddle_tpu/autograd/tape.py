@@ -177,6 +177,42 @@ def _node_vjp(node, cts):
     return vjp_fn(tuple(out_cts))
 
 
+def _has_hooks(t) -> bool:
+    hooks = getattr(t, "_grad_hooks", None)
+    return bool(hooks) and any(h is not None for h in hooks)
+
+
+def _apply_hooks(t, ct):
+    """Run a tensor's grad hooks (registration order) on the fully
+    accumulated cotangent; a hook returning non-None replaces it
+    (upstream Tensor.register_hook contract)."""
+    from ..tensor import Tensor
+    for h in getattr(t, "_grad_hooks", ()):
+        if h is None:
+            continue
+        out = h(Tensor(ct, stop_gradient=True))
+        if out is not None:
+            ct = out._value if hasattr(out, "_value") else jnp.asarray(out)
+    return ct
+
+
+def _finalize_hooked_outputs(node, cts, hook_done, deferred):
+    """Called when the reverse walk reaches a node: every CONSUMER of
+    this node's outputs has already been processed (the tape is
+    chronological), so each output's cotangent is final — the moment
+    registered grad hooks must fire.  If the tensor's ``.grad``
+    assignment was deferred (hooked leaf-like), complete it with the
+    hooked value."""
+    for o in node.outputs:
+        oid = id(o)
+        if oid in hook_done or oid not in cts or not _has_hooks(o):
+            continue
+        cts[oid] = _apply_hooks(o, cts[oid])
+        hook_done.add(oid)
+        if oid in deferred:
+            _add_grad(deferred.pop(oid), cts[oid])
+
+
 def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
     """Reverse-walk the tape from ``tensors`` (usually one scalar loss).
 
@@ -199,8 +235,11 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
         _accum(cts, id(t), seed)
 
     produced = {id(o): n for n in _tape for o in n.outputs}
+    hook_done: set = set()
+    deferred: Dict[int, Any] = {}   # hooked tensors awaiting .grad
 
     for node in reversed(_tape):
+        _finalize_hooked_outputs(node, cts, hook_done, deferred)
         in_cts = _node_vjp(node, cts)
         if in_cts is None:
             continue
@@ -208,13 +247,24 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
             t = node.args[i]
             if ct is None or t.stop_gradient:
                 continue
-            if id(t) in produced and not getattr(t, "_retain_grads", False):
-                _accum(cts, id(t), ct)   # interior: keep flowing
-            else:
-                _accum(cts, id(t), ct)
-                _add_grad(t, ct)
+            _accum(cts, id(t), ct)
+            wants_grad = (id(t) not in produced
+                          or getattr(t, "_retain_grads", False))
+            if wants_grad:
+                if _has_hooks(t):
+                    # defer: the hook must see the FULL accumulated
+                    # grad, not each contribution
+                    deferred[id(t)] = t
+                else:
+                    _add_grad(t, ct)
 
-    # leaves fed directly as roots (e.g. x.backward() on a leaf): nothing to do.
+    # hooked leaves have no producer node — flush them now
+    for tid, t in deferred.items():
+        val = cts[tid]
+        if tid not in hook_done:
+            val = _apply_hooks(t, val)
+        _add_grad(t, val)
+
     if not retain_graph:
         reset_tape()
 
@@ -270,7 +320,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             g._value if hasattr(g, "_value") else jnp.asarray(g))
         _accum(cts, id(t), seed)
 
+    hook_done: set = set()
     for node in reversed(_tape):
+        _finalize_hooked_outputs(node, cts, hook_done, {})
         in_cts = _node_vjp(node, cts)
         if in_cts is None:
             continue

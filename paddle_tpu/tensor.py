@@ -212,9 +212,27 @@ class Tensor:
         self._retain_grads = True
 
     def register_hook(self, hook):
-        # VERIFY-vs-reference: eager grad hooks not yet wired into tape walk.
-        raise NotImplementedError(
-            "Tensor.register_hook is not supported yet on the TPU build")
+        """Register a gradient hook fired during eager ``backward()``
+        when this tensor's (fully accumulated) gradient is computed.
+        The hook receives the grad Tensor and may return a replacement
+        (or None to keep it); replacements propagate to producers.
+
+        Parity: upstream ``Tensor.register_hook`` / C++ eager
+        ``TensorHook`` (paddle/fluid/eager/hooks.h); returns a
+        ``TensorHookRemoveHelper`` analog with ``.remove()``.  Dygraph
+        (tape) only — the jitted ``@to_static``/``Model.fit`` fast path
+        computes grads functionally and never fires tensor hooks,
+        matching upstream's dygraph-hook scoping."""
+        if self.stop_gradient:
+            raise RuntimeError(
+                "Cannot register_hook on a tensor with "
+                "stop_gradient=True — it will never receive a gradient")
+        if not callable(hook):
+            raise TypeError("hook must be callable")
+        if not hasattr(self, "_grad_hooks"):
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+        return _HookRemoveHelper(self, len(self._grad_hooks) - 1)
 
     @property
     def gradient(self):
@@ -332,6 +350,23 @@ class Tensor:
     def __and__(self, o): return self._op("logical_and", o)
     def __or__(self, o): return self._op("logical_or", o)
     def __xor__(self, o): return self._op("logical_xor", o)
+
+
+class _HookRemoveHelper:
+    """Return value of ``Tensor.register_hook`` (upstream
+    TensorHookRemoveHelper parity): ``.remove()`` detaches the hook."""
+
+    def __init__(self, tensor: "Tensor", idx: int):
+        self._tensor = tensor
+        self._idx = idx
+
+    def remove(self) -> bool:
+        hooks = getattr(self._tensor, "_grad_hooks", None)
+        if hooks is not None and self._idx < len(hooks) \
+                and hooks[self._idx] is not None:
+            hooks[self._idx] = None
+            return True
+        return False
 
 
 class Parameter(Tensor):

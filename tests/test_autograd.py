@@ -129,3 +129,82 @@ def test_grad_through_inplace_buffer_swap():
     x.set_value(np.array([100.0], dtype=np.float32))
     y.backward()
     np.testing.assert_allclose(x.grad.numpy(), [4.0])  # 2*x_old
+
+
+# ------------------- Tensor.register_hook (eager grad hooks) ---------------
+# parity: upstream Tensor.register_hook / eager TensorHook
+# (paddle/fluid/eager/hooks.h) — VERDICT r4 next #7.
+
+def test_register_hook_scales_leaf_grad():
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32),
+                         stop_gradient=False)
+    calls = []
+
+    def hook(g):
+        calls.append(g.numpy().copy())
+        return g * 2
+
+    h = x.register_hook(hook)
+    y = (x * x).sum()
+    y.backward()
+    # raw grad 2x = [2,4]; hook doubles -> [4,8]
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0], rtol=1e-6)
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [2.0, 4.0], rtol=1e-6)
+    assert h.remove() is True
+
+
+def test_register_hook_sees_full_accumulated_grad():
+    """Multi-consumer: the hook fires ONCE with the summed cotangent,
+    not per contribution."""
+    x = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    calls = []
+    x.register_hook(lambda g: calls.append(g.numpy().copy()))
+    y = x * 2 + x * 3       # dy/dx = 5
+    y.backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [5.0], rtol=1e-6)
+    np.testing.assert_allclose(x.grad.numpy(), [5.0], rtol=1e-6)
+
+
+def test_register_hook_interior_modifies_upstream_flow():
+    """A hook on an interior tensor replaces the grad that continues to
+    its producers (upstream semantics)."""
+    x = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    h = x * 2            # interior
+    h.register_hook(lambda g: g * 10)
+    y = (h * h).sum()    # dy/dh = 2h = 12; hooked -> 120; dx = 120*2
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [240.0], rtol=1e-6)
+
+
+def test_register_hook_none_keeps_grad_and_remove_works():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    seen = []
+    h1 = x.register_hook(lambda g: seen.append(1))   # returns None
+    h2 = x.register_hook(lambda g: g * 7)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [28.0], rtol=1e-6)  # 4*7
+    h2.remove()
+    x.clear_grad()
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0], rtol=1e-6)
+    assert len(seen) == 2
+
+
+def test_register_hook_on_stopped_tensor_raises():
+    x = paddle.to_tensor(np.array([1.0], np.float32))  # stop_gradient
+    with pytest.raises(RuntimeError, match="stop_gradient"):
+        x.register_hook(lambda g: g)
+
+
+def test_register_hook_fires_in_paddle_grad():
+    x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+    h = x * 4
+    h.register_hook(lambda g: g * 0 + 1.0)   # overwrite flowing grad
+    y = (h * h).sum()
+    gx, = paddle.grad(y, [x])
+    # dy/dh = 2h = 16 -> hooked to 1 -> dx = 1*4
+    np.testing.assert_allclose(gx.numpy(), [4.0], rtol=1e-6)
