@@ -1,47 +1,35 @@
 """Distributed checkpoint (parity: python/paddle/distributed/checkpoint/
 — SURVEY.md §5.4: orbax is sharded-by-construction, each host writes its
-shards, reshard-on-load is free via sharding metadata)."""
+shards, reshard-on-load is free via sharding metadata).
+
+``save_state_dict`` / ``load_state_dict`` keep upstream's call
+signature; the implementation lives in ``reshard.py`` — arrays restore
+directly into the TEMPLATE leaf's sharding, so a checkpoint written on
+one topology (dp2xmp2) loads into any other (dp4, dp1, pp-resliced)
+without a host gather."""
 
 from __future__ import annotations
 
-import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
-import numpy as np
-import jax
-
-
-def _get_checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.PyTreeCheckpointer()
+from .reshard import (save_state_dict as _save_resharded,
+                      load_state_dict as _load_resharded,
+                      save_runner_state, load_runner_state)
 
 
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False) -> None:
     """Save a (possibly sharded-jax.Array) state dict with orbax."""
-    from ...tensor import Tensor
-    tree = {k: (v._value if isinstance(v, Tensor) else v)
-            for k, v in state_dict.items()}
-    path = os.path.abspath(path)
-    _get_checkpointer().save(path, tree, force=True)
+    _save_resharded(state_dict, path)
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     offload: bool = False) -> Dict[str, Any]:
-    """Load into the given state dict IN PLACE (paddle convention).
-    Reshard-on-load: orbax restores to each array's current sharding."""
-    from ...tensor import Tensor
-    import orbax.checkpoint as ocp
-    path = os.path.abspath(path)
-    restored = _get_checkpointer().restore(path)
-    for k, v in state_dict.items():
-        if k in restored:
-            tgt = v
-            if isinstance(tgt, Tensor):
-                tgt._value = jax.numpy.asarray(
-                    restored[k], dtype=tgt._value.dtype)
+    """Load into the given state dict IN PLACE (paddle convention),
+    resharding every array to its template leaf's current sharding."""
+    _load_resharded(state_dict, path)
     return state_dict
 
 

@@ -1,0 +1,110 @@
+"""Cross-topology distributed checkpointing with reshard-on-load.
+
+Parity: upstream ``python/paddle/distributed/checkpoint/`` —
+``save_state_dict`` writes each rank's owned shards plus metadata;
+``load_state_dict`` merges/reslices them into the CURRENT topology's
+shards (the merge/reshard utilities SURVEY.md §5.4 calls out).
+
+TPU-native design: a sharded ``jax.Array`` already carries its
+``NamedSharding``; orbax records per-shard layout on save and, on
+restore, assembles exactly the bytes each target shard needs.  So the
+whole upstream merge/reshard subsystem collapses into "restore with the
+TARGET sharding in the restore args" — save from a dp2xmp2 mesh, load
+into dp4, dp1, or any other topology, no gather through host memory.
+
+    save_state_dict(tree, path)          # tree of Tensors/jax.Arrays
+    load_state_dict(template, path)      # template carries TARGET
+                                         # shardings; assigned in place
+
+``DistributedRunner`` integration: ``save_runner_state`` /
+``load_runner_state`` checkpoint params + optimizer slots of a placed
+runner; loading into a runner placed on a DIFFERENT mesh reshards
+automatically.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+
+from ...tensor import Tensor
+
+__all__ = ["save_state_dict", "load_state_dict",
+           "save_runner_state", "load_runner_state"]
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def save_state_dict(state_dict, path: str) -> None:
+    """Write a (possibly sharded) tree of Tensors / jax.Arrays.
+
+    Every process must call this (single-process on the virtual mesh);
+    orbax writes per-shard OCDBT records plus the tree metadata."""
+    import orbax.checkpoint as ocp
+    tree = _unwrap_tree(state_dict)
+    path = os.path.abspath(path)
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, args=ocp.args.PyTreeSave(tree))
+
+
+def load_state_dict(state_dict, path: str):
+    """Restore ``path`` into ``state_dict``'s arrays: each leaf is
+    re-laid-out to the TEMPLATE leaf's sharding (reshard-on-load).
+    Tensor leaves are updated in place; the restored raw tree is also
+    returned."""
+    import orbax.checkpoint as ocp
+    template = _unwrap_tree(state_dict)
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(
+        os.path.abspath(path),
+        args=ocp.args.PyTreeRestore(restore_args=restore_args))
+
+    # write back into Tensor leaves so live Layers see the new values
+    flat_t, _ = jax.tree_util.tree_flatten(
+        state_dict, is_leaf=lambda x: isinstance(x, Tensor))
+    flat_r, _ = jax.tree_util.tree_flatten(restored)
+    for t, r in zip(flat_t, flat_r):
+        if isinstance(t, Tensor):
+            t._value = r
+    return restored
+
+
+def _runner_tree(runner) -> Dict[str, Any]:
+    if not runner._placed:
+        runner.place()
+    params = {n: p._value for n, p in runner._name_to_param.items()}
+    return {"params": params, "opt": runner._opt_state,
+            "step": int(runner.optimizer._global_step)}
+
+
+def save_runner_state(runner, path: str) -> None:
+    """Checkpoint a placed DistributedRunner's params + optimizer
+    slots with their live shardings."""
+    save_state_dict(_runner_tree(runner), path)
+
+
+def load_runner_state(runner, path: str) -> None:
+    """Restore into a placed runner — on ANY mesh topology; arrays are
+    resharded to the runner's own placement on read."""
+    import orbax.checkpoint as ocp
+    if not runner._placed:
+        runner.place()
+    template = _runner_tree(runner)
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    ckptr = ocp.PyTreeCheckpointer()
+    restored = ckptr.restore(
+        os.path.abspath(path),
+        args=ocp.args.PyTreeRestore(restore_args=restore_args))
+    for n, p in runner._name_to_param.items():
+        p._value = restored["params"][n]
+    runner._opt_state = restored["opt"]
+    runner.optimizer._opt_state_tree = restored["opt"]
+    runner.optimizer._global_step = int(restored["step"])
+    runner.invalidate_cache()

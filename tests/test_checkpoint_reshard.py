@@ -1,0 +1,122 @@
+"""Cross-topology checkpoint reshard-on-load (VERDICT r4 next #5).
+
+Parity: upstream `python/paddle/distributed/checkpoint/` — a checkpoint
+saved from one parallel topology must load into a different one, with
+the framework merging/reslicing shards.  Here orbax restores each array
+straight into the target topology's NamedSharding (reshard.py)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.distributed import collective
+from paddle_tpu.distributed.runner import DistributedRunner
+from paddle_tpu.distributed.checkpoint import (
+    save_state_dict, load_state_dict, save_runner_state,
+    load_runner_state)
+from paddle_tpu.models import (gpt_tiny, GPTForCausalLM,
+                               GPTPretrainingCriterion)
+from paddle_tpu.tensor import Tensor
+
+pytestmark = pytest.mark.dist
+
+
+def _batch(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(0, 256, (8, 32)).astype(np.int64)
+    return [x], [np.roll(x, -1, axis=1)]
+
+
+def _make_runner(mesh_axes, n_dev):
+    devices = jax.devices()[:n_dev]
+    mesh = collective.build_mesh(mesh_axes, devices=devices)
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = GPTForCausalLM(gpt_tiny())
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=net.parameters())
+    r = DistributedRunner(net, opt, GPTPretrainingCriterion(),
+                          mesh=mesh)
+    r.place()
+    return r
+
+
+def test_reshard_dp2mp2_to_dp4_and_dp1(tmp_path):
+    """Train on dp2xmp2, checkpoint, resume on dp4 AND dp1: the next
+    step's loss must match the source topology's next step exactly
+    (same global batch, same math, different shardings)."""
+    path = str(tmp_path / "ckpt")
+    xs, ys = _batch(0)
+
+    src = _make_runner({"dp": 2, "mp": 2}, 4)
+    float(src.train_step(xs, ys))
+    float(src.train_step(xs, ys))
+    save_runner_state(src, path)
+    ref_next = float(src.train_step(xs, ys))   # step 3 on source
+
+    for axes, n in [({"dp": 4}, 4), ({"dp": 1}, 1)]:
+        dst = _make_runner(axes, n)
+        load_runner_state(dst, path)
+        got = float(dst.train_step(xs, ys))    # step 3 resumed
+        assert abs(got - ref_next) < 1e-3, \
+            f"resume on {axes}: loss {got} != source-next {ref_next}"
+        assert dst.optimizer._global_step >= 2
+
+
+def test_reshard_changes_actual_sharding(tmp_path):
+    """The loaded arrays live in the TARGET sharding (not a replicated
+    host-gather): a dp4-sharding-4 ZeRO runner's moment slots end up
+    sharded over 4 devices after loading a dp2xmp2 checkpoint."""
+    path = str(tmp_path / "ckpt")
+    src = _make_runner({"dp": 2, "mp": 2}, 4)
+    float(src.train_step(*_batch(0)))
+    save_runner_state(src, path)
+
+    devices = jax.devices()[:4]
+    mesh = collective.build_mesh({"sharding": 4}, devices=devices)
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = GPTForCausalLM(gpt_tiny())
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=net.parameters())
+    dst = DistributedRunner(net, opt, GPTPretrainingCriterion(),
+                            mesh=mesh, sharding_stage=2)
+    dst.place()
+    load_runner_state(dst, path)
+    # ZeRO-2: at least one moment slot should be sharded (not
+    # single-device) across the 4 'sharding' devices
+    sharded = 0
+    for st in dst._opt_state.values():
+        for v in st.values():
+            if hasattr(v, "sharding") and len(v.sharding.device_set) == 4:
+                sharded += 1
+    assert sharded > 0, "no optimizer slot is sharded over the target mesh"
+    got = float(dst.train_step(*_batch(0)))
+    assert np.isfinite(got)
+
+
+def test_save_load_state_dict_plain_tree(tmp_path):
+    """Module-level API on a plain tree of sharded Tensors."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    devices = jax.devices()[:4]
+    mesh = collective.build_mesh({"dp": 4}, devices=devices)
+    w = np.arange(32, dtype=np.float32).reshape(8, 4)
+    sharded = jax.device_put(w, NamedSharding(mesh, P("dp", None)))
+    sd = {"w": Tensor(sharded), "b": Tensor(np.ones(4, np.float32))}
+    save_state_dict(sd, str(tmp_path / "sd"))
+
+    mesh2 = collective.build_mesh({"dp": 2}, devices=devices[:2])
+    tgt = {"w": Tensor(jax.device_put(
+        np.zeros((8, 4), np.float32),
+        NamedSharding(mesh2, P(None, "dp")))),
+        "b": Tensor(np.zeros(4, np.float32))}
+    load_state_dict(tgt, str(tmp_path / "sd"))
+    np.testing.assert_allclose(tgt["w"].numpy(), w)
+    np.testing.assert_allclose(tgt["b"].numpy(), np.ones(4))
+    # target sharding honored: column-sharded over 2 devices
+    assert len(tgt["w"]._value.sharding.device_set) == 2
