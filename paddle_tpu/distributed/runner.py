@@ -280,7 +280,7 @@ class DistributedRunner:
         finally:
             coll.set_mesh(prev_mesh)
 
-    def _train_step_inner(self, inputs, labels) -> float:
+    def _prep_step_args(self, inputs, labels):
         if not self._placed:
             self.place()
         if self._step_fn is None:
@@ -300,6 +300,26 @@ class DistributedRunner:
             raise ValueError(
                 f"DistributedRunner was compiled for {self._n_inputs} "
                 f"inputs, got {len(inputs_v)}; create a new runner")
+        return inputs_v, labels_v
+
+    def lower_step(self, inputs, labels):
+        """AOT-lower the compiled train step (no execution): for HLO
+        collective audits and ``CompiledMemoryStats`` budget checks.
+        Returns the ``jax.stages.Lowered`` object."""
+        prev_mesh = coll.get_mesh()
+        coll.set_mesh(self.mesh)
+        try:
+            inputs_v, labels_v = self._prep_step_args(inputs, labels)
+            params, frozen, bufs = self._sync_val_cache()
+            lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+            return self._step_fn.lower(
+                params, frozen, bufs, self._opt_state, lr,
+                jnp.uint32(1), *inputs_v, *labels_v)
+        finally:
+            coll.set_mesh(prev_mesh)
+
+    def _train_step_inner(self, inputs, labels) -> float:
+        inputs_v, labels_v = self._prep_step_args(inputs, labels)
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
         self._step_ctr = getattr(self, "_step_ctr", 0) + 1
         ctr = jnp.uint32(self._step_ctr)

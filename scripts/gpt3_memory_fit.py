@@ -101,6 +101,12 @@ def fit(pp, mp, dp, seq=2048, micro_bs=2, acc=4, seed_params=True):
         rec = {
             "arm": f"dp{dp}xmp{mp}xpp{pp}",
             "model": "gpt3_1p3b",
+            # CPU lowering uses the composed O(S^2) attention (Pallas
+            # flash is TPU-only), so temp is an UPPER bound on the TPU
+            # figure: at seq 2048 the [B,H,S,S] probability tensors the
+            # flash kernels never materialize dominate the temp pool.
+            "note": "temp is an upper bound (composed O(S^2) attention "
+                    "on CPU; TPU flash path materializes O(S) instead)",
             "n_params": n_params,
             "seq": seq, "micro_bs": micro_bs, "acc": acc,
             "remat": True,

@@ -1,0 +1,94 @@
+"""8 -> 64 -> 256 chip scaling-efficiency projection (VERDICT r4 #3).
+
+Analytic model, grounded in (a) the round-5 MEASURED single-chip v5e
+step times (BASELINE.md) and (b) the HLO collective audit
+(tests/test_hlo_collective_audit.py) which verifies the model's two
+structural premises on the compiled program: the dp axis carries
+exactly the gradient all-reduce (4 bytes x per-chip grad elements at
+f32) and every other collective stays on intra-slice (ICI) axes.
+
+Topology: v5e-256 = 8 slices x 32 chips; v5e-64 = 2 x 32; v5e-8 = one
+slice (no DCN).  Mesh layout rule (DESIGN-DCN.md): dp outermost, so
+slice boundaries cut only dp.
+
+Per-step comm model (weak scaling, per-chip batch fixed):
+  ICI  all-reduce: t = 2*(n-1)/n * G_chip / BW_ici
+  DCN exchange   : t = 2*(S-1)/S * G_chip / BW_dcn  (hierarchical AR:
+                   intra-slice reduce-scatter leaves each chip 1/32 of
+                   the slice sum; the inter-slice exchange of those
+                   shards is BW_dcn per chip-pipe aggregated per slice)
+  overlap        : OVERLAP of the DCN time hides under backward
+                   (XLA latency-hiding scheduler; the dp all-reduce is
+                   off the critical path until the optimizer update)
+  efficiency     = t_compute / (t_compute + t_ici + exposed_dcn)
+
+Compression (compressed.py): bf16 = 2 bytes/elt exact; int8 EQuARX
+ring = (8 + 16/256) bits ~ 1.008 bytes/elt + fp32 block scales.
+
+Run: python scripts/scaling_projection.py [--emit-md]
+"""
+
+import argparse
+
+# measured round-5 v5e single-chip step times (BASELINE.md)
+CONFIGS = [
+    # name, step_ms (measured), grad elements per chip replica-group,
+    # note
+    ("ResNet-50 b64 (config 2, pure dp)", 44.46, 25.6e6, ""),
+    ("ERNIE-3.0-base b16 s512 (config 3)", 103.64, 118e6,
+     "sharding-2 keeps moments sharded; grads still all-reduce"),
+    ("GPT-2-small b8 s1024", 132.0, 124e6, ""),
+    ("GPT-3 1.3B mp2xpp2 (config 4)", 4 * 132.0, 1.316e9 / 4,
+     "per-chip grads = P/(mp*pp); step est. 4x GPT-small-class"),
+]
+
+BW_ICI = 90e9     # effective per-chip all-reduce bandwidth inside a
+                  # slice (v5e 2D-torus ring algorithm bandwidth)
+BW_DCN = 25e9     # effective per-chip inter-slice exchange bandwidth
+                  # (per slice aggregate / 32 chips sharing it)
+OVERLAP = 0.7     # DCN fraction hidden under backward
+SLICE = 32        # chips per slice
+
+BYTES = {"f32": 4.0, "bf16": 2.0, "int8": 8.0 / 8 + 16.0 / (8 * 256)}
+
+
+def efficiency(step_ms, grad_elems, n_chips, wire):
+    t_c = step_ms / 1e3
+    n_ici = min(n_chips, SLICE)
+    g_ici = grad_elems * 4.0          # intra-slice AR stays f32
+    t_ici = 2 * (n_ici - 1) / n_ici * g_ici / BW_ICI
+    n_slices = max(n_chips // SLICE, 1)
+    if n_slices > 1:
+        g_dcn = grad_elems * BYTES[wire] / SLICE  # post-RS shard/chip
+        t_dcn = 2 * (n_slices - 1) / n_slices * g_dcn * SLICE / BW_DCN
+        exposed = t_dcn * (1 - OVERLAP)
+    else:
+        exposed = 0.0
+    return t_c / (t_c + t_ici + exposed)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--emit-md", action="store_true")
+    args = ap.parse_args()
+    rows = []
+    for name, ms, g, note in CONFIGS:
+        for wire in ("f32", "bf16", "int8"):
+            effs = [efficiency(ms, g, n, wire) for n in (8, 64, 256)]
+            rows.append((name, wire, effs))
+    hdr = ("| config | dp wire | eff@8 | eff@64 | eff@256 |\n"
+           "|---|---|---|---|---|")
+    print(hdr)
+    for name, wire, effs in rows:
+        print(f"| {name} | {wire} | " +
+              " | ".join(f"{e*100:.1f}%" for e in effs) + " |")
+    print()
+    print(f"assumptions: BW_ici={BW_ICI/1e9:.0f} GB/s/chip, "
+          f"BW_dcn={BW_DCN/1e9:.0f} GB/s/chip-equiv per slice, "
+          f"overlap={OVERLAP}, slice={SLICE} chips; "
+          "intra-slice AR f32; structure validated by "
+          "tests/test_hlo_collective_audit.py")
+
+
+if __name__ == "__main__":
+    main()
