@@ -1,0 +1,103 @@
+"""Detection AP evaluation (host-side numpy).
+
+Parity: upstream PaddleDetection `ppdet/metrics/map_utils.py`
+(prune_zero_padding / DetectionMAP) and the fluid-era
+`paddle.metric.DetectionMAP` — mAP over classes at a fixed IoU
+threshold with VOC-style interpolation.  Evaluation is a host-side
+metric in upstream too (it runs between epochs, not inside the
+compiled step), so plain numpy is the TPU-native choice as well: the
+device path ends at `multiclass_nms` outputs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["voc_ap", "eval_detections_ap"]
+
+
+def _iou_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU between [N,4] and [M,4] xyxy boxes."""
+    if len(a) == 0 or len(b) == 0:
+        return np.zeros((len(a), len(b)), np.float32)
+    ax1, ay1, ax2, ay2 = a[:, 0:1], a[:, 1:2], a[:, 2:3], a[:, 3:4]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    ix1 = np.maximum(ax1, bx1)
+    iy1 = np.maximum(ay1, by1)
+    ix2 = np.minimum(ax2, bx2)
+    iy2 = np.minimum(ay2, by2)
+    inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0, None)
+    area_a = np.clip(ax2 - ax1, 0, None) * np.clip(ay2 - ay1, 0, None)
+    area_b = np.clip(bx2 - bx1, 0, None) * np.clip(by2 - by1, 0, None)
+    union = area_a + area_b - inter
+    return np.where(union > 0, inter / union, 0.0).astype(np.float32)
+
+
+def voc_ap(recall: np.ndarray, precision: np.ndarray) -> float:
+    """Continuous-interpolation VOC AP (area under the max-envelope
+    precision-recall curve; upstream map_type='integral')."""
+    r = np.concatenate([[0.0], recall, [1.0]])
+    p = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(p) - 2, -1, -1):
+        p[i] = max(p[i], p[i + 1])
+    idx = np.where(r[1:] != r[:-1])[0]
+    return float(np.sum((r[idx + 1] - r[idx]) * p[idx + 1]))
+
+
+def eval_detections_ap(
+        detections: Sequence[np.ndarray],
+        gt_boxes: Sequence[np.ndarray],
+        gt_labels: Sequence[np.ndarray],
+        num_classes: int,
+        iou_threshold: float = 0.5) -> Dict[str, object]:
+    """AP per class + mAP at one IoU threshold.
+
+    detections: per image, [N, 6] rows (label, score, x1, y1, x2, y2)
+      — exactly `multiclass_nms` / `PPYOLOE.postprocess` output;
+    gt_boxes / gt_labels: per image, [M, 4] xyxy and [M] int labels
+      (pass only valid rows — pruned padding, upstream
+      prune_zero_padding).
+    """
+    aps: Dict[int, float] = {}
+    for c in range(num_classes):
+        scored: List[Tuple[float, int, int]] = []  # score, img, det idx
+        npos = 0
+        per_img_gt = []
+        for i, (gb, gl) in enumerate(zip(gt_boxes, gt_labels)):
+            keep = np.asarray(gl) == c
+            per_img_gt.append(np.asarray(gb)[keep])
+            npos += int(keep.sum())
+        if npos == 0:
+            continue
+        for i, det in enumerate(detections):
+            det = np.asarray(det)
+            if det.size == 0:
+                continue
+            for j in np.where(det[:, 0].astype(int) == c)[0]:
+                scored.append((float(det[j, 1]), i, int(j)))
+        if not scored:
+            aps[c] = 0.0
+            continue
+        scored.sort(key=lambda t: -t[0])
+        matched = [np.zeros(len(g), bool) for g in per_img_gt]
+        tp = np.zeros(len(scored))
+        fp = np.zeros(len(scored))
+        for k, (_s, i, j) in enumerate(scored):
+            box = np.asarray(detections[i])[j, 2:6][None, :]
+            ious = _iou_matrix(box, per_img_gt[i])[0]
+            best = int(np.argmax(ious)) if len(ious) else -1
+            if best >= 0 and ious[best] >= iou_threshold \
+                    and not matched[i][best]:
+                matched[i][best] = True
+                tp[k] = 1
+            else:
+                fp[k] = 1
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        rec = ctp / npos
+        prec = ctp / np.maximum(ctp + cfp, 1e-9)
+        aps[c] = voc_ap(rec, prec)
+    mean_ap = float(np.mean(list(aps.values()))) if aps else 0.0
+    return {"map": mean_ap, "ap_per_class": aps,
+            "iou_threshold": iou_threshold}

@@ -152,3 +152,69 @@ def test_detector_jit_train_step_compiles_once_per_bucket():
 def test_ppyoloe_s_factory():
     net = ppyoloe_crn_s(num_classes=10)
     assert len(list(net.parameters())) > 50
+
+
+def test_detector_trains_to_nonzero_ap():
+    """VERDICT r4 next #8: train on a fixed synthetic labeled set, then
+    run the FULL eval path (forward -> postprocess -> multiclass_nms ->
+    AP@0.5).  The synthetic task (solid rectangles, class = fill
+    channel) is learnable; AP must rise well above chance."""
+    from paddle_tpu.vision.detection_eval import eval_detections_ap
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    C = 3
+    net = ppyoloe_tiny(num_classes=C)
+    net.train()
+    opt = optimizer.Adam(learning_rate=5e-3,
+                         parameters=net.parameters())
+    train = [_synth_batch(rng, 2, 64, num_classes=C) for _ in range(4)]
+    for step in range(48):
+        imgs, boxes, labels, mask = train[step % len(train)]
+        out = net(Tensor(imgs), gt_boxes=Tensor(boxes),
+                  gt_labels=Tensor(labels), gt_mask=Tensor(mask))
+        out["loss"].backward()
+        opt.step()
+        opt.clear_grad()
+
+    # eval on the SAME distribution (toy capacity net): e2e NMS path
+    net.eval()
+    dets, gtb, gtl = [], [], []
+    for imgs, boxes, labels, mask in train:
+        scores, pboxes = net(Tensor(imgs))
+        outs = net.postprocess(scores, pboxes, score_threshold=0.05,
+                               nms_threshold=0.6)
+        for b in range(imgs.shape[0]):
+            det = outs[b]
+            det = det.numpy() if hasattr(det, "numpy") else np.asarray(det)
+            dets.append(det)
+            valid = mask[b] > 0
+            gtb.append(boxes[b][valid])
+            gtl.append(labels[b][valid])
+    res = eval_detections_ap(dets, gtb, gtl, num_classes=C,
+                             iou_threshold=0.5)
+    assert res["map"] > 0.25, \
+        f"mAP@0.5 {res['map']:.3f} too low; per-class {res['ap_per_class']}"
+
+
+def test_eval_detections_ap_oracle():
+    """AP utility sanity: perfect detections -> AP 1; shifted boxes at
+    low IoU -> AP 0; one FP halves precision but not the envelope."""
+    from paddle_tpu.vision.detection_eval import eval_detections_ap
+
+    gt = [np.array([[10, 10, 30, 30], [40, 40, 60, 60]], np.float32)]
+    gl = [np.array([0, 1])]
+    perfect = [np.array([[0, 0.9, 10, 10, 30, 30],
+                         [1, 0.8, 40, 40, 60, 60]], np.float32)]
+    assert eval_detections_ap(perfect, gt, gl, 2)["map"] == 1.0
+
+    missed = [np.array([[0, 0.9, 100, 100, 120, 120],
+                        [1, 0.8, 200, 200, 220, 220]], np.float32)]
+    assert eval_detections_ap(missed, gt, gl, 2)["map"] == 0.0
+
+    with_fp = [np.array([[0, 0.9, 10, 10, 30, 30],
+                         [0, 0.5, 100, 100, 120, 120],
+                         [1, 0.8, 40, 40, 60, 60]], np.float32)]
+    r = eval_detections_ap(with_fp, gt, gl, 2)
+    assert r["ap_per_class"][0] == 1.0  # FP ranked below the TP
+    assert r["map"] == 1.0
