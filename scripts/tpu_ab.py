@@ -30,6 +30,14 @@ VARIANTS = [
     ("fused_lmce", {"PADDLE_TPU_FUSED_LMCE": "1"}),
     ("no_packed+fused_lmce", {"PADDLE_TPU_FLASH_NO_PACKED": "1",
                               "PADDLE_TPU_FUSED_LMCE": "1"}),
+    # head-dim-64 MXU experiment (VERDICT r4 #9): head-pair forward
+    # kernel — batched 64-contraction dots + full-width softmax lanes
+    ("headpack2", {"PADDLE_TPU_FLASH_HEADPACK": "2"}),
+    ("headpack2+fused_lmce", {"PADDLE_TPU_FLASH_HEADPACK": "2",
+                              "PADDLE_TPU_FUSED_LMCE": "1"}),
+    # KV-block sweep around the r3 winner (1024)
+    ("bk512", {"PADDLE_TPU_FLASH_BK": "512"}),
+    ("bk2048", {"PADDLE_TPU_FLASH_BK": "2048"}),
 ]
 
 
