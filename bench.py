@@ -425,15 +425,32 @@ def bench_flash_micro():
         for tag, fn in (("pallas", loss_pallas), ("xla", loss_ref)):
             if tag == "xla" and s > 4096:
                 continue   # O(S^2) composed bwd at 8k risks OOM/time
-            g = jax.jit(jax.grad(fn, argnums=(0, 1, 2)))
-            r = g(q, q, q)
-            jax.block_until_ready(r)
-            t0 = time.perf_counter()
-            for _ in range(5):
-                r = g(q, q, q)
-            jax.block_until_ready(r)
-            out[f"flash_{tag}_s{s}_ms"] = round(
-                (time.perf_counter() - t0) / 5 * 1000, 2)
+
+            # axon-tunnel-honest timing: identical dispatches get
+            # deduped and block_until_ready can return early, so CHAIN
+            # the fwd+bwd calls through a data dependency inside ONE
+            # jitted program and take the slope between two chain
+            # lengths, forcing completion with a host transfer.
+            def chain(n, fn=fn):
+                def run(q_):
+                    def body(carry, _):
+                        dq, _dk, _dv = jax.grad(
+                            fn, argnums=(0, 1, 2))(carry, carry, carry)
+                        return (carry + 1e-3 * dq.astype(carry.dtype)
+                                ), None
+                    c, _ = jax.lax.scan(body, q_, None, length=n)
+                    return c
+                j = jax.jit(run)
+                r = j(q)
+                _ = float(r[0, 0, 0].astype(jnp.float32))  # warm+sync
+                t0 = time.perf_counter()
+                r = j(q + 1e-4)
+                _ = float(r[0, 0, 0].astype(jnp.float32))
+                return time.perf_counter() - t0
+
+            n_lo, n_hi = (1, 5) if s >= 4096 else (2, 12)
+            per = (chain(n_hi) - chain(n_lo)) / (n_hi - n_lo)
+            out[f"flash_{tag}_s{s}_ms"] = round(per * 1000, 2)
     print("RESULT " + json.dumps(out), flush=True)
 
 

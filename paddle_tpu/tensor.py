@@ -276,7 +276,19 @@ class Tensor:
         self._value = self._value.at[idx].set(value)
 
     def __iter__(self):
-        for i in range(len(self)):
+        n = len(self)
+        if n > 64 and isinstance(self._value, jax.core.Tracer):
+            # iterating a TRACED tensor unrolls the Python loop into
+            # the graph — correct, but n copies of the body bloat the
+            # trace (dy2static reroutes scan-safe bodies to lax.scan;
+            # this warning covers the bodies it must leave in Python)
+            import warnings
+            warnings.warn(
+                f"iterating a traced Tensor of length {n} unrolls the "
+                "loop body into the compiled graph; prefer lax.scan-"
+                "compatible code (plain name assignments) or index "
+                "with a lax loop", stacklevel=2)
+        for i in range(n):
             yield self[i]
 
     def __bool__(self) -> bool:
