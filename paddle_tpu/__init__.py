@@ -89,6 +89,7 @@ from .autograd.py_layer import PyLayer  # noqa
 from . import autograd  # noqa
 from . import utils  # noqa
 from . import nn  # noqa
+from .nn.layer import LazyGuard  # noqa
 from . import optimizer  # noqa
 from . import io  # noqa
 from . import metric  # noqa

@@ -1,6 +1,6 @@
 """paddle.nn parity surface (python/paddle/nn/)."""
 
-from .layer import Layer  # noqa
+from .layer import Layer, LazyGuard  # noqa
 from .param_attr import ParamAttr  # noqa
 from . import initializer  # noqa
 from . import functional  # noqa

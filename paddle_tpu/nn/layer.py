@@ -22,6 +22,35 @@ from ..framework import dtype as dtypes
 from .param_attr import ParamAttr
 from . import initializer as init
 
+_LAZY_INIT = {"on": False}
+
+
+class LazyGuard:
+    """Defer parameter initialization while constructing a model.
+
+    Parity: upstream ``paddle.LazyGuard``
+    (`python/paddle/fluid/lazy_init.py`) — used to build billion-
+    parameter models without paying eager random-init (the values are
+    about to be overwritten by a checkpoint load, sharded device_put,
+    or an AOT compile that only needs shapes).  Under the guard,
+    ``create_parameter`` allocates a zeros placeholder and records the
+    initializer; ``layer.apply_deferred_init()`` materializes real
+    initial values later if training from scratch.
+
+    >>> with paddle.LazyGuard():
+    ...     net = GPTForCausalLM(gpt3_1p3b())     # seconds, not minutes
+    >>> net.set_state_dict(ckpt)                  # or apply_deferred_init()
+    """
+
+    def __enter__(self):
+        self._prev = _LAZY_INIT["on"]
+        _LAZY_INIT["on"] = True
+        return self
+
+    def __exit__(self, *exc):
+        _LAZY_INIT["on"] = self._prev
+        return False
+
 
 class HookRemoveHelper:
     def __init__(self, hooks, hook_id):
@@ -99,14 +128,34 @@ class Layer:
             initializer = init.Constant(0.0)
         else:
             initializer = init.XavierNormal()
-        value = initializer(shape, dtype)
+        if _LAZY_INIT["on"]:
+            # LazyGuard: skip the (possibly expensive) initializer —
+            # zeros placeholder now, recorded init applied on demand
+            value = jnp.zeros(tuple(shape), dtypes.to_jax_dtype(dtype))
+        else:
+            value = initializer(shape, dtype)
         name = attr.name if attr is not None and attr.name else None
         p = Parameter(value, dtype=dtype, name=name,
                       trainable=attr.trainable if attr is not None else True)
+        if _LAZY_INIT["on"]:
+            p._deferred_init = initializer
         if attr is not None:
             p.optimize_attr["learning_rate"] = attr.learning_rate
             p.regularizer = attr.regularizer
         return p
+
+    def apply_deferred_init(self) -> int:
+        """Materialize initial values for parameters created under
+        ``LazyGuard`` (zeros placeholders until now).  Returns how many
+        parameters were initialized.  No-op on eagerly built layers."""
+        n = 0
+        for _name, p in self.named_parameters():
+            ini = getattr(p, "_deferred_init", None)
+            if ini is not None:
+                p._value = jnp.asarray(ini(list(p.shape), p._value.dtype))
+                p._deferred_init = None
+                n += 1
+        return n
 
     def add_parameter(self, name: str, parameter: Optional[Parameter]):
         if parameter is None:

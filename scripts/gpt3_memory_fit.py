@@ -65,7 +65,10 @@ def fit(pp, mp, dp, seq=2048, micro_bs=2, acc=4, seed_params=True):
                         max_position_embeddings=seq,
                         use_flash_attention=False)
         t0 = time.time()
-        net = GPTForCausalLMPipe(cfg, num_stages=pp)
+        # AOT memory analysis needs shapes, not values: LazyGuard cuts
+        # the 1.3B eager random-init (~6 min single-core) to seconds
+        with paddle.LazyGuard():
+            net = GPTForCausalLMPipe(cfg, num_stages=pp)
         opt = optimizer.AdamW(learning_rate=1e-4,
                               parameters=net.parameters())
         n_params = sum(int(np.prod(p.shape)) for p in net.parameters())

@@ -201,3 +201,36 @@ def test_hooks():
     h.remove()
     net(paddle.randn([1, 2]))
     assert calls == [1]
+
+
+def test_lazy_guard_defers_then_applies_init():
+    """paddle.LazyGuard (upstream python/paddle/fluid/lazy_init.py):
+    construction under the guard skips initializers (zeros
+    placeholders + recorded init); apply_deferred_init materializes."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                            nn.Linear(32, 4))
+    for p in net.parameters():
+        assert float(abs(p.numpy()).sum()) == 0.0
+    n = net.apply_deferred_init()
+    assert n == 4
+    w = net[0].weight.numpy()
+    assert float(abs(w).sum()) > 0
+    # guard is scoped: eager construction untouched afterwards
+    l = nn.Linear(8, 8)
+    assert getattr(l.weight, "_deferred_init", None) is None
+    assert float(abs(l.weight.numpy()).sum()) > 0
+    # lazily built net still trains after deferred init
+    import numpy as np
+    from paddle_tpu import optimizer
+    opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+    x = paddle.to_tensor(np.ones((4, 16), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 4), np.float32))
+    loss = paddle.mse_loss(net(x), y)
+    loss.backward()
+    opt.step()
+    assert np.isfinite(float(loss.numpy()))
