@@ -115,6 +115,7 @@ from .hapi import callbacks  # noqa
 from . import audio  # noqa
 from . import text  # noqa
 from . import geometric  # noqa
+from . import inference  # noqa
 from .jit import to_static  # noqa
 from .distributed.parallel import DataParallel  # noqa
 

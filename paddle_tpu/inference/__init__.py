@@ -1,0 +1,352 @@
+"""paddle.inference — deploy-a-saved-model predictor API.
+
+Parity: upstream ``paddle/fluid/inference/`` (`AnalysisPredictor`,
+`paddle_inference_api.h`) and its Python surface
+``paddle.inference.Config`` / ``create_predictor`` — the contract a
+PaddleDetection/PaddleOCR deployment script uses:
+
+    config = Config(model_file, params_file)
+    predictor = create_predictor(config)
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0])
+    h.reshape(shape); h.copy_from_cpu(np_array)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    result = out.copy_to_cpu()
+
+Upstream runs a ProgramDesc through ~200 IR fuse passes and optional
+TensorRT subgraphs.  The TPU-native model format is the ``jax.export``
+StableHLO artifact written by ``paddle.jit.save`` (.pdmodel +
+.pdiparams + .pdmeta); "IR optimization" is XLA's job at compile time,
+so `switch_ir_optim`/`enable_memory_optim` are accepted no-op knobs
+(recorded on the config for `summary()`).  Programs exported with
+symbolic (dynamic) dims execute at any concrete shape; fixed-shape
+exports enforce their shape like upstream does.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import flatten as _flatten
+
+__all__ = [
+    "Config", "Predictor", "Tensor", "create_predictor",
+    "PrecisionType", "PlaceType", "get_version",
+]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"       # accepted for script compat; maps to the default
+    XPU = "xpu"       # jax backend (TPU when present)
+    CUSTOM = "custom"
+    TPU = "tpu"
+
+
+def get_version() -> str:
+    from .. import __version__
+    return f"paddle_tpu inference {__version__}"
+
+
+class Config:
+    """Mirror of ``paddle.inference.Config``.
+
+    Accepts either ``Config(prog_file, params_file)`` (upstream
+    two-file form — ``prog_file`` may be the ``.pdmodel`` path or the
+    ``jit.save`` prefix) or ``Config(model_dir)`` where the directory
+    contains exactly one ``*.pdmodel``.
+    """
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        self._prefix = None
+        if prog_file is not None and params_file is None \
+                and os.path.isdir(prog_file):
+            cands = [f for f in sorted(os.listdir(prog_file))
+                     if f.endswith(".pdmodel")]
+            if len(cands) != 1:
+                raise ValueError(
+                    f"Config(model_dir): expected exactly one .pdmodel "
+                    f"in {prog_file!r}, found {cands}")
+            self._prefix = os.path.join(prog_file, cands[0][:-len(".pdmodel")])
+        elif prog_file is not None:
+            p = prog_file
+            if p.endswith(".pdmodel"):
+                p = p[:-len(".pdmodel")]
+            self._prefix = p
+            if params_file is not None:
+                want = self._prefix + ".pdiparams"
+                if os.path.abspath(params_file) != os.path.abspath(want):
+                    raise ValueError(
+                        f"params_file {params_file!r} does not match the "
+                        f"model prefix (expected {want!r}); the TPU-native "
+                        "format keeps the jit.save prefix convention")
+        # accepted-for-compat knobs, recorded for summary()
+        self._use_device = PlaceType.TPU
+        self._device_id = 0
+        self._ir_optim = True
+        self._memory_optim = False
+        self._precision = PrecisionType.Float32
+        self._enable_profile = False
+
+    # --- upstream knob surface (device selection is advisory: jax owns
+    #     placement; these keep deployment scripts running unchanged) ---
+    def set_model(self, prog_file: str, params_file: str = None) -> None:
+        # upstream set_model only changes the paths — configured knobs
+        # (device, precision, ir/memory optim) must survive, and a
+        # failed path validation must leave the config untouched
+        saved = dict(self.__dict__)
+        try:
+            self.__init__(prog_file, params_file)
+        except Exception:
+            self.__dict__.update(saved)
+            raise
+        prefix = self._prefix
+        self.__dict__.update(saved)
+        self._prefix = prefix
+
+    def model_dir(self) -> str:
+        return os.path.dirname(self._prefix) if self._prefix else ""
+
+    def prog_file(self) -> str:
+        return (self._prefix + ".pdmodel") if self._prefix else ""
+
+    def params_file(self) -> str:
+        return (self._prefix + ".pdiparams") if self._prefix else ""
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0, precision=None) -> None:
+        self._use_device = PlaceType.GPU
+        self._device_id = device_id
+        if precision is not None:
+            self._precision = precision
+
+    def disable_gpu(self) -> None:
+        self._use_device = PlaceType.CPU
+
+    def use_gpu(self) -> bool:
+        return self._use_device == PlaceType.GPU
+
+    def enable_xpu(self, *a, **kw) -> None:
+        self._use_device = PlaceType.XPU
+
+    def switch_ir_optim(self, x: bool = True) -> None:
+        self._ir_optim = bool(x)
+
+    def ir_optim(self) -> bool:
+        return self._ir_optim
+
+    def enable_memory_optim(self, x: bool = True) -> None:
+        self._memory_optim = bool(x)
+
+    def enable_profile(self) -> None:
+        self._enable_profile = True
+
+    def switch_use_feed_fetch_ops(self, x: bool = False) -> None:
+        pass
+
+    def switch_specify_input_names(self, x: bool = True) -> None:
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int) -> None:
+        pass
+
+    def enable_tensorrt_engine(self, *a, **kw) -> None:
+        raise NotImplementedError(
+            "TensorRT subgraphs are CUDA-only upstream machinery; the "
+            "TPU-native predictor compiles the whole program with XLA. "
+            "Remove enable_tensorrt_engine() from the deployment script.")
+
+    def summary(self) -> str:
+        rows = [
+            ("model file", self.prog_file()),
+            ("params file", self.params_file()),
+            ("device", f"{self._use_device}:{self._device_id}"),
+            ("ir_optim (XLA)", str(self._ir_optim)),
+            ("memory_optim", str(self._memory_optim)),
+            ("precision", str(self._precision)),
+        ]
+        w = max(len(k) for k, _ in rows)
+        return "\n".join(f"{k.ljust(w)}  {v}" for k, v in rows)
+
+
+class Tensor:
+    """I/O handle (upstream ``paddle.inference.Tensor`` /
+    ``ZeroCopyTensor``): host-side staging + copy_to/from_cpu."""
+
+    def __init__(self, name: str, is_input: bool,
+                 spec: Optional[tuple] = None):
+        self._name = name
+        self._is_input = is_input
+        self._spec = spec          # (shape-with-None, dtype-str) | None
+        self._host: Optional[np.ndarray] = None
+        self._dev = None           # output-side jax array
+
+    def name(self) -> str:
+        return self._name
+
+    def reshape(self, shape: Sequence[int]) -> None:
+        if not self._is_input:
+            raise RuntimeError("reshape() is only valid on input handles")
+        dt = self._spec[1] if self._spec else "float32"
+        if self._host is not None and \
+                int(np.prod(shape)) == self._host.size:
+            self._host = np.ascontiguousarray(self._host).reshape(shape)
+        else:
+            self._host = np.zeros(tuple(int(s) for s in shape), dtype=dt)
+
+    def copy_from_cpu(self, data: np.ndarray) -> None:
+        if not self._is_input:
+            raise RuntimeError(
+                "copy_from_cpu() is only valid on input handles")
+        data = np.asarray(data)
+        if self._spec:
+            want, dt = self._spec
+            if len(want) != data.ndim or any(
+                    w is not None and int(w) != int(g)
+                    for w, g in zip(want, data.shape)):
+                raise ValueError(
+                    f"input {self._name!r}: shape {tuple(data.shape)} "
+                    f"does not match exported spec {tuple(want)} "
+                    "(None = dynamic)")
+            data = data.astype(dt, copy=True)
+        else:
+            # upstream ZeroCopyTensor copies into its own buffer — the
+            # caller may mutate/reuse the source array after this call
+            data = data.copy()
+        self._host = data
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._is_input:
+            raise RuntimeError(
+                "copy_to_cpu() is only valid on output handles")
+        if self._dev is None:
+            raise RuntimeError("run() has not produced this output yet")
+        return np.asarray(self._dev)
+
+    def shape(self) -> List[int]:
+        src = self._host if self._is_input else self._dev
+        if src is None:
+            return [(-1 if s is None else int(s)) for s in self._spec[0]] \
+                if self._spec else []
+        return list(src.shape)
+
+    def type(self):
+        src = self._host if self._is_input else self._dev
+        if src is not None:
+            return np.dtype(src.dtype)
+        return np.dtype(self._spec[1]) if self._spec else np.float32
+
+
+class Predictor:
+    """Executes a ``paddle.jit.save`` artifact (upstream
+    ``AnalysisPredictor``).  The exported StableHLO program is
+    deserialized once; clones share it (upstream's
+    ``predictor.clone()`` shares the optimized program the same way).
+    """
+
+    def __init__(self, config: Config, _shared=None):
+        self._config = config
+        if _shared is not None:
+            self._call, self._params, self._specs, self._n_out = _shared
+        else:
+            prefix = config._prefix
+            if not prefix:
+                raise ValueError("Config has no model path")
+            from ..jit.save_load import load as _jit_load
+            tl = _jit_load(prefix)
+            if tl._exported_fn is None:
+                err = tl._meta.get("export_error", "saved without input_spec")
+                raise RuntimeError(
+                    f"{prefix}.pdmodel has no executable program ({err}); "
+                    "re-export with paddle.jit.save(layer, path, "
+                    "input_spec=[...])")
+            self._call = tl._exported_fn
+            self._params = tl._params
+            self._specs = [
+                (tuple(None if (d is None or (isinstance(d, int) and d < 0))
+                       else int(d) for d in shp), dt)
+                for shp, dt in tl._meta.get("input_spec", [])]
+            self._n_out = len(tl._exported.out_avals)
+        self._inputs = [Tensor(f"x{i}", True, spec)
+                        for i, spec in enumerate(self._specs)]
+        # handles are created ONCE and stay valid across run() calls —
+        # deployment loops cache them at setup (upstream contract)
+        self._outputs: List[Tensor] = [Tensor(f"out{i}", False)
+                                       for i in range(self._n_out)]
+
+    def get_input_names(self) -> List[str]:
+        return [t.name() for t in self._inputs]
+
+    def get_input_handle(self, name: str) -> Tensor:
+        for t in self._inputs:
+            if t.name() == name:
+                return t
+        raise KeyError(f"no input named {name!r}; "
+                       f"inputs are {self.get_input_names()}")
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute.  Upstream signature: handles are filled beforehand
+        and ``run()`` takes no args; the list form (newer upstream
+        ``predictor.run([x, ...])``) is also supported and returns the
+        outputs directly."""
+        if inputs is not None:
+            if len(inputs) != len(self._inputs):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs but the program "
+                    f"takes {len(self._inputs)} "
+                    f"({self.get_input_names()})")
+            for h, x in zip(self._inputs, inputs):
+                h.copy_from_cpu(np.asarray(
+                    x.numpy() if hasattr(x, "numpy") else x))
+        feed = []
+        for h in self._inputs:
+            if h._host is None:
+                raise RuntimeError(
+                    f"input {h.name()!r} was never fed; call "
+                    "copy_from_cpu() on every input handle before run()")
+            feed.append(h._host)
+        out = self._call(self._params, *feed)
+        flat = _flatten(out)
+        # update cached handles in place — handle identity is stable
+        for t, o in zip(self._outputs, flat):
+            t._dev = o
+        if inputs is not None:
+            return [t.copy_to_cpu() for t in self._outputs]
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return [t.name() for t in self._outputs]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name() == name:
+                return t
+        raise KeyError(f"no output named {name!r}; outputs are "
+                       f"{self.get_output_names()}")
+
+    def clone(self) -> "Predictor":
+        return Predictor(self._config,
+                         _shared=(self._call, self._params, self._specs,
+                                  self._n_out))
+
+    def clear_intermediate_tensor(self) -> None:
+        pass
+
+    def try_shrink_memory(self) -> None:
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

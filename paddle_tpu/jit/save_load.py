@@ -103,12 +103,14 @@ def save(layer: Layer, path: str, input_spec=None, **configs) -> None:
 
 
 class TranslatedLayer(Layer):
-    def __init__(self, state, meta, exported_fn=None, params=None):
+    def __init__(self, state, meta, exported_fn=None, params=None,
+                 exported=None):
         super().__init__()
         self._state = state
         self._meta = meta
         self._exported_fn = exported_fn
         self._params = params
+        self._exported = exported   # jax.export.Exported (out_avals etc.)
 
     def forward(self, *args):
         if self._exported_fn is None:
@@ -142,6 +144,7 @@ def load(path: str, **configs) -> TranslatedLayer:
             meta = pickle.load(f)
     exported_fn = None
     params = None
+    exported = None
     if meta.get("exported") and os.path.exists(path + ".pdmodel"):
         from jax import export as _export
         with open(path + ".pdmodel", "rb") as f:
@@ -154,4 +157,4 @@ def load(path: str, **configs) -> TranslatedLayer:
         # so params here are the trainable dict in save()'s order)
         params = {k: jnp.asarray(v) for k, v in state.items()
                   if k in meta.get("param_names", state)}
-    return TranslatedLayer(state, meta, exported_fn, params)
+    return TranslatedLayer(state, meta, exported_fn, params, exported)

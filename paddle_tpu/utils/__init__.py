@@ -53,6 +53,10 @@ def flatten(nested) -> list:
         cur = stack.pop()
         if isinstance(cur, (list, tuple)):
             stack.extend(reversed(cur))
+        elif isinstance(cur, dict):
+            # sorted-key order: matches upstream paddle.utils.flatten
+            # (tf.nest-style) AND jax's dict-pytree leaf order
+            stack.extend(cur[k] for k in sorted(cur, reverse=True))
         else:
             out.append(cur)
     return out

@@ -11,7 +11,11 @@ Decomposition by config deltas:
   - AdamW vs SGD            -> optimizer update cost
   - full vs tiny vocab head -> lm-head + loss contribution
 """
+import os
+import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 import jax

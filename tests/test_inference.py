@@ -1,0 +1,211 @@
+"""paddle.inference predictor API (upstream AnalysisPredictor surface,
+paddle/fluid/inference/ + python paddle.inference) over the jit.save
+StableHLO artifact."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import (Config, Predictor, create_predictor,
+                                  PrecisionType)
+from paddle_tpu.static import InputSpec
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture(scope="module")
+def saved_model():
+    paddle.seed(7)
+    net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 3))
+    net.eval()
+    rng = np.random.RandomState(0)
+    x = rng.rand(5, 4).astype(np.float32)
+    ref = np.asarray(net(Tensor(x)).numpy())
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "deploy", "model")
+    from paddle_tpu.jit.save_load import save
+    # dynamic batch dim — the deployment norm
+    save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    return path, x, ref
+
+
+def test_upstream_handle_workflow(saved_model):
+    path, x, ref = saved_model
+    config = Config(path + ".pdmodel", path + ".pdiparams")
+    predictor = create_predictor(config)
+
+    names = predictor.get_input_names()
+    assert len(names) == 1
+    h = predictor.get_input_handle(names[0])
+    h.reshape([5, 4])
+    h.copy_from_cpu(x)
+    assert predictor.run() is True
+
+    out_names = predictor.get_output_names()
+    out = predictor.get_output_handle(out_names[0])
+    got = out.copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert out.shape() == [5, 3]
+
+
+def test_dynamic_batch_reruns_other_shape(saved_model):
+    path, x, ref = saved_model
+    predictor = create_predictor(Config(path))
+    h = predictor.get_input_handle("x0")
+    x2 = np.concatenate([x, x], axis=0)
+    h.copy_from_cpu(x2)
+    predictor.run()
+    got = predictor.get_output_handle("out0").copy_to_cpu()
+    assert got.shape == (10, 3)
+    np.testing.assert_allclose(got[:5], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_list_run_form_and_clone(saved_model):
+    path, x, ref = saved_model
+    predictor = create_predictor(Config(path))
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+    c = predictor.clone()
+    assert c._call is predictor._call           # program shared
+    outs2 = c.run([x])
+    np.testing.assert_allclose(outs2[0], outs[0], rtol=0, atol=0)
+
+
+def test_config_model_dir_form(saved_model):
+    path, x, ref = saved_model
+    config = Config(os.path.dirname(path))      # dir containing one model
+    predictor = create_predictor(config)
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_output_handle_stable_across_runs(saved_model):
+    """Deployment loops cache output handles at setup; the handle must
+    track every run(), not the run it was fetched after."""
+    path, x, ref = saved_model
+    predictor = create_predictor(Config(path))
+    # names and handles are available BEFORE the first run
+    assert predictor.get_output_names() == ["out0"]
+    out = predictor.get_output_handle("out0")
+    h = predictor.get_input_handle("x0")
+    h.copy_from_cpu(x)
+    predictor.run()
+    first = out.copy_to_cpu().copy()
+    np.testing.assert_allclose(first, ref, rtol=1e-5, atol=1e-6)
+    h.copy_from_cpu(x * 2.0)            # new data, same cached handle
+    predictor.run()
+    second = out.copy_to_cpu()
+    assert not np.allclose(first, second), \
+        "cached handle returned stale previous-run data"
+
+
+def test_run_input_count_mismatch_refuses(saved_model):
+    path, x, _ = saved_model
+    predictor = create_predictor(Config(path))
+    with pytest.raises(ValueError, match="got 2 inputs"):
+        predictor.run([x, x])
+
+
+def test_copy_from_cpu_snapshots_caller_buffer(saved_model):
+    """Upstream ZeroCopyTensor copies; mutating the source array after
+    copy_from_cpu must not change what run() computes on."""
+    path, x, ref = saved_model
+    predictor = create_predictor(Config(path))
+    buf = x.copy()
+    h = predictor.get_input_handle("x0")
+    h.copy_from_cpu(buf)
+    buf[:] = 0.0                       # caller reuses the staging buffer
+    predictor.run()
+    got = predictor.get_output_handle("out0").copy_to_cpu()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_set_model_failed_validation_leaves_config_unchanged(saved_model):
+    path, _, _ = saved_model
+    config = Config(path)
+    with pytest.raises(ValueError):
+        config.set_model(path + ".pdmodel", "/nonexistent/other.pdiparams")
+    assert config.prog_file() == path + ".pdmodel"
+    create_predictor(config)           # still loads the original model
+
+
+def test_set_model_preserves_knobs(saved_model):
+    path, _, _ = saved_model
+    config = Config(path)
+    config.enable_use_gpu(100, 3, PrecisionType.Half)
+    config.switch_ir_optim(False)
+    config.set_model(path + ".pdmodel", path + ".pdiparams")
+    assert config.use_gpu() and config._device_id == 3
+    assert config._precision == PrecisionType.Half
+    assert not config.ir_optim()
+    assert config.prog_file() == path + ".pdmodel"
+
+
+def test_config_knobs_and_summary(saved_model):
+    path, _, _ = saved_model
+    config = Config(path)
+    config.enable_use_gpu(100, 0, PrecisionType.Half)
+    assert config.use_gpu()
+    config.disable_gpu()
+    assert not config.use_gpu()
+    config.switch_ir_optim(False)
+    assert not config.ir_optim()
+    config.enable_memory_optim()
+    s = config.summary()
+    assert "model file" in s and path in s
+    with pytest.raises(NotImplementedError):
+        config.enable_tensorrt_engine(workspace_size=1 << 20)
+
+
+def test_shape_mismatch_and_unfed_input_refuse(saved_model):
+    path, x, _ = saved_model
+    predictor = create_predictor(Config(path))
+    h = predictor.get_input_handle("x0")
+    with pytest.raises(ValueError, match="does not match"):
+        h.copy_from_cpu(np.zeros((5, 7), np.float32))
+    with pytest.raises(RuntimeError, match="never fed"):
+        predictor.run()
+    with pytest.raises(KeyError):
+        predictor.get_input_handle("nope")
+
+
+def test_weights_only_artifact_refuses():
+    paddle.seed(0)
+    net = nn.Linear(2, 2)
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "w")
+    from paddle_tpu.jit.save_load import save
+    save(net, path)        # no input_spec -> no program
+    with pytest.raises(RuntimeError, match="no executable program"):
+        create_predictor(Config(path))
+
+
+def test_two_input_model_positional_names():
+    paddle.seed(1)
+
+    class Two(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) + b
+
+    net = Two()
+    net.eval()
+    d = tempfile.mkdtemp()
+    path = os.path.join(d, "two")
+    from paddle_tpu.jit.save_load import save
+    save(net, path, input_spec=[InputSpec([2, 4], "float32"),
+                                InputSpec([2, 4], "float32")])
+    p = create_predictor(Config(path))
+    assert p.get_input_names() == ["x0", "x1"]
+    rng = np.random.RandomState(3)
+    a = rng.rand(2, 4).astype(np.float32)
+    b = rng.rand(2, 4).astype(np.float32)
+    ref = np.asarray(net(Tensor(a), Tensor(b)).numpy())
+    outs = p.run([a, b])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
