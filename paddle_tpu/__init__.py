@@ -111,11 +111,14 @@ from . import distribution  # noqa
 
 from .framework.io import save, load  # noqa
 from .hapi.model import Model  # noqa
-from .hapi import callbacks  # noqa
 from . import audio  # noqa
 from . import text  # noqa
 from . import geometric  # noqa
 from . import inference  # noqa
+from . import regularizer  # noqa
+from . import callbacks  # noqa
+from . import sysconfig  # noqa
+from . import hub  # noqa
 from .jit import to_static  # noqa
 from .distributed.parallel import DataParallel  # noqa
 

@@ -1,0 +1,9 @@
+"""paddle.callbacks — top-level alias of the hapi callback family
+(parity: upstream ``python/paddle/callbacks.py``, which re-exports
+``paddle.hapi.callbacks``)."""
+
+from ..hapi.callbacks import *  # noqa: F401,F403
+from ..hapi import callbacks as _c
+
+__all__ = list(getattr(_c, "__all__", [n for n in dir(_c)
+                                       if n[0].isupper()]))

@@ -346,7 +346,7 @@ class PipelineParallel:
             return jax.device_put(v, NamedSharding(mesh, spec))
 
         params, frozen = {}, {}
-        decay, lrs = {}, {}
+        decay, l1s, lrs = {}, {}, {}
         opt = optimizer if hasattr(optimizer, "apply_gradients_tree") \
             else optimizer._inner_opt
         for g, p in plan["gname_to_param"].items():
@@ -359,6 +359,7 @@ class PipelineParallel:
             tgt[g] = p._value
             if not p.stop_gradient:
                 decay[g] = float(opt._param_decay(p))
+                l1s[g] = float(opt._param_l1(p))
                 lrs[g] = float(p.optimize_attr.get("learning_rate", 1.0))
         for (j, local), gs in plan["stack_index"].items():
             ps = [plan["gname_to_param"][g] for g in gs]
@@ -372,10 +373,25 @@ class PipelineParallel:
             tgt[name] = leaf
             if not rep.stop_gradient:
                 decay[name] = float(opt._param_decay(rep))
+                l1s[name] = float(opt._param_l1(rep))
                 lrs[name] = float(
                     rep.optimize_attr.get("learning_rate", 1.0))
+                # stacked body layers share ONE coefficient per leaf;
+                # refuse silently-wrong per-layer divergence
+                for p in ps[1:]:
+                    if (float(opt._param_decay(p)) != decay[name]
+                            or float(opt._param_l1(p)) != l1s[name]
+                            or float(p.optimize_attr.get(
+                                "learning_rate", 1.0)) != lrs[name]):
+                        raise ValueError(
+                            f"stacked pipeline layers in leaf {name!r} "
+                            "have differing per-param regularizer/"
+                            "learning-rate settings; per-layer "
+                            "coefficients are not supported for "
+                            "stacked uniform stages — set them "
+                            "uniformly or disable stage stacking")
         self._params, self._frozen = params, frozen
-        self._decay, self._lrs = decay, lrs
+        self._decay, self._l1s, self._lrs = decay, l1s, lrs
         self._buffers = {n: b._value for n, b in net.named_buffers()
                          if b is not None}
         if self._opt_tree is None:
@@ -532,7 +548,8 @@ class PipelineParallel:
                 loss_fn, has_aux=True)(params)
             new_p, new_s = self._opt.apply_gradients_tree(
                 params, grads, opt_state, lr,
-                decay_coeffs=self._decay, lr_scales=self._lrs)
+                decay_coeffs=self._decay, lr_scales=self._lrs,
+                l1_coeffs=self._l1s)
             return loss, new_p, new_s, new_bufs
 
         return jax.jit(step, donate_argnums=(0, 3))
@@ -629,6 +646,8 @@ class PipelineParallel:
         # pipelined path (ParamAttr regularizer / learning_rate parity)
         decay = {n: float(opt._param_decay(p))
                  for n, p in name_to_param.items() if not p.stop_gradient}
+        l1s = {n: float(opt._param_l1(p))
+               for n, p in name_to_param.items() if not p.stop_gradient}
         lrs = {n: float(p.optimize_attr.get("learning_rate", 1.0))
                for n, p in name_to_param.items() if not p.stop_gradient}
 
@@ -652,7 +671,7 @@ class PipelineParallel:
                 loss, grads = jax.value_and_grad(loss_fn)(params)
                 new_p, new_s = opt.apply_gradients_tree(
                     params, grads, opt_state, lr,
-                    decay_coeffs=decay, lr_scales=lrs)
+                    decay_coeffs=decay, lr_scales=lrs, l1_coeffs=l1s)
                 return loss, new_p, new_s
 
             self._inline_fn = jax.jit(step)

@@ -105,6 +105,9 @@ class DistributedRunner:
         self._decay_coeffs = {
             n: float(self.optimizer._param_decay(p))
             for n, p in name_to_param.items()}
+        self._l1_coeffs = {
+            n: float(self.optimizer._param_l1(p))
+            for n, p in name_to_param.items()}
         self._lr_scales = {
             n: float(p.optimize_attr.get("learning_rate", 1.0))
             for n, p in name_to_param.items()}
@@ -257,7 +260,8 @@ class DistributedRunner:
             new_params, new_state = opt.apply_gradients_tree(
                 params, grads, opt_state, lr,
                 decay_coeffs=runner._decay_coeffs,
-                lr_scales=runner._lr_scales)
+                lr_scales=runner._lr_scales,
+                l1_coeffs=runner._l1_coeffs)
             # pin updated params back to their canonical shardings so the
             # ZeRO-1 weight-update all-gather happens here, not lazily
             new_params = {

@@ -121,6 +121,15 @@ class Model:
     def _build_jit_train_step(self):
         opt = self._optimizer
         net = self.network
+        # per-param ParamAttr regularizer / learning_rate parity with the
+        # eager step() — same contract as the runner/pipeline/static engines
+        name_to_param = dict(net.named_parameters())
+        decay_coeffs = {n: float(opt._param_decay(p))
+                        for n, p in name_to_param.items()}
+        l1_coeffs = {n: float(opt._param_l1(p))
+                     for n, p in name_to_param.items()}
+        lr_scales = {n: float(p.optimize_attr.get("learning_rate", 1.0))
+                     for n, p in name_to_param.items()}
 
         def step(params, frozen, buffers, opt_state, lr, key, *data):
             n_in = self._n_inputs
@@ -142,7 +151,9 @@ class Model:
             (loss_val, (out_vals, new_buf)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
             new_params, new_opt_state = opt.apply_gradients_tree(
-                params, grads, opt_state, lr)
+                params, grads, opt_state, lr,
+                decay_coeffs=decay_coeffs, lr_scales=lr_scales,
+                l1_coeffs=l1_coeffs)
             return loss_val, out_vals, new_params, new_opt_state, new_buf
 
         return jax.jit(step)

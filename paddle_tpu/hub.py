@@ -1,0 +1,105 @@
+"""paddle.hub (parity: upstream ``python/paddle/hapi/hub.py``):
+load models published through a ``hubconf.py`` entry-point file.
+
+Sources: ``local`` (a directory containing hubconf.py) is fully
+supported.  ``github``/``gitee`` require network access, which this
+environment does not have — they fail loudly with the upstream-style
+message instead of hanging.
+
+hubconf.py contract (same as upstream/torch.hub): every public callable
+is an entry point; an optional ``dependencies`` list names required
+importable modules.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+_CACHE = {}   # resolved repo_dir -> executed hubconf module
+
+
+def _load_hubconf(repo_dir: str):
+    repo_dir = os.path.realpath(repo_dir)
+    cached = _CACHE.get(repo_dir)
+    if cached is not None:
+        return cached
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"no {_HUBCONF} found in {repo_dir!r} — a hub repo must "
+            "provide one (upstream contract)")
+    # one module object per repo, registered in sys.modules so classes
+    # defined in hubconf pickle/resolve, and import side effects run once
+    mod_name = f"_paddle_hubconf_{abs(hash(repo_dir))}"
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    sys.modules[mod_name] = mod
+    try:
+        spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(mod_name, None)
+        raise
+    finally:
+        sys.path.remove(repo_dir)
+    deps = getattr(mod, "dependencies", [])
+    missing = [d for d in deps
+               if importlib.util.find_spec(d) is None]
+    if missing:
+        sys.modules.pop(mod_name, None)
+        raise RuntimeError(
+            f"hub entry requires missing packages: {missing}")
+    _CACHE[repo_dir] = mod
+    return mod
+
+
+def _entry_points(mod) -> List[str]:
+    return sorted(n for n, v in vars(mod).items()
+                  if callable(v) and not n.startswith("_"))
+
+
+def _check_source(source: str):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected 'local', 'github' or "
+            "'gitee'")
+    if source != "local":
+        raise RuntimeError(
+            f"source={source!r} needs network access, which this "
+            "environment does not provide; clone the repo and use "
+            "source='local' with its path")
+
+
+def list(repo_dir: str, source: str = "github") -> List[str]:  # noqa: A001
+    """Entry points published by the repo's hubconf.py."""
+    _check_source(source)
+    return _entry_points(_load_hubconf(repo_dir))
+
+
+def _entry(repo_dir: str, model: str):
+    mod = _load_hubconf(repo_dir)
+    fn = getattr(mod, model, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(
+            f"{model!r} is not an entry point of {repo_dir!r}; "
+            f"available: {_entry_points(mod)}")
+    return fn
+
+
+def help(repo_dir: str, model: str, source: str = "github") -> str:  # noqa: A001
+    """Docstring of one entry point."""
+    _check_source(source)
+    return _entry(repo_dir, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "github",
+         **kwargs):
+    """Instantiate entry point ``model`` with kwargs."""
+    _check_source(source)
+    return _entry(repo_dir, model)(**kwargs)

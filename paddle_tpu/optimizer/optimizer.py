@@ -24,14 +24,9 @@ from ..autograd.tape import no_grad_ctx
 from .lr import LRScheduler
 
 
-class L2Decay:
-    def __init__(self, coeff=0.0):
-        self.coeff = float(coeff)
-
-
-class L1Decay:
-    def __init__(self, coeff=0.0):
-        self.coeff = float(coeff)
+# canonical definitions live in paddle.regularizer; these aliases keep
+# the historical paddle.optimizer.L1Decay/L2Decay import paths working
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
 
 
 class Optimizer:
@@ -49,9 +44,15 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._apply_decay_param_fun = apply_decay_param_fun
+        self._l1_decay = 0.0
         if isinstance(weight_decay, float):
             self._weight_decay = weight_decay
             self._decoupled = self._default_decoupled()
+        elif isinstance(weight_decay, L1Decay):
+            # L1 is a grad term (coeff * sign(w)), not an L2 coefficient
+            self._weight_decay = 0.0
+            self._l1_decay = weight_decay.coeff
+            self._decoupled = False
         elif isinstance(weight_decay, L2Decay):
             self._weight_decay = weight_decay.coeff
             self._decoupled = False
@@ -94,14 +95,26 @@ class Optimizer:
 
     # -- shared machinery ---------------------------------------------------
     def _param_decay(self, p) -> float:
-        if self._weight_decay == 0.0:
-            return 0.0
+        """L2 coefficient for this param (per-param regularizer wins)."""
         if self._apply_decay_param_fun is not None and \
                 not self._apply_decay_param_fun(p.name):
             return 0.0
-        if getattr(p, "regularizer", None) is not None:
-            return getattr(p.regularizer, "coeff", self._weight_decay)
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            if isinstance(reg, L1Decay):
+                return 0.0
+            return getattr(reg, "coeff", self._weight_decay)
         return self._weight_decay
+
+    def _param_l1(self, p) -> float:
+        """L1 coefficient for this param (per-param regularizer wins)."""
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            return 0.0
+        reg = getattr(p, "regularizer", None)
+        if reg is not None:
+            return reg.coeff if isinstance(reg, L1Decay) else 0.0
+        return self._l1_decay
 
     def _ensure_state(self, name: str, value):
         if name not in self._state:
@@ -144,12 +157,21 @@ class Optimizer:
             self._ensure_state(name, p._value)
             st = self._state[name]
             decay = self._param_decay(p)
+            l1 = self._param_l1(p)
             plr = lr * p.optimize_attr.get("learning_rate", 1.0)
             if isinstance(g, SelectedRows):
-                self._state[name] = self._sparse_step(
-                    p, g.merged(), st, plr, decay)
-                continue
-            gval = g._value
+                if l1 == 0.0:
+                    self._state[name] = self._sparse_step(
+                        p, g.merged(), st, plr, decay)
+                    continue
+                # L1 penalizes EVERY weight (sign term), so a row-wise
+                # sparse update would be wrong — densify
+                gval = g.merged().to_dense()
+            else:
+                gval = g._value
+            if l1 != 0.0:
+                w = st.get("master_weight", p._value)
+                gval = gval + (l1 * jnp.sign(w)).astype(gval.dtype)
             if "master_weight" in st:
                 mw = st["master_weight"]
                 new_mw, new_st = self._update(
@@ -196,7 +218,8 @@ class Optimizer:
                              grads: Dict[str, Any],
                              state: Dict[str, Any], lr,
                              decay_coeffs: Optional[Dict[str, float]] = None,
-                             lr_scales: Optional[Dict[str, float]] = None
+                             lr_scales: Optional[Dict[str, float]] = None,
+                             l1_coeffs: Optional[Dict[str, float]] = None
                              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """Pure: (params, grads, state, lr) → (new_params, new_state).
         Used inside jit — one fused XLA update over all tensors.
@@ -215,9 +238,14 @@ class Optimizer:
                 continue
             decay = self._weight_decay if decay_coeffs is None \
                 else decay_coeffs.get(n, self._weight_decay)
+            l1 = self._l1_decay if l1_coeffs is None \
+                else l1_coeffs.get(n, self._l1_decay)
             plr = lr if lr_scales is None \
                 else lr * lr_scales.get(n, 1.0)
             st = state[n]
+            if l1 != 0.0:
+                w = st.get("master_weight", v)
+                g = g + (l1 * jnp.sign(w)).astype(g.dtype)
             if "master_weight" in st:
                 mw = st["master_weight"]
                 nmw, nst = self._update(mw, g.astype(jnp.float32), st,

@@ -345,6 +345,8 @@ class Executor:
         # the eager step()
         decay_coeffs = {n: opt._param_decay(p)
                         for n, p in zip(names, t_params)}
+        l1_coeffs = {n: opt._param_l1(p)
+                     for n, p in zip(names, t_params)}
         lr_scales = {n: p.optimize_attr.get("learning_rate", 1.0)
                      for n, p in zip(names, t_params)}
 
@@ -364,7 +366,8 @@ class Executor:
                 gdict = dict(zip(names, grads))
                 new_p, new_s = opt.apply_gradients_tree(
                     pdict, gdict, state, lr,
-                    decay_coeffs=decay_coeffs, lr_scales=lr_scales)
+                    decay_coeffs=decay_coeffs, lr_scales=lr_scales,
+                    l1_coeffs=l1_coeffs)
                 new_tvals = [new_p[n] for n in names]
                 upd = {id(p): v for p, v in zip(t_params, new_tvals)}
                 fz = {id(p): v for p, v in zip(frozen_objs, fzvals)}

@@ -1,0 +1,24 @@
+"""paddle.sysconfig (parity: upstream ``python/paddle/sysconfig.py``):
+header/library paths for building extensions against the framework.
+
+The TPU-native framework is pure Python over jax — there are no
+framework C headers to compile against; get_include()/get_lib() return
+the package paths (existing dirs) so build scripts that merely join
+paths keep working, and native/ carries the in-repo C++ sources.
+"""
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    path = os.path.join(_PKG, "include")
+    return path if os.path.isdir(path) else _PKG
+
+
+def get_lib() -> str:
+    path = os.path.join(_PKG, "libs")
+    return path if os.path.isdir(path) else _PKG

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Tunnel watcher + hardware measurement queue.
+#
+# Polls the axon tunnel; when it is up AND a real jax backend init
+# succeeds, runs the round-5 hardware queue in order, logging each step.
+# Partial results survive outages (tpu_ab appends to AB_RESULTS.jsonl;
+# bench.py writes its JSON line to stdout -> log).  Exits when the whole
+# queue has completed, or after MAX_HOURS.
+set -u
+cd "$(dirname "$0")/.."
+LOG=hw_queue.log
+MAX_HOURS=${MAX_HOURS:-11}
+DEADLINE=$(( $(date +%s) + MAX_HOURS*3600 ))
+
+log() { echo "[$(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+probe() {
+    curl -sm 5 http://127.0.0.1:8103/ -o /dev/null -w "%{http_code}" 2>/dev/null
+    [ $? -eq 0 ] || return 1
+    # TCP up -> confirm a backend init + tiny computation completes
+    timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = jnp.ones((8, 8), jnp.bfloat16)
+assert float((x @ x)[0, 0]) == 8.0
+print('backend-ok', jax.devices())" >> "$LOG" 2>&1
+}
+
+STEP_FILE=.hw_queue_step
+step=$(cat "$STEP_FILE" 2>/dev/null || echo 0)
+
+run_step() {  # $1=idx $2=name $3...=cmd
+    local idx=$1 name=$2; shift 2
+    if [ "$step" -gt "$idx" ]; then return 0; fi
+    log "=== step $idx: $name ==="
+    "$@" >> "$LOG" 2>&1
+    local rc=$?
+    log "=== step $idx: $name done rc=$rc ==="
+    if [ $rc -eq 0 ]; then
+        step=$((idx+1)); echo "$step" > "$STEP_FILE"
+    fi
+    return $rc
+}
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+    if probe; then
+        log "tunnel UP — running queue from step $step"
+        run_step 0 "profile_gpt decomposition" \
+            timeout 3000 python scripts/profile_gpt.py || { sleep 60; continue; }
+        run_step 1 "tpu_ab kernel matrix" \
+            timeout 5400 python scripts/tpu_ab.py --timeout 480 || { sleep 60; continue; }
+        run_step 2 "full bench" \
+            timeout 1200 python bench.py || { sleep 60; continue; }
+        log "QUEUE COMPLETE"
+        exit 0
+    fi
+    sleep 60
+done
+log "deadline reached with step=$step"
+exit 1

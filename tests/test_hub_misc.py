@@ -1,0 +1,89 @@
+"""paddle.hub (local source), paddle.callbacks alias, paddle.sysconfig
+(upstream python/paddle/hapi/hub.py, callbacks.py, sysconfig.py)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture()
+def hub_repo(tmp_path):
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent("""
+        dependencies = ["numpy"]
+
+        from paddle_tpu import nn
+
+        def tiny_mlp(hidden=4, classes=2):
+            \"\"\"A tiny MLP entry point.\"\"\"
+            return nn.Sequential(nn.Linear(3, hidden), nn.ReLU(),
+                                 nn.Linear(hidden, classes))
+
+        def _private_helper():
+            pass
+    """))
+    return str(tmp_path)
+
+
+def test_hub_list_help_load_local(hub_repo):
+    assert paddle.hub.list(hub_repo, source="local") == ["tiny_mlp"]
+    assert "tiny MLP" in paddle.hub.help(hub_repo, "tiny_mlp",
+                                         source="local")
+    net = paddle.hub.load(hub_repo, "tiny_mlp", source="local", hidden=8)
+    from paddle_tpu.tensor import Tensor
+    out = net(Tensor(np.zeros((2, 3), np.float32)))
+    assert tuple(out.shape) == (2, 2)
+
+
+def test_hub_refuses_network_sources(hub_repo):
+    with pytest.raises(RuntimeError, match="network"):
+        paddle.hub.load(hub_repo, "tiny_mlp")       # default github
+    with pytest.raises(ValueError):
+        paddle.hub.list(hub_repo, source="bitbucket")
+
+
+def test_hub_unknown_entry_and_missing_hubconf(hub_repo, tmp_path):
+    with pytest.raises(RuntimeError, match="tiny_mlp"):
+        paddle.hub.load(hub_repo, "nope", source="local")
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(FileNotFoundError):
+        paddle.hub.list(str(empty), source="local")
+
+
+def test_hub_missing_dependency(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "dependencies = ['no_such_pkg_xyz']\n"
+        "def m():\n    return 1\n")
+    with pytest.raises(RuntimeError, match="no_such_pkg_xyz"):
+        paddle.hub.list(str(tmp_path), source="local")
+
+
+def test_hubconf_executes_once_across_calls(tmp_path):
+    marker = tmp_path / "count.txt"
+    (tmp_path / "hubconf.py").write_text(textwrap.dedent(f"""
+        with open({str(marker)!r}, "a") as f:
+            f.write("x")
+
+        def entry():
+            return 42
+    """))
+    paddle.hub.list(str(tmp_path), source="local")
+    assert paddle.hub.load(str(tmp_path), "entry", source="local") == 42
+    with pytest.raises(RuntimeError):
+        paddle.hub.load(str(tmp_path), "missing", source="local")
+    assert marker.read_text() == "x", "hubconf side effects re-ran"
+
+
+def test_callbacks_alias():
+    from paddle_tpu.hapi import callbacks as hapi_cb
+    assert paddle.callbacks.ModelCheckpoint is hapi_cb.ModelCheckpoint
+    assert paddle.callbacks.EarlyStopping is hapi_cb.EarlyStopping
+
+
+def test_sysconfig_paths_exist():
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert os.path.isdir(paddle.sysconfig.get_lib())
