@@ -7,3 +7,5 @@ from .cost_model import (  # noqa
     MeshCostInfo, AxisLink, CommOpCost, reshard_cost, all_reduce_cost,
     all_gather_cost, reduce_scatter_cost, all_to_all_cost, p2p_cost)
 from .planner import plan_tensor_parallel, PlanEntry  # noqa
+from .tuner import (  # noqa
+    ModelStats, Candidate, model_stats, tune_strategy, tune)

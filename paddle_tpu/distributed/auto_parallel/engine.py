@@ -101,6 +101,35 @@ class Engine:
                                    hbm_bytes=hbm_bytes)
         return self._planned
 
+    def tune(self, tokens_per_step: int, n_devices: Optional[int] = None,
+             hbm_bytes: float = 16e9, apply: bool = False, **kwargs):
+        """Parallel-strategy search (upstream parallel tuner): enumerate
+        dp*mp*pp factorizations of ``n_devices`` (default: all visible
+        devices), price each with the cost model, rank by step time.
+        With ``apply=True`` the winning candidate's degrees become this
+        Engine's mesh (must run before the step compiles).  Returns the
+        ranked candidate list either way."""
+        from .tuner import tune as _tune
+        if n_devices is None:
+            n_devices = len(jax.devices())
+        cands = _tune(self._model, tokens_per_step, n_devices,
+                      hbm_bytes=hbm_bytes, **kwargs)
+        if apply:
+            if self._runner is not None:
+                raise RuntimeError(
+                    "Engine.tune(apply=True) must run before the step "
+                    "is compiled; create a fresh Engine to re-tune")
+            best = next((c for c in cands if c.fits), None)
+            if best is None:
+                raise RuntimeError(
+                    "no candidate strategy fits the HBM budget: "
+                    + (cands[0].note if cands else "no candidates"))
+            axes = {k[:-7]: v for k, v in best.degrees.items()
+                    if k.endswith("_degree") and v > 1}
+            self._mesh = coll.build_mesh(axes)
+            self._tuned = best
+        return cands
+
     def _ensure_runner(self):
         if self._runner is not None:
             return
@@ -112,6 +141,8 @@ class Engine:
                                       None) or {}).get("stage", 2)
         elif getattr(self, "_planned", None) is not None:
             sharding_stage = self._planned.sharding_stage
+        elif getattr(self, "_tuned", None) is not None:
+            sharding_stage = self._tuned.sharding_stage
         self._runner = DistributedRunner(
             self._model, self._optimizer, self._loss, mesh=jmesh,
             sharding_stage=sharding_stage)

@@ -644,12 +644,9 @@ class PipelineParallel:
         name_to_param = dict(net.named_parameters())
         # per-param weight-decay / lr multipliers — SAME contract as the
         # pipelined path (ParamAttr regularizer / learning_rate parity)
-        decay = {n: float(opt._param_decay(p))
-                 for n, p in name_to_param.items() if not p.stop_gradient}
-        l1s = {n: float(opt._param_l1(p))
-               for n, p in name_to_param.items() if not p.stop_gradient}
-        lrs = {n: float(p.optimize_attr.get("learning_rate", 1.0))
-               for n, p in name_to_param.items() if not p.stop_gradient}
+        decay, l1s, lrs = opt._per_param_coeffs(
+            {n: p for n, p in name_to_param.items()
+             if not p.stop_gradient})
 
         if self._inline_fn is None:
             M = max(int(self.accumulate_steps), 1)

@@ -102,15 +102,8 @@ class DistributedRunner:
                         for n, p in name_to_param.items()}
         # per-param weight-decay coefficient and LR multiplier
         # (ParamAttr regularizer / learning_rate parity with step())
-        self._decay_coeffs = {
-            n: float(self.optimizer._param_decay(p))
-            for n, p in name_to_param.items()}
-        self._l1_coeffs = {
-            n: float(self.optimizer._param_l1(p))
-            for n, p in name_to_param.items()}
-        self._lr_scales = {
-            n: float(p.optimize_attr.get("learning_rate", 1.0))
-            for n, p in name_to_param.items()}
+        (self._decay_coeffs, self._l1_coeffs,
+         self._lr_scales) = self.optimizer._per_param_coeffs(name_to_param)
         for n, p in name_to_param.items():
             p._value = self._shard(p._value, self._pspecs[n])
         params = F.param_dict(self.network)

@@ -123,13 +123,8 @@ class Model:
         net = self.network
         # per-param ParamAttr regularizer / learning_rate parity with the
         # eager step() — same contract as the runner/pipeline/static engines
-        name_to_param = dict(net.named_parameters())
-        decay_coeffs = {n: float(opt._param_decay(p))
-                        for n, p in name_to_param.items()}
-        l1_coeffs = {n: float(opt._param_l1(p))
-                     for n, p in name_to_param.items()}
-        lr_scales = {n: float(p.optimize_attr.get("learning_rate", 1.0))
-                     for n, p in name_to_param.items()}
+        decay_coeffs, l1_coeffs, lr_scales = \
+            opt._per_param_coeffs(dict(net.named_parameters()))
 
         def step(params, frozen, buffers, opt_state, lr, key, *data):
             n_in = self._n_inputs

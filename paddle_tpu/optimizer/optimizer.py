@@ -106,6 +106,18 @@ class Optimizer:
             return getattr(reg, "coeff", self._weight_decay)
         return self._weight_decay
 
+    def _per_param_coeffs(self, name_to_param):
+        """(decay, l1, lr_scales) dicts for a name->Parameter mapping —
+        the ParamAttr regularizer / learning_rate contract every
+        compiled engine passes to ``apply_gradients_tree``."""
+        decay = {n: float(self._param_decay(p))
+                 for n, p in name_to_param.items()}
+        l1 = {n: float(self._param_l1(p))
+              for n, p in name_to_param.items()}
+        lrs = {n: float(p.optimize_attr.get("learning_rate", 1.0))
+               for n, p in name_to_param.items()}
+        return decay, l1, lrs
+
     def _param_l1(self, p) -> float:
         """L1 coefficient for this param (per-param regularizer wins)."""
         if self._apply_decay_param_fun is not None and \

@@ -343,12 +343,8 @@ class Executor:
                 "provide one")
         # per-param ParamAttr learning_rate / regularizer parity with
         # the eager step()
-        decay_coeffs = {n: opt._param_decay(p)
-                        for n, p in zip(names, t_params)}
-        l1_coeffs = {n: opt._param_l1(p)
-                     for n, p in zip(names, t_params)}
-        lr_scales = {n: p.optimize_attr.get("learning_rate", 1.0)
-                     for n, p in zip(names, t_params)}
+        decay_coeffs, l1_coeffs, lr_scales = \
+            opt._per_param_coeffs(dict(zip(names, t_params)))
 
         fn = program._compiled.get(sig)
         if fn is None:
