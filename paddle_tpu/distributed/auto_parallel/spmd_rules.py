@@ -631,6 +631,177 @@ def scatter_rule(x: DistSpec, index: DistSpec,
     return RuleResult([xs, idx, ups], [xs])
 
 
+def flatten_rule(x: DistSpec, start_axis: int = 0,
+                 stop_axis: int = -1) -> RuleResult:
+    """Flatten [start, stop] into one dim: the merged output dim keeps
+    the FIRST merged input dim's sharding (the major dim owns the
+    stride); later merged dims must be replicated (upstream
+    flatten/reshape rule behavior)."""
+    nd = x.ndim
+    start = start_axis % nd
+    stop = stop_axis % nd
+    in_dims = list(x.dims)
+    for i in range(start + 1, stop + 1):
+        in_dims[i] = None
+    out_dims = (in_dims[:start] + [in_dims[start]]
+                + in_dims[stop + 1:])
+    return RuleResult([DistSpec(in_dims)], [DistSpec(out_dims)])
+
+
+def pad_rule(x: DistSpec, paddings: Sequence[int] = (),
+             **_attrs) -> RuleResult:
+    """Padded dims must be replicated (a shard can't know whether it
+    owns the global edge); unpadded dims propagate.  ``paddings`` is
+    the flat (before, after) pairs list; a SHORT list applies to the
+    TRAILING dims (paddle.pad's convention — ops/manipulation.py);
+    missing/empty means all dims padded (conservative)."""
+    dims = list(x.dims)
+    if paddings:
+        pairs = list(zip(paddings[0::2], paddings[1::2]))
+        # align to trailing dims: pad=[1,1] on NCHW pads W only
+        offset = len(dims) - len(pairs)
+        for j, (lo, hi) in enumerate(pairs):
+            i = offset + j
+            if 0 <= i < len(dims) and (lo or hi):
+                dims[i] = None
+    else:
+        dims = [None] * len(dims)
+    s = DistSpec(dims)
+    return RuleResult([s], [s])
+
+
+def tri_rule(x: DistSpec, **_attrs) -> RuleResult:
+    """triu/tril: the mask is a pure function of GLOBAL indices, which
+    SPMD iota provides per shard — every placement passes through."""
+    return RuleResult([x], [x])
+
+
+def roll_rule(x: DistSpec, axis=None, **_attrs) -> RuleResult:
+    """Rolled dims need neighbor data across shard boundaries —
+    replicate them; ``axis=None`` (flattened roll) replicates all."""
+    dims = list(x.dims)
+    if axis is None:
+        dims = [None] * len(dims)
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+        for a in axes:
+            dims[a % len(dims)] = None
+    s = DistSpec(dims)
+    return RuleResult([s], [s])
+
+
+def rms_norm_rule(x: DistSpec, begin_norm_axis: int = -1) -> RuleResult:
+    """Same constraint shape as layer_norm (upstream rms_norm spmd
+    rule): normalized trailing dims replicate, leading propagate."""
+    return layer_norm_rule(x, begin_norm_axis)
+
+
+def group_norm_rule(x: DistSpec, **_attrs) -> RuleResult:
+    """NCHW group norm: stats span C-within-group and all spatial dims —
+    batch may shard, channel/spatial replicate (upstream group_norm
+    rule's conservative form)."""
+    dims = [x.dims[0]] + [None] * (x.ndim - 1)
+    s = DistSpec(dims)
+    return RuleResult([s], [s])
+
+
+def instance_norm_rule(x: DistSpec, **_attrs) -> RuleResult:
+    """NCHW instance norm: stats per (N, C) over spatial dims — N and C
+    may shard, spatial dims replicate."""
+    dims = [x.dims[0], x.dims[1] if x.ndim > 1 else None] \
+        + [None] * max(x.ndim - 2, 0)
+    s = DistSpec(dims)
+    return RuleResult([s], [s])
+
+
+def fused_rope_rule(*qkv: DistSpec, **_attrs) -> RuleResult:
+    """Rotary embedding over (q[, k[, v]]) each [b, s, h, d]: rotation
+    pairs live inside the head-feature dim — batch/seq/heads propagate
+    (merged across the given operands), the feature dim replicates
+    (upstream fused_rotary_position_embedding rule).  One out spec per
+    input."""
+    nd = qkv[0].ndim
+    merged: List = []
+    for d in range(nd):
+        m = None
+        for s in qkv:
+            m, conflict = _merge_dim(m, s.dims[d])
+            if conflict:
+                m = None
+                break
+        merged.append(m)
+    merged[-1] = None
+    spec = DistSpec(merged)
+    return RuleResult([spec] * len(qkv), [spec] * len(qkv))
+
+
+def swiglu_rule(x: DistSpec, y: Optional[DistSpec] = None,
+                **_attrs) -> RuleResult:
+    """swiglu: one-tensor form splits the last dim into (gate, value)
+    halves — a last-dim shard would mix halves, so it replicates;
+    two-tensor form silu(x)*y is elementwise and the last dim merges
+    like any elementwise op.  Leading dims propagate (merged)."""
+    if y is None:
+        dims = list(x.dims)
+        dims[-1] = None
+        s = DistSpec(dims)
+        return RuleResult([s], [s])
+    merged: List = []
+    for d in range(x.ndim):
+        m, conflict = _merge_dim(x.dims[d], y.dims[d])
+        merged.append(None if conflict else m)
+    s = DistSpec(merged)
+    return RuleResult([s, s], [s])
+
+
+def vector_norm_rule(x: DistSpec, axis=None, keepdim: bool = False,
+                     **_attrs) -> RuleResult:
+    """p_norm / squared_l2_norm: nonlinear reduction — the final
+    root/power is not sum-decomposable, so reduced dims must replicate
+    first.  ``axis=None`` (full reduction to a scalar) replicates
+    everything; an axis list keeps the surviving dims sharded."""
+    if axis is None:
+        return RuleResult([replicated(x.ndim)], [DistSpec(())])
+    axes = axis if isinstance(axis, (list, tuple)) else (axis,)
+    return nonlinear_reduction_rule(x, list(axes), keepdim=keepdim)
+
+
+def take_along_axis_rule(x: DistSpec, index: DistSpec,
+                         axis: int = 0) -> RuleResult:
+    """take_along_axis output has INDEX's rank/shape (unlike gather):
+    the indexed dim replicates on both operands, the other dims merge
+    between x and index and pass through to the output."""
+    nd = x.ndim
+    axis %= nd
+    xd, idxd, outd = [], [], []
+    for d in range(nd):
+        if d == axis:
+            xd.append(None)
+            idxd.append(None)
+            outd.append(None)
+            continue
+        m, conflict = _merge_dim(x.dims[d],
+                                 index.dims[d] if d < index.ndim
+                                 else None)
+        m = None if conflict else m
+        xd.append(m)
+        idxd.append(m)
+        outd.append(m)
+    return RuleResult([DistSpec(xd), DistSpec(idxd[:index.ndim])],
+                      [DistSpec(outd[:index.ndim])])
+
+
+def unbind_rule(x: DistSpec, axis: int = 0) -> RuleResult:
+    """Unbind removes ``axis``: that dim replicates, the rest pass
+    through to every output."""
+    nd = x.ndim
+    axis = axis % nd
+    dims = list(x.dims)
+    dims[axis] = None
+    out = DistSpec(dims[:axis] + dims[axis + 1:])
+    return RuleResult([DistSpec(dims)], [out])
+
+
 _RULES = {
     "matmul": matmul_rule,
     "conv2d": conv2d_rule,
@@ -679,6 +850,27 @@ _RULES = {
     "where": where_rule,
     "scatter": scatter_rule,
     "put_along_axis": scatter_rule,
+    # second round-5 widening batch (upstream per-op rule parity)
+    "flatten": flatten_rule,
+    "pad": pad_rule,
+    "triu": tri_rule,
+    "tril": tri_rule,
+    "roll": roll_rule,
+    "rms_norm": rms_norm_rule,
+    "group_norm": group_norm_rule,
+    "instance_norm": instance_norm_rule,
+    "fused_rotary_position_embedding": fused_rope_rule,
+    "fused_rope": fused_rope_rule,
+    "swiglu": swiglu_rule,
+    "p_norm": vector_norm_rule,
+    "squared_l2_norm": vector_norm_rule,
+    "unbind": unbind_rule,
+    "take_along_axis": take_along_axis_rule,
+    "bmm": matmul_rule,
+    "clip": unary_rule,
+    "amax": nonlinear_reduction_rule,
+    "amin": nonlinear_reduction_rule,
+    "logsumexp": nonlinear_reduction_rule,
 }
 
 

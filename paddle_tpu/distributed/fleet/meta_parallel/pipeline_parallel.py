@@ -346,9 +346,9 @@ class PipelineParallel:
             return jax.device_put(v, NamedSharding(mesh, spec))
 
         params, frozen = {}, {}
-        decay, l1s, lrs = {}, {}, {}
         opt = optimizer if hasattr(optimizer, "apply_gradients_tree") \
             else optimizer._inner_opt
+        coeff_params = {}           # tree-name -> representative param
         for g, p in plan["gname_to_param"].items():
             if id(p) in plan["body_ids"]:
                 continue
@@ -358,9 +358,7 @@ class PipelineParallel:
             p._value = put(p._value, spec)
             tgt[g] = p._value
             if not p.stop_gradient:
-                decay[g] = float(opt._param_decay(p))
-                l1s[g] = float(opt._param_l1(p))
-                lrs[g] = float(p.optimize_attr.get("learning_rate", 1.0))
+                coeff_params[g] = p
         for (j, local), gs in plan["stack_index"].items():
             ps = [plan["gname_to_param"][g] for g in gs]
             rep = ps[0]
@@ -372,17 +370,18 @@ class PipelineParallel:
             tgt = frozen if rep.stop_gradient else params
             tgt[name] = leaf
             if not rep.stop_gradient:
-                decay[name] = float(opt._param_decay(rep))
-                l1s[name] = float(opt._param_l1(rep))
-                lrs[name] = float(
-                    rep.optimize_attr.get("learning_rate", 1.0))
+                coeff_params[name] = rep
                 # stacked body layers share ONE coefficient per leaf;
                 # refuse silently-wrong per-layer divergence
+                rd, rl1, rlr = (float(opt._param_decay(rep)),
+                                float(opt._param_l1(rep)),
+                                float(rep.optimize_attr.get(
+                                    "learning_rate", 1.0)))
                 for p in ps[1:]:
-                    if (float(opt._param_decay(p)) != decay[name]
-                            or float(opt._param_l1(p)) != l1s[name]
+                    if (float(opt._param_decay(p)) != rd
+                            or float(opt._param_l1(p)) != rl1
                             or float(p.optimize_attr.get(
-                                "learning_rate", 1.0)) != lrs[name]):
+                                "learning_rate", 1.0)) != rlr):
                         raise ValueError(
                             f"stacked pipeline layers in leaf {name!r} "
                             "have differing per-param regularizer/"
@@ -391,7 +390,8 @@ class PipelineParallel:
                             "stacked uniform stages — set them "
                             "uniformly or disable stage stacking")
         self._params, self._frozen = params, frozen
-        self._decay, self._l1s, self._lrs = decay, l1s, lrs
+        self._decay, self._l1s, self._lrs = \
+            opt._per_param_coeffs(coeff_params)
         self._buffers = {n: b._value for n, b in net.named_buffers()
                          if b is not None}
         if self._opt_tree is None:

@@ -90,12 +90,30 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
     start, end, step = unwrap(start), unwrap(end), unwrap(step)
     if end is None:
         start, end = 0, start
+    import jax.core as _core
+    if any(isinstance(v, _core.Tracer) and not _is_concrete(v)
+           for v in (start, end, step)):
+        raise ValueError(
+            "paddle.arange with a TRACED start/end/step would produce a "
+            "dynamic shape, which XLA cannot compile (SURVEY.md §7.3 "
+            "hard part 3). Inside @to_static/jit, either make the bound "
+            "a Python int (static), or restructure as a fixed-length "
+            "loop with masking: iterate paddle.arange(MAX) and guard "
+            "the body with `i < n`.")
     if dtype is None:
         if any(isinstance(v, float) for v in (start, end, step)):
             dtype = dtypes.default_float_dtype()
         else:
             dtype = dtypes.int64
     return Tensor(jnp.arange(start, end, step, _dt(dtype)))
+
+
+def _is_concrete(v) -> bool:
+    try:
+        int(v)
+        return True
+    except Exception:
+        return False
 
 
 def linspace(start, stop, num, dtype=None, name=None):

@@ -688,3 +688,94 @@ def test_tile_onehot_where_scatter_rules():
     r = infer_forward("scatter", s, DistSpec((None,)),
                       DistSpec((None, "mp")), axis=0)
     assert r.out_spec.dims == (None, "mp")
+
+
+def test_flatten_pad_tri_roll_rules():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        infer_forward, DistSpec)
+    s = DistSpec(("dp", "mp", None, None))
+    # flatten [1..2]: merged dim keeps dim-1's sharding
+    r = infer_forward("flatten", s, start_axis=1, stop_axis=2)
+    assert r.out_spec.dims == ("dp", "mp", None)
+    # flattening a dim whose LATER members are sharded replicates them
+    s2 = DistSpec((None, None, "mp", None))
+    r = infer_forward("flatten", s2, start_axis=1, stop_axis=2)
+    assert r.in_specs[0].dims == (None, None, None, None)
+    r = infer_forward("pad", DistSpec(("dp", "mp")),
+                      paddings=[0, 0, 1, 1])
+    assert r.out_spec.dims == ("dp", None)
+    r = infer_forward("triu", DistSpec(("dp", None, "mp")))
+    assert r.out_spec.dims == ("dp", None, "mp")   # pure pass-through
+    r = infer_forward("roll", DistSpec(("dp", "mp")), axis=1)
+    assert r.in_specs[0].dims == ("dp", None)
+    r = infer_forward("roll", DistSpec(("dp", "mp")))   # flattened roll
+    assert r.in_specs[0].dims == (None, None)
+
+
+def test_norm_family_rules():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        infer_forward, DistSpec)
+    s = DistSpec(("dp", None, "mp"))
+    r = infer_forward("rms_norm", s)
+    assert r.in_specs[0].dims == ("dp", None, None)
+    nchw = DistSpec(("dp", "mp", None, None))
+    r = infer_forward("group_norm", nchw)
+    assert r.in_specs[0].dims == ("dp", None, None, None)
+    r = infer_forward("instance_norm", nchw)
+    assert r.in_specs[0].dims == ("dp", "mp", None, None)
+    r = infer_forward("p_norm", DistSpec(("dp", "mp")))
+    assert r.out_spec.dims == ()
+    assert r.in_specs[0].dims == (None, None)
+
+
+def test_rope_swiglu_unbind_alias_rules():
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        infer_forward, DistSpec)
+    bshd = DistSpec(("dp", "sep", "mp", None))
+    r = infer_forward("fused_rope", bshd)
+    assert r.out_spec.dims == ("dp", "sep", "mp", None)
+    r = infer_forward("swiglu", DistSpec(("dp", None, "mp")))
+    assert r.in_specs[0].dims == ("dp", None, None)
+    r = infer_forward("unbind", DistSpec(("pp", "dp", "mp")), axis=0)
+    assert r.out_spec.dims == ("dp", "mp")
+    # aliases resolve
+    r = infer_forward("bmm", DistSpec(("dp", None, "mp")),
+                      DistSpec(("dp", "mp", None)))
+    assert r.out_spec.ndim == 3
+    r = infer_forward("logsumexp", DistSpec(("dp", "mp")), axes=[1])
+    assert r.in_specs[0].dims == ("dp", None)
+    r = infer_forward("take_along_axis", DistSpec(("dp", "mp")),
+                      DistSpec((None,)), axis=0)
+    assert r.in_specs[0].dims == (None, "mp")
+
+
+def test_rule_fix_regressions():
+    """take_along_axis rank, trailing-dims pad, multi-input rope/swiglu,
+    p_norm with axis (review findings)."""
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import (
+        infer_forward, DistSpec)
+    # take_along_axis keeps index's rank; non-axis dims merge
+    r = infer_forward("take_along_axis", DistSpec(("dp", "mp")),
+                      DistSpec((None, "mp")), axis=0)
+    assert r.out_spec.ndim == 2
+    assert r.out_spec.dims == (None, "mp")
+    # short pad list applies to TRAILING dims: NCHW pad=[1,1] pads W
+    r = infer_forward("pad", DistSpec(("dp", None, None, "mp")),
+                      paddings=[1, 1])
+    assert r.in_specs[0].dims == ("dp", None, None, None)
+    # multi-input rope merges placements, feature dim replicated
+    q = DistSpec(("dp", "sep", "mp", None))
+    k = DistSpec(("dp", None, "mp", None))
+    r = infer_forward("fused_rope", q, k)
+    assert len(r.in_specs) == 2 and len(r.out_specs) == 2
+    # one-sided merge wins (module convention): k resharded onto 'sep'
+    assert r.in_specs[0].dims == ("dp", "sep", "mp", None)
+    assert r.in_specs[1].dims == ("dp", "sep", "mp", None)
+    # two-tensor swiglu is elementwise (last dim can stay sharded)
+    r = infer_forward("swiglu", DistSpec(("dp", "mp")),
+                      DistSpec(("dp", "mp")))
+    assert r.out_spec.dims == ("dp", "mp")
+    # p_norm with axis keeps surviving dims sharded
+    r = infer_forward("p_norm", DistSpec(("dp", "mp")), axis=-1)
+    assert r.in_specs[0].dims == ("dp", None)
+    assert r.out_spec.dims == ("dp",)

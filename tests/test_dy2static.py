@@ -814,3 +814,37 @@ def test_assert_only_function_converts():
 
     np.testing.assert_allclose(f(T([1.])).numpy(), [2.])
     assert "__d2s__" in f.code
+
+
+def test_traced_arange_bound_fails_loudly_with_guidance():
+    """A tensor-valued arange bound inside @to_static is a dynamic
+    shape XLA cannot compile — must raise the guided error, not a raw
+    jax ConcretizationTypeError (loud-failure ethos)."""
+    import numpy as np
+    import pytest
+    import paddle_tpu as paddle
+    from paddle_tpu import jit
+    from paddle_tpu.tensor import Tensor
+
+    @jit.to_static
+    def f(x, n):
+        ys = []
+        for i in paddle.arange(0, n):
+            ys.append(x * i.astype("float32"))
+        return paddle.stack(ys)
+
+    with pytest.raises(ValueError, match="fixed-length"):
+        f(Tensor(np.ones((2,), np.float32)), Tensor(np.int64(4)))
+
+    # the error's suggested masked fixed-length rewrite compiles
+    @jit.to_static
+    def g(x, n):
+        acc = paddle.zeros([4, 2], "float32")
+        for i in paddle.arange(0, 4):
+            m = (i < n).astype("float32")
+            acc[i] = x * i.astype("float32") * m
+        return acc
+
+    out = g(Tensor(np.ones((2,), np.float32)), Tensor(np.int64(3)))
+    got = np.asarray(out.numpy())[:, 0]
+    np.testing.assert_allclose(got, [0.0, 1.0, 2.0, 0.0])
