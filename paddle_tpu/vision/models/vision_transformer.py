@@ -101,12 +101,41 @@ class VisionTransformer(nn.Layer):
         if num_classes > 0:
             self.head = nn.Linear(embed_dim, num_classes)
 
+    def _pos_embed_for(self, n_patches: int):
+        """Position embeddings for an ``n_patches`` input — bilinear
+        grid interpolation when the resolution differs from the build
+        size (the standard ViT multi-resolution recipe; PaddleClas
+        resize_pos_embed parity).  ``n_patches`` is a static Python int
+        per compiled bucket, so each bucket compiles its own resized
+        table — config 5's bucketed dynamic-shape strategy (SURVEY.md
+        §7.3 hard part 3)."""
+        n_built = int(self.pos_embed.shape[1]) - 1
+        if n_patches == n_built:
+            return self.pos_embed
+        cls_pe = self.pos_embed[:, :1]
+        grid_pe = self.pos_embed[:, 1:]
+        g_old = int(round(float(n_built) ** 0.5))
+        g_new = int(round(float(n_patches) ** 0.5))
+        if g_old * g_old != n_built or g_new * g_new != n_patches:
+            raise ValueError(
+                f"cannot interpolate position embeddings from "
+                f"{n_built} to {n_patches} patches: non-square grid")
+        e = grid_pe.shape[2]
+        pe = ops.transpose(ops.reshape(grid_pe, [1, g_old, g_old, e]),
+                           [0, 3, 1, 2])
+        pe = ops.interpolate(pe, size=[g_new, g_new], mode="bilinear",
+                             align_corners=False)
+        pe = ops.reshape(ops.transpose(pe, [0, 2, 3, 1]),
+                         [1, g_new * g_new, e])
+        return ops.concat([cls_pe, pe], axis=1)
+
     def forward(self, x):
         b = x.shape[0]
         x = self.patch_embed(x)
         cls = ops.expand(self.cls_token, [b, 1, self.cls_token.shape[2]])
+        pos = self._pos_embed_for(int(x.shape[1]))
         x = ops.concat([cls, x], axis=1)
-        x = self.pos_drop(x + self.pos_embed)
+        x = self.pos_drop(x + pos)
         for blk in self.blocks:
             x = blk(x)
         x = self.norm(x)

@@ -47,7 +47,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
         run_step 0 "profile_gpt decomposition" \
             timeout 3000 python scripts/profile_gpt.py || { sleep 60; continue; }
         run_step 1 "tpu_ab kernel matrix" \
-            timeout 5400 python scripts/tpu_ab.py --timeout 480 || { sleep 60; continue; }
+            timeout 5400 python scripts/tpu_ab.py --timeout 480 --also-vit || { sleep 60; continue; }
         run_step 2 "full bench" \
             timeout 1200 python bench.py || { sleep 60; continue; }
         log "QUEUE COMPLETE"

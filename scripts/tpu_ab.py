@@ -41,10 +41,10 @@ VARIANTS = [
 ]
 
 
-def run_variant(name, env_extra, timeout):
+def run_variant(name, env_extra, timeout, child="gpt"):
     env = dict(os.environ)
     env.update(env_extra)
-    env["_GRAFT_BENCH_CHILD"] = "gpt"
+    env["_GRAFT_BENCH_CHILD"] = child
     # each cell IS one variant — suppress bench_gpt's own in-process
     # variant sweep (it would nest extra compiles and mislabel
     # combinations under the cell's env)
@@ -71,6 +71,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--timeout", type=int, default=480)
     ap.add_argument("--also-resnet", action="store_true")
+    ap.add_argument("--also-vit", action="store_true")
     args = ap.parse_args()
 
     out_path = os.path.join(HERE, "AB_RESULTS.jsonl")
@@ -83,30 +84,18 @@ def main():
             f.write(json.dumps(r) + "\n")
         print(json.dumps(r), flush=True)
 
+    extra_children = []
     if args.also_resnet:
-        env = dict(os.environ)
-        env["_GRAFT_BENCH_CHILD"] = "resnet"
-        try:
-            proc = subprocess.run(
-                [sys.executable, os.path.join(HERE, "bench.py")],
-                env=env, cwd=HERE, capture_output=True, text=True,
-                timeout=args.timeout)
-        except subprocess.TimeoutExpired:
-            proc = None
-            r = {"variant": "resnet50",
-                 "error": f"timeout {args.timeout}s"}
-            results.append(r)
-            with open(out_path, "a") as f:
-                f.write(json.dumps(r) + "\n")
-            print(json.dumps(r), flush=True)
-        for line in (proc.stdout.splitlines() if proc else []):
-            if line.startswith("RESULT "):
-                r = json.loads(line[len("RESULT "):])
-                r["variant"] = "resnet50"
-                results.append(r)
-                with open(out_path, "a") as f:
-                    f.write(json.dumps(r) + "\n")
-                print(json.dumps(r), flush=True)
+        extra_children.append(("resnet50", "resnet"))
+    if args.also_vit:
+        extra_children.append(("vit_b16_bucketed", "vit"))
+    for label, child in extra_children:
+        print(f"--- {label} ---", flush=True)
+        r = run_variant(label, {}, args.timeout, child=child)
+        results.append(r)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(r) + "\n")
+        print(json.dumps(r), flush=True)
 
     ok = [r for r in results if "tokens_per_sec" in r]
     if ok:

@@ -192,3 +192,65 @@ def test_ernie_finetune_on_imdb_via_hapi():
         os.environ["PADDLE_TPU_SYNTH_N"] = "512"
     # the synthetic corpus is separable by construction
     assert ev["acc"] > 0.9, ev
+
+
+def test_vit_multi_resolution_bucketed_training():
+    """Config 5's ViT dynamic-shape story: position embeddings
+    interpolate per resolution bucket, one compiled program per bucket,
+    and training decreases loss across MIXED-resolution steps."""
+    import numpy as np
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+    from paddle_tpu.vision.models import VisionTransformer
+
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    net = VisionTransformer(img_size=32, patch_size=8, in_chans=3,
+                            num_classes=4, embed_dim=64, depth=2,
+                            num_heads=4)
+    net.train()
+    opt = optimizer.Adam(5e-3, parameters=net.parameters())
+    lossf = nn.CrossEntropyLoss()
+    # two resolution buckets: the build size (32 -> 4x4 patches) and a
+    # larger eval-style size (48 -> 6x6 patches)
+    batches = {}
+    for size in (32, 48):
+        x = rng.rand(4, 3, size, size).astype(np.float32)
+        y = rng.randint(0, 4, (4,)).astype(np.int64)
+        batches[size] = (x, y)
+    first, last = {}, {}
+    for step in range(40):
+        size = (32, 48)[step % 2]
+        x, y = batches[size]
+        loss = lossf(net(Tensor(x)), Tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        lv = float(loss.numpy())
+        first.setdefault(size, lv)
+        last[size] = lv
+    for size in (32, 48):
+        assert last[size] < 0.6 * first[size], \
+            f"bucket {size}: {first[size]} -> {last[size]}"
+
+
+def test_vit_pos_embed_interpolation_identity_and_refusal():
+    import numpy as np
+    import pytest
+    from paddle_tpu.tensor import Tensor
+    from paddle_tpu.vision.models import VisionTransformer
+
+    paddle.seed(0)
+    net = VisionTransformer(img_size=32, patch_size=8, in_chans=3,
+                            num_classes=0, embed_dim=64, depth=1,
+                            num_heads=4)
+    net.eval()
+    # same resolution: the exact table is used (identity)
+    pe = net._pos_embed_for(16)
+    assert pe is net.pos_embed
+    # non-square patch count refuses loudly
+    with pytest.raises(ValueError, match="non-square"):
+        net._pos_embed_for(15)
+    # different square resolution produces the right count
+    pe = net._pos_embed_for(36)
+    assert tuple(pe.shape) == (1, 37, 64)
