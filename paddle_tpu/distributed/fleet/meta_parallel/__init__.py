@@ -9,4 +9,5 @@ from .parallel_wrappers import (  # noqa
 from .sharding_parallel import (  # noqa
     GroupShardedStage2, GroupShardedStage3, GroupShardedOptimizerStage2)
 from .context_parallel import (  # noqa
-    ring_flash_attention, ulysses_attention, split_sequence)
+    ring_flash_attention, ulysses_attention, split_sequence,
+    zigzag_split_sequence, zigzag_merge_sequence, zigzag_indices)

@@ -69,12 +69,15 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 
 
 def ring_flash_attention(query, key, value, causal=False,
-                         seq_axis="sep", name=None):
+                         seq_axis="sep", balanced=False, name=None):
     """Ring (context-parallel) attention over the 'sep' mesh axis
-    (parity: PaddleNLP ring_flash_attention — SURVEY.md §5.7)."""
+    (parity: PaddleNLP ring_flash_attention — SURVEY.md §5.7).
+    ``balanced=True``: zigzag causal load balancing (inputs in zigzag
+    chunk order — see ``zigzag_split_sequence``)."""
     from ...distributed.fleet.meta_parallel.context_parallel import \
         ring_flash_attention as _ring
-    return _ring(query, key, value, causal=causal, seq_axis=seq_axis)
+    return _ring(query, key, value, causal=causal, seq_axis=seq_axis,
+                 balanced=balanced)
 
 
 def ulysses_attention(query, key, value, causal=False, seq_axis="sep",

@@ -160,3 +160,92 @@ def test_gpt_sep_with_mp_matches_serial():
     collective.set_mesh(None)
 
     np.testing.assert_allclose(l1, l2, rtol=5e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_zigzag_ring_attention_matches_reference(causal):
+    """Balanced (zigzag) ring attention == full attention exactly:
+    zigzag-split -> balanced ring -> zigzag-merge reproduces the
+    reference for both causal and bidirectional."""
+    _need_devices(8)
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel \
+        import (ring_flash_attention, zigzag_split_sequence,
+                zigzag_merge_sequence)
+    mesh = collective.build_mesh({"sep": 4, "dp": 2})
+    collective.set_mesh(mesh)
+    q, k, v = _rand_qkv()
+
+    def run(a, b_, c):
+        az = zigzag_split_sequence(a, mesh=mesh)
+        bz = zigzag_split_sequence(b_, mesh=mesh)
+        cz = zigzag_split_sequence(c, mesh=mesh)
+        oz = ring_flash_attention.raw(az, bz, cz, causal=causal,
+                                      mesh=mesh, balanced=True)
+        return zigzag_merge_sequence(oz, mesh=mesh)
+
+    out = jax.jit(run)(q, k, v)
+    ref = _ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_zigzag_ring_attention_gradients_match():
+    _need_devices(8)
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel \
+        import (ring_flash_attention, zigzag_split_sequence,
+                zigzag_merge_sequence)
+    mesh = collective.build_mesh({"sep": 4, "dp": 2})
+    collective.set_mesh(mesh)
+    q, k, v = _rand_qkv(s=16)
+
+    def loss_zz(a, b_, c):
+        az = zigzag_split_sequence(a, mesh=mesh)
+        bz = zigzag_split_sequence(b_, mesh=mesh)
+        cz = zigzag_split_sequence(c, mesh=mesh)
+        oz = ring_flash_attention.raw(az, bz, cz, causal=True,
+                                      mesh=mesh, balanced=True)
+        o = zigzag_merge_sequence(oz, mesh=mesh)
+        return (o * jnp.arange(o.size).reshape(o.shape)).sum()
+
+    def loss_ref(a, b_, c):
+        o = _ref(a, b_, c, True)
+        return (o * jnp.arange(o.size).reshape(o.shape)).sum()
+
+    gz = jax.jit(jax.grad(loss_zz, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for a, b_ in zip(gz, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=3e-3, atol=3e-3)
+
+
+def test_zigzag_split_merge_roundtrip_and_indices():
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel \
+        import zigzag_indices
+    idx = zigzag_indices(32, 4)           # 8 chunks of 4
+    # rank 0 gets chunks 0 and 7, rank 1 chunks 1 and 6, ...
+    assert list(idx[:8]) == [0, 1, 2, 3, 28, 29, 30, 31]
+    assert list(idx[8:16]) == [4, 5, 6, 7, 24, 25, 26, 27]
+    assert sorted(idx) == list(range(32))
+
+
+def test_zigzag_refuses_indivisible_seq():
+    _need_devices(8)
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel \
+        import ring_flash_attention
+    mesh = collective.build_mesh({"sep": 4, "dp": 2})
+    q, k, v = _rand_qkv(s=12)             # 12 % (2*4) != 0
+    with pytest.raises(ValueError, match="zigzag"):
+        ring_flash_attention.raw(q, k, v, causal=True, mesh=mesh,
+                                 balanced=True)
+
+
+def test_zigzag_split_refuses_indivisible_directly():
+    """The split utility itself must refuse (not silently truncate)
+    when 2*sep does not divide the sequence."""
+    _need_devices(8)
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel \
+        import zigzag_split_sequence
+    mesh = collective.build_mesh({"sep": 4, "dp": 2})
+    x = jnp.ones((2, 12, 4, 8), jnp.float32)      # 12 % 8 != 0
+    with pytest.raises(ValueError, match="zigzag"):
+        zigzag_split_sequence(x, mesh=mesh)
