@@ -253,10 +253,14 @@ def zigzag_split_sequence(x, seq_axis: str = "sep", dim: int = 1,
     the loss) — the layout then rides through every transformer layer,
     which is the upstream llama3-CP usage pattern.  Accepts a Tensor
     (tape-recorded) or a raw jax array (inside jit)."""
+    from ....tensor import Tensor
     n = _sep_degree(mesh or coll.get_mesh(), seq_axis)
     if n <= 1:
         return x
-    fn = _zigzag_split_prim if hasattr(x, "_value") \
+    # isinstance, not hasattr: concrete jax.Array also exposes a
+    # _value property, which would misroute raw eager arrays through
+    # the Tensor-wrapping primitive path
+    fn = _zigzag_split_prim if isinstance(x, Tensor) \
         else _zigzag_split_prim.raw
     return fn(x, n=n, dim=dim, seq_axis=seq_axis)
 
@@ -264,10 +268,11 @@ def zigzag_split_sequence(x, seq_axis: str = "sep", dim: int = 1,
 def zigzag_merge_sequence(x, seq_axis: str = "sep", dim: int = 1,
                           mesh=None):
     """Inverse of :func:`zigzag_split_sequence`."""
+    from ....tensor import Tensor
     n = _sep_degree(mesh or coll.get_mesh(), seq_axis)
     if n <= 1:
         return x
-    fn = _zigzag_merge_prim if hasattr(x, "_value") \
+    fn = _zigzag_merge_prim if isinstance(x, Tensor) \
         else _zigzag_merge_prim.raw
     return fn(x, n=n, dim=dim)
 
@@ -365,6 +370,12 @@ def ring_flash_attention(query, key, value, causal=False,
             raise ValueError(
                 f"balanced ring attention: 2*sep_degree = {2 * n} must "
                 f"divide seq len {query.shape[1]} (zigzag chunking)")
+        if not causal:
+            # no mask -> no imbalance to fix; the plain full-block ring
+            # computes the identical result on zigzag-ordered data
+            # without the quarter-block slicing overhead
+            return _cp_shard_map(_ring_attention_shard, query, key,
+                                 value, False, mesh_, seq_axis)
         return _cp_shard_map(_ring_attention_shard_zigzag, query, key,
                              value, causal, mesh_, seq_axis)
     return _ring_attention_impl(query, key, value, causal=causal,

@@ -60,3 +60,6 @@ def flatten(nested) -> list:
         else:
             out.append(cur)
     return out
+
+
+from . import cpp_extension  # noqa: E402

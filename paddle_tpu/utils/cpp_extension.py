@@ -1,0 +1,237 @@
+"""paddle.utils.cpp_extension — custom C++ operator extensions.
+
+Parity: upstream's custom-operator toolchain
+(``python/paddle/utils/cpp_extension/`` — ``load``/``setup`` compiling
+``PD_BUILD_OP`` sources into importable ops).  Upstream JIT-compiles
+C++/CUDA against libpaddle and registers kernels into the PHI registry.
+
+TPU-native stance: there is no device-side C++ ABI to compile against —
+device kernels are Pallas (``ops/pallas_ops.py`` is the template).
+What a C++ extension CAN add on TPU is a **host operator**: the
+compiled function runs on the host CPU and is stitched into compiled
+programs as an XLA host callback (``jax.pure_callback``), which is also
+how it stays usable eagerly and under ``@to_static``/jit.  Gradients
+are supported by supplying a second C symbol (upstream's backward-op
+analog) that becomes the op's custom VJP.
+
+C ABI (fixed for every op; all buffers are contiguous row-major):
+
+.. code-block:: c
+
+    // forward: read n_ins input buffers, write the output buffer
+    void op(const float** ins, const int64_t** shapes,
+            const int32_t* ndims, int32_t n_ins,
+            float* out, const int64_t* out_shape, int32_t out_ndim);
+
+    // backward (optional): inputs + upstream grad -> per-input grads
+    void op_grad(const float** ins, const int64_t** shapes,
+                 const int32_t* ndims, int32_t n_ins,
+                 const float* grad_out, const int64_t* gout_shape,
+                 int32_t gout_ndim, float** grad_ins);
+
+Usage::
+
+    mod = paddle.utils.cpp_extension.load(
+        name="my_ext", sources=["relu2.cc"])
+    relu2 = mod.def_op("relu2", grad_symbol="relu2_grad")
+    y = relu2(x)            # Tensor in/out, tape-recorded, jit-safe
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["load", "load_inline", "CppExtension"]
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+_I64P = ctypes.POINTER(ctypes.c_int64)
+_lock = threading.Lock()
+
+
+def _default_build_dir(name: str) -> str:
+    root = os.environ.get(
+        "PADDLE_TPU_EXTENSION_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache",
+                     "paddle_tpu_extensions"))
+    return os.path.join(root, name)
+
+
+def _compile(name: str, sources: Sequence[str],
+             extra_cxx_flags: Sequence[str],
+             build_directory: Optional[str], verbose: bool) -> str:
+    bdir = build_directory or _default_build_dir(name)
+    os.makedirs(bdir, exist_ok=True)
+    h = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(extra_cxx_flags).encode())
+    so = os.path.join(bdir, f"{name}_{h.hexdigest()[:16]}.so")
+    with _lock:
+        if not os.path.exists(so):
+            # compile to a tmp path and os.rename into place: rename is
+            # atomic on one filesystem, so a CONCURRENT PROCESS never
+            # dlopens a half-written .so (the exists-check is then a
+            # true commit point; the threading lock only covers threads)
+            tmp = f"{so}.tmp.{os.getpid()}"
+            cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+                   + list(extra_cxx_flags) + list(sources) + ["-o", tmp])
+            if verbose:
+                print("cpp_extension:", " ".join(cmd))
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise RuntimeError(
+                    f"cpp_extension build of {name!r} failed:\n"
+                    f"{proc.stderr[-4000:]}")
+            os.replace(tmp, so)
+    return so
+
+
+class CppExtension:
+    """A loaded extension library; ``def_op`` binds C symbols as ops."""
+
+    def __init__(self, name: str, so_path: str):
+        self.name = name
+        self.so_path = so_path
+        self._lib = ctypes.CDLL(so_path)
+
+    def _symbol(self, sym: str):
+        try:
+            return getattr(self._lib, sym)
+        except AttributeError:
+            raise AttributeError(
+                f"extension {self.name!r} has no symbol {sym!r}; "
+                "declare it extern \"C\"") from None
+
+    def def_op(self, symbol: str, grad_symbol: Optional[str] = None,
+               out_shape: Optional[Callable] = None,
+               dtype: str = "float32") -> Callable:
+        """Bind C symbol ``symbol`` as a framework op.
+
+        ``out_shape(*input_shapes) -> shape``: defaults to input 0's
+        shape.  ``grad_symbol``: optional backward symbol (see module
+        docstring ABI) enabling autograd through the op.
+        """
+        import jax
+        import jax.numpy as jnp
+        fwd_c = self._symbol(symbol)
+        fwd_c.restype = None
+        bwd_c = self._symbol(grad_symbol) if grad_symbol else None
+        if bwd_c is not None:
+            bwd_c.restype = None
+        np_dtype = np.dtype(dtype)
+        if np_dtype != np.float32:
+            raise NotImplementedError(
+                "cpp_extension v1 supports float32 buffers; cast at the "
+                "call site (the host callback would copy anyway)")
+        shape_fn = out_shape or (lambda *shapes: shapes[0])
+
+        def _marshal(arrays):
+            arrays = [np.ascontiguousarray(a, dtype=np.float32)
+                      for a in arrays]
+            n = len(arrays)
+            ins = (_F32P * n)(*[a.ctypes.data_as(_F32P) for a in arrays])
+            shp_arrs = [np.asarray(a.shape, dtype=np.int64)
+                        if a.ndim else np.zeros(1, np.int64)
+                        for a in arrays]
+            shapes = (_I64P * n)(*[s.ctypes.data_as(_I64P)
+                                   for s in shp_arrs])
+            ndims = (ctypes.c_int32 * n)(*[a.ndim for a in arrays])
+            return arrays, ins, shapes, ndims, shp_arrs
+
+        def host_fwd(*arrays):
+            arrays, ins, shapes, ndims, keep = _marshal(arrays)
+            oshape = tuple(int(d) for d in
+                           shape_fn(*[a.shape for a in arrays]))
+            out = np.zeros(oshape, np.float32)
+            oshp = np.asarray(oshape, dtype=np.int64) \
+                if out.ndim else np.zeros(1, np.int64)
+            fwd_c(ins, shapes, ndims, ctypes.c_int32(len(arrays)),
+                  out.ctypes.data_as(_F32P),
+                  oshp.ctypes.data_as(_I64P),
+                  ctypes.c_int32(out.ndim))
+            return out
+
+        def host_bwd(*arrays_and_g):
+            arrays, g = arrays_and_g[:-1], arrays_and_g[-1]
+            arrays, ins, shapes, ndims, keep = _marshal(arrays)
+            g = np.ascontiguousarray(g, dtype=np.float32)
+            gshp = np.asarray(g.shape, dtype=np.int64) \
+                if g.ndim else np.zeros(1, np.int64)
+            gouts = [np.zeros(a.shape, np.float32) for a in arrays]
+            gptr = (_F32P * len(arrays))(
+                *[go.ctypes.data_as(_F32P) for go in gouts])
+            bwd_c(ins, shapes, ndims, ctypes.c_int32(len(arrays)),
+                  g.ctypes.data_as(_F32P),
+                  gshp.ctypes.data_as(_I64P), ctypes.c_int32(g.ndim),
+                  gptr)
+            return tuple(gouts)
+
+        def raw_call(*vals):
+            oshape = tuple(int(d) for d in
+                           shape_fn(*[v.shape for v in vals]))
+            sd = jax.ShapeDtypeStruct(oshape, jnp.float32)
+            return jax.pure_callback(host_fwd, sd, *vals,
+                                     vmap_method="sequential")
+
+        if bwd_c is not None:
+            raw_vjp = jax.custom_vjp(raw_call)
+
+            def _f(*vals):
+                return raw_call(*vals), vals
+
+            def _b(res, g):
+                sds = tuple(jax.ShapeDtypeStruct(v.shape, jnp.float32)
+                            for v in res)
+                outs = jax.pure_callback(host_bwd, sds, *res, g,
+                                         vmap_method="sequential")
+                return tuple(outs)
+
+            raw_vjp.defvjp(_f, _b)
+            impl = raw_vjp
+        else:
+            impl = raw_call
+
+        from ..ops._primitive import primitive
+        op = primitive(impl, name=f"{self.name}.{symbol}")
+        op.__doc__ = (f"custom C++ host op {symbol!r} from "
+                      f"{self.so_path} (XLA host callback)")
+        return op
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_flags: Sequence[str] = (),
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> CppExtension:
+    """Compile ``sources`` with g++ and return the loaded extension
+    (upstream ``paddle.utils.cpp_extension.load`` shape).  Builds are
+    content-hash cached in ``build_directory``."""
+    if isinstance(sources, (str, os.PathLike)):
+        sources = [sources]
+    so = _compile(name, [os.fspath(s) for s in sources],
+                  list(extra_cxx_flags), build_directory, verbose)
+    return CppExtension(name, so)
+
+
+def load_inline(name: str, cpp_source: str,
+                extra_cxx_flags: Sequence[str] = (),
+                build_directory: Optional[str] = None,
+                verbose: bool = False) -> CppExtension:
+    """Like :func:`load` but takes the C++ source as a string."""
+    bdir = build_directory or _default_build_dir(name)
+    os.makedirs(bdir, exist_ok=True)
+    src = os.path.join(
+        bdir, f"{name}_{hashlib.sha256(cpp_source.encode()).hexdigest()[:16]}.cc")
+    if not os.path.exists(src):
+        with open(src, "w") as f:
+            f.write(cpp_source)
+    return load(name, [src], extra_cxx_flags, bdir, verbose)

@@ -172,7 +172,6 @@ def test_zigzag_ring_attention_matches_reference(causal):
         import (ring_flash_attention, zigzag_split_sequence,
                 zigzag_merge_sequence)
     mesh = collective.build_mesh({"sep": 4, "dp": 2})
-    collective.set_mesh(mesh)
     q, k, v = _rand_qkv()
 
     def run(a, b_, c):
@@ -195,7 +194,6 @@ def test_zigzag_ring_attention_gradients_match():
         import (ring_flash_attention, zigzag_split_sequence,
                 zigzag_merge_sequence)
     mesh = collective.build_mesh({"sep": 4, "dp": 2})
-    collective.set_mesh(mesh)
     q, k, v = _rand_qkv(s=16)
 
     def loss_zz(a, b_, c):
@@ -249,3 +247,23 @@ def test_zigzag_split_refuses_indivisible_directly():
     x = jnp.ones((2, 12, 4, 8), jnp.float32)      # 12 % 8 != 0
     with pytest.raises(ValueError, match="zigzag"):
         zigzag_split_sequence(x, mesh=mesh)
+
+
+def test_zigzag_utilities_preserve_raw_array_type():
+    """Eager raw jax arrays must come back as raw arrays (concrete
+    jax.Array also has a _value property — the dispatch must not
+    misroute it through the Tensor-wrapping primitive)."""
+    _need_devices(8)
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel \
+        import zigzag_split_sequence, zigzag_merge_sequence
+    from paddle_tpu.tensor import Tensor
+    mesh = collective.build_mesh({"sep": 4, "dp": 2})
+    x = jnp.arange(2 * 32 * 4 * 8, dtype=jnp.float32
+                   ).reshape(2, 32, 4, 8)
+    z = zigzag_split_sequence(x, mesh=mesh)          # eager, raw in
+    assert not isinstance(z, Tensor)
+    back = zigzag_merge_sequence(z, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(back), np.asarray(x))
+    # Tensor in -> Tensor out
+    zt = zigzag_split_sequence(Tensor(x), mesh=mesh)
+    assert isinstance(zt, Tensor)

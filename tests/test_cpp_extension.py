@@ -1,0 +1,146 @@
+"""paddle.utils.cpp_extension — custom C++ host operators compiled with
+g++ and stitched into XLA programs as host callbacks (upstream
+python/paddle/utils/cpp_extension/ custom-op toolchain, TPU-native
+design: host op = pure_callback; device kernels are Pallas)."""
+
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.utils import cpp_extension
+
+RELU2_SRC = textwrap.dedent("""
+    #include <cstdint>
+
+    extern "C" void relu2(const float** ins, const int64_t** shapes,
+                          const int32_t* ndims, int32_t n_ins,
+                          float* out, const int64_t* out_shape,
+                          int32_t out_ndim) {
+        int64_t n = 1;
+        for (int32_t i = 0; i < out_ndim; ++i) n *= out_shape[i];
+        const float* x = ins[0];
+        for (int64_t i = 0; i < n; ++i) {
+            float v = x[i] > 0.f ? x[i] : 0.f;
+            out[i] = v * v;
+        }
+    }
+
+    extern "C" void relu2_grad(const float** ins,
+                               const int64_t** shapes,
+                               const int32_t* ndims, int32_t n_ins,
+                               const float* grad_out,
+                               const int64_t* gout_shape,
+                               int32_t gout_ndim, float** grad_ins) {
+        int64_t n = 1;
+        for (int32_t i = 0; i < gout_ndim; ++i) n *= gout_shape[i];
+        const float* x = ins[0];
+        float* gx = grad_ins[0];
+        for (int64_t i = 0; i < n; ++i)
+            gx[i] = x[i] > 0.f ? 2.f * x[i] * grad_out[i] : 0.f;
+    }
+
+    extern "C" void pairwise_mul(const float** ins,
+                                 const int64_t** shapes,
+                                 const int32_t* ndims, int32_t n_ins,
+                                 float* out, const int64_t* out_shape,
+                                 int32_t out_ndim) {
+        int64_t n = 1;
+        for (int32_t i = 0; i < out_ndim; ++i) n *= out_shape[i];
+        for (int64_t i = 0; i < n; ++i) out[i] = ins[0][i] * ins[1][i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    bdir = str(tmp_path_factory.mktemp("ext"))
+    return cpp_extension.load_inline("testext", RELU2_SRC,
+                                     build_directory=bdir)
+
+
+def test_forward_eager_matches_numpy(ext):
+    relu2 = ext.def_op("relu2", grad_symbol="relu2_grad")
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32)
+    y = relu2(Tensor(x))
+    np.testing.assert_allclose(np.asarray(y.numpy()),
+                               np.maximum(x, 0) ** 2, rtol=1e-6)
+
+
+def test_backward_through_tape(ext):
+    relu2 = ext.def_op("relu2", grad_symbol="relu2_grad")
+    rng = np.random.RandomState(1)
+    x = Tensor(rng.randn(3, 3).astype(np.float32))
+    x.stop_gradient = False
+    y = relu2(x)
+    y.sum().backward()
+    xv = np.asarray(x.numpy())
+    expect = np.where(xv > 0, 2 * xv, 0.0)
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), expect,
+                               rtol=1e-6)
+
+
+def test_under_jit_and_to_static(ext):
+    import jax
+    relu2 = ext.def_op("relu2", grad_symbol="relu2_grad")
+
+    @paddle.jit.to_static
+    def f(a):
+        return relu2(a) + 1.0
+
+    rng = np.random.RandomState(2)
+    x = rng.randn(2, 8).astype(np.float32)
+    out = f(Tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.maximum(x, 0) ** 2 + 1.0, rtol=1e-6)
+    # grad under jax.jit through the custom vjp
+    g = jax.jit(jax.grad(lambda v: relu2.raw(v).sum()))(x)
+    np.testing.assert_allclose(np.asarray(g),
+                               np.where(x > 0, 2 * x, 0.0), rtol=1e-6)
+
+
+def test_multi_input_op(ext):
+    mul = ext.def_op("pairwise_mul")
+    rng = np.random.RandomState(3)
+    a = rng.randn(6).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    out = mul(Tensor(a), Tensor(b))
+    np.testing.assert_allclose(np.asarray(out.numpy()), a * b, rtol=1e-6)
+
+
+def test_build_cache_and_errors(ext, tmp_path):
+    # same source: cached .so reused (content-hash name exists once)
+    so1 = ext.so_path
+    ext2 = cpp_extension.load_inline("testext", RELU2_SRC,
+                                     build_directory=os.path.dirname(so1))
+    assert ext2.so_path == so1
+    # unknown symbol fails loudly
+    with pytest.raises(AttributeError, match="no symbol"):
+        ext.def_op("nope")
+    # broken source reports the compiler error
+    with pytest.raises(RuntimeError, match="build of"):
+        cpp_extension.load_inline("bad", "not c++ at all",
+                                  build_directory=str(tmp_path))
+
+
+def test_trains_inside_model_step(ext):
+    """The custom op participates in a real optimization loop."""
+    from paddle_tpu import nn, optimizer
+    relu2 = ext.def_op("relu2", grad_symbol="relu2_grad")
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    opt = optimizer.SGD(0.1, parameters=lin.parameters())
+    rng = np.random.RandomState(4)
+    x = Tensor(rng.rand(8, 4).astype(np.float32))
+    first = None
+    for _ in range(20):
+        loss = relu2(lin(x)).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < 0.5 * first
