@@ -20,6 +20,7 @@ from ...ops.nn_ops import (  # noqa
     pixel_unshuffle, channel_shuffle, temporal_shift, linear,
     square_error_cost, pairwise_distance, huber_loss, soft_margin_loss,
     poisson_nll_loss, gaussian_nll_loss, triplet_margin_loss,
+    multi_margin_loss, triplet_margin_with_distance_loss,
     multi_label_soft_margin_loss, ctc_loss, conv1d_transpose,
     conv3d_transpose, max_pool3d, avg_pool3d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool3d, bilinear, fold,

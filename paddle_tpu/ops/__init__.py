@@ -59,6 +59,11 @@ _METHOD_OPS = [
     "isfinite",
     # creation-ish
     "zeros_like", "ones_like", "full_like",
+    # round-5 widening
+    "sgn", "sinc", "gammaln", "digamma", "lgamma", "i0", "i1", "i0e",
+    "i1e", "positive", "isreal", "isneginf", "isposinf", "pdist",
+    "view_as", "slice_scatter", "select_scatter", "diagonal_scatter",
+    "hsplit", "vsplit", "dsplit",
 ]
 
 _g = globals()

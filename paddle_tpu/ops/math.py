@@ -742,3 +742,124 @@ def vander(x, n=None, increasing=False):
     if not increasing:
         pows = pows[::-1]
     return x[..., :, None] ** pows
+
+
+# -- round-5 widening: special functions & misc math (upstream
+#    python/paddle/tensor/math.py additions) ------------------------------
+
+@primitive
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@primitive
+def sgn(x):
+    """Complex-aware sign: x/|x| for complex, sign(x) for real
+    (upstream paddle.sgn)."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.where(
+            mag == 0, 1.0, mag))
+    return jnp.sign(x)
+
+
+@primitive
+def logaddexp2(x, y):
+    return jnp.logaddexp2(x, y)
+
+
+@primitive
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@primitive
+def gammainc(x, y):
+    """Regularized lower incomplete gamma P(x, y) (upstream arg order:
+    paddle.gammainc(x, y) = P(x, y))."""
+    return jax.scipy.special.gammainc(x, y)
+
+
+@primitive
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@primitive
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@primitive
+def multigammaln(x, p=1):
+    return jax.scipy.special.multigammaln(x, int(p))
+
+
+@primitive
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+@primitive
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+@primitive
+def positive(x):
+    return jnp.positive(x)
+
+
+@primitive
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+@primitive
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+@primitive
+def isreal(x):
+    return jnp.isreal(x)
+
+
+@primitive
+def pdist(x, p=2.0):
+    """Condensed pairwise distance of rows: [N, D] -> [N*(N-1)/2]
+    (upstream paddle.pdist)."""
+    n = x.shape[0]
+    diff = x[:, None, :] - x[None, :, :]
+    if p == 2.0:
+        d = jnp.sqrt(jnp.maximum(jnp.sum(diff * diff, axis=-1), 1e-24))
+    else:
+        d = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    iu = jnp.triu_indices(n, k=1)
+    return d[iu]
+
+
+@primitive
+def cartesian_prod(*xs):
+    """Cartesian product of 1-D tensors: [N1*...*Nk, k] (upstream
+    paddle.cartesian_prod)."""
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.reshape(-1) for g in grids], axis=-1) \
+        if len(xs) > 1 else xs[0].reshape(-1)
+
+
+@primitive(nondiff=(0,))
+def combinations(x, r=2, with_replacement=False):
+    """r-length combinations of a 1-D tensor's elements, [C, r]
+    (upstream paddle.combinations).  The index set is computed at trace
+    time (static length), the gather is compiled."""
+    import itertools
+    import numpy as np
+    n = x.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), int(r))
+          if with_replacement else itertools.combinations(range(n),
+                                                          int(r)))
+    idx = np.asarray(list(it), dtype=np.int32)
+    if idx.size == 0:
+        idx = idx.reshape(0, int(r))
+    return x[jnp.asarray(idx)]

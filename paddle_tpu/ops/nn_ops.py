@@ -1061,6 +1061,57 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
 
 
 @primitive
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    """Multi-class margin loss (upstream F.multi_margin_loss):
+    mean_j max(0, margin - x[y] + x[j])^p / C, j != y."""
+    n, c = input.shape
+    x_y = jnp.take_along_axis(input, label[:, None], axis=1)
+    loss = jnp.maximum(margin - x_y + input, 0.0) ** p
+    if weight is not None:
+        loss = loss * weight[label][:, None]
+    # the j == y term contributes margin^p; subtract it out
+    own = jnp.take_along_axis(loss, label[:, None], axis=1)
+    loss = (jnp.sum(loss, axis=1, keepdims=True) - own) / c
+    return _reduce_loss(loss[:, 0], reduction)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin=1.0, swap=False,
+                                      reduction="mean", name=None):
+    """Upstream F.triplet_margin_with_distance_loss: triplet loss under
+    a user distance callable (defaults to pairwise L2).  Python-level:
+    the callable composes recorded primitives, so autograd flows."""
+    from . import math as _m
+    from ..tensor import Tensor as _T
+
+    if distance_function is None:
+        # epsilon inside the norm (upstream pairwise_distance default):
+        # d(a, a) must have a finite gradient or identical anchor/
+        # positive samples NaN the whole training run
+        def distance_function(a, b):
+            d = (a - b) + 1e-6
+            return (d * d).sum(-1).sqrt() if isinstance(d, _T) \
+                else jnp.sqrt(jnp.sum(d * d, axis=-1))
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dn_alt = distance_function(positive, negative)
+        dn = _m.minimum(dn, dn_alt) if isinstance(dn, _T) \
+            else jnp.minimum(dn, dn_alt)
+    zero = 0.0
+    loss = (dp - dn + margin)
+    loss = loss.clip(min=zero) if isinstance(loss, _T) \
+        else jnp.maximum(loss, zero)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+@primitive
 def multi_label_soft_margin_loss(input, label, weight=None,
                                  reduction="mean"):
     loss = -(label * jax.nn.log_sigmoid(input)

@@ -232,6 +232,10 @@ def load_inline(name: str, cpp_source: str,
     src = os.path.join(
         bdir, f"{name}_{hashlib.sha256(cpp_source.encode()).hexdigest()[:16]}.cc")
     if not os.path.exists(src):
-        with open(src, "w") as f:
+        # atomic write (same discipline as _compile's .so rename): a
+        # concurrent process must never read a half-written source
+        tmp = f"{src}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(cpp_source)
+        os.replace(tmp, src)
     return load(name, [src], extra_cxx_flags, bdir, verbose)
