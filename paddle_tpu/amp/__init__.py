@@ -11,3 +11,4 @@ from .auto_cast import (  # noqa
     auto_cast, autocast, amp_guard, white_list, black_list)
 from .grad_scaler import GradScaler, AmpScaler  # noqa
 from .decorate import decorate, amp_decorate  # noqa
+from . import debugging  # noqa

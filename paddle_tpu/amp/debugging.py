@@ -1,0 +1,187 @@
+"""paddle.amp.debugging — mixed-precision numerics debugging.
+
+Parity: upstream ``python/paddle/amp/debugging.py``:
+``collect_operator_stats`` (per-op low/high-precision call counts),
+``check_numerics`` (explicit nan/inf probe), ``TensorCheckerConfig`` +
+``enable_tensor_checker`` (per-op automatic nan/inf scanning), and
+``compare_accuracy`` (diff two collected runs).
+
+TPU-native wiring: the op layer already funnels every primitive
+through one wrapper (``ops/_primitive.py``), so stats collection is a
+zero-copy observation hook on that choke point (dtype of each input
+AFTER amp casting — i.e. the dtype the MXU actually computes in), and
+the tensor checker maps onto the framework's ``FLAGS_check_nan_inf``
+per-op scan.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ..ops import _primitive
+from .. import flags as _flags
+
+__all__ = [
+    "collect_operator_stats", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "check_numerics",
+    "TensorCheckerConfig", "enable_tensor_checker",
+    "disable_tensor_checker", "compare_accuracy",
+]
+
+_BUCKETS = ("FP16", "BF16", "FP32", "OTHER")
+_ORDER = {"FP16": 0, "BF16": 1, "FP32": 2, "OTHER": 3}
+_stats: Optional[Dict[str, Dict[str, int]]] = None
+
+
+def _bucket(dtype) -> str:
+    if dtype == jnp.float16:
+        return "FP16"
+    if dtype == jnp.bfloat16:
+        return "BF16"
+    if dtype == jnp.float32:
+        return "FP32"
+    return "OTHER"
+
+
+def _observe(opname: str, vals):
+    rec = _stats.setdefault(opname,
+                            {b: 0 for b in _BUCKETS})
+    seen = None
+    for v in vals:
+        dt = getattr(v, "dtype", None)
+        if dt is None:
+            continue
+        b = _bucket(dt)
+        # bucket the CALL by its lowest-precision float input
+        # (upstream counts calls per op per dtype)
+        if seen is None or _ORDER[b] < _ORDER[seen]:
+            seen = b
+    rec[seen or "OTHER"] += 1
+
+
+def enable_operator_stats_collection() -> None:
+    """Start counting op calls per compute dtype (upstream
+    enable_operator_stats_collection).
+
+    Counts are PYTHON-DISPATCH counts: a ``@to_static``/jit-compiled
+    region contributes its ops once per TRACE (zero on compile-cache
+    hits), so collect around eager runs — the dtype MIX is the signal
+    either way."""
+    global _stats
+    if _stats is not None:
+        raise RuntimeError(
+            "operator stats collection is already enabled; nested "
+            "collect_operator_stats would silently discard the outer "
+            "scope's counts")
+    _stats = {}
+    _primitive.set_stats_hook(_observe)
+
+
+def disable_operator_stats_collection() -> Dict[str, Dict[str, int]]:
+    """Stop collecting, PRINT the summary table (upstream behavior),
+    and also return the raw stats dict for programmatic use."""
+    global _stats
+    _primitive.set_stats_hook(None)
+    out = _stats or {}
+    _stats = None
+    _print_table(out)
+    return out
+
+
+def _print_table(stats: Dict[str, Dict[str, int]]) -> None:
+    print("<------------------------------ op list "
+          "------------------------------->")
+    hdr = f"{'op':<28}" + "".join(f"{b:>8}" for b in _BUCKETS)
+    print(hdr)
+    for op in sorted(stats):
+        row = stats[op]
+        print(f"{op:<28}" + "".join(f"{row[b]:>8}" for b in _BUCKETS))
+    print("<----------------------------------- end "
+          "----------------------------->")
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """Context form: prints the op/dtype table on exit."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=None):
+    """Explicit nan/inf probe (upstream paddle.amp.debugging.
+    check_numerics): raises on non-finite values with op/var context;
+    returns (num_nan, num_inf) tensors like upstream."""
+    from ..tensor import Tensor
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(v.dtype, jnp.inexact):
+        z = jnp.zeros((), jnp.int64)
+        return Tensor(z), Tensor(z)
+    num_nan = jnp.sum(jnp.isnan(v)).astype(jnp.int64)
+    num_inf = jnp.sum(jnp.isinf(v)).astype(jnp.int64)
+    import jax
+    if not isinstance(v, jax.core.Tracer):
+        n_nan, n_inf = int(num_nan), int(num_inf)
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"check_numerics: op={op_type!r} var={var_name!r} has "
+                f"{n_nan} NaN and {n_inf} Inf values "
+                f"(shape {tuple(v.shape)}, dtype {v.dtype})")
+    return Tensor(num_nan), Tensor(num_inf)
+
+
+class TensorCheckerConfig:
+    """Upstream TensorCheckerConfig reduced to its load-bearing knob:
+    enable (per-op nan/inf scanning).  ``debug_mode``/``output_dir``
+    accepted for script compat."""
+
+    def __init__(self, enable: bool = True, debug_mode=None,
+                 output_dir: Optional[str] = None, **kwargs):
+        self.enable = bool(enable)
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def enable_tensor_checker(config: TensorCheckerConfig) -> None:
+    """Per-op automatic nan/inf scan — maps onto FLAGS_check_nan_inf
+    (the same per-primitive scan upstream's checker hooks provide)."""
+    _flags.set_flags({"FLAGS_check_nan_inf": bool(config.enable)})
+
+
+def disable_tensor_checker() -> None:
+    _flags.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def compare_accuracy(run_a, run_b, output_filename: Optional[str] = None,
+                     atol: int = 0) -> Dict[str, Dict]:
+    """Diff two operator-stats collections (upstream compare_accuracy
+    diffs two run dumps).  ``run_a``/``run_b``: dicts returned by
+    ``disable_operator_stats_collection`` or paths to JSON dumps of
+    them.  Returns {op: {"a": counts, "b": counts}} for ops whose
+    dtype mix differs by more than ``atol`` calls; optionally writes
+    the report as JSON."""
+    def _load(r):
+        if isinstance(r, str):
+            with open(r) as f:
+                return json.load(f)
+        return r
+
+    a, b = _load(run_a), _load(run_b)
+    diff = {}
+    for op in sorted(set(a) | set(b)):
+        ra = a.get(op, {k: 0 for k in _BUCKETS})
+        rb = b.get(op, {k: 0 for k in _BUCKETS})
+        if any(abs(ra.get(k, 0) - rb.get(k, 0)) > atol
+               for k in _BUCKETS):
+            diff[op] = {"a": ra, "b": rb}
+    if output_filename:
+        with open(output_filename, "w") as f:
+            json.dump(diff, f, indent=1, sort_keys=True)
+    return diff

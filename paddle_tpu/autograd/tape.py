@@ -128,6 +128,17 @@ def _ones_like(val):
     return jnp.ones_like(val)
 
 
+def _ct_like(ct, out_tensor):
+    """Cast a cotangent to its primal output's dtype (amp O1 mixes
+    float dtypes across consumer boundaries — the grad-dtype
+    unification every branch of the walk must apply)."""
+    want = out_tensor._value.dtype
+    if getattr(ct, "dtype", want) != want and hasattr(ct, "astype") \
+            and jnp.issubdtype(want, jnp.inexact):
+        return ct.astype(want)
+    return ct
+
+
 def _node_vjp(node, cts):
     """VJP one tape node given the cotangent accumulator.
 
@@ -141,8 +152,8 @@ def _node_vjp(node, cts):
         full = [cts.get(id(o)) for o in node.outputs]
         if all(c is None for c in full):
             return None
-        full = [jnp.zeros_like(o._value) if c is None else c
-                for o, c in zip(node.outputs, full)]
+        full = [jnp.zeros_like(o._value) if c is None
+                else _ct_like(c, o) for o, c in zip(node.outputs, full)]
         return _pylayer_vjp(node, full)
     eager_vjp = getattr(node.fn, "_eager_vjp", None)
     if eager_vjp is not None:
@@ -151,7 +162,8 @@ def _node_vjp(node, cts):
         out_cts = [cts.get(id(o)) for o in node.outputs]
         if all(c is None for c in out_cts):
             return None
-        out_cts = [jnp.zeros_like(o._value) if c is None else c
+        out_cts = [jnp.zeros_like(o._value) if c is None
+                   else _ct_like(c, o)
                    for o, c in zip(node.outputs, out_cts)]
         return eager_vjp(node, out_cts)
     out_idx = [j for j, o in enumerate(node.outputs)
@@ -161,7 +173,9 @@ def _node_vjp(node, cts):
     out_cts = [cts.get(id(node.outputs[j])) for j in out_idx]
     if all(c is None for c in out_cts):
         return None
-    out_cts = [jnp.zeros_like(node.outputs[j]._value) if c is None else c
+    # jax.vjp requires ct dtype == primal output dtype (see _ct_like)
+    out_cts = [jnp.zeros_like(node.outputs[j]._value) if c is None
+               else _ct_like(c, node.outputs[j])
                for j, c in zip(out_idx, out_cts)]
     diff_vals = [node.arg_vals[i] for i in node.diff_idx]
 

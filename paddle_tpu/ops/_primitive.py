@@ -38,10 +38,17 @@ _amp_hook: Optional[Callable] = None
 # static.record_op so every op call is captured into the current
 # Program (SURVEY.md §3.5 — the trace-recorder static world).
 _static_hook: list = [None]
+# observation-only hook: (opname, vals) AFTER amp casting — used by
+# paddle.amp.debugging operator-stats collection; must not mutate
+_stats_hook: list = [None]
 
 
 def set_static_hook(hook: Optional[Callable]) -> None:
     _static_hook[0] = hook
+
+
+def set_stats_hook(hook: Optional[Callable]) -> None:
+    _stats_hook[0] = hook
 
 
 def set_amp_hook(hook: Optional[Callable]) -> None:
@@ -88,6 +95,8 @@ def primitive(fn=None, *, name: Optional[str] = None,
                     vals.append(a)
             if _amp_hook is not None:
                 vals = _amp_hook(opname, vals)
+            if _stats_hook[0] is not None:
+                _stats_hook[0](opname, vals)
             out_vals = f(*vals, **kwargs)
             multi = isinstance(out_vals, tuple)
             outs = tuple(_wrap_out(v)

@@ -1,0 +1,107 @@
+"""paddle.amp.debugging (upstream python/paddle/amp/debugging.py):
+operator stats collection, check_numerics, tensor checker,
+compare_accuracy."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import amp, nn
+from paddle_tpu.amp import debugging
+from paddle_tpu.tensor import Tensor
+
+
+def test_operator_stats_collection_counts_amp_dtypes(capsys):
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    x = Tensor(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+    with debugging.collect_operator_stats():
+        with amp.auto_cast(level="O1", dtype="bfloat16"):
+            _ = net(x)
+        _ = x + x                        # fp32, outside autocast
+    outp = capsys.readouterr().out
+    assert "op list" in outp and "linear" in outp
+    # programmatic form
+    debugging.enable_operator_stats_collection()
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        _ = net(x)
+    stats = debugging.disable_operator_stats_collection()
+    capsys.readouterr()
+    assert stats["linear"]["BF16"] >= 1      # autocast computed in bf16
+    assert sum(stats["linear"].values()) == stats["linear"]["BF16"]
+
+
+def test_check_numerics_raises_with_context():
+    bad = Tensor(np.array([1.0, np.nan, np.inf], np.float32))
+    with pytest.raises(FloatingPointError, match="my_op.*act"):
+        debugging.check_numerics(bad, op_type="my_op", var_name="act")
+    ok = Tensor(np.ones(3, np.float32))
+    n_nan, n_inf = debugging.check_numerics(ok)
+    assert int(n_nan.numpy()) == 0 and int(n_inf.numpy()) == 0
+
+
+def test_tensor_checker_flags_roundtrip():
+    cfg = debugging.TensorCheckerConfig(enable=True)
+    debugging.enable_tensor_checker(cfg)
+    try:
+        assert paddle.get_flags(["FLAGS_check_nan_inf"])[
+            "FLAGS_check_nan_inf"]
+        # the per-op scan actually fires
+        bad = Tensor(np.array([np.inf], np.float32))
+        with pytest.raises(FloatingPointError):
+            _ = bad + 1.0
+    finally:
+        debugging.disable_tensor_checker()
+    assert not paddle.get_flags(["FLAGS_check_nan_inf"])[
+        "FLAGS_check_nan_inf"]
+
+
+def test_compare_accuracy_diffs_runs(tmp_path):
+    a = {"matmul": {"FP16": 0, "BF16": 5, "FP32": 0, "OTHER": 0},
+         "add": {"FP16": 0, "BF16": 0, "FP32": 3, "OTHER": 0}}
+    b = {"matmul": {"FP16": 0, "BF16": 0, "FP32": 5, "OTHER": 0},
+         "add": {"FP16": 0, "BF16": 0, "FP32": 3, "OTHER": 0}}
+    out = str(tmp_path / "diff.json")
+    diff = debugging.compare_accuracy(a, b, output_filename=out)
+    assert "matmul" in diff and "add" not in diff
+    import json
+    assert json.load(open(out))["matmul"]["b"]["FP32"] == 5
+
+
+def test_nested_collection_refuses():
+    debugging.enable_operator_stats_collection()
+    try:
+        with pytest.raises(RuntimeError, match="already enabled"):
+            debugging.enable_operator_stats_collection()
+    finally:
+        debugging.disable_operator_stats_collection()
+
+
+def test_o1_backward_through_pylayer_boundary():
+    """The ct-dtype cast must cover the PyLayer branch of the tape walk
+    too (review finding: O1 crossing a PyLayer instead of a plain
+    primitive)."""
+    import numpy as np
+    from paddle_tpu.autograd import PyLayer
+    from paddle_tpu import amp
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2.0
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2.0
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = Tensor(np.random.RandomState(0).rand(3, 4).astype(np.float32))
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        h = lin(x)                       # bf16 out
+        h2 = Double.apply(h)             # PyLayer over bf16
+        loss = (h2.astype("float32") ** 2).mean()   # fp32 consumer
+    loss.backward()
+    g = lin.weight.grad
+    assert g is not None
+    assert np.isfinite(np.asarray(g.numpy())).all()

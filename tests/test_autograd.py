@@ -208,3 +208,25 @@ def test_register_hook_fires_in_paddle_grad():
     gx, = paddle.grad(y, [x])
     # dy/dh = 2h = 16 -> hooked to 1 -> dx = 1*4
     np.testing.assert_allclose(gx.numpy(), [4.0], rtol=1e-6)
+
+
+def test_eager_backward_through_o1_mixed_dtype_boundary():
+    """O1 autocast: a bf16 activation consumed by an fp32-blacklisted
+    op accumulates an fp32 cotangent; the tape walk must cast it back
+    to the producer's output dtype (regression: jax.vjp rejects the
+    mismatched ct with 'unexpected JAX type')."""
+    import numpy as np
+    from paddle_tpu import amp, nn
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.rand(16, 8).astype(np.float32))
+    y = Tensor(rng.randint(0, 4, (16,)).astype(np.int64))
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        loss = nn.CrossEntropyLoss()(net(x), y)
+    loss.backward()
+    g = net[0].weight.grad
+    assert g is not None
+    assert np.isfinite(np.asarray(g.numpy())).all()
