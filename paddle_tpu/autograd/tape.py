@@ -312,12 +312,129 @@ def _add_grad(t, ct) -> None:
         t.grad = Tensor(t.grad._value + ct, stop_gradient=True)
 
 
+def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
+    """``paddle.grad(create_graph=True)``: higher-order path.
+
+    The eager walk computes grad VALUES but leaves no producing nodes
+    on the tape, so a second ``paddle.grad`` would see them as unused.
+    Here the input→output subgraph is REPLAYED as one pure function,
+    its vjp is taken with ``jax.vjp``, and the whole computation is
+    recorded back onto the tape as a closure op over ``inputs`` — the
+    returned grads are then themselves differentiable (upstream
+    double-grad semantics; SURVEY.md §4 autograd tests row).
+    """
+    from ..tensor import Tensor
+    from ..ops._primitive import apply_closure
+
+    nodes = list(_tape)
+    in_ids = [id(t) for t in inputs]
+    out_ids = [id(t) for t in outputs]
+
+    # forward-reachable from inputs, then backward-reachable to outputs
+    dep = set(in_ids)
+    sub = []
+    for node in nodes:
+        if any(isinstance(a, Tensor) and id(a) in dep for a in node.args):
+            sub.append(node)
+            dep.update(id(o) for o in node.outputs)
+    need = set(out_ids)
+    keep = []
+    for node in reversed(sub):
+        if any(id(o) in need for o in node.outputs):
+            keep.append(node)
+            need.update(id(a) for a in node.args
+                        if isinstance(a, Tensor))
+    keep.reverse()
+    for node in keep:
+        if "__pylayer__" in node.kwargs:
+            raise NotImplementedError(
+                "paddle.grad(create_graph=True) through a PyLayer is "
+                "not supported; express the custom backward with "
+                "jax-differentiable ops or take the outer grad with "
+                "paddle.incubate.autograd functional transforms")
+
+    unused = [i for i, t in enumerate(inputs) if id(t) not in need
+              and id(t) not in out_ids]
+    if unused and not allow_unused:
+        raise RuntimeError(
+            "One of the differentiated tensors appears unused; "
+            "pass allow_unused=True to return None for it.")
+
+    seeds = tuple(
+        _ct_like(_ones_like(t._value) if g is None else (
+            g._value if hasattr(g, "_value") else jnp.asarray(g)), t)
+        for t, g in zip(outputs, grad_outputs))
+
+    # the env is id-keyed, so duplicate `inputs` entries must collapse
+    # to ONE closure argument — each duplicate position then receives
+    # the full gradient (matching the eager path's per-position reads)
+    uniq_inputs, uniq_ids, pos_to_uniq = [], [], []
+    for t in inputs:
+        if id(t) not in uniq_ids:
+            uniq_ids.append(id(t))
+            uniq_inputs.append(t)
+        pos_to_uniq.append(uniq_ids.index(id(t)))
+
+    # every required-grad LEAF the subgraph reads (parameters, other
+    # tape-external tensors) must be a differentiable argument of the
+    # recorded closure, not a baked-in constant — otherwise the outer
+    # backward of the returned grads cannot reach them
+    # (d(grad-penalty)/dθ).  Tensors PRODUCED by kept nodes are
+    # recomputed inside the replay and never read from env — keeping
+    # them out avoids dead closure arguments.
+    produced = {id(o) for node in keep for o in node.outputs}
+    extra, seen = [], set(uniq_ids)
+    for node in keep:
+        for a in node.args:
+            if (isinstance(a, Tensor) and not a.stop_gradient
+                    and id(a) not in seen and id(a) not in produced):
+                seen.add(id(a))
+                extra.append(a)
+    all_diff = uniq_inputs + extra
+    n_in = len(uniq_inputs)
+
+    def f(*vals):
+        env = {id(t): v for t, v in zip(all_diff, vals)}
+        for node in keep:
+            nvals = []
+            for a, rec in zip(node.args, node.arg_vals):
+                if isinstance(a, Tensor) and id(a) in env:
+                    v = env[id(a)]
+                    # recorded arg_vals may be amp-cast copies
+                    if getattr(v, "dtype", None) is not None and \
+                            getattr(rec, "dtype", None) is not None \
+                            and v.dtype != rec.dtype:
+                        v = v.astype(rec.dtype)
+                    nvals.append(v)
+                else:
+                    nvals.append(rec)
+            outs = node.fn(*nvals, **node.kwargs)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for o, ov in zip(node.outputs, outs):
+                env[id(o)] = ov
+        return tuple(env.get(oid, t._value)
+                     for oid, t in zip(out_ids, outputs))
+
+    def g(*vals):
+        rest = vals[n_in:]
+        _, vjp_fn = jax.vjp(
+            lambda *iv: f(*iv, *rest), *vals[:n_in])
+        return vjp_fn(seeds)
+
+    outs = apply_closure(g, all_diff, name="grad")
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    return [None if i in unused else outs[pos_to_uniq[i]]
+            for i in range(len(inputs))]
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
          create_graph=False, only_inputs=True, allow_unused=False,
          no_grad_vars=None):
     """``paddle.grad`` — returns grads of ``outputs`` w.r.t. ``inputs``
     without touching ``.grad`` slots.  Implemented by running the normal
-    tape walk into a private accumulator."""
+    tape walk into a private accumulator; ``create_graph=True`` instead
+    replays the subgraph under ``jax.vjp`` and records the grads as
+    tape outputs so they are differentiable again (double grad)."""
     from ..tensor import Tensor
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
@@ -327,6 +444,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         grad_outputs = [None] * len(outputs)
     elif not isinstance(grad_outputs, (list, tuple)):
         grad_outputs = [grad_outputs]
+
+    if create_graph:
+        return _grad_create_graph(outputs, inputs, grad_outputs,
+                                  allow_unused)
 
     cts: Dict[int, Any] = {}
     for t, g in zip(outputs, grad_outputs):

@@ -230,3 +230,92 @@ def test_eager_backward_through_o1_mixed_dtype_boundary():
     g = net[0].weight.grad
     assert g is not None
     assert np.isfinite(np.asarray(g.numpy())).all()
+
+
+def test_double_and_triple_grad_create_graph():
+    """paddle.grad(create_graph=True) records the grads on the tape so
+    they differentiate again (upstream double-grad; x^3 derivatives)."""
+    import numpy as np
+    from paddle_tpu.tensor import Tensor
+
+    x = Tensor(np.array(2.0, np.float32))
+    x.stop_gradient = False
+    y = x * x * x
+    (g,) = paddle.grad([y], [x], create_graph=True)
+    (gg,) = paddle.grad([g], [x], create_graph=True)
+    (ggg,) = paddle.grad([gg], [x])
+    assert float(g.numpy()) == 12.0
+    assert float(gg.numpy()) == 12.0
+    assert float(ggg.numpy()) == 6.0
+
+
+def test_gradient_penalty_flows_into_parameters():
+    """WGAN-GP pattern: loss built from input-grads must propagate
+    second-order gradients into the PARAMETERS (they are closure
+    arguments, not baked constants)."""
+    import numpy as np
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 1))
+    opt = optimizer.Adam(1e-2, parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    first = None
+    for _ in range(60):
+        xb = Tensor(rng.rand(16, 4).astype(np.float32))
+        xb.stop_gradient = False
+        (gx,) = paddle.grad([net(xb).sum()], [xb], create_graph=True)
+        loss = ((((gx ** 2).sum(1)).sqrt() - 1.0) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        first = first if first is not None else float(loss.numpy())
+    assert float(loss.numpy()) < 0.1 * first
+
+
+def test_create_graph_unused_input_contract():
+    import numpy as np
+    import pytest
+    from paddle_tpu.tensor import Tensor
+
+    x = Tensor(np.array(2.0, np.float32)); x.stop_gradient = False
+    z = Tensor(np.array(3.0, np.float32)); z.stop_gradient = False
+    y = x * x
+    with pytest.raises(RuntimeError, match="unused"):
+        paddle.grad([y], [x, z], create_graph=True)
+    gx, gz = paddle.grad([y], [x, z], create_graph=True,
+                         allow_unused=True)
+    assert gz is None and float(gx.numpy()) == 4.0
+
+
+def test_create_graph_duplicate_inputs_get_full_grad():
+    """paddle.grad([y], [x, x], create_graph=True) must return the full
+    gradient at BOTH positions (eager-path parity)."""
+    import numpy as np
+    from paddle_tpu.tensor import Tensor
+
+    x = Tensor(np.array(3.0, np.float32))
+    x.stop_gradient = False
+    y = x * x
+    g1, g2 = paddle.grad([y], [x, x], create_graph=True)
+    assert float(g1.numpy()) == 6.0 and float(g2.numpy()) == 6.0
+
+
+def test_create_graph_o1_seed_dtype():
+    """fp32 grad_outputs seed against a bf16 O1 output must be cast,
+    not rejected (same contract as the eager walk's _ct_like)."""
+    import numpy as np
+    from paddle_tpu import amp, nn
+    from paddle_tpu.tensor import Tensor
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = Tensor(np.random.RandomState(0).rand(2, 4).astype(np.float32))
+    x.stop_gradient = False
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        out = lin(x)                     # bf16 output
+    seed = Tensor(np.ones((2, 4), np.float32))
+    (g,) = paddle.grad([out], [x], grad_outputs=[seed],
+                       create_graph=True)
+    assert np.isfinite(np.asarray(g.numpy())).all()
