@@ -360,10 +360,16 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
             "One of the differentiated tensors appears unused; "
             "pass allow_unused=True to return None for it.")
 
-    seeds = tuple(
-        _ct_like(_ones_like(t._value) if g is None else (
-            g._value if hasattr(g, "_value") else jnp.asarray(g)), t)
-        for t, g in zip(outputs, grad_outputs))
+    for node in keep:
+        for t in list(node.outputs) + [a for a in node.args
+                                       if isinstance(a, Tensor)]:
+            if _has_hooks(t):
+                raise NotImplementedError(
+                    "paddle.grad(create_graph=True) does not run "
+                    "Tensor.register_hook hooks (the subgraph is "
+                    "replayed under jax.vjp, outside the eager walk "
+                    "that fires them); remove the hook or use "
+                    "create_graph=False")
 
     # the env is id-keyed, so duplicate `inputs` entries must collapse
     # to ONE closure argument — each duplicate position then receives
@@ -390,8 +396,23 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
                     and id(a) not in seen and id(a) not in produced):
                 seen.add(id(a))
                 extra.append(a)
+    # grad_outputs that are required-grad Tensors are part of the graph
+    # (g = seed * dy/dx): they must be closure arguments too, or the
+    # outer backward misses the d(seed)/d(...) * dy/dx term
+    for go in grad_outputs:
+        if isinstance(go, Tensor) and not go.stop_gradient:
+            if id(go) in produced:
+                raise NotImplementedError(
+                    "paddle.grad(create_graph=True): a grad_outputs "
+                    "tensor produced INSIDE the differentiated "
+                    "subgraph would need its dependence replayed "
+                    "jointly; detach it or restructure the objective")
+            if id(go) not in seen:
+                seen.add(id(go))
+                extra.append(go)
     all_diff = uniq_inputs + extra
     n_in = len(uniq_inputs)
+    id_to_slot = {id(t): j for j, t in enumerate(all_diff)}
 
     def f(*vals):
         env = {id(t): v for t, v in zip(all_diff, vals)}
@@ -417,9 +438,19 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
 
     def g(*vals):
         rest = vals[n_in:]
+        seeds = []
+        for t, go in zip(outputs, grad_outputs):
+            if isinstance(go, Tensor) and id(go) in id_to_slot:
+                sv = vals[id_to_slot[id(go)]]
+            elif go is None:
+                sv = _ones_like(t._value)
+            else:
+                sv = go._value if hasattr(go, "_value") \
+                    else jnp.asarray(go)
+            seeds.append(_ct_like(sv, t))
         _, vjp_fn = jax.vjp(
             lambda *iv: f(*iv, *rest), *vals[:n_in])
-        return vjp_fn(seeds)
+        return vjp_fn(tuple(seeds))
 
     outs = apply_closure(g, all_diff, name="grad")
     outs = outs if isinstance(outs, tuple) else (outs,)

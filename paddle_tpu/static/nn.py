@@ -106,3 +106,146 @@ def prelu(x, mode="all", param_attr=None, name=None):
     num = 1 if mode == "all" else int(x.shape[1])
     layer = _nn.PReLU(num_parameters=num, weight_attr=param_attr)
     return layer(x)
+
+
+# -- structured control flow (upstream paddle.static.nn.cond /
+#    while_loop / case / switch_case, python/paddle/static/nn/
+#    control_flow.py).  Dual-mode like the rest of the framework:
+#    concrete predicates run the chosen branch eagerly (tape-recorded,
+#    differentiable); traced predicates lower to lax.cond/while_loop
+#    (the XLA structured-control-flow contract — both branches traced,
+#    matching output structures required). -------------------------------
+
+def _is_traced(v) -> bool:
+    from ..jit.dy2static import is_traced
+    return is_traced(v)
+
+
+def _unwrap_tree(o):
+    from ..jit.dy2static import _unwrap
+    if isinstance(o, (list, tuple)):
+        return type(o)(_unwrap_tree(v) for v in o)
+    return _unwrap(o)
+
+
+def _wrap_tree(o):
+    from ..jit.dy2static import _wrap
+    if isinstance(o, (list, tuple)):
+        return type(o)(_wrap_tree(v) for v in o)
+    return _wrap(o)
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Run ``true_fn()`` or ``false_fn()`` by ``pred``.  Traced pred →
+    ``lax.cond`` (both branches compiled; outputs must match in
+    shape/dtype/structure)."""
+    import jax
+    from ..tensor import Tensor
+
+    pv = pred._value if isinstance(pred, Tensor) else pred
+    if not _is_traced(pred):
+        chosen = true_fn if bool(pv) else false_fn
+        return chosen() if chosen is not None else None
+
+    def _branch(fn):
+        def run(_):
+            return _unwrap_tree(fn() if fn is not None else ())
+        return run
+
+    out = jax.lax.cond(pv.astype(bool).reshape(()),
+                       _branch(true_fn), _branch(false_fn), 0)
+    return _wrap_tree(out)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """``while cond(*vars): vars = body(*vars)``.  Concrete initial
+    condition → Python loop (differentiable through the tape); traced →
+    ``lax.while_loop`` over the carried values."""
+    import jax
+    from ..tensor import Tensor
+
+    if not isinstance(loop_vars, (list, tuple)):
+        raise TypeError("loop_vars must be a list/tuple of Tensors")
+    loop_vars = list(loop_vars)
+
+    traced = any(_is_traced(v) for v in loop_vars)
+    if not traced:
+        # Python loop while everything stays concrete; if the body
+        # injects a traced value into the carry (closure over a jit
+        # arg), hand the REMAINING iterations to lax.while_loop seeded
+        # with the current vars (dy2static's re-probing dispatch)
+        while True:
+            r = cond(*loop_vars)
+            if _is_traced(r) or any(_is_traced(v) for v in loop_vars):
+                traced = True
+                break
+            if not bool(r._value if isinstance(r, Tensor) else r):
+                return loop_vars
+            out = body(*loop_vars)
+            loop_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+
+    def c(vals):
+        r = cond(*_wrap_tree(tuple(vals)))
+        r = r._value if isinstance(r, Tensor) else r
+        return r.astype(bool).reshape(())
+
+    def b(vals):
+        out = body(*_wrap_tree(tuple(vals)))
+        out = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(_unwrap_tree(tuple(out)))
+
+    init = tuple(_unwrap_tree(tuple(loop_vars)))
+    final = jax.lax.while_loop(c, b, init)
+    return list(_wrap_tree(tuple(final)))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is true wins (upstream case): nested
+    conds, so it compiles under tracing too."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    (pred, fn), rest = pred_fn_pairs[0], pred_fn_pairs[1:]
+    if not rest and default is None:
+        # upstream: last fn is the fallback when no default given
+        return cond(pred, fn, fn)
+    tail = (lambda: case(rest, default)) if rest else default
+    return cond(pred, fn, tail)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (upstream switch_case).  Traced
+    index → ``lax.switch``."""
+    import jax
+    from ..tensor import Tensor
+
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [int(k) for k, _ in items]
+    fns = [f for _, f in items]
+    iv = branch_index._value if isinstance(branch_index, Tensor) \
+        else branch_index
+    if not _is_traced(branch_index):
+        k = int(iv)
+        if k in keys:
+            return fns[keys.index(k)]()
+        if default is None:
+            raise ValueError(
+                f"switch_case: index {k} not in branches {keys} and no "
+                "default given")
+        return default()
+    if default is None:
+        default = fns[-1]
+    # lax.switch needs dense 0..N-1: map key -> slot, unknown -> default
+    import jax.numpy as jnp
+    slot = jnp.full((), len(fns), jnp.int32)
+    for i, k in enumerate(keys):
+        slot = jnp.where(iv == k, i, slot)
+
+    def _b(fn):
+        return lambda _: _unwrap_tree(fn())
+
+    out = jax.lax.switch(slot, [_b(f) for f in fns] + [_b(default)], 0)
+    return _wrap_tree(out)

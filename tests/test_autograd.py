@@ -319,3 +319,32 @@ def test_create_graph_o1_seed_dtype():
     (g,) = paddle.grad([out], [x], grad_outputs=[seed],
                        create_graph=True)
     assert np.isfinite(np.asarray(g.numpy())).all()
+
+
+def test_create_graph_grad_outputs_coupling():
+    """grad_outputs that require grad are part of the double-grad graph:
+    g = v * dy/dx with v = 2*z must give d g/d z = 2 * dy/dx."""
+    import numpy as np
+    from paddle_tpu.tensor import Tensor
+
+    x = Tensor(np.array(3.0, np.float32)); x.stop_gradient = False
+    z = Tensor(np.array(5.0, np.float32)); z.stop_gradient = False
+    y = x * x                       # dy/dx = 6
+    v = z * 2.0                     # seed depends on z
+    (g,) = paddle.grad([y], [x], grad_outputs=[v], create_graph=True)
+    assert float(g.numpy()) == 60.0          # v * dy/dx = 10*6
+    (gz,) = paddle.grad([g], [z])
+    assert float(gz.numpy()) == 12.0         # d(2z*6)/dz
+
+
+def test_create_graph_refuses_hooks():
+    import numpy as np
+    import pytest
+    from paddle_tpu.tensor import Tensor
+
+    x = Tensor(np.array(2.0, np.float32)); x.stop_gradient = False
+    h = x * 2.0
+    h.register_hook(lambda g: g * 2)
+    y = h * h
+    with pytest.raises(NotImplementedError, match="register_hook"):
+        paddle.grad([y], [x], create_graph=True)

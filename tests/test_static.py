@@ -122,3 +122,82 @@ def test_static_fetch_unconsumed_param():
     out, w = exe.run(main, feed={"x": np.ones((1, 2), np.float32)},
                      fetch_list=[_, lin.weight])
     np.testing.assert_allclose(w, np.asarray(lin.weight.numpy()))
+
+
+class TestControlFlowFunctional:
+    """paddle.static.nn.cond/while_loop/case/switch_case (upstream
+    python/paddle/static/nn/control_flow.py) — dual-mode: eager
+    concrete and traced (lax.cond/while_loop/switch)."""
+
+    def test_cond_eager_and_grad(self):
+        import numpy as np
+        x = Tensor(np.array(3.0, np.float32))
+        x.stop_gradient = False
+        out = static.nn.cond(x > 0, lambda: x * 2.0, lambda: x * 5.0)
+        out.backward()
+        assert float(out.numpy()) == 6.0
+        assert float(x.grad.numpy()) == 2.0
+
+    def test_while_loop_eager_and_grad(self):
+        import numpy as np
+        s = Tensor(np.array(1.0, np.float32))
+        s.stop_gradient = False
+        i = Tensor(np.array(0, np.int64))
+        i2, out = static.nn.while_loop(
+            lambda i_, v: i_ < 4,
+            lambda i_, v: [i_ + 1, v * 2.0], [i, s])
+        assert int(i2.numpy()) == 4 and float(out.numpy()) == 16.0
+        out.backward()
+        assert float(s.grad.numpy()) == 16.0
+
+    def test_traced_under_to_static(self):
+        import numpy as np
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static
+        def f(x):
+            y = static.nn.cond(x.sum() > 0, lambda: x * 2.0,
+                               lambda: x - 1.0)
+            i = paddle.zeros([], "int64")
+            i, y = static.nn.while_loop(
+                lambda i_, v: i_ < 3,
+                lambda i_, v: (i_ + 1, v + 1.0), [i, y])
+            return y
+
+        pos = np.asarray(f(Tensor(np.ones(2, np.float32))).numpy())
+        np.testing.assert_allclose(pos, [5.0, 5.0])
+        neg = np.asarray(f(Tensor(-np.ones(2, np.float32))).numpy())
+        np.testing.assert_allclose(neg, [1.0, 1.0])   # (-1-1)+3
+
+    def test_case_and_switch_case(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        r = static.nn.case(
+            [(Tensor(np.bool_(False)), lambda: Tensor(np.float32(1.0))),
+             (Tensor(np.bool_(True)), lambda: Tensor(np.float32(2.0)))],
+            default=lambda: Tensor(np.float32(3.0)))
+        assert float(r.numpy()) == 2.0
+        r = static.nn.switch_case(
+            Tensor(np.int64(7)),
+            {1: lambda: Tensor(np.float32(10.0))},
+            default=lambda: Tensor(np.float32(-1.0)))
+        assert float(r.numpy()) == -1.0
+
+        @paddle.jit.to_static
+        def g(k, x):
+            return static.nn.switch_case(
+                k, {0: lambda: x + 1.0, 3: lambda: x * 3.0},
+                default=lambda: x * 0.0)
+
+        x = Tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(
+            np.asarray(g(Tensor(np.int64(3)), x).numpy()), [3.0, 3.0])
+        np.testing.assert_allclose(
+            np.asarray(g(Tensor(np.int64(9)), x).numpy()), [0.0, 0.0])
+
+    def test_switch_case_unknown_key_refuses_eager(self):
+        import numpy as np
+        import pytest
+        with pytest.raises(ValueError, match="not in branches"):
+            static.nn.switch_case(Tensor(np.int64(5)),
+                                  {1: lambda: Tensor(np.float32(1.0))})
