@@ -4,3 +4,4 @@ from . import lr  # noqa
 from .optimizer import (  # noqa
     Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, RMSProp, Lamb,
     Adadelta, Adamax, L2Decay, L1Decay)
+from .lbfgs import LBFGS  # noqa

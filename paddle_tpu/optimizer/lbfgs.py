@@ -1,0 +1,161 @@
+"""paddle.optimizer.LBFGS (parity: python/paddle/optimizer/lbfgs.py).
+
+Closure-style quasi-Newton optimizer: ``opt.step(closure)`` runs up to
+``max_iter`` L-BFGS iterations, re-evaluating the user closure (which
+computes the loss and calls ``backward()``) as the line search probes
+trial points — the torch/paddle LBFGS usage contract.
+
+TPU-native stance: the two-loop recursion and zoom line search come
+from optax (``optax.lbfgs``), driven EAGERLY over the parameters'
+concrete values — L-BFGS is a host-driven sequential algorithm (each
+line-search probe depends on the previous), so per-probe dispatch is
+the right shape; the model math inside the closure still runs on
+device.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+from .optimizer import Optimizer
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate: float = 1.0, max_iter: int = 20,
+                 max_eval: Optional[int] = None,
+                 tolerance_grad: float = 1e-7,
+                 tolerance_change: float = 1e-9,
+                 history_size: int = 100,
+                 line_search_fn: Optional[str] = None,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate=learning_rate,
+                         parameters=parameters,
+                         weight_decay=weight_decay, grad_clip=grad_clip,
+                         name=name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', got "
+                f"{line_search_fn!r}")
+        self._max_iter = int(max_iter)
+        self._max_eval = (int(max_eval) if max_eval is not None
+                          else self._max_iter * 5 // 4)
+        self._tol_grad = float(tolerance_grad)
+        self._tol_change = float(tolerance_change)
+        self._history = int(history_size)
+        self._line_search = line_search_fn
+        self._tx = None
+        self._tx_state = None
+        self._tx_lr = None
+
+    def _default_decoupled(self):
+        return False
+
+    def _init_state(self, value):
+        return {}
+
+    def _update(self, v, g, st, lr, decay):   # pragma: no cover
+        raise RuntimeError(
+            "LBFGS has no per-tensor update rule; call "
+            "opt.step(closure) with a loss closure")
+
+    # -- closure plumbing --------------------------------------------------
+    def _set_params(self, tree):
+        for p in self._parameter_list:
+            if p.name in tree:
+                p._value = tree[p.name]
+
+    def _eval(self, closure) -> tuple:
+        """Run the closure at the CURRENT param values; return
+        (loss_value, grad_tree)."""
+        loss = closure()
+        lv = loss._value if isinstance(loss, Tensor) else jnp.asarray(loss)
+        grads = {}
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            g = p.grad
+            grads[p.name] = (jnp.zeros_like(p._value) if g is None
+                             else g._value)
+        return lv.astype(jnp.float32), grads
+
+    def step(self, closure: Callable = None):
+        """Run up to ``max_iter`` L-BFGS iterations.  ``closure`` must
+        clear grads, compute the loss, call ``backward()`` and return
+        the loss — and is re-evaluated by the line search."""
+        if closure is None:
+            raise ValueError(
+                "LBFGS.step requires a closure: step(lambda: "
+                "(opt.clear_grad(), loss:=compute(), loss.backward(), "
+                "loss)[-1])")
+        import optax
+
+        trainable = [p for p in self._parameter_list
+                     if not p.stop_gradient]
+        names = [p.name for p in trainable]
+        if len(set(names)) != len(names):
+            dup = sorted({n for n in names if names.count(n) > 1})
+            raise ValueError(
+                f"LBFGS: duplicate parameter names {dup} — the "
+                "name-keyed parameter tree would silently collapse "
+                "them; give the parameters distinct names")
+        params = {p.name: p._value for p in trainable}
+        lr = float(self.get_lr())
+        if self._tx is None or (self._line_search is None
+                                and lr != self._tx_lr):
+            # rebuild when the (fixed-step) lr changed — LRScheduler /
+            # set_lr must keep working; the L-BFGS curvature memory
+            # lives in _tx_state, which we keep when only lr changes
+            old_state = self._tx_state
+            if self._line_search == "strong_wolfe":
+                self._tx = optax.lbfgs(
+                    learning_rate=None,        # zoom linesearch scales
+                    memory_size=self._history)
+            else:
+                self._tx = optax.lbfgs(
+                    learning_rate=lr,
+                    memory_size=self._history,
+                    linesearch=None)
+            self._tx_lr = lr
+            self._tx_state = old_state if old_state is not None \
+                else self._tx.init(params)
+
+        evals = [0]
+
+        def value_fn(tree):
+            # line-search probe: move params, re-run the closure
+            evals[0] += 1
+            self._set_params(tree)
+            v, _ = self._eval(closure)
+            return v
+
+        loss = None
+        for _ in range(self._max_iter):
+            if evals[0] >= self._max_eval:
+                break
+            self._set_params(params)
+            value, grads = self._eval(closure)
+            evals[0] += 1
+            loss = value
+            gnorm = float(max(
+                (float(jnp.max(jnp.abs(g))) for g in grads.values()),
+                default=0.0))
+            if gnorm <= self._tol_grad:
+                break
+            updates, self._tx_state = self._tx.update(
+                grads, self._tx_state, params, value=value,
+                grad=grads, value_fn=value_fn)
+            new_params = optax.apply_updates(params, updates)
+            change = float(max(
+                (float(jnp.max(jnp.abs(new_params[k] - params[k])))
+                 for k in params), default=0.0))
+            params = new_params
+            if change <= self._tol_change:
+                break
+        self._set_params(params)
+        self._global_step += 1
+        return Tensor(loss) if loss is not None else None

@@ -122,17 +122,13 @@ def _is_traced(v) -> bool:
 
 
 def _unwrap_tree(o):
-    from ..jit.dy2static import _unwrap
-    if isinstance(o, (list, tuple)):
-        return type(o)(_unwrap_tree(v) for v in o)
-    return _unwrap(o)
+    from ..jit.dy2static import _tree_out
+    return _tree_out(o)          # full pytree (dict/list/tuple) support
 
 
 def _wrap_tree(o):
-    from ..jit.dy2static import _wrap
-    if isinstance(o, (list, tuple)):
-        return type(o)(_wrap_tree(v) for v in o)
-    return _wrap(o)
+    from ..jit.dy2static import _tree_in
+    return _tree_in(o)
 
 
 def cond(pred, true_fn=None, false_fn=None, name=None):
@@ -231,11 +227,9 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         k = int(iv)
         if k in keys:
             return fns[keys.index(k)]()
-        if default is None:
-            raise ValueError(
-                f"switch_case: index {k} not in branches {keys} and no "
-                "default given")
-        return default()
+        # upstream fallback: the LAST branch doubles as the default
+        # when none is given — same rule the traced path applies
+        return (default or fns[-1])()
     if default is None:
         default = fns[-1]
     # lax.switch needs dense 0..N-1: map key -> slot, unknown -> default

@@ -1,0 +1,105 @@
+"""paddle.optimizer.LBFGS (upstream python/paddle/optimizer/lbfgs.py):
+closure-driven quasi-Newton with strong-Wolfe line search."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.tensor import Parameter, Tensor
+
+
+def test_rosenbrock_strong_wolfe():
+    w = Parameter(jnp.asarray(np.array([-1.2, 1.0], np.float32)),
+                  name="w")
+    opt = optimizer.LBFGS(learning_rate=1.0, max_iter=20,
+                          line_search_fn="strong_wolfe",
+                          parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        x, y = w[0], w[1]
+        loss = (1.0 - x) ** 2 + 100.0 * (y - x * x) ** 2
+        loss.backward()
+        return loss
+
+    for _ in range(6):
+        loss = opt.step(closure)
+    assert float(loss.numpy()) < 1e-6
+    np.testing.assert_allclose(np.asarray(w.numpy()), [1.0, 1.0],
+                               atol=1e-3)
+
+
+def test_linear_regression_matches_closed_form():
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 3).astype(np.float32)
+    true_w = np.array([[2.0], [-1.0], [0.5]], np.float32)
+    Y = X @ true_w
+    paddle.seed(0)
+    lin = nn.Linear(3, 1, bias_attr=False)
+    opt = optimizer.LBFGS(max_iter=30, line_search_fn="strong_wolfe",
+                          parameters=lin.parameters())
+    lossf = nn.MSELoss()
+
+    def closure():
+        opt.clear_grad()
+        loss = lossf(lin(Tensor(X)), Tensor(Y))
+        loss.backward()
+        return loss
+
+    for _ in range(3):
+        loss = opt.step(closure)
+    assert float(loss.numpy()) < 1e-9
+    np.testing.assert_allclose(np.asarray(lin.weight.numpy()), true_w,
+                               atol=1e-3)
+
+
+def test_fixed_step_mode_without_linesearch():
+    w = Parameter(jnp.asarray(np.array([4.0], np.float32)), name="w")
+    opt = optimizer.LBFGS(learning_rate=0.4, max_iter=50,
+                          parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = (w * w).sum()
+        loss.backward()
+        return loss
+
+    loss = opt.step(closure)
+    assert float(loss.numpy()) < 1e-6
+
+
+def test_step_requires_closure_and_bad_linesearch_name():
+    w = Parameter(jnp.zeros(2, jnp.float32), name="w")
+    opt = optimizer.LBFGS(parameters=[w])
+    with pytest.raises(ValueError, match="closure"):
+        opt.step()
+    with pytest.raises(ValueError, match="strong_wolfe"):
+        optimizer.LBFGS(parameters=[w], line_search_fn="backtracking")
+
+
+def test_set_lr_takes_effect_and_duplicate_names_refuse():
+    w = Parameter(jnp.asarray(np.array([4.0], np.float32)), name="w")
+    opt = optimizer.LBFGS(learning_rate=1e-6, max_iter=1,
+                          parameters=[w])
+
+    def closure():
+        opt.clear_grad()
+        loss = (w * w).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    moved_tiny = abs(float(w.numpy()) - 4.0)
+    opt.set_lr(0.4)
+    for _ in range(40):
+        loss = opt.step(closure)
+    assert moved_tiny < 1e-4          # first step barely moved
+    assert float(loss.numpy()) < 1e-5  # post-set_lr steps converge
+
+    a = Parameter(jnp.zeros(1, jnp.float32), name="same")
+    b = Parameter(jnp.zeros(1, jnp.float32), name="same")
+    opt2 = optimizer.LBFGS(parameters=[a, b])
+    with pytest.raises(ValueError, match="duplicate parameter names"):
+        opt2.step(lambda: Tensor(jnp.zeros((), jnp.float32)))

@@ -195,9 +195,12 @@ class TestControlFlowFunctional:
         np.testing.assert_allclose(
             np.asarray(g(Tensor(np.int64(9)), x).numpy()), [0.0, 0.0])
 
-    def test_switch_case_unknown_key_refuses_eager(self):
+    def test_switch_case_unknown_key_falls_back_to_last(self):
+        """Upstream rule (and the traced path's rule): with no default,
+        the LAST branch handles unknown indices — eager must match."""
         import numpy as np
-        import pytest
-        with pytest.raises(ValueError, match="not in branches"):
-            static.nn.switch_case(Tensor(np.int64(5)),
-                                  {1: lambda: Tensor(np.float32(1.0))})
+        r = static.nn.switch_case(
+            Tensor(np.int64(5)),
+            {1: lambda: Tensor(np.float32(1.0)),
+             2: lambda: Tensor(np.float32(2.0))})
+        assert float(r.numpy()) == 2.0
