@@ -10,8 +10,9 @@ native ring buffer is ever needed — §7.0 defers it).
 
 from .dataset import (  # noqa
     Dataset, IterableDataset, TensorDataset, ComposeDataset,
-    ChainDataset, Subset, ConcatDataset, random_split)
+    ChainDataset, Subset, ConcatDataset, random_split,
+    WorkerInfo, get_worker_info)
 from .sampler import (  # noqa
     Sampler, SequenceSampler, RandomSampler, WeightedRandomSampler,
-    BatchSampler, DistributedBatchSampler)
+    BatchSampler, DistributedBatchSampler, SubsetRandomSampler)
 from .dataloader import DataLoader, default_collate_fn  # noqa

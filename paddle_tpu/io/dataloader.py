@@ -216,7 +216,58 @@ class DataLoader:
             batch = [self.dataset[i] for i in indices]
             yield self.collate_fn(batch)
 
+    def _generate_iterable_workers(self):
+        """IterableDataset with num_workers > 0: each worker THREAD
+        iterates the dataset with its thread-local WorkerInfo set, so
+        a dataset that shards by ``get_worker_info()`` (the upstream
+        contract) splits the stream; batching/drop_last apply per
+        worker, batches interleave in completion order."""
+        import queue as _q
+        from .dataset import WorkerInfo, _set_worker_info
+        out = _q.Queue(maxsize=self.num_workers
+                       * max(1, self.prefetch_factor))
+        _END = object()
+
+        def work(wid):
+            try:
+                _set_worker_info(WorkerInfo(wid, self.num_workers,
+                                            self.dataset))
+                if self.worker_init_fn is not None:
+                    self.worker_init_fn(wid)
+                batch = []
+                for sample in self.dataset:
+                    batch.append(sample)
+                    if self.batch_size and len(batch) == self.batch_size:
+                        out.put(self.collate_fn(batch))
+                        batch = []
+                if batch and not self.drop_last:
+                    out.put(self.collate_fn(batch))
+            except BaseException as e:
+                out.put(e)
+            finally:
+                out.put(_END)
+
+        import threading as _t
+        threads = [_t.Thread(target=work, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        done = 0
+        while done < self.num_workers:
+            item = out.get()
+            if item is _END:
+                done += 1
+            elif isinstance(item, BaseException):
+                raise item
+            else:
+                yield item
+
     def __iter__(self):
+        if self._iterable_mode and self.num_workers > 0:
+            gen = self._generate_iterable_workers
+            return _DevicePrefetcher(
+                _PrefetchIterator(gen, self.prefetch_factor)) \
+                if self.use_buffer_reader else gen()
         if (self.num_workers > 0 and not self._iterable_mode
                 and self.batch_sampler is not None):
             from .. import native

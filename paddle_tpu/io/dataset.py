@@ -111,3 +111,35 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[offset:offset + l]))
         offset += l
     return out
+
+
+# -- worker context (upstream paddle.io.get_worker_info) -------------------
+
+class WorkerInfo:
+    """Identity of the current DataLoader worker (upstream WorkerInfo:
+    id / num_workers / dataset)."""
+
+    def __init__(self, id: int, num_workers: int, dataset=None):
+        self.id = int(id)
+        self.num_workers = int(num_workers)
+        self.dataset = dataset
+
+    def __repr__(self):
+        return (f"WorkerInfo(id={self.id}, "
+                f"num_workers={self.num_workers})")
+
+
+import threading as _threading
+
+_WORKER_TLS = _threading.local()
+
+
+def _set_worker_info(info) -> None:
+    _WORKER_TLS.info = info
+
+
+def get_worker_info():
+    """None in the main process; a :class:`WorkerInfo` inside a
+    DataLoader worker thread (the IterableDataset sharding contract;
+    thread-local because the native reader's workers are threads)."""
+    return getattr(_WORKER_TLS, "info", None)

@@ -77,12 +77,13 @@ class _WorkerState:
     """Everything the worker threads touch; no back-ref to the iterator."""
 
     def __init__(self, dataset, batches, collate_fn, queue,
-                 worker_init_fn):
+                 worker_init_fn, num_workers=1):
         self.dataset = dataset
         self.batches = batches
         self.collate = collate_fn
         self.queue = queue
         self.worker_init_fn = worker_init_fn
+        self.num_workers = num_workers
         self.cursor = 0
         self.lock = threading.Lock()
 
@@ -111,6 +112,9 @@ def _pickle_exc(e: BaseException) -> bytes:
 def _worker_main(state: _WorkerState, wid: int):
     q = state.queue
     try:
+        from .dataset import WorkerInfo, _set_worker_info
+        _set_worker_info(WorkerInfo(wid, state.num_workers,
+                                    state.dataset))
         if state.worker_init_fn is not None:
             state.worker_init_fn(wid)
         while True:
@@ -146,7 +150,8 @@ class NativeMapIterator:
             self._num_workers * max(1, prefetch_factor))
         self._queue = queue
         self._state = _WorkerState(dataset, batch_indices, collate_fn,
-                                   queue, worker_init_fn)
+                                   queue, worker_init_fn,
+                                   num_workers=self._num_workers)
         self._next_out = 0
         self._stash = {}
         self._done_workers = 0

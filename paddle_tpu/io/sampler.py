@@ -143,3 +143,19 @@ class DistributedBatchSampler(BatchSampler):
 
     def set_epoch(self, epoch):
         self.epoch = epoch
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample randomly (without replacement) from a fixed index subset
+    (upstream io.SubsetRandomSampler)."""
+
+    def __init__(self, indices, generator=None):
+        super().__init__(None)
+        self.indices = list(indices)
+
+    def __iter__(self):
+        order = np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in order])
+
+    def __len__(self):
+        return len(self.indices)

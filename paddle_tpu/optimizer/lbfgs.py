@@ -71,16 +71,28 @@ class LBFGS(Optimizer):
 
     def _eval(self, closure) -> tuple:
         """Run the closure at the CURRENT param values; return
-        (loss_value, grad_tree)."""
+        (loss_value, grad_tree) with the base-Optimizer grad_clip and
+        regularizer contract applied."""
         loss = closure()
         lv = loss._value if isinstance(loss, Tensor) else jnp.asarray(loss)
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if not p.stop_gradient and p.grad is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        got = {p.name: g for p, g in params_grads}
         grads = {}
         for p in self._parameter_list:
             if p.stop_gradient:
                 continue
-            g = p.grad
-            grads[p.name] = (jnp.zeros_like(p._value) if g is None
-                             else g._value)
+            g = got.get(p.name)
+            gv = jnp.zeros_like(p._value) if g is None else g._value
+            decay = self._param_decay(p)
+            if decay:
+                gv = gv + decay * p._value
+            l1 = self._param_l1(p)
+            if l1:
+                gv = gv + l1 * jnp.sign(p._value)
+            grads[p.name] = gv
         return lv.astype(jnp.float32), grads
 
     def step(self, closure: Callable = None):
@@ -105,20 +117,18 @@ class LBFGS(Optimizer):
                 "them; give the parameters distinct names")
         params = {p.name: p._value for p in trainable}
         lr = float(self.get_lr())
-        if self._tx is None or (self._line_search is None
-                                and lr != self._tx_lr):
-            # rebuild when the (fixed-step) lr changed — LRScheduler /
-            # set_lr must keep working; the L-BFGS curvature memory
-            # lives in _tx_state, which we keep when only lr changes
+        if self._tx is None or lr != self._tx_lr:
+            # rebuild when lr changes — LRScheduler / set_lr must keep
+            # working in BOTH modes (upstream scales the line-search
+            # step by lr too); the L-BFGS curvature memory lives in
+            # _tx_state, which we keep across the rebuild
             old_state = self._tx_state
             if self._line_search == "strong_wolfe":
                 self._tx = optax.lbfgs(
-                    learning_rate=None,        # zoom linesearch scales
-                    memory_size=self._history)
+                    learning_rate=lr, memory_size=self._history)
             else:
                 self._tx = optax.lbfgs(
-                    learning_rate=lr,
-                    memory_size=self._history,
+                    learning_rate=lr, memory_size=self._history,
                     linesearch=None)
             self._tx_lr = lr
             self._tx_state = old_state if old_state is not None \
@@ -134,10 +144,15 @@ class LBFGS(Optimizer):
             return v
 
         loss = None
-        for _ in range(self._max_iter):
+        for it in range(self._max_iter):
             if evals[0] >= self._max_eval:
                 break
             self._set_params(params)
+            # NOTE: the accepted line-search point was already probed
+            # by value_fn, so this re-evaluation costs one extra
+            # closure per iteration.  optax's state-cached value/grad
+            # cannot be reused here because _eval post-processes grads
+            # (clip + regularizer) — correctness over the saved eval.
             value, grads = self._eval(closure)
             evals[0] += 1
             loss = value

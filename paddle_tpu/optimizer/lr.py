@@ -302,3 +302,50 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * (self.exp_gamma ** self.last_epoch)
         return self.base_lr + amp
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr *= lr_lambda(epoch) each step (upstream MultiplicativeDecay:
+    cumulative product of the factor over epochs)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        e = self.last_epoch
+        # incremental product for the per-step path; recompute only on
+        # restores/jumps (O(n^2) loop every step would re-invoke the
+        # lambda billions of times over a long run)
+        if getattr(self, "_cum_epoch", None) == e - 1 and e >= 1:
+            self._cum_lr = self._cum_lr * self.lr_lambda(e)
+        else:
+            lr = self.base_lr
+            for k in range(1, e + 1):
+                lr *= self.lr_lambda(k)
+            self._cum_lr = lr
+        self._cum_epoch = e
+        return self._cum_lr
+
+
+class LinearLR(LRScheduler):
+    """Linear ramp of the factor from ``start_factor`` to
+    ``end_factor`` over ``total_steps`` (upstream LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps,
+                 start_factor=1.0 / 3.0, end_factor=1.0,
+                 last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        self.total_steps = int(total_steps)
+        self.start_factor = float(start_factor)
+        self.end_factor = float(end_factor)
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        frac = t / self.total_steps
+        factor = self.start_factor + (self.end_factor
+                                      - self.start_factor) * frac
+        return self.base_lr * factor
