@@ -64,6 +64,16 @@ _METHOD_OPS = [
     "i1e", "positive", "isreal", "isneginf", "isposinf", "pdist",
     "view_as", "slice_scatter", "select_scatter", "diagonal_scatter",
     "hsplit", "vsplit", "dsplit",
+    # method-parity batch: every op here already exists top-level
+    "addmm", "amax", "amin", "angle", "bincount", "bucketize", "conj",
+    "copysign", "corrcoef", "cov", "cross", "cummax", "cummin",
+    "deg2rad", "diff", "erfinv", "expm1", "frac", "frexp", "gcd",
+    "heaviside", "histogram", "hypot", "imag", "index_add",
+    "index_fill", "index_put", "inner", "kron", "lcm", "ldexp",
+    "logaddexp", "logcumsumexp", "logit", "masked_scatter", "mode",
+    "multigammaln", "nanmedian", "nanquantile", "nextafter", "outer",
+    "quantile", "rad2deg", "real", "renorm", "searchsorted", "vander",
+    "where",
 ]
 
 _g = globals()

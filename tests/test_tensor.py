@@ -100,3 +100,34 @@ def test_numpy_roundtrip():
     arr = np.random.rand(4, 5).astype(np.float32)
     t = paddle.to_tensor(arr)
     np.testing.assert_array_equal(t.numpy(), arr)
+
+
+def test_method_parity_batch_round5():
+    """Ops attached as Tensor methods (upstream patches ~300 methods;
+    spot-check the round-5 batch behaves like the functional forms)."""
+    import numpy as np
+    from paddle_tpu.ops import _METHOD_OPS
+    from paddle_tpu.ops import __dict__ as _opsns
+    from paddle_tpu.tensor import Tensor
+
+    # the attach loop skips silently — enforce the list's invariant:
+    # every listed name resolves and became a callable method
+    for name in _METHOD_OPS:
+        assert name in _opsns, f"_METHOD_OPS names a missing op: {name}"
+        assert callable(getattr(Tensor, name, None)), name
+
+    t = Tensor(np.array([1.0, -2.0, 3.0], np.float32))
+    np.testing.assert_allclose(np.asarray(t.expm1().numpy()),
+                               np.expm1([1.0, -2.0, 3.0]), rtol=1e-6)
+    assert tuple(t.outer(t).shape) == (3, 3)
+    assert float(t.amax().numpy()) == 3.0
+    cond = Tensor(np.array([True, False, True]))
+    np.testing.assert_allclose(
+        np.asarray(cond.where(t, t * 0).numpy()), [1.0, 0.0, 3.0])
+    m = Tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+    np.testing.assert_allclose(
+        np.asarray(m.kron(m).numpy()),
+        np.kron(np.arange(4).reshape(2, 2), np.arange(4).reshape(2, 2)))
+    for name in ("corrcoef", "cov", "quantile", "searchsorted",
+                 "index_add", "renorm", "logcumsumexp"):
+        assert callable(getattr(t, name)), name
