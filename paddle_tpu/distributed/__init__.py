@@ -15,7 +15,10 @@ from .parallel import (  # noqa
 from .communication import (  # noqa
     all_reduce, all_gather, broadcast, reduce, reduce_scatter, alltoall,
     all_to_all, send, recv, isend, irecv, scatter, barrier, new_group,
-    wait, ReduceOp, get_group)
+    wait, ReduceOp, get_group, all_gather_object, alltoall_single,
+    broadcast_object_list, scatter_object_list, gather,
+    destroy_process_group)
+from . import stream  # noqa
 from . import fleet  # noqa
 from . import sharding  # noqa
 from .collective import split, get_mesh, set_mesh  # noqa

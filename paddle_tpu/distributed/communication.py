@@ -155,12 +155,16 @@ def all_gather(tensor_list, tensor, group: Optional[Group] = None,
 
 
 def all_gather_object(object_list, obj, group=None):
+    """Gather picklable objects from every rank (upstream
+    all_gather_object).  Cross-process transport rides the
+    jax.distributed control plane (global group only)."""
+    import jax
     g = get_group(group)
-    if g.nranks <= 1:
+    if g.nranks <= 1 or jax.process_count() <= 1:
         object_list.append(obj)
         return object_list
-    raise RuntimeError("all_gather_object requires multi-process eager "
-                       "comm; unsupported")
+    _require_global(g, "all_gather_object")
+    return _all_gather_object_multiproc(object_list, obj)
 
 
 def broadcast(tensor: Tensor, src: int, group: Optional[Group] = None,
@@ -291,3 +295,136 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 def stream_allreduce(*args, **kwargs):
     return all_reduce(*args, **kwargs)
+
+
+# -- object collectives + teardown (upstream communication/group.py,
+#    all_gather/broadcast/scatter *_object* forms).  Cross-process
+#    transport is the jax.distributed control plane
+#    (multihost_utils) — objects pickle to uint8 payloads.  The control
+#    plane is GLOBAL: collectives over sub-groups would need per-group
+#    stores (upstream creates one TCPStore per group), so sub-group
+#    object collectives refuse loudly instead of deadlocking or
+#    returning wrong members. ------------------------------------------
+
+def _obj_to_u8(obj):
+    import pickle
+    return np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+
+
+def _u8_to_obj(arr):
+    import pickle
+    return pickle.loads(np.asarray(arr, dtype=np.uint8).tobytes())
+
+
+def _require_global(g, what: str):
+    import jax
+    if g.nranks not in (1, jax.process_count()):
+        raise NotImplementedError(
+            f"{what} over a sub-group needs a per-group control plane "
+            "(upstream: one store per group); only the default/global "
+            "group is supported — restructure with a global call plus "
+            "local selection")
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None):
+    """In-place broadcast of a list of picklable objects from ``src``
+    (upstream broadcast_object_list).  Two control-plane rounds by
+    necessity: broadcast_one_to_all requires every process to allocate
+    the SAME shape, so the length must be agreed before the payload."""
+    import jax
+    g = get_group(group)
+    if g.nranks <= 1 or jax.process_count() <= 1:
+        return object_list
+    _require_global(g, "broadcast_object_list")
+    from jax.experimental import multihost_utils as mh
+    payload = _obj_to_u8(object_list) if jax.process_index() == src \
+        else np.zeros(0, np.uint8)
+    n = int(mh.broadcast_one_to_all(
+        np.asarray(len(payload), np.int64),
+        is_source=jax.process_index() == src))
+    buf = np.zeros(n, np.uint8)
+    buf[:len(payload)] = payload[:n]
+    out = mh.broadcast_one_to_all(buf,
+                                  is_source=jax.process_index() == src)
+    object_list[:] = _u8_to_obj(out)
+    return object_list
+
+
+def _all_gather_object_multiproc(object_list, obj):
+    from jax.experimental import multihost_utils as mh
+    payload = _obj_to_u8(obj)
+    lens = mh.process_allgather(np.asarray(len(payload), np.int64))
+    n = int(np.max(lens))
+    buf = np.zeros(n, np.uint8)
+    buf[:len(payload)] = payload
+    gathered = mh.process_allgather(buf)       # [procs, n]
+    for r in range(gathered.shape[0]):
+        object_list.append(_u8_to_obj(gathered[r, :int(lens[r])]))
+    return object_list
+
+
+# back-compat name for the explicit cross-process form
+all_gather_object_multiproc = _all_gather_object_multiproc
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Each rank receives its element of ``in_object_list`` from
+    ``src`` (upstream scatter_object_list; transported as a broadcast
+    + local pick — correct, control-plane-sized)."""
+    import jax
+    g = get_group(group)
+    if g.nranks <= 1 or jax.process_count() <= 1:
+        out_object_list.append(in_object_list[0] if in_object_list
+                               else None)
+        return out_object_list
+    _require_global(g, "scatter_object_list")
+    holder = [in_object_list if in_object_list is not None else []]
+    broadcast_object_list(holder, src=src, group=group)
+    out_object_list.append(holder[0][jax.process_index()])
+    return out_object_list
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op: bool = True):
+    """Collective gather to ``dst`` (upstream gather).  Inside a
+    compiled region every rank computes the gather (SPMD symmetry) and
+    non-dst ranks simply ignore the result — the XLA-native shape of a
+    rooted collective."""
+    g = get_group(group)
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if _is_traced(v) and g.axis_name:
+        gathered = lax.all_gather(v, g.axis_name)
+        if gather_list is not None:
+            for i in range(g.nranks):
+                gather_list.append(Tensor(gathered[i]))
+            return gather_list
+        return Tensor(gathered)
+    if g.nranks <= 1:
+        if gather_list is not None:
+            gather_list.append(tensor)
+            return gather_list
+        return tensor
+    raise RuntimeError("eager cross-process gather unsupported; run "
+                       "inside the compiled step (SPMD) or use "
+                       "all_gather_object for host objects")
+
+
+def destroy_process_group(group=None):
+    """Teardown (upstream destroy_process_group).  Destroying the
+    DEFAULT group shuts down the jax.distributed control plane and
+    clears the cached default group/mesh.  Sub-groups hold no runtime
+    resources here (mesh axes are free — SURVEY.md §3.3), so
+    destroying one is a documented no-op."""
+    global _default_group
+    if group is None:
+        import jax
+        try:
+            if jax.process_count() > 1:
+                jax.distributed.shutdown()
+        except Exception:
+            pass
+        _default_group = None
+        from . import collective as _coll
+        _coll.set_mesh(None)
+    return None

@@ -1104,3 +1104,31 @@ def test_model_save_load_after_mesh_fit():
          for k, v in sd.items() if k.endswith(".moment1")]
     assert m and sum(m) > 0
     model2.fit(Synth(), batch_size=8, epochs=1, verbose=0)
+
+
+def test_object_collectives_single_process_and_stream_namespace():
+    """Single-process forms of the *_object_* collectives, gather, and
+    the paddle.distributed.stream aliases (cross-process behavior is
+    covered by test_launch_multiproc)."""
+    import numpy as np
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.tensor import Tensor
+
+    lst = [{"a": 1}]
+    assert dist.broadcast_object_list(lst, src=0)[0] == {"a": 1}
+    objs = []
+    dist.all_gather_object(objs, "payload")
+    assert objs == ["payload"]
+    out = []
+    dist.scatter_object_list(out, ["only"], src=0)
+    assert out == ["only"]
+
+    t = Tensor(np.ones(4, np.float32))
+    gl = []
+    dist.gather(t, gl, dst=0)
+    assert len(gl) == 1
+
+    # stream namespace aliases accept the use_calc_stream knob
+    dist.stream.all_reduce(t, use_calc_stream=True)
+    dist.stream.broadcast(t, src=0, use_calc_stream=False)
+    assert dist.destroy_process_group() is None

@@ -42,7 +42,21 @@ WORKER = textwrap.dedent("""
                     out_shardings=NamedSharding(mesh, P()))(arr)
     val = float(total)
     assert val == 3.0, val
-    print(f"RANK-{rank}-COLLECTIVE-OK sum={val}", flush=True)
+
+    # object collectives over the control plane (upstream *_object_*
+    # forms): broadcast a config dict, allgather per-rank payloads
+    from paddle_tpu.distributed import (broadcast_object_list,
+                                        all_gather_object)
+    cfg = [{"lr": 0.1, "name": "from-rank0"}] if rank == 0 else [None]
+    broadcast_object_list(cfg, src=0)
+    assert cfg[0]["name"] == "from-rank0", cfg
+
+    objs = []
+    all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    assert [o["rank"] for o in objs] == [0, 1], objs
+    assert objs[1]["tag"] == "xx"
+    print(f"RANK-{rank}-COLLECTIVE-OK sum={val} objs={len(objs)}",
+          flush=True)
 """)
 
 
