@@ -26,6 +26,10 @@ __all__ = [
     "Beta", "Dirichlet", "Exponential", "Gamma", "Laplace",
     "LogNormal", "Gumbel", "Multinomial", "kl_divergence",
     "register_kl",
+    "Poisson", "Geometric", "Cauchy", "Chi2", "StudentT", "Binomial",
+    "ContinuousBernoulli", "MultivariateNormal", "Transform",
+    "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "ChainTransform", "TransformedDistribution",
 ]
 
 
@@ -595,3 +599,353 @@ def _kl_laplace(p, q):
                 + loc_abs)
     return _op(_f, p._loc_t, p._scale_t, q._loc_t, q._scale_t,
                name="kl_laplace")
+
+
+# -- round-5 widening batch (upstream python/paddle/distribution/:
+#    poisson.py, geometric.py, cauchy.py, chi2.py, student_t.py,
+#    binomial.py, multivariate_normal.py, continuous_bernoulli.py,
+#    transform.py, transformed_distribution.py) ---------------------------
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self._rate_t = _t(rate)
+        self.rate = _v(rate)
+        super().__init__(jnp.shape(self.rate))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(jax.random.poisson(
+            _key(), jnp.broadcast_to(self.rate, shp)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _op(lambda r, v: v * jnp.log(r) - r
+                   - jax.scipy.special.gammaln(v + 1.0),
+                   self._rate_t, _t(value), name="poisson_log_prob")
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p over k = 0, 1, 2, ... (upstream geometric:
+    number of failures before the first success)."""
+
+    def __init__(self, probs, name=None):
+        self._probs_t = _t(probs)
+        self.probs = _v(probs)
+        super().__init__(jnp.shape(self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(_key(), shp, minval=1e-7, maxval=1.0)
+        p = jnp.broadcast_to(self.probs, shp)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    def log_prob(self, value):
+        return _op(lambda p, v: v * jnp.log1p(-p) + jnp.log(p),
+                   self._probs_t, _t(value), name="geometric_log_prob")
+
+    def entropy(self):
+        return _op(lambda p: (-(1 - p) * jnp.log1p(-p)
+                              - p * jnp.log(p)) / p,
+                   self._probs_t, name="geometric_entropy")
+
+    @property
+    def mean(self):
+        return Tensor((1.0 - self.probs) / self.probs)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._loc_t, self._scale_t = _t(loc), _t(scale)
+        self.loc, self.scale = _v(loc), _v(scale)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.loc),
+                                              jnp.shape(self.scale)))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(_key(), shp, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+        return _op(lambda l, s: l + s * jnp.tan(jnp.pi * (u - 0.5)),
+                   self._loc_t, self._scale_t, name="cauchy_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        return _op(lambda l, s, v: -jnp.log(jnp.pi) - jnp.log(s)
+                   - jnp.log1p(((v - l) / s) ** 2),
+                   self._loc_t, self._scale_t, _t(value),
+                   name="cauchy_log_prob")
+
+    def entropy(self):
+        return _op(lambda s: jnp.log(4 * jnp.pi * s), self._scale_t,
+                   name="cauchy_entropy")
+
+
+class Chi2(Gamma):
+    """Chi-squared with ``df`` degrees of freedom = Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _v(df)
+        # divide BEFORE unwrapping: a Tensor df must stay on the tape
+        # so log_prob/backward reach it
+        conc = df / 2.0 if isinstance(df, Tensor) else _v(df) / 2.0
+        super().__init__(concentration=conc, rate=0.5)
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self._df_t, self._loc_t = _t(df), _t(loc)
+        self._scale_t = _t(scale)
+        self.df, self.loc, self.scale = _v(df), _v(loc), _v(scale)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.df), jnp.shape(self.loc),
+            jnp.shape(self.scale)))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        t = jax.random.t(_key(), self.df, shp)
+        return Tensor(self.loc + self.scale * t)
+
+    def log_prob(self, value):
+        def _f(df, l, s, v):
+            z = (v - l) / s
+            g = jax.scipy.special.gammaln
+            return (g((df + 1) / 2) - g(df / 2)
+                    - 0.5 * jnp.log(df * jnp.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+        return _op(_f, self._df_t, self._loc_t, self._scale_t,
+                   _t(value), name="studentt_log_prob")
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self._n_t, self._probs_t = _t(total_count), _t(probs)
+        self.total_count = _v(total_count)
+        self.probs = _v(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), jnp.shape(self.probs)))
+
+    def sample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        return Tensor(jax.random.binomial(
+            _key(), self.total_count, self.probs,
+            shape=shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _f(n, p, v):
+            g = jax.scipy.special.gammaln
+            return (g(n + 1) - g(v + 1) - g(n - v + 1)
+                    + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+        return _op(_f, self._n_t, self._probs_t, _t(value),
+                   name="binomial_log_prob")
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+
+class ContinuousBernoulli(Distribution):
+    """Upstream continuous_bernoulli.py: CB(λ) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self._probs_t = _t(probs)
+        self.probs = _v(probs)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _log_norm(self, lam):
+        # C(λ) = 2 atanh(1-2λ) / (1-2λ), with the λ→1/2 limit of 2
+        lo, hi = self._lims
+        safe = jnp.where((lam > lo) & (lam < hi), 0.25, lam)
+        c = (2.0 * jnp.arctanh(1.0 - 2.0 * safe)) / (1.0 - 2.0 * safe)
+        return jnp.where((lam > lo) & (lam < hi),
+                         jnp.log(2.0), jnp.log(jnp.abs(c)))
+
+    def log_prob(self, value):
+        return _op(lambda p, v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                   + self._log_norm(p),
+                   self._probs_t, _t(value), name="cb_log_prob")
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        u = jax.random.uniform(_key(), shp, minval=1e-6,
+                               maxval=1.0 - 1e-6)
+
+        def _f(p):
+            lo, hi = self._lims
+            mid = (p > lo) & (p < hi)
+            safe = jnp.where(mid, 0.25, p)
+            x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                 / (jnp.log(safe) - jnp.log1p(-safe)))
+            return jnp.where(mid, u, x)
+        return _op(_f, self._probs_t, name="cb_rsample")
+
+    sample = rsample
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "pass exactly one of covariance_matrix / scale_tril")
+        self._loc_t = _t(loc)
+        self.loc = _v(loc)
+        if scale_tril is not None:
+            self._tril_t = _t(scale_tril)
+        else:
+            # cholesky through _op: a Tensor covariance stays on the
+            # tape so log_prob/rsample grads reach it
+            self._tril_t = _op(jnp.linalg.cholesky,
+                               _t(covariance_matrix), name="mvn_chol")
+        self._tril = self._tril_t._value
+        d = self.loc.shape[-1]
+        super().__init__(self.loc.shape[:-1], (d,))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape, self._batch_shape)
+        d = self._event_shape[0]
+        eps = jax.random.normal(_key(), shp + (d,))
+        return _op(lambda l, t: l + jnp.einsum(
+            "...ij,...j->...i", jnp.broadcast_to(t, shp + (d, d)), eps),
+            self._loc_t, self._tril_t, name="mvn_rsample")
+
+    sample = rsample
+
+    def log_prob(self, value):
+        def _f(l, t, v):
+            d = self._event_shape[0]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(
+                t, diff[..., None], lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.abs(
+                jnp.diagonal(t, axis1=-2, axis2=-1))), -1)
+            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+                    - 0.5 * d * jnp.log(2 * jnp.pi))
+        return _op(_f, self._loc_t, self._tril_t, _t(value),
+                   name="mvn_log_prob")
+
+    def entropy(self):
+        d = self._event_shape[0]
+        return _op(lambda t: 0.5 * d * (1.0 + jnp.log(2 * jnp.pi))
+                   + jnp.sum(jnp.log(jnp.abs(
+                       jnp.diagonal(t, axis1=-2, axis2=-1))), -1),
+                   self._tril_t, name="mvn_entropy")
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+
+# -- transforms (upstream paddle.distribution.transform) -------------------
+
+class Transform:
+    """Bijection with log|det J| (upstream Transform base)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc, self.scale = _t(loc), _t(scale)
+
+    def forward(self, x):
+        return _op(lambda l, s, v: l + s * v, self.loc, self.scale,
+                   _t(x), name="affine_fwd")
+
+    def inverse(self, y):
+        return _op(lambda l, s, v: (v - l) / s, self.loc, self.scale,
+                   _t(y), name="affine_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(lambda s, v: jnp.broadcast_to(
+            jnp.log(jnp.abs(s)), jnp.shape(v)),
+            self.scale, _t(x), name="affine_logdet")
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _op(jnp.exp, _t(x), name="exp_fwd")
+
+    def inverse(self, y):
+        return _op(jnp.log, _t(y), name="exp_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _op(jax.nn.sigmoid, _t(x), name="sigmoid_fwd")
+
+    def inverse(self, y):
+        return _op(lambda v: jnp.log(v) - jnp.log1p(-v), _t(y),
+                   name="sigmoid_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(lambda v: -jax.nn.softplus(-v) - jax.nn.softplus(v),
+                   _t(x), name="sigmoid_logdet")
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t.forward_log_det_jacobian(x)
+            total = ld if total is None else total + ld
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """y = T(x), x ~ base (upstream transformed_distribution.py)."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transform = (transforms if isinstance(transforms, Transform)
+                          else ChainTransform(transforms))
+        super().__init__(base._batch_shape, base._event_shape)
+
+    def sample(self, shape=()):
+        return self.transform.forward(self.base.sample(shape))
+
+    def rsample(self, shape=()):
+        return self.transform.forward(self.base.rsample(shape))
+
+    def log_prob(self, value):
+        x = self.transform.inverse(value)
+        ld = self.transform.forward_log_det_jacobian(x)
+        return self.base.log_prob(x) - ld
