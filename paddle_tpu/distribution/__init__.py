@@ -694,7 +694,7 @@ class Chi2(Gamma):
         self.df = _v(df)
         # divide BEFORE unwrapping: a Tensor df must stay on the tape
         # so log_prob/backward reach it
-        conc = df / 2.0 if isinstance(df, Tensor) else _v(df) / 2.0
+        conc = df / 2.0 if isinstance(df, Tensor) else self.df / 2.0
         super().__init__(concentration=conc, rate=0.5)
 
 
@@ -808,7 +808,6 @@ class MultivariateNormal(Distribution):
             # tape so log_prob/rsample grads reach it
             self._tril_t = _op(jnp.linalg.cholesky,
                                _t(covariance_matrix), name="mvn_chol")
-        self._tril = self._tril_t._value
         d = self.loc.shape[-1]
         super().__init__(self.loc.shape[:-1], (d,))
 
@@ -826,11 +825,23 @@ class MultivariateNormal(Distribution):
         def _f(l, t, v):
             d = self._event_shape[0]
             diff = v - l
-            sol = jax.scipy.linalg.solve_triangular(
-                t, diff[..., None], lower=True)[..., 0]
+            if t.ndim == 2:
+                # ONE solve with the values as stacked RHS columns —
+                # not N batched tiny solves
+                sol = jax.scipy.linalg.solve_triangular(
+                    t, diff.reshape(-1, d).T, lower=True)
+                maha = jnp.sum(sol * sol, 0).reshape(diff.shape[:-1])
+            else:
+                # batched factor: solve_triangular needs MATCHING batch
+                # dims (no implicit broadcast) — tile over the values
+                tb = jnp.broadcast_to(t, diff.shape[:-1]
+                                      + t.shape[-2:])
+                sol = jax.scipy.linalg.solve_triangular(
+                    tb, diff[..., None], lower=True)[..., 0]
+                maha = jnp.sum(sol * sol, -1)
             logdet = jnp.sum(jnp.log(jnp.abs(
                 jnp.diagonal(t, axis1=-2, axis2=-1))), -1)
-            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+            return (-0.5 * maha - logdet
                     - 0.5 * d * jnp.log(2 * jnp.pi))
         return _op(_f, self._loc_t, self._tril_t, _t(value),
                    name="mvn_log_prob")
@@ -949,3 +960,55 @@ class TransformedDistribution(Distribution):
         x = self.transform.inverse(value)
         ld = self.transform.forward_log_det_jacobian(x)
         return self.base.log_prob(x) - ld
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _op(lambda rp, rq: rp * (jnp.log(rp) - jnp.log(rq))
+               - rp + rq, p._rate_t, q._rate_t, name="kl_poisson")
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def _f(pp, pq):
+        return ((1.0 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-pq))
+                + jnp.log(pp) - jnp.log(pq))
+    return _op(_f, p._probs_t, q._probs_t, name="kl_geometric")
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    def _f(lp, sp, lq, sq):
+        return jnp.log(((sp + sq) ** 2 + (lp - lq) ** 2)
+                       / (4.0 * sp * sq))
+    return _op(_f, p._loc_t, p._scale_t, q._loc_t, q._scale_t,
+               name="kl_cauchy")
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def _f(lp, tp, lq, tq):
+        d = lp.shape[-1]
+        # broadcast both factors/locs to the joint batch first:
+        # solve_triangular has NO implicit batch broadcast
+        batch = jnp.broadcast_shapes(tp.shape[:-2], tq.shape[:-2],
+                                     lp.shape[:-1], lq.shape[:-1])
+        tp = jnp.broadcast_to(tp, batch + tp.shape[-2:])
+        tq = jnp.broadcast_to(tq, batch + tq.shape[-2:])
+        lp = jnp.broadcast_to(lp, batch + lp.shape[-1:])
+        lq = jnp.broadcast_to(lq, batch + lq.shape[-1:])
+        # M = Lq^{-1} Lp ; trace term = ||M||_F^2
+        m = jax.scipy.linalg.solve_triangular(tq, tp, lower=True)
+        tr = jnp.sum(m * m, axis=(-2, -1))
+        diff = lq - lp
+        sol = jax.scipy.linalg.solve_triangular(
+            tq, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(sol * sol, -1)
+        logdet = (jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            tq, axis1=-2, axis2=-1))), -1)
+            - jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+                tp, axis1=-2, axis2=-1))), -1))
+        return 0.5 * (tr + maha - d) + logdet
+    return _op(_f, p._loc_t, p._tril_t, q._loc_t, q._tril_t,
+               name="kl_mvn")

@@ -414,3 +414,75 @@ def test_mvn_and_chi2_parameter_gradients():
     assert df.grad is not None and np.isfinite(float(df.grad.numpy()))
 
     assert "Poisson" in D.__all__ and "TransformedDistribution" in D.__all__
+
+
+def test_kl_round5_closed_forms_vs_monte_carlo():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+
+    paddle.seed(0)
+
+    def mc_kl(p, q, n=400000):
+        s = p.sample((n,))
+        return float((p.log_prob(s) - q.log_prob(s)).mean().numpy())
+
+    pairs = [
+        (D.Poisson(3.0), D.Poisson(5.0)),
+        (D.Geometric(0.3), D.Geometric(0.6)),
+        (D.Cauchy(0.0, 1.0), D.Cauchy(1.0, 2.0)),
+    ]
+    for p, q in pairs:
+        kl = float(D.kl_divergence(p, q).numpy())
+        est = mc_kl(p, q)
+        assert abs(kl - est) < 0.05, (type(p).__name__, kl, est)
+        assert kl >= -1e-6
+
+    a = np.array([[2.0, 0.3], [0.3, 1.0]], np.float32)
+    p = D.MultivariateNormal(np.zeros(2, np.float32),
+                             covariance_matrix=a)
+    q = D.MultivariateNormal(np.ones(2, np.float32),
+                             covariance_matrix=np.eye(2,
+                                                      dtype=np.float32))
+    kl = float(D.kl_divergence(p, q).numpy())
+    est = mc_kl(p, q, n=200000)
+    assert abs(kl - est) < 0.05, (kl, est)
+
+
+def test_continuous_bernoulli_normalization_and_moments():
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distribution as D
+    from paddle_tpu.tensor import Tensor
+
+    for lam in (0.2, 0.5, 0.8):
+        d = D.ContinuousBernoulli(lam)
+        xs = np.linspace(1e-4, 1 - 1e-4, 2001).astype(np.float32)
+        pdf = np.asarray(d.prob(Tensor(xs)).numpy())
+        integral = np.trapezoid(pdf, xs)
+        assert abs(integral - 1.0) < 1e-3, (lam, integral)
+        # sample mean vs analytic mean lam/(2lam-1) + 1/(2 atanh(1-2lam))
+        paddle.seed(0)
+        s = np.asarray(d.sample((40000,)).numpy())
+        if abs(lam - 0.5) < 1e-6:
+            want = 0.5
+        else:
+            want = lam / (2 * lam - 1) \
+                + 1.0 / (2.0 * np.arctanh(1.0 - 2.0 * lam))
+        assert abs(s.mean() - want) < 0.01, (lam, s.mean(), want)
+        assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_kl_mvn_batched_shapes():
+    import numpy as np
+    import paddle_tpu.distribution as D
+
+    locs = np.stack([np.zeros(2), np.ones(2)]).astype(np.float32)
+    covs = np.stack([np.eye(2), 2 * np.eye(2)]).astype(np.float32)
+    p = D.MultivariateNormal(locs, covariance_matrix=covs)     # batch 2
+    q = D.MultivariateNormal(np.zeros(2, np.float32),
+                             covariance_matrix=np.eye(
+                                 2, dtype=np.float32))         # scalar
+    kl = np.asarray(D.kl_divergence(p, q).numpy())
+    assert kl.shape == (2,)
+    assert kl[0] < 1e-6 and kl[1] > 0.5     # identical vs shifted+scaled
