@@ -90,6 +90,7 @@ from . import autograd  # noqa
 from . import utils  # noqa
 from . import nn  # noqa
 from .nn.layer import LazyGuard  # noqa
+from .nn.param_attr import ParamAttr  # noqa
 from . import optimizer  # noqa
 from . import io  # noqa
 from . import metric  # noqa

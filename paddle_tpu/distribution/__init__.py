@@ -809,7 +809,11 @@ class MultivariateNormal(Distribution):
             self._tril_t = _op(jnp.linalg.cholesky,
                                _t(covariance_matrix), name="mvn_chol")
         d = self.loc.shape[-1]
-        super().__init__(self.loc.shape[:-1], (d,))
+        # joint batch: a batched covariance with unbatched loc is a
+        # batched distribution
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._tril_t._value.shape[:-2])
+        super().__init__(batch, (d,))
 
     def rsample(self, shape=()):
         shp = _shape(shape, self._batch_shape)
@@ -833,11 +837,13 @@ class MultivariateNormal(Distribution):
                 maha = jnp.sum(sol * sol, 0).reshape(diff.shape[:-1])
             else:
                 # batched factor: solve_triangular needs MATCHING batch
-                # dims (no implicit broadcast) — tile over the values
-                tb = jnp.broadcast_to(t, diff.shape[:-1]
-                                      + t.shape[-2:])
+                # dims (no implicit broadcast) — joint-broadcast BOTH
+                batch = jnp.broadcast_shapes(t.shape[:-2],
+                                             diff.shape[:-1])
+                tb = jnp.broadcast_to(t, batch + t.shape[-2:])
+                db = jnp.broadcast_to(diff, batch + diff.shape[-1:])
                 sol = jax.scipy.linalg.solve_triangular(
-                    tb, diff[..., None], lower=True)[..., 0]
+                    tb, db[..., None], lower=True)[..., 0]
                 maha = jnp.sum(sol * sol, -1)
             logdet = jnp.sum(jnp.log(jnp.abs(
                 jnp.diagonal(t, axis1=-2, axis2=-1))), -1)

@@ -6,4 +6,5 @@ from .ops.linalg import (  # noqa
     pinv, solve, triangular_solve, lstsq, matrix_power, matrix_rank, det,
     slogdet, cond, lu, multi_dot, corrcoef, cov, householder_product,
     matrix_exp, lu_unpack, vector_norm, matrix_norm, svd_lowrank,
-    pca_lowrank)
+    pca_lowrank, svdvals, ormqr)
+

@@ -200,3 +200,48 @@ def calculate_gain(nonlinearity: str, param=None) -> float:
     if nonlinearity == "selu":
         return 3.0 / 4.0
     return 1.0
+
+
+class Bilinear(Initializer):
+    """Bilinear-interpolation kernels for transposed-conv upsampling
+    (upstream nn.initializer.Bilinear): weight shape
+    [C_out, C_in, K, K] gets the classic bilinear upsample filter on
+    every channel pair's diagonal."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got "
+                f"shape {list(shape)}")
+        c_out, c_in, kh, kw = (int(s) for s in shape)
+        if kh != kw:
+            raise ValueError("Bilinear initializer needs square kernels")
+        f = math.ceil(kh / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:kh, :kw]
+        filt = ((1 - abs(og[0] / f - c))
+                * (1 - abs(og[1] / f - c))).astype(np.float32)
+        # upstream fills EVERY element by spatial position (the
+        # canonical use is groups=C with weight [C, 1, K, K], where a
+        # diagonal-only fill would zero all but the first channel)
+        w = np.broadcast_to(filt, (c_out, c_in, kh, kw)).copy()
+        import jax.numpy as jnp
+        from ..framework import dtype as dtypes
+        return jnp.asarray(w, dtypes.to_jax_dtype(dtype))
+
+
+# -- global default initializers (upstream set_global_initializer) ---------
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None) -> None:
+    """Framework-wide default initializers used when a layer gets no
+    ParamAttr/initializer (upstream nn.initializer
+    .set_global_initializer; pass None, None to reset)."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init
+
+
+def _global_default(is_bias: bool):
+    return _GLOBAL_INIT["bias" if is_bias else "weight"]

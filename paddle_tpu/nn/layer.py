@@ -122,6 +122,10 @@ class Layer:
         attr = ParamAttr._to_attr(attr)
         if attr is not None and attr.initializer is not None:
             initializer = attr.initializer
+        elif init._global_default(is_bias) is not None:
+            # set_global_initializer overrides LAYER defaults too —
+            # upstream: only an explicit param_attr initializer wins
+            initializer = init._global_default(is_bias)
         elif default_initializer is not None:
             initializer = default_initializer
         elif is_bias:

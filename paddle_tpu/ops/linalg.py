@@ -347,3 +347,40 @@ def pca_lowrank(x, q=None, center=True, niter=2):
         q = min(6, m, n)
     xc = x - jnp.mean(x, axis=-2, keepdims=True) if center else x
     return _lowrank_svd(xc, q, niter)
+
+
+def svdvals(x, name=None):
+    """Singular values only (upstream linalg.svdvals)."""
+    from ._primitive import apply_closure
+
+    def _f(a):
+        return jnp.linalg.svd(a, compute_uv=False)
+    return apply_closure(_f, [x if isinstance(x, Tensor) else Tensor(x)],
+                         name="svdvals")
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply ``y`` by the orthogonal Q encoded as Householder
+    reflectors (``x``, ``tau`` from a QR factorization) — upstream
+    linalg.ormqr.  Q is materialized via householder_product (XLA has
+    no apply-without-forming primitive; m x m Q matmul is MXU work)."""
+    from ._primitive import apply_closure
+
+    def _f(a, t, b):
+        # build the FULL m x m Q: pad the reflector block to square and
+        # the taus with zeros (zero tau = identity reflector), since
+        # householder_product of the raw [m, n] block yields only the
+        # thin Q while ormqr applies the complete orthogonal factor
+        m, n = a.shape[-2], a.shape[-1]
+        if n < m:
+            pad_a = [(0, 0)] * (a.ndim - 1) + [(0, m - n)]
+            a = jnp.pad(a, pad_a)
+            pad_t = [(0, 0)] * (t.ndim - 1) + [(0, m - t.shape[-1])]
+            t = jnp.pad(t, pad_t)
+        q = jax.lax.linalg.householder_product(a, t)
+        if transpose:
+            q = jnp.swapaxes(q, -2, -1)
+        return q @ b if left else b @ q
+
+    wrap = lambda v: v if isinstance(v, Tensor) else Tensor(v)
+    return apply_closure(_f, [wrap(x), wrap(tau), wrap(y)], name="ormqr")

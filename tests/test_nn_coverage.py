@@ -591,3 +591,53 @@ def test_clip_grad_norm_accepts_generator():
     gn = np.sqrt(sum(float((np.asarray(p.grad.numpy()) ** 2).sum())
                      for p in fc.parameters()))
     assert gn < 1.0 + 1e-4, gn
+
+
+def test_bilinear_initializer_and_global_default():
+    """nn.initializer.Bilinear (deconv upsampling kernels) +
+    set_global_initializer (upstream initializer additions)."""
+    import numpy as np
+    from paddle_tpu import nn
+
+    # upstream fills EVERY element by spatial position — the canonical
+    # use is groups=C with weight [C, 1, K, K]
+    w = np.asarray(nn.initializer.Bilinear()([3, 1, 4, 4], "float32"))
+    assert abs(w[0, 0].sum() - 4.0) < 1e-5   # filter sums to ratio^2
+    assert np.allclose(w[0, 0], w[1, 0]) and np.allclose(w[0, 0],
+                                                         w[2, 0])
+
+    nn.initializer.set_global_initializer(nn.initializer.Constant(0.5),
+                                          nn.initializer.Constant(0.1))
+    try:
+        lin = nn.Linear(3, 2)
+        assert float(np.asarray(lin.weight.numpy())[0, 0]) == 0.5
+        assert abs(float(np.asarray(lin.bias.numpy())[0]) - 0.1) < 1e-7
+    finally:
+        nn.initializer.set_global_initializer(None, None)
+    lin2 = nn.Linear(3, 2)
+    assert float(np.asarray(lin2.weight.numpy())[0, 0]) != 0.5
+
+
+def test_linalg_svdvals_and_ormqr():
+    import numpy as np
+    import scipy.linalg as sla
+    import paddle_tpu as paddle
+    from paddle_tpu.tensor import Tensor
+
+    rng = np.random.RandomState(0)
+    a = rng.rand(5, 3).astype(np.float32)
+    sv = np.asarray(paddle.linalg.svdvals(Tensor(a)).numpy())
+    np.testing.assert_allclose(sv, np.linalg.svd(a, compute_uv=False),
+                               rtol=1e-5)
+
+    (h, tau), _ = sla.qr(a, mode="raw")
+    h = np.asarray(h, np.float32)
+    tau = np.asarray(tau, np.float32)
+    y = rng.rand(5, 2).astype(np.float32)
+    qfull, _ = sla.qr(a)
+    out = np.asarray(paddle.linalg.ormqr(
+        Tensor(h), Tensor(tau), Tensor(y)).numpy())
+    np.testing.assert_allclose(out, qfull @ y, atol=1e-5)
+    outT = np.asarray(paddle.linalg.ormqr(
+        Tensor(h), Tensor(tau), Tensor(y), transpose=True).numpy())
+    np.testing.assert_allclose(outT, qfull.T @ y, atol=1e-5)
