@@ -15,6 +15,7 @@ from .conv import (  # noqa
     Conv3DTranspose)
 from .pooling import (  # noqa
     MaxPool2D, AvgPool2D, MaxPool1D, AvgPool1D, AdaptiveAvgPool2D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
     AdaptiveMaxPool2D, AdaptiveAvgPool1D, MaxPool3D, AvgPool3D,
     AdaptiveAvgPool3D, AdaptiveMaxPool1D, AdaptiveMaxPool3D)
 from .norm import (  # noqa

@@ -9,6 +9,7 @@ from ...ops.activation import (  # noqa
     softmax, log_softmax, gumbel_softmax, glu, maxout, thresholded_relu)
 from ...ops.nn_ops import (  # noqa
     conv1d, conv2d, conv3d, conv2d_transpose, max_pool1d, max_pool2d,
+    max_unpool1d, max_unpool2d, max_unpool3d,
     avg_pool1d, avg_pool2d, adaptive_avg_pool1d, adaptive_avg_pool2d,
     adaptive_max_pool2d, layer_norm, rms_norm, instance_norm, group_norm,
     local_response_norm, dropout, dropout2d, dropout3d, alpha_dropout,

@@ -209,7 +209,6 @@ class Bilinear(Initializer):
     every channel pair's diagonal."""
 
     def __call__(self, shape, dtype):
-        import numpy as np
         if len(shape) != 4:
             raise ValueError(
                 f"Bilinear initializer needs a 4-D conv weight, got "
@@ -226,8 +225,6 @@ class Bilinear(Initializer):
         # canonical use is groups=C with weight [C, 1, K, K], where a
         # diagonal-only fill would zero all but the first channel)
         w = np.broadcast_to(filt, (c_out, c_in, kh, kw)).copy()
-        import jax.numpy as jnp
-        from ..framework import dtype as dtypes
         return jnp.asarray(w, dtypes.to_jax_dtype(dtype))
 
 

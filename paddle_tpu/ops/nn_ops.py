@@ -244,6 +244,10 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     # computed by patch extraction + argmax (ties: first wins)
     if data_format != "NCHW":
         raise NotImplementedError("return_mask expects NCHW")
+    if ceil_mode:
+        raise NotImplementedError(
+            "max_pool2d: return_mask with ceil_mode is unsupported "
+            "(the mask patch extraction assumes floor-mode output)")
     k = _pair(kernel_size)
     s = _pair(stride if stride is not None else kernel_size)
     pad = _conv_padding(padding, 2)
@@ -293,6 +297,18 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
         k if stride is None else tuple(stride))
     if isinstance(s, tuple) and len(s) != 1:
         s = (s[0],)
+    if return_mask:
+        if ceil_mode:
+            raise NotImplementedError(
+                "max_pool1d: return_mask with ceil_mode is unsupported "
+                "(the mask patch extraction assumes floor-mode output)")
+        # lower through the 2-D mask machinery with a unit H dim; the
+        # flat H*W index with H=1 IS the L index
+        p = padding if isinstance(padding, int) else tuple(padding)[0]
+        out, mask = max_pool2d.raw(x[:, :, None, :], (1, k[0]),
+                                   (1, s[0]), (0, p),
+                                   return_mask=True)
+        return out[:, :, 0, :], mask[:, :, 0, :]
     p = _conv_padding(padding, 1)
     neg = -jnp.inf
     cfg = p if isinstance(p, str) else [(0, 0), (0, 0)] + list(p)
@@ -1285,10 +1301,48 @@ def _pool3d(x, kernel, stride, padding, init, op, is_avg=False,
 @primitive
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
-    if return_mask:
-        raise NotImplementedError("max_pool3d return_mask")
-    return _pool3d(x, kernel_size, stride, padding, -jnp.inf,
-                   jax.lax.max, ceil_mode=ceil_mode)
+    out = _pool3d(x, kernel_size, stride, padding, -jnp.inf,
+                  jax.lax.max, ceil_mode=ceil_mode)
+    if not return_mask:
+        return out
+    if ceil_mode:
+        raise NotImplementedError(
+            "max_pool3d: return_mask with ceil_mode is unsupported "
+            "(the mask patch extraction assumes floor-mode output)")
+    if isinstance(padding, str):
+        raise NotImplementedError("return_mask with str padding")
+    # patch-extraction argmax over the k^3 window (paddle convention:
+    # flat index into D*H*W; ties -> first)
+    def _trip(v):
+        return (v,) * 3 if isinstance(v, int) else tuple(v)
+    k = _trip(kernel_size)
+    s = _trip(stride if stride is not None else kernel_size)
+    pd = _trip(padding)
+    n, c, d, h, w = x.shape
+    od, oh, ow = out.shape[2:]
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + [(pd[i], pd[i])
+                                        for i in range(3)],
+                 constant_values=-jnp.inf)
+    patches, flat_idx = [], []
+    for a in range(k[0]):
+        for b in range(k[1]):
+            for e in range(k[2]):
+                patches.append(xp[:, :,
+                                  a:a + od * s[0]:s[0],
+                                  b:b + oh * s[1]:s[1],
+                                  e:e + ow * s[2]:s[2]])
+                zz = (jnp.arange(od) * s[0] + a - pd[0])[:, None, None]
+                yy = (jnp.arange(oh) * s[1] + b - pd[1])[None, :, None]
+                xx = (jnp.arange(ow) * s[2] + e - pd[2])[None, None, :]
+                flat_idx.append((zz * h + yy) * w + xx)
+    stacked = jnp.stack(patches, axis=-1)
+    idx_map = jnp.stack([jnp.broadcast_to(f, (od, oh, ow))
+                         for f in flat_idx], axis=-1)
+    which = jnp.argmax(stacked, axis=-1)
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(idx_map, (n, c, od, oh, ow, len(patches))),
+        which[..., None], axis=-1)[..., 0].astype(jnp.int64)
+    return out, mask
 
 
 @primitive
@@ -1473,3 +1527,70 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                + gather(x0, y1) * ((1 - wx) * wy)[..., None]
                + gather(x1, y1) * (wx * wy)[..., None])
     return jnp.moveaxis(out, -1, 1).astype(x.dtype)   # [N, C, Hg, Wg]
+
+
+def _unpool_out_size(in_size, kernel, stride, padding, output_size,
+                     ndim):
+    def _tup(v):
+        return (v,) * ndim if isinstance(v, int) else tuple(v)
+    k, s, p = _tup(kernel), _tup(stride if stride is not None
+                                 else kernel), _tup(padding)
+    if output_size is not None:
+        out = tuple(int(o) for o in output_size[-ndim:])
+    else:
+        out = tuple((in_size[i] - 1) * s[i] - 2 * p[i] + k[i]
+                    for i in range(ndim))
+    return out
+
+
+def _unpool_scatter(x, indices, out_spatial):
+    """Shared unpool body: flatten spatial dims, scatter values to
+    their argmax indices, reshape to the output spatial shape."""
+    n, c = x.shape[0], x.shape[1]
+    flat = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1)
+    total = 1
+    for s_ in out_spatial:
+        total *= s_
+    nb = jnp.arange(n)[:, None, None]
+    cb = jnp.arange(c)[None, :, None]
+    out = jnp.zeros((n, c, total), x.dtype)
+    out = out.at[nb, cb, idx].set(flat)
+    return out.reshape((n, c) + tuple(out_spatial))
+
+
+@primitive(nondiff=(1,))
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    """Inverse of max_pool2d(return_mask=True): values scatter back to
+    their argmax positions, everything else zero (upstream
+    F.max_unpool2d; argument order matches upstream — data_format
+    BEFORE output_size)."""
+    if data_format != "NCHW":
+        raise NotImplementedError("max_unpool2d expects NCHW")
+    n, c, h, w = x.shape
+    out_sp = _unpool_out_size((h, w), kernel_size, stride, padding,
+                              output_size, 2)
+    return _unpool_scatter(x, indices, out_sp)
+
+
+@primitive(nondiff=(1,))
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d expects NCL")
+    n, c, l = x.shape
+    out_sp = _unpool_out_size((l,), kernel_size, stride, padding,
+                              output_size, 1)
+    return _unpool_scatter(x, indices, out_sp)
+
+
+@primitive(nondiff=(1,))
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d expects NCDHW")
+    n, c, d, h, w = x.shape
+    out_sp = _unpool_out_size((d, h, w), kernel_size, stride, padding,
+                              output_size, 3)
+    return _unpool_scatter(x, indices, out_sp)

@@ -906,6 +906,9 @@ EXEMPTIONS = {
     "max_pool1d": "composite",
     "max_pool2d": "composite",
     "maxout": "composite",
+    "max_unpool1d": "composite",
+    "max_unpool2d": "composite",
+    "max_unpool3d": "composite",
     "pixel_shuffle": "composite",
     "pixel_unshuffle": "composite",
     "prelu": "composite",

@@ -641,3 +641,61 @@ def test_linalg_svdvals_and_ormqr():
     outT = np.asarray(paddle.linalg.ormqr(
         Tensor(h), Tensor(tau), Tensor(y), transpose=True).numpy())
     np.testing.assert_allclose(outT, qfull.T @ y, atol=1e-5)
+
+
+def test_max_unpool_family_torch_oracle():
+    """max_pool return_mask (1d mask was silently absent; 3d refused)
+    + MaxUnPool1D/2D/3D round-trips, exact vs torch."""
+    import numpy as np
+    import torch
+    from paddle_tpu import nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.tensor import Tensor
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    pooled, mask = F.max_pool2d(Tensor(x), 2, 2, return_mask=True)
+    un = nn.MaxUnPool2D(2, 2)(pooled, mask)
+    tp, tm = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2,
+                                            return_indices=True)
+    tu = torch.nn.functional.max_unpool2d(tp, tm, 2, 2)
+    np.testing.assert_array_equal(np.asarray(un.numpy()), tu.numpy())
+
+    x1 = rng.rand(2, 3, 10).astype(np.float32)
+    p1, m1 = F.max_pool1d(Tensor(x1), 2, 2, return_mask=True)
+    tp1, tm1 = torch.nn.functional.max_pool1d(torch.tensor(x1), 2, 2,
+                                              return_indices=True)
+    np.testing.assert_array_equal(np.asarray(m1.numpy()), tm1.numpy())
+    u1 = nn.MaxUnPool1D(2, 2)(p1, m1)
+    tu1 = torch.nn.functional.max_unpool1d(tp1, tm1, 2, 2)
+    np.testing.assert_array_equal(np.asarray(u1.numpy()), tu1.numpy())
+
+    x3 = rng.rand(1, 2, 4, 4, 4).astype(np.float32)
+    p3, m3 = F.max_pool3d(Tensor(x3), 2, 2, return_mask=True)
+    tp3, tm3 = torch.nn.functional.max_pool3d(torch.tensor(x3), 2, 2,
+                                              return_indices=True)
+    np.testing.assert_array_equal(np.asarray(m3.numpy()), tm3.numpy())
+    u3 = nn.MaxUnPool3D(2, 2)(p3, m3)
+    tu3 = torch.nn.functional.max_unpool3d(tp3, tm3, 2, 2)
+    np.testing.assert_array_equal(np.asarray(u3.numpy()), tu3.numpy())
+
+
+def test_max_pool_mask_guards_and_upstream_arg_order():
+    import numpy as np
+    import pytest
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.tensor import Tensor
+
+    x = Tensor(np.random.RandomState(0).rand(1, 1, 5, 5).astype(
+        np.float32))
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        F.max_pool2d(x, 2, 2, ceil_mode=True, return_mask=True)
+    x3 = Tensor(np.random.RandomState(0).rand(1, 1, 5, 5, 5).astype(
+        np.float32))
+    with pytest.raises(NotImplementedError, match="ceil_mode"):
+        F.max_pool3d(x3, 2, 2, ceil_mode=True, return_mask=True)
+    # upstream positional order: data_format comes before output_size
+    p, m = F.max_pool2d(Tensor(np.random.RandomState(0).rand(
+        1, 1, 4, 4).astype(np.float32)), 2, 2, return_mask=True)
+    out = F.max_unpool2d(p, m, 2, 2, 0, "NCHW")
+    assert tuple(out.shape) == (1, 1, 4, 4)
