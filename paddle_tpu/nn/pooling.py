@@ -148,34 +148,32 @@ class AdaptiveMaxPool3D(Layer):
 
 
 class _MaxUnPoolNd(Layer):
-    _fn = None
+    _default_format = None
 
     def __init__(self, kernel_size, stride=None, padding=0,
                  data_format=None, output_size=None, name=None):
         super().__init__()
         self.kernel_size, self.stride = kernel_size, stride
         self.padding, self.output_size = padding, output_size
-        self.data_format = data_format
+        self.data_format = data_format or self._default_format
 
     def forward(self, x, indices):
-        from . import functional as F
-        kw = {}
-        if self.data_format is not None:
-            kw["data_format"] = self.data_format
-        return getattr(F, self._fn)(x, indices, self.kernel_size,
-                                    self.stride, self.padding,
-                                    output_size=self.output_size,
-                                    **kw)
+        return self._fn(x, indices, self.kernel_size, self.stride,
+                        self.padding, data_format=self.data_format,
+                        output_size=self.output_size)
 
 
 class MaxUnPool1D(_MaxUnPoolNd):
     """Inverse of MaxPool1D(return_mask=True) (upstream MaxUnPool1D)."""
-    _fn = "max_unpool1d"
+    _fn = staticmethod(ops.max_unpool1d)
+    _default_format = "NCL"
 
 
 class MaxUnPool2D(_MaxUnPoolNd):
-    _fn = "max_unpool2d"
+    _fn = staticmethod(ops.max_unpool2d)
+    _default_format = "NCHW"
 
 
 class MaxUnPool3D(_MaxUnPoolNd):
-    _fn = "max_unpool3d"
+    _fn = staticmethod(ops.max_unpool3d)
+    _default_format = "NCDHW"

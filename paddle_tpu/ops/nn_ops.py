@@ -302,11 +302,16 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
             raise NotImplementedError(
                 "max_pool1d: return_mask with ceil_mode is unsupported "
                 "(the mask patch extraction assumes floor-mode output)")
+        if isinstance(padding, str):
+            raise NotImplementedError("return_mask with str padding")
         # lower through the 2-D mask machinery with a unit H dim; the
-        # flat H*W index with H=1 IS the L index
-        p = padding if isinstance(padding, int) else tuple(padding)[0]
+        # flat H*W index with H=1 IS the L index.  Normalise padding
+        # through the SAME resolver as the non-mask path so int, pair,
+        # and asymmetric forms all agree with it
+        (plo_hi,) = _conv_padding(padding, 1)
         out, mask = max_pool2d.raw(x[:, :, None, :], (1, k[0]),
-                                   (1, s[0]), (0, p),
+                                   (1, s[0]),
+                                   [0, 0, plo_hi[0], plo_hi[1]],
                                    return_mask=True)
         return out[:, :, 0, :], mask[:, :, 0, :]
     p = _conv_padding(padding, 1)
@@ -1311,17 +1316,19 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
             "(the mask patch extraction assumes floor-mode output)")
     if isinstance(padding, str):
         raise NotImplementedError("return_mask with str padding")
+    if data_format != "NCDHW":
+        raise NotImplementedError("return_mask expects NCDHW")
     # patch-extraction argmax over the k^3 window (paddle convention:
     # flat index into D*H*W; ties -> first)
     def _trip(v):
         return (v,) * 3 if isinstance(v, int) else tuple(v)
     k = _trip(kernel_size)
     s = _trip(stride if stride is not None else kernel_size)
-    pd = _trip(padding)
+    # SAME resolver as _pool3d: int, per-dim, and lo/hi pair forms
+    pd = _conv_padding(padding, 3)
     n, c, d, h, w = x.shape
     od, oh, ow = out.shape[2:]
-    xp = jnp.pad(x, [(0, 0), (0, 0)] + [(pd[i], pd[i])
-                                        for i in range(3)],
+    xp = jnp.pad(x, [(0, 0), (0, 0)] + list(pd),
                  constant_values=-jnp.inf)
     patches, flat_idx = [], []
     for a in range(k[0]):
@@ -1331,9 +1338,12 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                                   a:a + od * s[0]:s[0],
                                   b:b + oh * s[1]:s[1],
                                   e:e + ow * s[2]:s[2]])
-                zz = (jnp.arange(od) * s[0] + a - pd[0])[:, None, None]
-                yy = (jnp.arange(oh) * s[1] + b - pd[1])[None, :, None]
-                xx = (jnp.arange(ow) * s[2] + e - pd[2])[None, None, :]
+                zz = (jnp.arange(od) * s[0] + a
+                      - pd[0][0])[:, None, None]
+                yy = (jnp.arange(oh) * s[1] + b
+                      - pd[1][0])[None, :, None]
+                xx = (jnp.arange(ow) * s[2] + e
+                      - pd[2][0])[None, None, :]
                 flat_idx.append((zz * h + yy) * w + xx)
     stacked = jnp.stack(patches, axis=-1)
     idx_map = jnp.stack([jnp.broadcast_to(f, (od, oh, ow))
@@ -1552,6 +1562,14 @@ def _unpool_scatter(x, indices, out_spatial):
     total = 1
     for s_ in out_spatial:
         total *= s_
+    if not isinstance(idx, jax.core.Tracer):
+        mx = int(jnp.max(idx)) if idx.size else -1
+        if mx >= total:
+            raise ValueError(
+                f"max_unpool: index {mx} is out of range for output "
+                f"spatial size {tuple(out_spatial)} ({total} elements); "
+                "check kernel/stride/padding/output_size against the "
+                "pooling that produced the indices")
     nb = jnp.arange(n)[:, None, None]
     cb = jnp.arange(c)[None, :, None]
     out = jnp.zeros((n, c, total), x.dtype)

@@ -2,10 +2,10 @@ from .lenet import LeNet  # noqa
 from .resnet import (  # noqa
     ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
     resnext50_32x4d, resnext50_64x4d, resnext101_32x4d,
-    resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
+    resnext101_32x8d, resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
     wide_resnet50_2,
     wide_resnet101_2)
-from .vgg import VGG, vgg16, vgg19  # noqa
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa
 from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa
 from .mobilenetv3 import (  # noqa

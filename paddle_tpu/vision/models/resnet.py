@@ -176,6 +176,11 @@ def resnext101_32x4d(pretrained=False, **kwargs):
                    **kwargs)
 
 
+def resnext101_32x8d(pretrained=False, **kwargs):
+    return _resnet(BottleneckBlock, 101, pretrained, groups=32, width=8,
+                   **kwargs)
+
+
 def resnext101_64x4d(pretrained=False, **kwargs):
     return _resnet(BottleneckBlock, 101, pretrained, groups=64, width=4,
                    **kwargs)

@@ -5,6 +5,10 @@ from __future__ import annotations
 from ... import nn
 
 _CFGS = {
+    "vgg11": [64, "M", 128, "M", 256, 256, "M",
+              512, 512, "M", 512, 512, "M"],
+    "vgg13": [64, 64, "M", 128, 128, "M", 256, 256, "M",
+              512, 512, "M", 512, 512, "M"],
     "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
               512, 512, 512, "M", 512, 512, 512, "M"],
     "vgg19": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
@@ -58,3 +62,11 @@ def vgg16(pretrained=False, batch_norm=False, **kwargs):
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
     return VGG(_make_features(_CFGS["vgg19"], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_features(_CFGS["vgg11"], batch_norm), **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return VGG(_make_features(_CFGS["vgg13"], batch_norm), **kwargs)

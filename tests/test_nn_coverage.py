@@ -699,3 +699,36 @@ def test_max_pool_mask_guards_and_upstream_arg_order():
         1, 1, 4, 4).astype(np.float32)), 2, 2, return_mask=True)
     out = F.max_unpool2d(p, m, 2, 2, 0, "NCHW")
     assert tuple(out.shape) == (1, 1, 4, 4)
+
+
+def test_pool_mask_padding_forms_and_unpool_oob():
+    import numpy as np
+    import pytest
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.tensor import Tensor
+
+    rng = np.random.RandomState(0)
+    x1 = Tensor(rng.rand(1, 2, 9).astype(np.float32))
+    # asymmetric pair padding agrees between mask and non-mask paths
+    p_plain = F.max_pool1d(x1, 3, 2, padding=[1, 2])
+    p_mask, _ = F.max_pool1d(x1, 3, 2, padding=[1, 2],
+                             return_mask=True)
+    np.testing.assert_array_equal(np.asarray(p_plain.numpy()),
+                                  np.asarray(p_mask.numpy()))
+    with pytest.raises(NotImplementedError, match="str padding"):
+        F.max_pool1d(x1, 3, 2, padding="same", return_mask=True)
+
+    x3 = Tensor(rng.rand(1, 1, 6, 6, 6).astype(np.float32))
+    p_plain = F.max_pool3d(x3, 2, 2, padding=[1, 0, 1, 0, 1, 0])
+    p_mask, _ = F.max_pool3d(x3, 2, 2, padding=[1, 0, 1, 0, 1, 0],
+                             return_mask=True)
+    np.testing.assert_array_equal(np.asarray(p_plain.numpy()),
+                                  np.asarray(p_mask.numpy()))
+    with pytest.raises(NotImplementedError, match="NCDHW"):
+        F.max_pool3d(x3, 2, 2, data_format="NDHWC", return_mask=True)
+
+    # out-of-range indices refuse loudly
+    x = Tensor(rng.rand(1, 1, 8, 8).astype(np.float32))
+    pooled, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+    with pytest.raises(ValueError, match="out of range"):
+        F.max_unpool2d(pooled, mask, 2, 2, output_size=(2, 2))

@@ -71,3 +71,20 @@ def test_densenet_variant_channels():
     net = M.densenet161(num_classes=4)
     out = _fwd(net, size=64)
     assert out.shape == [1, 4]
+
+
+def test_vgg11_13_and_resnext101_32x8d_torchvision_param_parity():
+    """New zoo entries match torchvision parameter counts exactly
+    (the structural-identity oracle)."""
+    import numpy as np
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    feats = M.vgg11(num_classes=0, with_pool=False)
+    n = sum(int(np.prod(p.shape)) for p in feats.parameters())
+    assert n == 9_220_480                  # torchvision vgg11.features
+    feats = M.vgg13(num_classes=0, with_pool=False)
+    n = sum(int(np.prod(p.shape)) for p in feats.parameters())
+    assert n == 9_404_992
+    net = M.resnext101_32x8d(num_classes=1000)
+    n = sum(int(np.prod(p.shape)) for p in net.parameters())
+    assert n == 88_791_336                 # torchvision resnext101_32x8d
