@@ -1306,6 +1306,10 @@ def _pool3d(x, kernel, stride, padding, init, op, is_avg=False,
 @primitive
 def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCDHW"):
+    if data_format != "NCDHW":
+        # _pool3d always pools axes 2-4; NDHWC would silently mix
+        # channels into the window
+        raise NotImplementedError("max_pool3d expects NCDHW")
     out = _pool3d(x, kernel_size, stride, padding, -jnp.inf,
                   jax.lax.max, ceil_mode=ceil_mode)
     if not return_mask:
@@ -1562,14 +1566,15 @@ def _unpool_scatter(x, indices, out_spatial):
     total = 1
     for s_ in out_spatial:
         total *= s_
-    if not isinstance(idx, jax.core.Tracer):
-        mx = int(jnp.max(idx)) if idx.size else -1
-        if mx >= total:
+    if not isinstance(idx, jax.core.Tracer) and idx.size:
+        mx, mn = int(jnp.max(idx)), int(jnp.min(idx))
+        if mx >= total or mn < 0:
             raise ValueError(
-                f"max_unpool: index {mx} is out of range for output "
-                f"spatial size {tuple(out_spatial)} ({total} elements); "
-                "check kernel/stride/padding/output_size against the "
-                "pooling that produced the indices")
+                f"max_unpool: index range [{mn}, {mx}] is out of range "
+                f"for output spatial size {tuple(out_spatial)} "
+                f"({total} elements); check kernel/stride/padding/"
+                "output_size against the pooling that produced the "
+                "indices")
     nb = jnp.arange(n)[:, None, None]
     cb = jnp.arange(c)[None, :, None]
     out = jnp.zeros((n, c, total), x.dtype)

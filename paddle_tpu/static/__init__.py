@@ -209,8 +209,23 @@ class Executor:
 
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, scope=None):
+        if isinstance(program, _InferenceProgram):
+            feed = feed or {}
+            missing = [n for n in program.feed_names if n not in feed]
+            if missing:
+                raise KeyError(
+                    f"Executor.run: missing feed values for {missing}")
+            vals = [np.asarray(feed[n]) for n in program.feed_names]
+            out = program._call(program._params, *vals)
+            flat = out if isinstance(out, (tuple, list)) else (out,)
+            if fetch_list is not None:
+                flat = [flat[i] for i in fetch_list]
+            if return_numpy:
+                return [np.asarray(jax.device_get(r)) for r in flat]
+            return [Tensor(r) for r in flat]
         program = program if isinstance(program, Program) else \
             (program or default_main_program())
+        _global_scope._last_program = program
         feed = feed or {}
         fetch_list = fetch_list or []
         if not fetch_list:
@@ -405,3 +420,382 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
     from ..autograd import tape as _tape
     return _tape.grad(targets, inputs, grad_outputs=target_gradients,
                       allow_unused=True)
+
+
+# ---------------------------------------------------------------------------
+# deployment + scope + misc static surface (upstream python/paddle/static/)
+# ---------------------------------------------------------------------------
+
+class _InferenceProgram:
+    """Loaded inference artifact: Executor.run dispatches here."""
+
+    def __init__(self, call, params, feed_names, n_out):
+        self._call = call
+        self._params = params
+        self.feed_names = list(feed_names)
+        self.n_out = n_out
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export the pruned inference graph + parameters (upstream
+    paddle.static.save_inference_model writing .pdmodel/.pdiparams).
+
+    The recorded Program is replayed as ONE pure function of
+    (params, *feeds), exported via jax.export — the SAME artifact
+    format as paddle.jit.save, so paddle.inference.create_predictor
+    loads the result directly."""
+    import os as _os
+    import pickle as _pickle
+    program = program or default_main_program()
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feed_names = []
+    for v in feed_vars:
+        n = getattr(v, "name", None)
+        if n is None or n not in program._feed_ids:
+            raise ValueError(
+                "save_inference_model: every feed_var must come from "
+                "paddle.static.data of this program")
+        feed_names.append(n)
+    fetch_ids = []
+    for v in fetch_vars:
+        sid = getattr(v, "_sym_id", None)
+        if sid is None or sid not in program._sym_ids:
+            raise ValueError(
+                "save_inference_model: fetch_vars must be outputs "
+                "recorded in this program")
+        fetch_ids.append(sid)
+
+    # live params the graph references, name-keyed
+    param_objs, seen = [], set()
+    for _, arg_specs, _, _ in program._nodes:
+        for kind, ref in arg_specs:
+            if kind == "param" and id(ref) not in seen:
+                seen.add(id(ref))
+                param_objs.append(ref)
+    names, used = [], set()
+    for i, p in enumerate(param_objs):
+        n = getattr(p, "name", None) or f"param_{i}"
+        if n in used:
+            n = f"{n}__{i}"
+        used.add(n)
+        names.append(n)
+    # prune to the fetch subgraph (upstream prune_backward +
+    # feed/fetch pruning): the recorded program may hold loss/metric
+    # branches that read feeds (labels) the inference model must not
+    # require
+    need = set(fetch_ids)
+    keep = []
+    for node in reversed(program._nodes):
+        _, arg_specs_, _, out_ids_ = node
+        if any(o in need for o in out_ids_):
+            keep.append(node)
+            need.update(ref for kind, ref in arg_specs_
+                        if kind == "sym")
+    keep.reverse()
+    extra = [n for n, fid in program._feed_ids.items()
+             if fid in need and n not in feed_names]
+    if extra:
+        raise ValueError(
+            f"save_inference_model: the fetch subgraph also reads "
+            f"feeds {extra} not listed in feed_vars — add them or "
+            "fetch a tensor that does not depend on them")
+    nodes = keep
+    # restrict saved params to those the PRUNED graph reads
+    pruned_param_ids = {id(ref) for _, arg_specs_, _, _ in keep
+                        for kind, ref in arg_specs_ if kind == "param"}
+    pruned = [(n, p) for n, p in zip(names, param_objs)
+              if id(p) in pruned_param_ids]
+    names = [n for n, _ in pruned]
+    param_objs = [p for _, p in pruned]
+    feed_id_list = [program._feed_ids[n] for n in feed_names]
+
+    def pure(params, *feeds):
+        env = dict(zip(feed_id_list, feeds))
+        pmap = {id(p): params[n] for n, p in zip(names, param_objs)}
+
+        def resolve(spec):
+            kind, ref = spec
+            if kind == "sym":
+                return env[ref]
+            if kind == "param":
+                return pmap[id(ref)]
+            return ref
+
+        for f, arg_specs, kw, out_ids in nodes:
+            vals = [resolve(s) for s in arg_specs]
+            out = f(*vals, **kw)
+            outs = out if isinstance(out, tuple) else (out,)
+            for sid, v in zip(out_ids, outs):
+                env[sid] = v
+        return tuple(env[sid] for sid in fetch_ids)
+
+    from jax import export as _export
+    scope = _export.SymbolicScope()
+    sym_ct = 0
+    avals = []
+    specs = []
+    for n in feed_names:
+        sp = program._feed_specs[n]
+        dims = []
+        has_sym = False
+        for di in sp.shape:
+            if di is None or (isinstance(di, int) and di < 0):
+                dims.append(f"d{sym_ct}")
+                sym_ct += 1
+                has_sym = True
+            else:
+                dims.append(str(di))
+        shape = _export.symbolic_shape(",".join(dims), scope=scope) \
+            if has_sym else tuple(int(d) for d in dims)
+        avals.append(jax.ShapeDtypeStruct(shape, sp.dtype.np_dtype))
+        specs.append((tuple(sp.shape), str(np.dtype(sp.dtype.np_dtype))))
+    params_now = {n: p._value for n, p in zip(names, param_objs)}
+    exported = _export.export(jax.jit(pure))(params_now, *avals)
+
+    d = _os.path.dirname(path_prefix)
+    if d:
+        _os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    state = {n: np.asarray(jax.device_get(v))
+             for n, v in params_now.items()}
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        _pickle.dump(state, f, protocol=4)
+    meta = {"class": "StaticInferenceModel", "exported": True,
+            "input_spec": specs, "param_names": names,
+            "feed_names": feed_names, "n_out": len(fetch_ids)}
+    with open(path_prefix + ".pdmeta", "wb") as f:
+        _pickle.dump(meta, f)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load a saved inference artifact (upstream contract: returns
+    [program, feed_target_names, fetch_targets]); run it with
+    ``exe.run(program, feed={...}, fetch_list=fetch_targets)``."""
+    from ..jit.save_load import load as _jit_load
+    tl = _jit_load(path_prefix)
+    if tl._exported_fn is None:
+        raise RuntimeError(
+            f"{path_prefix}.pdmodel holds no executable program")
+    meta = tl._meta
+    feed_names = meta.get("feed_names",
+                          [f"x{i}"
+                           for i in range(len(meta.get("input_spec",
+                                                       [])))])
+    n_out = meta.get("n_out", len(tl._exported.out_avals))
+    prog = _InferenceProgram(tl._exported_fn, tl._params, feed_names,
+                             n_out)
+    fetch_targets = list(range(n_out))
+    return [prog, list(feed_names), fetch_targets]
+
+
+# -- scope shims (upstream Scope/Variable access) --------------------------
+
+class _VarView:
+    def __init__(self, name, value):
+        self.name = name
+        self._value = value
+
+    def get_tensor(self):
+        return np.asarray(jax.device_get(self._value))
+
+
+class Scope:
+    """Name → parameter view over the live eager parameters referenced
+    by the default Program (upstream Scope holds static Variables; here
+    parameters ARE the live store — SURVEY.md §3.5)."""
+
+    _last_program = None
+
+    def _programs(self):
+        progs = [default_main_program()]
+        lp = getattr(self, "_last_program", None)
+        if lp is not None and lp not in progs \
+                and isinstance(lp, Program):
+            progs.append(lp)
+        return progs
+
+    def find_var(self, name):
+        for prog in self._programs():
+            for _, arg_specs, _, _ in prog._nodes:
+                for kind, ref in arg_specs:
+                    if kind == "param" and getattr(ref, "name", None) \
+                            == name:
+                        return _VarView(name, ref._value)
+        return None
+
+    def var_names(self):
+        out = []
+        for prog in self._programs():
+            for _, arg_specs, _, _ in prog._nodes:
+                for kind, ref in arg_specs:
+                    if kind == "param":
+                        n = getattr(ref, "name", None)
+                        if n and n not in out:
+                            out.append(n)
+        return out
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    prev, _global_scope = _global_scope, scope
+    try:
+        yield
+    finally:
+        _global_scope = prev
+
+
+# -- places / guards -------------------------------------------------------
+
+def cpu_places(device_count=None):
+    from ..places import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Upstream returns CUDA places; here every accelerator is the TPU
+    (SURVEY.md §2.1 Place row) — returns the framework places for the
+    visible devices so device-count logic in scripts keeps working."""
+    from ..places import TPUPlace
+    ids = device_ids if device_ids is not None \
+        else range(len(jax.devices()))
+    return [TPUPlace(i) for i in ids]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Accepted for script compatibility: XLA owns placement inside a
+    compiled program, so the guard is advisory (documented no-op)."""
+    yield
+
+
+# -- misc ops / vars -------------------------------------------------------
+
+def save(program, model_path, protocol=4, **configs):
+    """Save a Program's parameters (upstream static.save → .pdparams)."""
+    from ..framework.io import save as _save
+    state = {}
+    for _, arg_specs, _, _ in program._nodes:
+        for kind, ref in arg_specs:
+            if kind == "param":
+                n = getattr(ref, "name", None)
+                if n and n not in state:
+                    state[n] = Tensor(ref._value)
+    _save(state, model_path + ".pdparams")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Load parameters saved by static.save back into the live params.
+    Refuses when NOTHING matches (auto-generated names shifted between
+    processes would otherwise leave the model on random init with no
+    error)."""
+    from ..framework.io import load as _load
+    state = _load(model_path + ".pdparams")
+    loaded, seen = 0, set()
+    for _, arg_specs, _, _ in program._nodes:
+        for kind, ref in arg_specs:
+            n = getattr(ref, "name", None)
+            if kind == "param" and n in state and id(ref) not in seen:
+                seen.add(id(ref))
+                v = state[n]
+                ref._value = jnp.asarray(
+                    v.numpy() if isinstance(v, Tensor) else v)
+                loaded += 1
+    if state and loaded == 0:
+        raise RuntimeError(
+            f"static.load: none of the {len(state)} saved parameters "
+            "matched this program's parameter names — parameter "
+            "auto-names depend on construction order; rebuild the "
+            "model identically or name parameters explicitly "
+            "(ParamAttr(name=...))")
+    return loaded
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    v = jnp.full(tuple(shape), value,
+                 dtypes.convert_dtype(dtype).np_dtype)
+    p = Parameter(v, name=name)
+    p.stop_gradient = True
+    return p
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn.layer import Layer
+    helper = Layer()
+    return helper.create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+
+
+Variable = Tensor       # upstream static.Variable ≈ the tensor handle
+
+
+def Print(input, first_n=-1, message=None, summarize=20, **kwargs):
+    """Debug print op (upstream static.Print): prints eagerly, uses
+    jax.debug.print under tracing, and passes the value through."""
+    v = input._value if isinstance(input, Tensor) else input
+    msg = (message + " ") if message else ""
+    if isinstance(v, jax.core.Tracer):
+        jax.debug.print(msg + "{x}", x=v)
+        return input
+    arr = np.asarray(v)
+    shown = arr if arr.ndim == 0 else arr[..., :summarize]
+    print(f"{msg}{shown}")
+    return input
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy op (upstream static.accuracy)."""
+    from .. import ops
+    topk_idx = ops.topk(input, k=k, axis=-1)[1]
+    lab = label if len(label.shape) == len(topk_idx.shape) \
+        else label.unsqueeze(-1)
+    hit = (topk_idx == lab.astype(topk_idx.dtype)).astype("float32")
+    return hit.sum(-1).mean()
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Wrap a host Python callable as an op (upstream static.py_func) —
+    implemented as an XLA host callback, so it works eagerly AND inside
+    compiled programs (same machinery as paddle.utils.cpp_extension)."""
+    from ..ops._primitive import apply_closure
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    out_sds = [jax.ShapeDtypeStruct(tuple(o.shape),
+                                    o._value.dtype) for o in outs]
+
+    def host(*arrays):
+        res = func(*[np.asarray(a) for a in arrays])
+        res = res if isinstance(res, (list, tuple)) else (res,)
+        return tuple(np.asarray(r, dtype=sd.dtype)
+                     for r, sd in zip(res, out_sds))
+
+    def raw(*vals):
+        sds = tuple(out_sds)
+        r = jax.pure_callback(host, sds, *vals,
+                              vmap_method="sequential")
+        return r if len(sds) > 1 else r[0]
+
+    result = apply_closure(raw, list(xs), name="py_func")
+    # upstream contract: results are WRITTEN INTO the out variables so
+    # downstream ops read them (not just the return value)
+    res_list = result if isinstance(result, tuple) else (result,)
+    for o, r in zip(outs, res_list):
+        o._value = r._value
+        o.stop_gradient = r.stop_gradient
+    return result

@@ -1,0 +1,160 @@
+"""paddle.static deployment + scope + misc surface (upstream
+python/paddle/static/: save/load_inference_model, static.save/load,
+global_scope, places, py_func, Print, accuracy, create_*)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+from paddle_tpu.tensor import Tensor
+
+
+@pytest.fixture()
+def built(tmp_path):
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            lin = nn.Linear(4, 2)
+            out = lin(x)
+        exe = static.Executor()
+        xv = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        (ref,) = exe.run(main, feed={"x": xv}, fetch_list=[out])
+    finally:
+        paddle.disable_static()
+    return main, x, out, lin, exe, xv, ref, str(tmp_path)
+
+
+def test_save_load_inference_model_roundtrip(built):
+    main, x, out, lin, exe, xv, ref, d = built
+    prefix = os.path.join(d, "infer")
+    static.save_inference_model(prefix, [x], [out], exe, program=main)
+    prog, feed_names, fetch_targets = static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    (got,) = exe.run(prog, feed={"x": xv}, fetch_list=fetch_targets)
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+    # dynamic batch via the exported symbolic dim
+    (got2,) = exe.run(prog, feed={"x": np.concatenate([xv, xv])},
+                      fetch_list=fetch_targets)
+    assert got2.shape == (6, 2)
+    # same artifact loads through paddle.inference
+    from paddle_tpu.inference import Config, create_predictor
+    pred = create_predictor(Config(prefix))
+    np.testing.assert_allclose(pred.run([xv])[0], ref, rtol=1e-6)
+
+
+def test_static_save_load_params(built):
+    main, x, out, lin, exe, xv, ref, d = built
+    path = os.path.join(d, "ckpt")
+    static.save(main, path)
+    w0 = np.asarray(lin.weight.numpy()).copy()
+    lin.weight._value = lin.weight._value * 0.0
+    assert static.load(main, path) >= 1
+    np.testing.assert_allclose(np.asarray(lin.weight.numpy()), w0)
+
+
+def test_scope_and_places_and_guards(built):
+    main, x, out, lin, exe, xv, ref, d = built
+    v = static.global_scope().find_var(lin.weight.name)
+    assert v is not None and v.get_tensor().shape == (4, 2)
+    assert lin.weight.name in static.global_scope().var_names()
+    with static.scope_guard(static.Scope()):
+        pass
+    assert len(static.cpu_places(2)) == 2
+    assert len(static.cuda_places()) >= 1
+    with static.device_guard("gpu:0"):
+        pass
+
+
+def test_py_func_and_print_and_accuracy():
+    import jax
+    rng = np.random.RandomState(0)
+    x = Tensor(rng.rand(4, 3).astype(np.float32))
+
+    out_template = Tensor(np.zeros((4, 3), np.float32))
+    r = static.py_func(lambda a: a * 2.0 + 1.0, x, out_template)
+    np.testing.assert_allclose(np.asarray(r.numpy()),
+                               np.asarray(x.numpy()) * 2 + 1, rtol=1e-6)
+    # works inside jit (host callback)
+    g = jax.jit(lambda v: static.py_func(
+        lambda a: a * 2.0 + 1.0, Tensor(v), out_template)._value)
+    np.testing.assert_allclose(np.asarray(g(x._value)),
+                               np.asarray(x.numpy()) * 2 + 1, rtol=1e-6)
+
+    static.Print(x, message="dbg")          # eager path prints
+
+    logits = Tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    labels = Tensor(np.array([1, 1], np.int64))
+    acc = static.accuracy(logits, labels)
+    assert abs(float(acc.numpy()) - 0.5) < 1e-6
+
+
+def test_create_vars():
+    g = static.create_global_var([2, 2], 3.0, "float32")
+    assert float(np.asarray(g.numpy()).sum()) == 12.0
+    p = static.create_parameter([3, 3], "float32")
+    assert tuple(p.shape) == (3, 3)
+    assert static.Variable is Tensor
+
+
+def test_save_inference_model_prunes_label_branch(tmp_path):
+    """The recorded program holds a loss branch reading the label feed;
+    exporting [x]->[logits] must prune it (and refuse only when the
+    FETCH actually needs an unlisted feed)."""
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("px", [None, 4], "float32")
+            y = static.data("py", [None], "int64")
+            lin = nn.Linear(4, 3)
+            logits = lin(x)
+            loss = nn.CrossEntropyLoss()(logits, y)
+        exe = static.Executor()
+        prefix = str(tmp_path / "pruned")
+        static.save_inference_model(prefix, [x], [logits], exe,
+                                    program=main)
+        with pytest.raises(ValueError, match="py"):
+            static.save_inference_model(str(tmp_path / "bad"), [x],
+                                        [loss], exe, program=main)
+    finally:
+        paddle.disable_static()
+    prog, feeds, fetches = static.load_inference_model(prefix)
+    assert feeds == ["px"]
+    xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+    (out,) = static.Executor().run(prog, feed={"px": xv},
+                                   fetch_list=fetches)
+    assert out.shape == (2, 3)
+
+
+def test_py_func_writes_out_and_print_scalar():
+    x = Tensor(np.array([1.0, 2.0], np.float32))
+    out = Tensor(np.zeros(2, np.float32))
+    static.py_func(lambda a: a + 5.0, x, out)
+    np.testing.assert_allclose(np.asarray(out.numpy()), [6.0, 7.0])
+    static.Print(Tensor(np.float32(3.0)), message="scalar")   # no crash
+
+
+def test_static_load_refuses_no_match(tmp_path, built):
+    main, x, out, lin, exe, xv, ref, d = built
+    path = str(tmp_path / "p")
+    static.save(main, path)
+    other = static.Program()      # empty program: nothing matches
+    paddle.enable_static()
+    try:
+        with static.program_guard(other):
+            x2 = static.data("x2", [None, 4], "float32")
+            lin2 = nn.Linear(4, 2)
+            _ = lin2(x2)
+    finally:
+        paddle.disable_static()
+    # names differ (fresh auto names) -> loud refusal, not silent 0
+    if lin2.weight.name != lin.weight.name:
+        with pytest.raises(RuntimeError, match="none of the"):
+            static.load(other, path)
