@@ -330,8 +330,14 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
     in_ids = [id(t) for t in inputs]
     out_ids = [id(t) for t in outputs]
 
-    # forward-reachable from inputs, then backward-reachable to outputs
-    dep = set(in_ids)
+    # forward-reachable from inputs, then backward-reachable to outputs.
+    # Static-mode FEED placeholders seed reachability too: a node
+    # computed purely from a feed (param-free preprocessing) must be
+    # REPLAYED, not baked at its placeholder value
+    feed_ids = {id(a) for node in nodes for a in node.args
+                if isinstance(a, Tensor)
+                and getattr(a, "_is_feed", False)}
+    dep = set(in_ids) | feed_ids
     sub = []
     for node in nodes:
         if any(isinstance(a, Tensor) and id(a) in dep for a in node.args):
@@ -392,8 +398,15 @@ def _grad_create_graph(outputs, inputs, grad_outputs, allow_unused):
     extra, seen = [], set(uniq_ids)
     for node in keep:
         for a in node.args:
-            if (isinstance(a, Tensor) and not a.stop_gradient
-                    and id(a) not in seen and id(a) not in produced):
+            if (isinstance(a, Tensor)
+                    and id(a) not in seen and id(a) not in produced
+                    # static-mode FEED placeholders must be closure
+                    # args even though they don't require grad: the
+                    # Executor substitutes the fed value at replay —
+                    # baking the placeholder in would differentiate at
+                    # the wrong point
+                    and (not a.stop_gradient
+                         or getattr(a, "_is_feed", False))):
                 seen.add(id(a))
                 extra.append(a)
     # grad_outputs that are required-grad Tensors are part of the graph

@@ -199,6 +199,9 @@ def data(name: str, shape, dtype="float32", lod_level=0) -> Tensor:
     t._sym_id = sid
     prog._feed_ids[name] = sid
     prog._sym_ids.add(sid)
+    if not hasattr(prog, "_feed_tensors"):
+        prog._feed_tensors = {}
+    prog._feed_tensors[name] = t
     prog._compiled.clear()
     return t
 
@@ -426,6 +429,40 @@ def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
 # deployment + scope + misc static surface (upstream python/paddle/static/)
 # ---------------------------------------------------------------------------
 
+def _collect_params(program):
+    """Unique (name, Parameter) pairs the program's nodes read, in
+    first-appearance order with de-duplicated auto names."""
+    objs, seen = [], set()
+    for _, arg_specs, _, _ in program._nodes:
+        for kind, ref in arg_specs:
+            if kind == "param" and id(ref) not in seen:
+                seen.add(id(ref))
+                objs.append(ref)
+    names, used = [], set()
+    for i, p in enumerate(objs):
+        n = getattr(p, "name", None) or f"param_{i}"
+        if n in used:
+            n = f"{n}__{i}"
+        used.add(n)
+        names.append(n)
+    return names, objs
+
+
+def _prune_to_fetches(nodes, fetch_ids):
+    """Backward slice: the nodes needed to produce ``fetch_ids`` and
+    the full set of sym ids they read (upstream feed/fetch pruning)."""
+    need = set(fetch_ids)
+    keep = []
+    for node in reversed(nodes):
+        _, arg_specs_, _, out_ids_ = node
+        if any(o in need for o in out_ids_):
+            keep.append(node)
+            need.update(ref for kind, ref in arg_specs_
+                        if kind == "sym")
+    keep.reverse()
+    return keep, need
+
+
 class _InferenceProgram:
     """Loaded inference artifact: Executor.run dispatches here."""
 
@@ -469,33 +506,12 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                 "recorded in this program")
         fetch_ids.append(sid)
 
-    # live params the graph references, name-keyed
-    param_objs, seen = [], set()
-    for _, arg_specs, _, _ in program._nodes:
-        for kind, ref in arg_specs:
-            if kind == "param" and id(ref) not in seen:
-                seen.add(id(ref))
-                param_objs.append(ref)
-    names, used = [], set()
-    for i, p in enumerate(param_objs):
-        n = getattr(p, "name", None) or f"param_{i}"
-        if n in used:
-            n = f"{n}__{i}"
-        used.add(n)
-        names.append(n)
-    # prune to the fetch subgraph (upstream prune_backward +
-    # feed/fetch pruning): the recorded program may hold loss/metric
-    # branches that read feeds (labels) the inference model must not
-    # require
-    need = set(fetch_ids)
-    keep = []
-    for node in reversed(program._nodes):
-        _, arg_specs_, _, out_ids_ = node
-        if any(o in need for o in out_ids_):
-            keep.append(node)
-            need.update(ref for kind, ref in arg_specs_
-                        if kind == "sym")
-    keep.reverse()
+    # live params the graph references, name-keyed; prune to the fetch
+    # subgraph (upstream prune_backward + feed/fetch pruning): the
+    # recorded program may hold loss/metric branches that read feeds
+    # (labels) the inference model must not require
+    names, param_objs = _collect_params(program)
+    keep, need = _prune_to_fetches(program._nodes, fetch_ids)
     extra = [n for n, fid in program._feed_ids.items()
              if fid in need and n not in feed_names]
     if extra:
@@ -687,13 +703,8 @@ def device_guard(device=None):
 def save(program, model_path, protocol=4, **configs):
     """Save a Program's parameters (upstream static.save → .pdparams)."""
     from ..framework.io import save as _save
-    state = {}
-    for _, arg_specs, _, _ in program._nodes:
-        for kind, ref in arg_specs:
-            if kind == "param":
-                n = getattr(ref, "name", None)
-                if n and n not in state:
-                    state[n] = Tensor(ref._value)
+    names, objs = _collect_params(program)
+    state = {n: Tensor(p._value) for n, p in zip(names, objs)}
     _save(state, model_path + ".pdparams")
 
 
@@ -799,3 +810,122 @@ def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
         o._value = r._value
         o.stop_gradient = r.stop_gradient
     return result
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append gradient computation for ``loss`` (upstream
+    static.append_backward): returns ``[(param, grad)]`` for the
+    parameters actually reachable from the loss (upstream emits no
+    None-grad pairs).
+
+    The grads are produced through the create_graph tape path, which
+    records them as ONE closure node in the current Program — so they
+    are fetchable by ``Executor.run`` and consumable by further
+    recorded ops (custom static update rules)."""
+    prog = default_main_program()
+    sid = getattr(loss, "_sym_id", None)
+    if sid is None or sid not in prog._sym_ids:
+        raise RuntimeError(
+            "append_backward: loss was not recorded in the CURRENT "
+            "program — call it inside the same program_guard that "
+            "built the loss (upstream resolves via loss.block.program; "
+            "here the current program must match)")
+    if parameter_list is None:
+        _, objs = _collect_params(prog)
+        params = [p for p in objs
+                  if not p.stop_gradient
+                  and getattr(p, "trainable", True)]
+    else:
+        params = list(parameter_list)
+    if not params:
+        raise RuntimeError(
+            "append_backward: no trainable parameters reachable from "
+            "the recorded program")
+    # differentiate the PROGRAM graph, not the autograd tape: static
+    # mode records every op (including param-free preprocessing of
+    # feeds the tape never sees), so the replay is the ground truth
+    keep, need = _prune_to_fetches(prog._nodes, [sid])
+    used_param_ids = {id(ref) for _, specs_, _, _ in keep
+                      for kind, ref in specs_ if kind == "param"}
+    params = [p for p in params if id(p) in used_param_ids]
+    if not params:
+        raise RuntimeError(
+            "append_backward: no trainable parameter is reachable from "
+            "this loss")
+    feed_items = [(n, fid) for n, fid in prog._feed_ids.items()
+                  if fid in need]
+    feed_tensors = [prog._feed_tensors[n] for n, _ in feed_items]
+    nf = len(feed_tensors)
+    nodes_ = list(keep)
+
+    def raw(*vals):
+        fvals = vals[:nf]
+        pvals = vals[nf:]
+
+        def loss_of(pv):
+            env = {fid: v for (_, fid), v in zip(feed_items, fvals)}
+            pmap = {id(p): v for p, v in zip(params, pv)}
+
+            def resolve(spec):
+                kind, ref = spec
+                if kind == "sym":
+                    return env[ref]
+                if kind == "param":
+                    # params not being differentiated stay constants
+                    return pmap.get(id(ref), getattr(ref, "_value",
+                                                     ref))
+                return ref
+
+            for f, specs_, kw, out_ids in nodes_:
+                avals = [resolve(sp) for sp in specs_]
+                out = f(*avals, **kw)
+                outs = out if isinstance(out, tuple) else (out,)
+                for oid, v in zip(out_ids, outs):
+                    env[oid] = v
+            return jnp.sum(env[sid])
+
+        return jax.grad(loss_of)(tuple(pvals))
+
+    from ..ops._primitive import apply_closure
+    grads = apply_closure(raw, feed_tensors + list(params),
+                          name="append_backward")
+    grads = grads if isinstance(grads, tuple) else (grads,)
+    return list(zip(params, grads))
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune to the feed→fetch subgraph (upstream normalize_program):
+    the returned test-mode clone keeps only the nodes the fetches need
+    and only the feed declarations listed in ``feed_vars`` — so
+    ``exe.run(pruned, feed={only listed feeds})`` works even when the
+    original program declared more feeds (labels)."""
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    fetch_ids = []
+    for v in fetch_vars:
+        sid = getattr(v, "_sym_id", None)
+        if sid is None or sid not in program._sym_ids:
+            raise ValueError(
+                "normalize_program: fetch_vars must be outputs recorded "
+                "in this program")
+        fetch_ids.append(sid)
+    keep_names = {getattr(v, "name", None) for v in feed_vars}
+    keep, need = _prune_to_fetches(program._nodes, fetch_ids)
+    extra = [n for n, fid in program._feed_ids.items()
+             if fid in need and n not in keep_names]
+    if extra:
+        raise ValueError(
+            f"normalize_program: the fetch subgraph also reads feeds "
+            f"{extra} not listed in feed_vars")
+    cl = program.clone(for_test=True)
+    cl._nodes = list(keep)
+    cl._feed_ids = {n: fid for n, fid in program._feed_ids.items()
+                    if n in keep_names}
+    cl._feed_specs = {n: sp for n, sp in program._feed_specs.items()
+                      if n in keep_names}
+    cl._compiled = {}
+    cl._version += 1
+    return cl

@@ -158,3 +158,81 @@ def test_static_load_refuses_no_match(tmp_path, built):
     if lin2.weight.name != lin.weight.name:
         with pytest.raises(RuntimeError, match="none of the"):
             static.load(other, path)
+
+
+def test_append_backward_fetchable_grads():
+    """static.append_backward records tape grads as a program node:
+    fetchable, and they track the FED value (not the placeholder)."""
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("ab_x", [4, 3], "float32")
+            lin = nn.Linear(3, 1, bias_attr=False)
+            loss = (lin(x) ** 2).mean()
+            (p, g), = static.append_backward(loss)
+            assert p is lin.weight
+        exe = static.Executor()
+        xv = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        _, gv = exe.run(main, feed={"ab_x": xv}, fetch_list=[loss, g])
+        w = np.asarray(lin.weight.numpy())
+        expect = 2 * xv.T @ (xv @ w) / 4
+        np.testing.assert_allclose(gv, expect, rtol=1e-5)
+        _, gv2 = exe.run(main, feed={"ab_x": xv * 2},
+                         fetch_list=[loss, g])
+        np.testing.assert_allclose(gv2, expect * 4, rtol=1e-5)
+        assert static.normalize_program(main, [x], [loss])._train is None
+    finally:
+        paddle.disable_static()
+
+
+def test_append_backward_feed_derived_and_none_filter():
+    """Param-free preprocessing of a feed must be replayed at the FED
+    value (not baked at the placeholder); unreachable params yield no
+    pair."""
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("fd_x", [4, 3], "float32")
+            lin = nn.Linear(3, 1, bias_attr=False)
+            other = nn.Linear(3, 1, bias_attr=False)   # unreachable
+            h = x * 2.0                                # param-free pre
+            loss = (lin(h) ** 2).mean()
+            pairs = static.append_backward(loss)
+            assert len(pairs) == 1 and pairs[0][0] is lin.weight
+            g = pairs[0][1]
+        exe = static.Executor()
+        xv = np.random.RandomState(0).rand(4, 3).astype(np.float32)
+        _, gv = exe.run(main, feed={"fd_x": xv}, fetch_list=[loss, g])
+        w = np.asarray(lin.weight.numpy())
+        h_ = xv * 2.0
+        expect = 2 * h_.T @ (h_ @ w) / 4
+        np.testing.assert_allclose(gv, expect, rtol=1e-5)
+    finally:
+        paddle.disable_static()
+
+
+def test_normalize_program_prunes_feeds():
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("np_x", [None, 4], "float32")
+            y = static.data("np_y", [None], "int64")
+            lin = nn.Linear(4, 3)
+            logits = lin(x)
+            loss = nn.CrossEntropyLoss()(logits, y)
+        pruned = static.normalize_program(main, [x], [logits])
+        exe = static.Executor()
+        xv = np.random.RandomState(0).rand(2, 4).astype(np.float32)
+        (out,) = exe.run(pruned, feed={"np_x": xv},
+                         fetch_list=[logits])     # no label needed
+        assert out.shape == (2, 3)
+        with pytest.raises(ValueError, match="np_y"):
+            static.normalize_program(main, [x], [loss])
+    finally:
+        paddle.disable_static()
