@@ -6,7 +6,8 @@ from .base.topology import (  # noqa
 from .fleet import Fleet, fleet_instance as _fleet  # noqa
 from . import meta_parallel  # noqa
 from . import utils  # noqa
-from .recompute import recompute, recompute_sequential  # noqa
+from .recompute import (recompute, recompute_sequential,
+                        recompute_hybrid)  # noqa
 
 # module-level singleton API (upstream: fleet.init(...) etc.)
 init = _fleet.init
