@@ -28,6 +28,7 @@ from .compressed import (  # noqa
     quantized_all_reduce, bf16_all_reduce, compressed_psum_tree)
 from .fleet.recompute import recompute  # noqa
 from . import checkpoint  # noqa
+from . import resilience  # noqa
 from . import passes  # noqa
 
 # auto-parallel style API

@@ -17,15 +17,24 @@ events surface as SIGTERM from the launch watchdog).
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import signal
 import threading
-from typing import Any, Dict, Optional
+import warnings
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 import jax
 
 from ...tensor import Tensor
+from ..resilience import faults as _faults
+from ..resilience import retry as _retry
+
+#: written into each step dir at commit time; restore only trusts steps
+#: whose on-disk bytes still match it (torn/corrupt dirs are skipped)
+MANIFEST_NAME = "RESILIENCE_MANIFEST.json"
 
 
 def _to_arrays(tree):
@@ -71,13 +80,21 @@ class CheckpointManager:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.save_interval_steps = max(1, int(save_interval_steps))
+        self._async = bool(async_save)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep,
             save_interval_steps=self.save_interval_steps,
             enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
-        self._lock = threading.Lock()
+        # RLock: the SIGTERM preemption handler may re-enter save()
+        # while the main thread holds the lock lower on the same stack
+        self._lock = threading.RLock()
         self._last_payload = None
+        self._pending_manifest: List[int] = []
+        self._prev_sigterm = None
+        self._sigterm_handler = None
+        self._in_save = False
+        self._deferred_sigterm = None
 
     # -- save ---------------------------------------------------------------
     def _payload(self, model=None, optimizer=None,
@@ -94,18 +111,175 @@ class CheckpointManager:
     def save(self, step: int, model=None, optimizer=None,
              extra: Optional[Dict[str, Any]] = None,
              force: bool = False) -> bool:
-        """Save if the step hits the interval (or force). Async-safe."""
+        """Save if the step hits the interval (or force). Async-safe.
+
+        The write is retried on transient IO errors; once the data is
+        committed a verification manifest (sizes + sha256 digests of
+        every file in the step dir) is written alongside it, making the
+        step eligible for :meth:`restore`'s verified scan."""
         import orbax.checkpoint as ocp
         with self._lock:
-            self._last_payload = (model, optimizer, extra)
-            saved = self._mgr.save(
-                step, args=ocp.args.StandardSave(
-                    self._payload(model, optimizer, extra)),
-                force=force)
-            return bool(saved)
+            self._in_save = True
+            try:
+                self._last_payload = (model, optimizer, extra)
+                payload = self._payload(model, optimizer, extra)
+
+                def _write():
+                    _faults.fault_point("checkpoint.save", step=step)
+                    return self._mgr.save(
+                        step, args=ocp.args.StandardSave(payload),
+                        force=force)
+
+                saved = _retry.retry_call(
+                    _write, max_attempts=3, base_delay=0.1,
+                    deadline=60.0, retry_on=(OSError,),
+                    label="checkpoint.save")
+                if saved:
+                    # manifest hashing happens OUTSIDE the lock
+                    # (below): the data is committed, and holding the
+                    # lock across sha256 of a large tree would starve
+                    # the SIGTERM preemption path
+                    self._pending_manifest.append(int(step))
+            finally:
+                self._in_save = False
+        if saved:
+            from ..resilience import watchdog as _wd
+            _wd.notify_step(int(step))  # checkpoint IO is progress
+            if self._async:
+                # rolling flush: orbax serialises saves, so by the
+                # time save(N) returns every pending step < N is fully
+                # committed and safe to digest — without this, a
+                # SIGKILLed async run leaves its whole incarnation
+                # unmanifested and restore rolls back past all of it
+                self._flush_manifests(older_than=int(step))
+            else:
+                self._flush_manifests()
+        # a SIGTERM that landed while the save above was mid-flight
+        # was deferred (re-entering orbax mid-write corrupts both
+        # checkpoints); run it now that the manager is idle
+        deferred, self._deferred_sigterm = self._deferred_sigterm, None
+        if deferred is not None and self._sigterm_handler is not None:
+            self._sigterm_handler(*deferred)
+        return bool(saved)
 
     def wait_until_finished(self):
         self._mgr.wait_until_finished()
+        self._flush_manifests()
+
+    # -- verification --------------------------------------------------------
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _flush_manifests(self, older_than: Optional[int] = None):
+        if not self._pending_manifest:
+            return
+        if self._async and older_than is None:
+            # never digest a step whose async write is still in
+            # flight — a manifest over half-written files would brand
+            # a good checkpoint corrupt forever.  (With ``older_than``
+            # the caller guarantees those writes have completed.)
+            self._mgr.wait_until_finished()
+        if older_than is None:
+            pending, self._pending_manifest = \
+                self._pending_manifest, []
+        else:
+            pending = [t for t in self._pending_manifest
+                       if t < older_than]
+            self._pending_manifest = [
+                t for t in self._pending_manifest if t >= older_than]
+        kept = None
+        for step in pending:
+            if os.path.isdir(self._step_dir(step)):
+                self._commit_manifest(step)
+                continue
+            # distinguish "async save failed" (the vanished step is
+            # the newest we know of) from healthy max_to_keep
+            # retention (orbax deleted an old step)
+            if kept is None:
+                try:
+                    kept = set(self._mgr.all_steps())
+                except Exception:
+                    kept = set()
+            if step in kept or step > max(kept, default=-1):
+                warnings.warn(
+                    f"CheckpointManager: step {step} was queued for a "
+                    "commit manifest but its directory never appeared "
+                    "(async save failed?); it will stay unverified")
+
+    @staticmethod
+    def _digest(path: str) -> str:
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+
+    def _walk_step_files(self, step: int) -> Dict[str, str]:
+        """rel-path → abs-path of every data file in a step dir (the
+        one traversal shared by manifest creation and verification)."""
+        root = self._step_dir(step)
+        out: Dict[str, str] = {}
+        for dirpath, _, files in os.walk(root):
+            for name in files:
+                if name == MANIFEST_NAME:
+                    continue
+                p = os.path.join(dirpath, name)
+                out[os.path.relpath(p, root)] = p
+        return out
+
+    def _scan_files(self, step: int) -> Dict[str, Dict[str, Any]]:
+        return {rel: {"size": os.path.getsize(p),
+                      "sha256": self._digest(p)}
+                for rel, p in self._walk_step_files(step).items()}
+
+    def _commit_manifest(self, step: int):
+        """Written strictly AFTER the checkpoint data is on disk: a
+        crash between data-commit and manifest leaves the step
+        *unverified*, so restore skips it (torn-commit semantics)."""
+        _faults.fault_point("checkpoint.commit", step=step)
+        manifest = {"step": int(step), "files": self._scan_files(step)}
+        path = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        tmp = path + ".tmp"
+
+        def _write():
+            with open(tmp, "w") as f:
+                json.dump(manifest, f)
+            os.replace(tmp, path)
+
+        _retry.retry_call(_write, max_attempts=3, base_delay=0.05,
+                          deadline=15.0, retry_on=(OSError,),
+                          label="checkpoint.manifest")
+
+    def verify_step(self, step: int) -> bool:
+        """True iff the step dir's bytes match its commit manifest."""
+        path = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return False
+        expected = manifest.get("files", {})
+        actual = self._walk_step_files(step)
+        if set(expected) - set(actual):
+            return False  # files missing (truncated dir)
+        for rel, meta in expected.items():
+            p = actual[rel]
+            try:
+                if os.path.getsize(p) != meta["size"]:
+                    return False
+                if self._digest(p) != meta["sha256"]:
+                    return False
+            except OSError:
+                return False
+        return True
+
+    def verified_steps(self) -> List[int]:
+        self._flush_manifests()
+        return [s for s in self.all_steps() if self.verify_step(s)]
+
+    def latest_verified_step(self) -> Optional[int]:
+        vs = self.verified_steps()
+        return vs[-1] if vs else None
 
     # -- restore ------------------------------------------------------------
     def latest_step(self) -> Optional[int]:
@@ -115,13 +289,125 @@ class CheckpointManager:
         return sorted(self._mgr.all_steps())
 
     def restore(self, model=None, optimizer=None,
-                step: Optional[int] = None) -> int:
-        """Load the given (or latest) step into model/optimizer in
-        place; returns the restored step (0 if no checkpoint)."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
-            return 0
-        restored = self._mgr.restore(step)
+                step: Optional[int] = None,
+                verified_only: bool = True) -> int:
+        """Load the given (or latest usable) step into model/optimizer
+        in place; returns the restored step (0 if no checkpoint).
+
+        With ``step=None`` the scan walks **backwards** over saved
+        steps: unverified or corrupt dirs (torn commit, truncated
+        files, digest mismatch) are skipped with a warning instead of
+        crashing the job on the newest checkpoint — the elastic
+        RESTART contract resumes from the latest checkpoint that can
+        actually be read.  Steps whose bytes contradict their commit
+        manifest are never attempted.  Manifest-less steps
+        (pre-resilience checkpoints, or commits whose manifest flush
+        was lost to a SIGKILL) are attempted *after* all verified
+        steps when ``verified_only=True`` (default, warned), or
+        newest-first alongside them when ``verified_only=False``.
+        On success every *newer* step is quarantined — renamed into
+        ``_quarantined/``, never deleted — so the resumed run can
+        re-save those step numbers while the bytes stay
+        recoverable."""
+        if step is not None:
+            return self._restore_step(int(step), model, optimizer)
+        self._flush_manifests()
+        candidates = sorted(self.all_steps(), reverse=True)
+        # classification is by manifest EXISTENCE only (cheap); the
+        # sha256 check runs lazily per attempted step, so the common
+        # newest-step-is-fine relaunch never digests older checkpoints
+        manifested = [s for s in candidates if os.path.exists(
+            os.path.join(self._step_dir(s), MANIFEST_NAME))]
+        unverified = [s for s in candidates if s not in manifested]
+        corrupt: List[int] = []      # bytes contradict their manifest
+        order = (manifested + unverified) if verified_only else \
+            candidates
+        for s in order:
+            if s in manifested:
+                if not self.verify_step(s):
+                    warnings.warn(
+                        f"CheckpointManager: step {s} failed "
+                        "verification (torn or corrupt checkpoint); "
+                        "falling back to an older step")
+                    corrupt.append(s)
+                    continue
+            else:
+                warnings.warn(
+                    f"CheckpointManager: attempting manifest-less "
+                    f"step {s} (pre-resilience checkpoint, or its "
+                    "manifest flush was lost); restoring without "
+                    "verification")
+            try:
+                restored = self._restore_step(s, model, optimizer)
+            except Exception as e:  # noqa: BLE001 — scan past bad dirs
+                warnings.warn(
+                    f"CheckpointManager: restoring step {s} failed "
+                    f"({type(e).__name__}: {e}); falling back to an "
+                    "older step")
+                continue
+            # every newer step is unusable garbage from an aborted
+            # future (failed verification, failed read, or was never
+            # trusted): move it out of the step namespace or the
+            # resumed run wedges on orbax's existing-step refusal at
+            # re-save time
+            self._quarantine_steps([t for t in candidates if t > s])
+            return restored
+        # nothing restorable: still quarantine dirs whose bytes
+        # contradict their own manifest (definite corruption), or a
+        # from-scratch rerun wedges on StepAlreadyExists the moment it
+        # re-reaches those step numbers.  Steps that merely failed to
+        # *read* (transient outage) are left untouched.
+        self._quarantine_steps(corrupt)
+        return 0
+
+    def _quarantine_steps(self, steps: List[int]):
+        """Move unusable step dirs aside (``_quarantined/``): clears
+        the step namespace so the resumed run can re-save those steps,
+        while preserving the bytes for manual recovery."""
+        qroot = os.path.join(self.directory, "_quarantined")
+        for s in sorted(set(steps)):
+            src = self._step_dir(s)
+            if not os.path.isdir(src):
+                continue
+            os.makedirs(qroot, exist_ok=True)
+            dst = os.path.join(qroot, str(s))
+            n = 0
+            while os.path.exists(dst):
+                n += 1
+                dst = os.path.join(qroot, f"{s}.{n}")
+            warnings.warn(
+                f"CheckpointManager: quarantining unusable checkpoint "
+                f"step {s} -> {dst}")
+            try:
+                os.replace(src, dst)
+            except OSError as e:
+                warnings.warn(
+                    f"CheckpointManager: could not quarantine step "
+                    f"{s} ({e}); a later save of this step may fail")
+        if steps:
+            try:
+                self._mgr.reload()
+            except Exception:
+                pass
+
+    def _restore_step(self, step: int, model=None, optimizer=None
+                      ) -> int:
+        import orbax.checkpoint as ocp
+
+        def _read():
+            _faults.fault_point("checkpoint.restore", step=step)
+            try:
+                # explicit item layout: required in a fresh process,
+                # where the manager has never saved and so has no
+                # registered handler for the step
+                return self._mgr.restore(
+                    step, args=ocp.args.StandardRestore())
+            except (KeyError, TypeError):
+                return self._mgr.restore(step)
+
+        restored = _retry.retry_call(
+            _read, max_attempts=3, base_delay=0.1, deadline=60.0,
+            retry_on=(OSError,), label="checkpoint.restore")
         if model is not None and "model" in restored:
             sd = model.state_dict()
             _assign_back(sd, restored["model"])
@@ -132,11 +418,23 @@ class CheckpointManager:
 
     # -- preemption ---------------------------------------------------------
     def save_on_preemption(self, get_step, model=None, optimizer=None):
-        """Install a SIGTERM handler that force-saves before exit.
-        ``get_step``: callable returning the current step."""
+        """Install a SIGTERM handler that force-saves before exit
+        (TPU maintenance events surface as SIGTERM from the launch
+        watchdog).  ``get_step``: callable returning the current step.
+        The previous handler is preserved and restored by
+        :meth:`uninstall_preemption_handler` / :meth:`close` — without
+        that, a manager outliving its training phase would keep
+        force-saving stale state on every later SIGTERM."""
         prev = signal.getsignal(signal.SIGTERM)
 
         def handler(signum, frame):
+            if self._in_save:
+                # the signal interrupted a frame that is inside
+                # self._mgr.save(): orbax is not re-entrant, so a save
+                # from here would corrupt both checkpoints.  Defer —
+                # save() runs the handler as soon as it unwinds.
+                self._deferred_sigterm = (signum, frame)
+                return
             try:
                 self.save(int(get_step()), model, optimizer, force=True)
                 self.wait_until_finished()
@@ -146,11 +444,38 @@ class CheckpointManager:
                 else:
                     raise SystemExit(143)
 
+        self._prev_sigterm = prev
+        self._sigterm_handler = handler
         signal.signal(signal.SIGTERM, handler)
 
+    def uninstall_preemption_handler(self):
+        """Restore the pre-existing SIGTERM disposition (no-op when the
+        handler was never installed, or when someone else has since
+        replaced it — never clobber a newer handler)."""
+        if self._sigterm_handler is None:
+            return
+        try:
+            if signal.getsignal(signal.SIGTERM) is self._sigterm_handler:
+                signal.signal(signal.SIGTERM,
+                              self._prev_sigterm or signal.SIG_DFL)
+        except ValueError:
+            pass  # not the main thread: leave the handler in place
+        finally:
+            self._sigterm_handler = None
+            self._prev_sigterm = None
+
     def close(self):
+        self.uninstall_preemption_handler()
         try:
             self._mgr.wait_until_finished()
+            self._flush_manifests()
             self._mgr.close()
         except Exception:
             pass
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -80,7 +80,8 @@ def quantized_all_reduce(x, axis_name: str, bits: int = 8,
     W-1 reduce-scatter hops (each rank owns chunk r at the end) then
     W-1 all-gather hops; every payload crosses the link quantized.
     Returns fp32 of x's shape (cast back to x.dtype)."""
-    W = lax.axis_size(axis_name)
+    from .shard_map_compat import axis_size
+    W = axis_size(axis_name)
     if W == 1:
         return x
     r = lax.axis_index(axis_name)

@@ -15,6 +15,7 @@ get_hybrid_communicate_group = _fleet.get_hybrid_communicate_group
 distributed_model = _fleet.distributed_model
 distributed_optimizer = _fleet.distributed_optimizer
 distributed_runner = _fleet.distributed_runner
+enable_resilience = _fleet.enable_resilience
 worker_index = _fleet.worker_index
 worker_num = _fleet.worker_num
 is_first_worker = _fleet.is_first_worker

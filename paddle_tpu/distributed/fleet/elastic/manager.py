@@ -28,6 +28,9 @@ import urllib.error
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from ...resilience import faults as _faults
+from ...resilience import retry as _retry
+
 
 class ElasticStatus(enum.Enum):
     COMPLETED = "completed"
@@ -52,6 +55,23 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         if body:
             self.wfile.write(body)
+
+    def handle_one_request(self):
+        # server-side chaos: an ``error`` rule on kv.server answers 500
+        # (registry hiccup as clients see it); ``latency`` stalls the
+        # response inside fire()
+        try:
+            _faults.fault_point("kv.server")
+        except _faults.InjectedFault:
+            try:
+                self.raw_requestline = self.rfile.readline(65537)
+                if self.raw_requestline and self.parse_request():
+                    self._send(500)
+            except Exception:
+                pass
+            self.close_connection = True
+            return
+        super().handle_one_request()
 
     def do_PUT(self):
         n = int(self.headers.get("Content-Length", 0))
@@ -116,17 +136,37 @@ class KVServer:
 
 
 class KVClient:
-    def __init__(self, server: str, timeout: float = 3.0):
+    """All registry traffic goes through one retried request path:
+    transient transport errors and 5xx responses back off and retry
+    (``resilience.retry``); 4xx other than 404 fail fast."""
+
+    def __init__(self, server: str, timeout: float = 3.0,
+                 max_attempts: int = 5, retry_deadline: float = 15.0):
         self._base = server.rstrip("/")
         self._timeout = timeout
+        self._max_attempts = max_attempts
+        self._retry_deadline = retry_deadline
 
-    def _req(self, method: str, path: str, data: Optional[bytes] = None):
+    @staticmethod
+    def _giveup(e: BaseException) -> bool:
+        return (isinstance(e, urllib.error.HTTPError)
+                and 400 <= e.code < 500)
+
+    def _send(self, method: str, path: str, data: Optional[bytes]):
+        _faults.fault_point("kv.request", method=method, path=path)
         req = urllib.request.Request(self._base + path, data=data,
                                      method=method)
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            return resp.read().decode()
+
+    def _req(self, method: str, path: str, data: Optional[bytes] = None):
         try:
-            with urllib.request.urlopen(req,
-                                        timeout=self._timeout) as resp:
-                return resp.read().decode()
+            return _retry.retry_call(
+                self._send, method, path, data,
+                max_attempts=self._max_attempts,
+                base_delay=0.05, max_delay=1.0,
+                deadline=self._retry_deadline,
+                giveup=self._giveup, label="kv.request")
         except urllib.error.HTTPError as e:
             if e.code == 404:
                 return None
@@ -142,6 +182,8 @@ class KVClient:
         self._req("DELETE", key)
 
     def heartbeat(self, node_id: str, payload: str = ""):
+        if _faults.should_drop("kv.heartbeat", node=node_id):
+            return  # injected lost heartbeat
         self._req("PUT", f"/hb/{node_id}", payload.encode())
 
     def members(self, prefix: str) -> Dict[str, str]:
@@ -268,17 +310,27 @@ class ElasticManager:
         expires), then return the active set (capped at np_max)."""
         deadline = time.time() + (timeout or self.elastic_timeout)
         while time.time() < deadline:
-            m = self.members()
+            try:
+                m = self.members()
+            except Exception:
+                time.sleep(0.5)  # registry blip: keep waiting
+                continue
             if self.runnable(m):
                 # settle: wait one beat for stragglers up to np_max
                 time.sleep(self.heartbeat_interval)
-                m2 = self.members()
+                try:
+                    m2 = self.members()
+                except Exception:
+                    continue
                 if len(m2) >= len(m):
                     return self.active_members(m2)
                 # membership shrank while settling: re-evaluate
                 continue
             time.sleep(0.5)
-        return self.active_members()
+        try:
+            return self.active_members()
+        except Exception:
+            return []
 
     def seed(self, members: List[str]) -> None:
         """Pin the membership the pod was spawned with as the watch
@@ -286,11 +338,26 @@ class ElasticManager:
         relaunch."""
         self._last_members = list(members)
 
-    def watch(self) -> Optional[ElasticStatus]:
-        """One poll step for the controller loop."""
+    def failure_detector(self, grace: float = 0.0):
+        """A :class:`~...resilience.FailureDetector` bound to this
+        job's membership view (used by the launch controller to log
+        and classify member loss/join between relaunch decisions)."""
+        from ...resilience import FailureDetector
+        return FailureDetector(self.members, np_min=self.np_min,
+                               np_max=self.np_max, grace=grace)
+
+    def watch(self, members: Optional[List[str]] = None
+              ) -> Optional[ElasticStatus]:
+        """One poll step for the controller loop.  Pass ``members`` to
+        reuse a snapshot fetched this tick.  A registry outage is no
+        judgment (None), not a crash — transient KV loss must never
+        take the launch master down."""
         if not self.enabled:
             return None
-        m = self.active_members()
+        try:
+            m = self.active_members(members)
+        except Exception:
+            return None  # registry unreachable: keep the pod running
         if self._last_members is None:
             self._last_members = m
             return None

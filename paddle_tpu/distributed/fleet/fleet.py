@@ -134,6 +134,40 @@ class Fleet:
             input_specs=input_specs, amp_level=amp_level,
             amp_dtype=amp_dtype, remat=bool(s.recompute))
 
+    def enable_resilience(self, hang_timeout: Optional[float] = None,
+                          on_hang=None, dump_path: Optional[str] = None):
+        """Arm the process resilience hooks for fleet-driven training.
+
+        * Fault plan: ``PADDLE_FAULT_PLAN`` (if set) is installed so
+          chaos schedules reach fleet jobs without code changes.
+        * Hang watchdog (``hang_timeout`` seconds): fed by every
+          committed ``DistributedRunner`` step; on stall it dumps all
+          thread stacks, runs ``on_hang`` (typically a force-save
+          through a :class:`CheckpointManager`), and exits nonzero so
+          the launch master relaunches with checkpoint-resume instead
+          of wedging the pod.
+
+        Returns the started :class:`HangWatchdog` (or None).
+        """
+        from ..resilience import (faults, HangWatchdog,
+                                  install_watchdog)
+        # lazy env pickup: installs PADDLE_FAULT_PLAN only when no
+        # injector is active, so a programmatically installed plan
+        # (faults.install) is never clobbered by an empty env
+        faults.active_plan()
+        if not hang_timeout:
+            return None
+        from ..resilience import current_watchdog
+        prev = current_watchdog()
+        if prev is not None:
+            # stop the old thread before swapping, or the orphan —
+            # no longer fed by notify_step — times out and force-exits
+            # a healthy process
+            prev.stop()
+        wd = HangWatchdog(timeout=hang_timeout, on_hang=on_hang,
+                          dump_path=dump_path)
+        return install_watchdog(wd.start())
+
     def distributed_optimizer(self, optimizer, strategy=None):
         if strategy is not None:
             self._strategy = strategy

@@ -308,8 +308,9 @@ def _cp_shard_map(shard_fn, q, k, v, causal, mesh, seq_axis):
     spec = P(baxes if baxes else None, seq_axis, head_ax, None)
     fn = functools.partial(shard_fn, causal=causal, axis_name=seq_axis,
                            n_shards=n)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
+    from ...shard_map_compat import shard_map
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)
 
 
 def _ring_attention_impl(query, key, value, causal=False,

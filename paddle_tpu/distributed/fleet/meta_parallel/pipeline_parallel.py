@@ -49,7 +49,7 @@ def pipeline_spmd(stage_fn: Callable, stacked_params: Any, x_micro: Any,
     """
     mesh = mesh or coll.ensure_mesh()
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ...shard_map_compat import shard_map
 
     num_micro = x_micro.shape[0]
     T = num_micro + num_stages - 1
@@ -122,7 +122,7 @@ def pipeline_spmd_interleaved(stage_fn: Callable, stacked_params: Any,
     """
     mesh = mesh or coll.ensure_mesh()
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from ...shard_map_compat import shard_map
 
     V, Pdeg = vpp_degree, num_stages
     S = Pdeg * V

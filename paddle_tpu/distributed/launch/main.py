@@ -51,6 +51,9 @@ def parse_args(argv=None):
 
 def _spawn_pod(args, nproc: int, world: int, endpoints: List[str],
                master: str, node_rank: int) -> List[subprocess.Popen]:
+    from ..resilience import faults as _faults
+    _faults.fault_point("launch.spawn", node_rank=node_rank,
+                        world=world)
     procs = []
     for local_rank in range(nproc):
         rank = node_rank * nproc + local_rank
@@ -115,6 +118,11 @@ def main(argv=None):
                                  np=str(args.nnodes),
                                  node_id=my_endpoint)
         elastic.register(payload=my_endpoint)
+        # failure detector: names WHICH member was lost/joined between
+        # relaunch decisions (watch() only says "the set changed")
+        detector = elastic.failure_detector(
+            grace=elastic.heartbeat_interval)
+        detector.poll()  # seed baseline
 
     procs: List[subprocess.Popen] = []
     restarts = 0
@@ -176,10 +184,23 @@ def main(argv=None):
                     failed = True
                     break
                 if elastic is not None:
-                    ev = elastic.watch()
+                    # one membership fetch per tick, shared by the
+                    # detector and the watch decision; an outage tick
+                    # (snap None) is "no judgment", not a crash
+                    try:
+                        snap = elastic.members()
+                    except Exception:
+                        snap = None
+                    if snap is not None:
+                        for mev in detector.poll(snap):
+                            print(f"launch: member {mev.kind}: "
+                                  f"{mev.member}", file=sys.stderr)
+                    ev = elastic.watch(members=snap)
                     if ev is not None:
                         print(f"launch: elastic event {ev.value}; "
-                              "restarting pod with new membership")
+                              "restarting pod with new membership — "
+                              "trainers resume from the latest "
+                              "verified checkpoint")
                         _kill_pod(procs)
                         relaunch = True
                         break

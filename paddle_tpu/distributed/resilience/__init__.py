@@ -1,0 +1,30 @@
+"""Resilience layer: deterministic fault injection, retry/backoff,
+hang watchdog, membership failure detection, verified checkpoint
+recovery (DESIGN-RESILIENCE.md).
+
+On real pods preemptions and slice losses are routine, so fault
+tolerance is a first-class, *testable* subsystem: every failure mode
+the recovery paths claim to handle can be injected deterministically
+(``FaultPlan``) and exercised in the chaos suite
+(``tests/test_resilience.py``, ``-m chaos``).
+"""
+
+from .faults import (FaultPlan, FaultRule, FaultInjector, InjectedFault,
+                     fault_point, should_drop, install, install_from_env,
+                     active_plan, clear)
+from .retry import (RetryExhausted, retry_call, retryable, retry_stats,
+                    reset_retry_stats)
+from .watchdog import (HangWatchdog, install_watchdog, notify_step,
+                       current_watchdog)
+from .failure_detector import FailureDetector, MemberEvent
+
+__all__ = [
+    "FaultPlan", "FaultRule", "FaultInjector", "InjectedFault",
+    "fault_point", "should_drop", "install", "install_from_env",
+    "active_plan", "clear",
+    "RetryExhausted", "retry_call", "retryable", "retry_stats",
+    "reset_retry_stats",
+    "HangWatchdog", "install_watchdog", "notify_step",
+    "current_watchdog",
+    "FailureDetector", "MemberEvent",
+]

@@ -1,0 +1,109 @@
+"""Timeout-based membership failure detector over elastic heartbeats.
+
+The KV registry's TTL already evicts silent members server-side; the
+detector adds the *client-side* judgment the launch controller needs:
+which members joined, which were lost (TTL expiry or explicit exit),
+and what that means for the job — keep running, relaunch with the new
+world (``RESTART``), or hold below quorum (``HOLD``).  This is the
+"graceful degradation" half of elastic checkpoint-restart: member loss
+is an expected event that maps to *resume from the latest verified
+checkpoint*, never a wedge.
+
+Pure polling (no extra threads): the launch controller calls ``poll()``
+from its existing watch loop.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class MemberEvent:
+    kind: str           # "joined" | "lost"
+    member: str
+    at: float           # wall-clock seconds
+
+    def __str__(self):
+        return f"{self.kind}:{self.member}"
+
+
+class FailureDetector:
+    """Tracks a member set produced by ``members_fn`` and classifies
+    transitions.
+
+    ``members_fn``: zero-arg callable returning the current alive
+    member list (e.g. ``ElasticManager.members``).  ``grace`` seconds
+    must elapse with a member absent before it is declared lost —
+    absorbing one dropped poll (registry restart, transient 5xx)
+    without declaring a failure.
+    """
+
+    def __init__(self, members_fn: Callable[[], List[str]],
+                 np_min: int = 1, np_max: Optional[int] = None,
+                 grace: float = 0.0):
+        self._members_fn = members_fn
+        self.np_min = int(np_min)
+        self.np_max = np_max
+        self.grace = float(grace)
+        self._known: Dict[str, float] = {}     # member -> last seen
+        self._suspected: Dict[str, float] = {}  # member -> first missed
+        self._seeded = False
+
+    # -- observation ---------------------------------------------------------
+    def poll(self, members: Optional[List[str]] = None
+             ) -> List[MemberEvent]:
+        """One observation step; returns the events since last poll.
+        Pass ``members`` to reuse a snapshot the caller already
+        fetched this tick (halves registry round-trips)."""
+        now = time.time()
+        if members is not None:
+            current = set(members)
+        else:
+            try:
+                current = set(self._members_fn())
+            except Exception:
+                # registry unreachable: no judgment — absence of
+                # evidence is handled by per-member grace, not mass
+                # eviction
+                return []
+        events: List[MemberEvent] = []
+        first = not self._seeded
+        self._seeded = True
+        for m in current:
+            self._suspected.pop(m, None)
+            if m not in self._known and not first:
+                events.append(MemberEvent("joined", m, now))
+            self._known[m] = now
+        for m in list(self._known):
+            if m in current:
+                continue
+            missed_since = self._suspected.setdefault(m, now)
+            if now - missed_since >= self.grace:
+                del self._known[m]
+                del self._suspected[m]
+                events.append(MemberEvent("lost", m, now))
+        return events
+
+    # -- judgment ------------------------------------------------------------
+    def alive(self) -> List[str]:
+        return sorted(self._known)
+
+    def suspects(self) -> List[str]:
+        return sorted(self._suspected)
+
+    def quorum(self) -> bool:
+        return len(self._known) >= self.np_min
+
+    def decide(self, events: List[MemberEvent]) -> Optional[str]:
+        """Map events to the controller action: None (steady),
+        ``"restart"`` (membership changed, still runnable — relaunch
+        and resume from the latest verified checkpoint) or ``"hold"``
+        (below np_min — wait for members)."""
+        if not events:
+            return None
+        if not self.quorum():
+            return "hold"
+        return "restart"

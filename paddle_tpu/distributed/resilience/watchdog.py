@@ -1,0 +1,137 @@
+"""Hang watchdog: detect a wedged training process and turn it into a
+clean relaunch instead of a stuck pod.
+
+A TPU pod that deadlocks (collective desync, host-callback wedge, NFS
+stall) burns its whole reservation: the launch master only reacts to
+*exits*.  ``HangWatchdog`` closes that gap — the training loop calls
+``notify_step(step)`` after every committed step; a daemon thread
+checks progress, and when no step lands within ``timeout`` seconds it
+
+1. dumps all-thread Python stacks (``faulthandler``) to stderr and
+   ``dump_path`` — the post-mortem for *where* it wedged,
+2. runs ``on_hang`` (typically force-save a checkpoint), and
+3. ``os._exit(exit_code)`` so the launch watchdog sees a dead rank,
+   kills the pod, and relaunches with checkpoint-resume.
+
+Set ``exit_code=None`` to stop after the callback (used by tests, or
+when an outer supervisor owns process lifetime).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+class HangWatchdog:
+    def __init__(self, timeout: float = 600.0,
+                 on_hang: Optional[Callable[[], None]] = None,
+                 dump_path: Optional[str] = None,
+                 exit_code: Optional[int] = 124,
+                 poll_interval: Optional[float] = None):
+        self.timeout = float(timeout)
+        self.on_hang = on_hang
+        self.dump_path = dump_path
+        self.exit_code = exit_code
+        self.poll_interval = poll_interval or max(
+            0.05, min(5.0, self.timeout / 4.0))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_progress = time.monotonic()
+        self._last_step: Optional[int] = None
+        self.fired = False
+
+    # -- progress ------------------------------------------------------------
+    def notify_step(self, step: Optional[int] = None):
+        self._last_progress = time.monotonic()
+        if step is not None:
+            self._last_step = step
+
+    @property
+    def last_step(self) -> Optional[int]:
+        return self._last_step
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "HangWatchdog":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._last_progress = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-hang-watchdog",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def __enter__(self) -> "HangWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- detection -----------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.poll_interval):
+            stalled = time.monotonic() - self._last_progress
+            if stalled < self.timeout:
+                continue
+            self.fired = True
+            self._dump(stalled)
+            try:
+                if self.on_hang is not None:
+                    self.on_hang()
+            finally:
+                if self.exit_code is not None:
+                    os._exit(self.exit_code)
+            return  # callback-only mode: one shot
+
+    def _dump(self, stalled: float):
+        msg = (f"[watchdog] no training step for {stalled:.1f}s "
+               f"(timeout {self.timeout}s, last step "
+               f"{self._last_step}); dumping all thread stacks\n")
+        sys.stderr.write(msg)
+        sys.stderr.flush()
+        try:
+            faulthandler.dump_traceback(file=sys.stderr,
+                                        all_threads=True)
+        except Exception:
+            pass
+        if self.dump_path:
+            try:
+                with open(self.dump_path, "w") as f:
+                    f.write(msg)
+                    faulthandler.dump_traceback(file=f, all_threads=True)
+            except OSError:
+                pass
+
+
+# -- process-global hookup (the runner notifies whoever is installed) --------
+_current: Optional[HangWatchdog] = None
+
+
+def install_watchdog(wd: Optional[HangWatchdog]) -> Optional[HangWatchdog]:
+    """Register ``wd`` as the process watchdog fed by
+    ``DistributedRunner.train_step`` (None uninstalls)."""
+    global _current
+    _current = wd
+    return wd
+
+
+def current_watchdog() -> Optional[HangWatchdog]:
+    return _current
+
+
+def notify_step(step: Optional[int] = None):
+    wd = _current
+    if wd is not None:
+        wd.notify_step(step)

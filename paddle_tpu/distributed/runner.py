@@ -33,6 +33,8 @@ from ..nn import functional_call as F
 from ..framework import random as _random
 from . import collective as coll
 from .fleet.meta_parallel.sharding_parallel import shard_spec_for
+from .resilience import faults as _faults
+from .resilience import watchdog as _watchdog
 
 
 _data_axes = coll.data_axes
@@ -315,6 +317,14 @@ class DistributedRunner:
         finally:
             coll.set_mesh(prev_mesh)
 
+    def set_global_step(self, step: int):
+        """Align the runner's step counter with a restored checkpoint:
+        per-step RNG keys are folded from this counter, so resuming at
+        the right count reproduces the uninterrupted trajectory; the
+        resilience layer (kill-at-step fault plans, hang watchdog) also
+        reports this counter."""
+        self._step_ctr = int(step)
+
     def _train_step_inner(self, inputs, labels) -> float:
         inputs_v, labels_v = self._prep_step_args(inputs, labels)
         lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
@@ -337,6 +347,11 @@ class DistributedRunner:
             if b is not None:
                 b._value = v
                 bufs[n] = v
+        # resilience hooks: the committed step feeds the hang watchdog
+        # (progress proof) and the chaos layer (kill-at-step-N plans);
+        # both are no-ops unless installed
+        _watchdog.notify_step(self._step_ctr)
+        _faults.fault_point("train.step", step=self._step_ctr)
         if self.capture_outputs:
             return loss, out_vals
         return loss
@@ -413,6 +428,9 @@ class DistributedRunner:
 
     def eval_step(self, inputs, labels):
         """Compiled forward + loss (no grad, no update)."""
+        # validation batches are progress too: keep the hang watchdog
+        # from declaring a long eval pass between train steps a hang
+        _watchdog.notify_step()
         prev_mesh = coll.get_mesh()
         coll.set_mesh(self.mesh)
         try:
@@ -435,6 +453,7 @@ class DistributedRunner:
 
     def predict_step(self, inputs):
         """Compiled forward; returns raw outputs."""
+        _watchdog.notify_step()
         prev_mesh = coll.get_mesh()
         coll.set_mesh(self.mesh)
         try:

@@ -17,6 +17,7 @@ from jax import lax
 
 from .....tensor import Tensor
 from ..... import ops
+from .....distributed.shard_map_compat import axis_size as _axis_size
 
 
 @ops.primitive(name="global_scatter")
@@ -30,7 +31,7 @@ def global_scatter(x, local_count=None, global_count=None, group=None,
     """
     name = axis_name or getattr(group, "axis_name", None)
     if name is not None and isinstance(x, jax.core.Tracer):
-        n = lax.axis_size(name)
+        n = _axis_size(name)
         e = x.shape[0]
         parts = x.reshape((n, e // n) + x.shape[1:])
         return lax.all_to_all(parts, name, split_axis=0, concat_axis=1,
@@ -47,7 +48,7 @@ def global_gather(x, local_count=None, global_count=None, group=None,
     x: [E_local, n·C, d] → [E, C, d]."""
     name = axis_name or getattr(group, "axis_name", None)
     if name is not None and isinstance(x, jax.core.Tracer):
-        n = lax.axis_size(name)
+        n = _axis_size(name)
         e_local, nc = x.shape[0], x.shape[1]
         parts = x.reshape((e_local, n, nc // n) + x.shape[2:])
         parts = jnp.moveaxis(parts, 1, 0)           # [n, E_local, C, d]

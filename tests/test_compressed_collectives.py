@@ -6,7 +6,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from paddle_tpu.distributed.shard_map_compat import shard_map
 
 from paddle_tpu.distributed.compressed import (
     quantized_all_reduce, bf16_all_reduce, compressed_psum_tree)

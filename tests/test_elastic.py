@@ -127,6 +127,59 @@ def test_np_max_caps_active_members(server):
         m.exit()
 
 
+def test_scale_up_within_bounds_triggers_restart(server):
+    """A joiner within [np_min, np_max] changes the active set →
+    RESTART (relaunch with the bigger world), and the job stays
+    runnable throughout."""
+    a = ElasticManager(server=server.endpoint, job_id="u", np="1:3",
+                       node_id="node-a", heartbeat_interval=0.3)
+    a.register()
+    time.sleep(0.4)
+    assert a.watch() is None          # baseline: just node-a
+    assert a.runnable()
+    b = ElasticManager(server=server.endpoint, job_id="u", np="1:3",
+                       node_id="node-b", heartbeat_interval=0.3)
+    b.register()                      # scale-up: 1 → 2 (within max 3)
+    deadline = time.time() + 5
+    ev = None
+    while time.time() < deadline and ev is None:
+        ev = a.watch()
+        time.sleep(0.2)
+    assert ev == ElasticStatus.RESTART
+    assert a.runnable()
+    assert a.active_members() == ["node-a", "node-b"]
+    a.exit()
+    b.exit()
+
+
+def test_heartbeat_ttl_expiry_evicts_dead_member(server):
+    """A member that stops heartbeating (process death, not graceful
+    exit) must be evicted by the registry TTL and reported lost by the
+    failure detector."""
+    a = ElasticManager(server=server.endpoint, job_id="t", np="1:3",
+                       node_id="node-a", heartbeat_interval=0.3)
+    b = ElasticManager(server=server.endpoint, job_id="t", np="1:3",
+                       node_id="node-b", heartbeat_interval=0.3)
+    a.register()
+    b.register()
+    time.sleep(0.4)
+    det = a.failure_detector()
+    det.poll()
+    assert det.alive() == ["node-a", "node-b"]
+    # simulate death: stop b's heartbeat thread WITHOUT the graceful
+    # registry delete that exit() performs
+    b._stop.set()
+    deadline = time.time() + 6        # server ttl=1.5 must lapse
+    lost = []
+    while time.time() < deadline and not lost:
+        lost = [e for e in det.poll() if e.kind == "lost"]
+        time.sleep(0.2)
+    assert [e.member for e in lost] == ["node-b"]
+    assert det.decide(lost) == "restart"   # 1 left >= np_min=1
+    assert a.members() == ["node-a"]
+    a.exit()
+
+
 def test_seeded_watch_detects_spawn_window_change(server):
     a = ElasticManager(server=server.endpoint, job_id="s", np="1:3",
                        node_id="node-a", heartbeat_interval=0.3)

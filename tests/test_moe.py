@@ -108,7 +108,7 @@ def test_moe_expert_parallel_parity_on_mesh():
 
 def test_global_scatter_gather_roundtrip_on_mesh():
     _need_devices(8)
-    from jax import shard_map
+    from paddle_tpu.distributed.shard_map_compat import shard_map
     from jax.sharding import PartitionSpec as P
     from paddle_tpu.distributed import collective
     mesh = collective.build_mesh({"mp": 8})
