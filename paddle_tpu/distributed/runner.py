@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..tensor import Tensor
 from ..nn import functional_call as F
 from ..framework import random as _random
+from ..io.staging import to_device_values
 from . import collective as coll
 from .fleet.meta_parallel.sharding_parallel import shard_spec_for
 from .resilience import faults as _faults
@@ -284,14 +285,12 @@ class DistributedRunner:
             self.place()
         if self._step_fn is None:
             self._step_fn = self._build()
-        inputs_v = [i._value if isinstance(i, Tensor)
-                    else jax.device_put(np.asarray(i)) for i in
-                    (inputs if isinstance(inputs, (list, tuple))
-                     else [inputs])]
-        labels_v = [l._value if isinstance(l, Tensor)
-                    else jax.device_put(np.asarray(l)) for l in
-                    (labels if isinstance(labels, (list, tuple))
-                     else [labels])]
+        # the shared staging path (io/staging.py): Tensors and jax
+        # arrays pass through, host leaves take one batched async put
+        inputs_v = to_device_values(
+            inputs if isinstance(inputs, (list, tuple)) else [inputs])
+        labels_v = to_device_values(
+            labels if isinstance(labels, (list, tuple)) else [labels])
         if getattr(self, "_n_inputs", None) is None:
             self._n_inputs = len(inputs_v)
         elif self._n_inputs != len(inputs_v):
@@ -393,17 +392,23 @@ class DistributedRunner:
         self._val_cache = None
 
     # -- eval / predict ------------------------------------------------------
-    def _eval_build(self, with_loss: bool):
+    def _eval_build(self, with_loss: bool, n_in: int):
+        """One compiled inference step per (mode, arity) — the input
+        split is a builder argument, not trace-time ``self`` state, so
+        a different arity compiles a new program instead of silently
+        reusing a stale trace.  The buffers dict — the one state
+        argument an inference step can alias — is donated: it passes
+        through (updated under train-mode BN) and comes back, so XLA
+        reuses the buffers instead of copying."""
         net = self.network
         loss_layer = self.loss_fn
 
         capture = self.capture_outputs
 
         def run(params, frozen, buffers, *data):
-            n_in = self._n_inputs if with_loss else len(data)
             inputs = [Tensor(v) for v in data[:n_in]]
             labels = [Tensor(v) for v in data[n_in:]]
-            with F.bind(net, params, buffers, frozen):
+            with F.bind(net, params, buffers, frozen) as holder:
                 from ..autograd import tape as _tape
                 with _tape.no_grad_ctx():
                     out = net(*inputs)
@@ -412,19 +417,48 @@ class DistributedRunner:
                             else [out]
                         loss = loss_layer(*outs, *labels)
                         lv = loss._value.astype(jnp.float32)
-                        if capture:
-                            return lv, [o._value for o in outs]
-                        return lv
-            if isinstance(out, (list, tuple)):
-                return [o._value for o in out]
-            return out._value
+                        payload = (lv, [o._value for o in outs]) \
+                            if capture else lv
+                    elif isinstance(out, (list, tuple)):
+                        payload = [o._value for o in out]
+                    else:
+                        payload = out._value
+            return payload, holder.get("buffers", {})
 
-        return jax.jit(run)
+        return jax.jit(run, donate_argnums=(2,))
 
     def _eval_values(self):
         if not self._placed:
             self.place()
         return self._sync_val_cache()
+
+    def _get_eval_fn(self, with_loss: bool, n_in: int):
+        cache = getattr(self, "_eval_cache", None)
+        if cache is None:
+            cache = self._eval_cache = {}
+        key = (with_loss, n_in)
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = self._eval_build(with_loss, n_in)
+        return fn
+
+    def _stage_eval_data(self, seq):
+        """Host→device staging of one inference batch through the
+        shared path (io/staging.py): Tensors and jax arrays pass
+        through untouched — no D2H round trip — and host leaves take
+        one batched async device_put."""
+        return to_device_values(
+            seq if isinstance(seq, (list, tuple)) else [seq])
+
+    def _commit_eval_buffers(self, new_buf):
+        """Rebind the donated buffers to the returned (aliased) arrays
+        so the next step never touches the donated originals."""
+        bufs = self._sync_val_cache()[2]
+        for n, v in new_buf.items():
+            b = self._name_to_buf.get(n)
+            if b is not None:
+                b._value = v
+            bufs[n] = v
 
     def eval_step(self, inputs, labels):
         """Compiled forward + loss (no grad, no update)."""
@@ -435,19 +469,14 @@ class DistributedRunner:
         coll.set_mesh(self.mesh)
         try:
             params, frozen, bufs = self._eval_values()
-            if getattr(self, "_eval_fn", None) is None:
-                self._eval_fn = self._eval_build(with_loss=True)
-            iv = [i._value if isinstance(i, Tensor)
-                  else jax.device_put(np.asarray(i)) for i in
-                  (inputs if isinstance(inputs, (list, tuple))
-                   else [inputs])]
-            lv = [l._value if isinstance(l, Tensor)
-                  else jax.device_put(np.asarray(l)) for l in
-                  (labels if isinstance(labels, (list, tuple))
-                   else [labels])]
+            iv = self._stage_eval_data(inputs)
+            lv = self._stage_eval_data(labels)
             if getattr(self, "_n_inputs", None) is None:
                 self._n_inputs = len(iv)
-            return self._eval_fn(params, frozen, bufs, *iv, *lv)
+            fn = self._get_eval_fn(True, len(iv))
+            payload, new_buf = fn(params, frozen, bufs, *iv, *lv)
+            self._commit_eval_buffers(new_buf)
+            return payload
         finally:
             coll.set_mesh(prev_mesh)
 
@@ -458,13 +487,10 @@ class DistributedRunner:
         coll.set_mesh(self.mesh)
         try:
             params, frozen, bufs = self._eval_values()
-            if getattr(self, "_predict_fn", None) is None:
-                self._predict_fn = self._eval_build(with_loss=False)
-            iv = [i._value if isinstance(i, Tensor)
-                  else jax.device_put(np.asarray(i)) for i in
-                  (inputs if isinstance(inputs, (list, tuple))
-                   else [inputs])]
-            out = self._predict_fn(params, frozen, bufs, *iv)
+            iv = self._stage_eval_data(inputs)
+            fn = self._get_eval_fn(False, len(iv))
+            out, new_buf = fn(params, frozen, bufs, *iv)
+            self._commit_eval_buffers(new_buf)
             if isinstance(out, list):
                 return [Tensor(o) for o in out]
             return Tensor(out)

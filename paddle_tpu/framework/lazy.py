@@ -1,0 +1,113 @@
+"""Deferred device→host materialization (DESIGN-PERF.md).
+
+A ``LazyScalar`` carries a device value through the training-loop
+logging/callback plumbing WITHOUT forcing a host sync: ``Model.fit``
+dispatches compiled steps back-to-back and the loss/metric scalars ride
+along as live device arrays.  The device→host transfer happens at the
+first host *use* — ``float()``, ``np.asarray()``, formatting — i.e.
+when a callback actually renders the value.  Verbose-interval logging
+pays the sync; the hot loop does not.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class LazyScalar:
+    """Device scalar with on-demand host materialization.
+
+    ``post`` (optional) is a host-side finisher applied to the fetched
+    array — e.g. picking one top-k slot and dividing by the batch count
+    — so derived per-batch stats cost zero extra device dispatches.
+    """
+
+    __slots__ = ("_dev", "_post", "_host")
+
+    def __init__(self, dev, post=None):
+        self._dev = dev
+        self._post = post
+        self._host = None
+
+    def _materialize(self):
+        """THE device→host sync point for hot-loop scalars."""
+        if self._host is None:
+            import jax
+            h = np.asarray(jax.device_get(self._dev))
+            if self._post is not None:
+                h = np.asarray(self._post(h))
+            self._host = h
+            self._dev = self._post = None
+        return self._host
+
+    # -- host-use surface (each of these is a sanctioned sync) ---------
+    def __array__(self, dtype=None):
+        h = self._materialize()
+        return h.astype(dtype) if dtype is not None else h
+
+    def __float__(self):
+        return float(self._materialize())
+
+    def __int__(self):
+        return int(self._materialize())
+
+    def __bool__(self):
+        return bool(self._materialize())
+
+    def item(self):
+        return self._materialize().item()
+
+    def numpy(self):
+        return self._materialize()
+
+    def __format__(self, spec):
+        if spec:
+            return format(float(self), spec)
+        return str(self._materialize())
+
+    def __repr__(self):
+        return f"LazyScalar({self._materialize()!r})"
+
+    # comparisons / arithmetic delegate to the materialized value so
+    # ported logging & early-stop code keeps working unchanged
+    def __eq__(self, other):
+        return self._materialize() == other
+
+    def __ne__(self, other):
+        return self._materialize() != other
+
+    def __lt__(self, other):
+        return self._materialize() < other
+
+    def __le__(self, other):
+        return self._materialize() <= other
+
+    def __gt__(self, other):
+        return self._materialize() > other
+
+    def __ge__(self, other):
+        return self._materialize() >= other
+
+    def __add__(self, other):
+        return self._materialize() + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._materialize() - other
+
+    def __rsub__(self, other):
+        return other - self._materialize()
+
+    def __mul__(self, other):
+        return self._materialize() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._materialize() / other
+
+    def __rtruediv__(self, other):
+        return other / self._materialize()
+
+    __hash__ = object.__hash__

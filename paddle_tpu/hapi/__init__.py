@@ -1,3 +1,4 @@
 from .model import Model  # noqa
+from .train_state import TrainState, LazyScalar  # noqa
 from . import callbacks  # noqa
 from .summary import summary  # noqa

@@ -120,6 +120,9 @@ class ProgBarLogger(Callback):
             print(f"Epoch {epoch + 1}/{total}")
 
     def _fmt(self, logs):
+        # formatting is the sanctioned device→host sync point of the
+        # async hot loop (DESIGN-PERF.md): LazyScalar losses/metrics
+        # materialize here, at verbose-interval cadence — not per step
         items = []
         for k, v in (logs or {}).items():
             if k in ("batch_size", "step"):
@@ -127,6 +130,8 @@ class ProgBarLogger(Callback):
             if isinstance(v, (list, np.ndarray)):
                 v = np.asarray(v).reshape(-1)
                 v = float(v[0]) if v.size else 0.0
+            elif hasattr(v, "_materialize"):
+                v = float(v)
             if isinstance(v, float):
                 items.append(f"{k}: {v:.4f}")
             else:
@@ -184,7 +189,9 @@ class EarlyStopping(Callback):
         cur = logs.get(self.monitor)
         if cur is None:
             return
-        if isinstance(cur, (list, np.ndarray)):
+        if not isinstance(cur, (int, float)):
+            # lists, arrays and LazyScalar all materialize here — the
+            # early-stop decision is an epoch-boundary sync point
             cur = float(np.asarray(cur).reshape(-1)[0])
         better = (self.best is None
                   or (self.mode == "min" and cur < self.best -

@@ -7,6 +7,14 @@ with a C++ backward queue; the TPU adapter compiles the WHOLE train step
 conclusion of SURVEY.md §3.1: "on TPU the entire train_batch becomes ONE
 traced+compiled function".  Eager mode (`Model.prepare(jit=False)`) uses
 the tape for parity/debugging.
+
+The hot loop is fully asynchronous and device-resident
+(DESIGN-PERF.md): params/opt_state/buffers live in a donated
+``TrainState`` owned by the loop (the Layer tree re-syncs only at
+epoch/save/eval boundaries), compiled steps are cached per
+(arity, shapes, dtypes, amp) signature, and loss/metric scalars ride
+through the callbacks as ``LazyScalar`` — only a callback that
+actually formats a value pays the device→host sync.
 """
 
 from __future__ import annotations
@@ -26,7 +34,9 @@ from ..metric import Metric
 from ..framework import random as _random
 from ..framework.io import save as _save, load as _load
 from ..optimizer.lr import LRScheduler
+from ..io.staging import to_device_values
 from . import callbacks as cbk_mod
+from .train_state import TrainState, LazyScalar
 
 
 def _to_list(x):
@@ -44,9 +54,12 @@ class Model:
         self._loss = None
         self._metrics: List[Metric] = []
         self._use_jit = True
-        self._jit_train_step = None
-        self._jit_eval_step = None
-        self._opt_state = None
+        # compiled-step cache keyed by (kind, arity, shapes, dtypes,
+        # donation, amp) — replaces the single _jit_train_step slot and
+        # its stale-trace hazard (self._n_inputs baked into the trace)
+        self._step_cache: Dict[Any, Any] = {}
+        self._train_state: Optional[TrainState] = None
+        self._in_fit = False
         self._runner = None
         self._accumulate = 1
         self.stop_training = False
@@ -69,8 +82,8 @@ class Model:
             elif isinstance(amp_configs, dict):
                 self._amp_level = amp_configs.get("level", "O1")
                 self._amp_dtype = amp_configs.get("dtype", "bfloat16")
-        self._jit_train_step = None
-        self._jit_eval_step = None
+        self._step_cache = {}
+        self._train_state = None
         self._runner = None
 
     def _mesh_runner(self):
@@ -95,13 +108,9 @@ class Model:
 
     # -- single-batch APIs --------------------------------------------------
     def _prepare_data(self, data):
-        out = []
-        for d in _to_list(data):
-            if isinstance(d, Tensor):
-                out.append(d._value)
-            else:
-                out.append(jnp.asarray(np.asarray(d)))
-        return out
+        # one async batched device_put through the shared staging path
+        # (io/staging.py) — no jnp round-trip, no per-step host copy
+        return to_device_values(_to_list(data))
 
     def _forward_with_loss(self, inputs, labels):
         """Runs in both eager and traced contexts."""
@@ -118,16 +127,94 @@ class Model:
             loss = outs[0]
         return loss, outs
 
-    def _build_jit_train_step(self):
+    # -- compiled-step cache -------------------------------------------------
+    @staticmethod
+    def _data_signature(values):
+        # np.dtype objects hash — no per-step str() allocation
+        return tuple((v.shape, v.dtype) for v in values)
+
+    def _get_step_fn(self, kind, n_in, values, donate=True):
+        key = (kind, n_in, self._data_signature(values), donate,
+               self._amp_level, self._amp_dtype)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            if kind == "train":
+                fn = self._build_jit_train_step(n_in, donate)
+            else:
+                fn = self._build_jit_eval_step(n_in)
+            self._step_cache[key] = fn
+        return fn
+
+    def compile_stats(self):
+        """Introspection for the recompile-count regression tests and
+        perf triage: one cache entry per (kind, arity, shapes, dtypes,
+        donation, amp) signature; ``traces`` sums the underlying jit
+        cache sizes — growth on a fixed workload means silent
+        retracing."""
+        traces = 0
+        for fn in self._step_cache.values():
+            try:
+                traces += fn._cache_size()
+            except Exception:
+                pass
+        return {"entries": len(self._step_cache), "traces": traces}
+
+    # -- per-step host-overhead caches ---------------------------------------
+    def _lr_value(self):
+        """Device scalar for the current LR, re-staged only when the
+        scheduler actually changes it (not every step)."""
+        lr = float(self._optimizer.get_lr())
+        cached = getattr(self, "_lr_cache", None)
+        if cached is None or cached[0] != lr:
+            cached = (lr, jnp.asarray(lr, dtype=jnp.float32))
+            self._lr_cache = cached
+        return cached[1]
+
+    def _base_key(self, gen):
+        """PRNGKey(seed) staged once per generator seed; the per-step
+        fold_in happens inside the compiled step."""
+        cached = getattr(self, "_base_key_cache", None)
+        if cached is None or cached[0] != gen._seed:
+            import jax.random as jrandom
+            cached = (gen._seed, jrandom.PRNGKey(gen._seed))
+            self._base_key_cache = cached
+        return cached[1]
+
+    # -- device-resident state ----------------------------------------------
+    def _ensure_train_state(self):
+        if self._train_state is None:
+            self._train_state = TrainState(self.network, self._optimizer)
+        return self._train_state
+
+    def _sync_train_state(self):
+        """Boundary sync: rebind the Layer tree to the device-resident
+        state (reference writes only — no device transfer)."""
+        if self._train_state is not None:
+            self._train_state.sync_to_layers()
+
+    def _device_metric_fns(self):
+        """Pure per-batch stat fns of the device-capable metrics — they
+        trace INTO the compiled step, so metric updates cost the hot
+        loop zero extra dispatches."""
+        return [m.device_batch_stats() for m in self._metrics
+                if getattr(m, "supports_device_update", False)]
+
+    def _build_jit_train_step(self, n_in, donate=True):
         opt = self._optimizer
         net = self.network
+        metric_fns = self._device_metric_fns()
         # per-param ParamAttr regularizer / learning_rate parity with the
         # eager step() — same contract as the runner/pipeline/static engines
         decay_coeffs, l1_coeffs, lr_scales = \
             opt._per_param_coeffs(dict(net.named_parameters()))
 
-        def step(params, frozen, buffers, opt_state, lr, key, *data):
-            n_in = self._n_inputs
+        def step(params, frozen, buffers, opt_state, lr, base_key, ctr,
+                 *data):
+            # per-step key derived INSIDE the compiled program —
+            # bit-identical to Generator.draw_key()'s
+            # fold_in(PRNGKey(seed), counter), but with zero eager
+            # host dispatches per step
+            key = jax.random.fold_in(base_key, ctr)
             inputs = [Tensor(v) for v in data[:n_in]]
             labels = [Tensor(v) for v in data[n_in:]]
 
@@ -149,31 +236,54 @@ class Model:
                 params, grads, opt_state, lr,
                 decay_coeffs=decay_coeffs, lr_scales=lr_scales,
                 l1_coeffs=l1_coeffs)
-            return loss_val, out_vals, new_params, new_opt_state, new_buf
+            # metric stats ride the same XLA program (correct/total
+            # computed in the compiled step — DESIGN-PERF.md)
+            mstats = ([mf(out_vals[0], data[n_in]) for mf in metric_fns]
+                      if metric_fns and len(data) > n_in and out_vals
+                      else [])
+            return (loss_val, out_vals, mstats, new_params,
+                    new_opt_state, new_buf)
 
-        return jax.jit(step)
+        # donate the device-resident state (params/buffers/opt_state):
+        # XLA reuses the buffers for the updated state in place.  The
+        # non-donating variant backs update=False calls, where the old
+        # state must survive.
+        return jax.jit(step,
+                       donate_argnums=(0, 2, 3) if donate else ())
 
-    def _build_jit_eval_step(self):
+    def _build_jit_eval_step(self, n_in):
         net = self.network
+        metric_fns = self._device_metric_fns()
 
         def step(params, frozen, buffers, *data):
-            n_in = self._n_inputs
             inputs = [Tensor(v) for v in data[:n_in]]
             labels = [Tensor(v) for v in data[n_in:]]
-            with F.bind(net, params, buffers, frozen):
+            with F.bind(net, params, buffers, frozen) as holder:
                 from ..autograd import tape as _tape
                 with _tape.no_grad_ctx():
                     loss, outs = self._forward_with_loss(inputs, labels)
-            return loss._value, [o._value for o in outs]
+            out_vals = [o._value for o in outs]
+            mstats = ([mf(out_vals[0], data[n_in]) for mf in metric_fns]
+                      if metric_fns and len(data) > n_in and out_vals
+                      else [])
+            return (loss._value, out_vals, mstats,
+                    holder.get("buffers", {}))
 
-        return jax.jit(step)
+        # buffers are the one state argument an inference step can
+        # alias: they pass through (updated under train-mode BN) and
+        # come back, so the donated dict is reused, not copied
+        return jax.jit(step, donate_argnums=(2,))
 
     def train_batch(self, inputs, labels=None, update=True):
         from ..profiler import RecordEvent
         with RecordEvent("train_batch"):
             self.network.train()
-            inputs_v = self._prepare_data(inputs)
-            labels_v = self._prepare_data(labels)
+            in_list = _to_list(inputs)
+            lb_list = _to_list(labels)
+            # ONE batched async device_put covers inputs and labels
+            vals = to_device_values(in_list + lb_list)
+            inputs_v = vals[:len(in_list)]
+            labels_v = vals[len(in_list):]
             self._n_inputs = len(inputs_v)
             runner = self._mesh_runner() if update else None
             if runner is not None:
@@ -185,41 +295,31 @@ class Model:
             return self._train_batch_eager(inputs_v, labels_v, update)
 
     def _train_batch_jit(self, inputs_v, labels_v, update=True):
-        if self._jit_train_step is None:
-            self._jit_train_step = self._build_jit_train_step()
-        net = self.network
-        params = F.param_dict(net)
-        frozen = F.frozen_dict(net)
-        buffers = F.buffer_dict(net)
-        if self._opt_state is None:
-            restored = getattr(self._optimizer, "_opt_state_tree", None)
-            if restored and set(restored) == set(params):
-                self._opt_state = restored
-            else:
-                if restored:
-                    import warnings
-                    warnings.warn(
-                        "Model: restored optimizer state keys do not "
-                        "match the network parameters; re-initializing "
-                        "moments")
-                self._opt_state = self._optimizer.init_state_tree(params)
-        lr = jnp.asarray(self._optimizer.get_lr(), dtype=jnp.float32)
-        key = _random.default_generator().draw_key()
-        loss_val, out_vals, new_params, new_opt_state, new_buf = \
-            self._jit_train_step(params, frozen, buffers, self._opt_state,
-                                 lr, key, *inputs_v, *labels_v)
+        state = self._ensure_train_state()
+        state.refresh()
+        data = (*inputs_v, *labels_v)
+        # update=False must not donate: the discarded step may not
+        # consume the live state
+        fn = self._get_step_fn("train", len(inputs_v), data,
+                               donate=update)
+        lr = self._lr_value()
+        # advance the generator without an eager draw; the step derives
+        # the same key from (base_key, counter) inside the compiled
+        # program
+        gen = _random.default_generator()
+        base_key, ctr = self._base_key(gen), gen._counter
+        gen._counter += 1
+        loss_val, out_vals, mstats, new_params, new_opt_state, new_buf \
+            = fn(state.params, state.frozen, state.buffers,
+                 state.opt_state, lr, base_key, np.uint32(ctr), *data)
         if update:
-            name_to_param = dict(net.named_parameters())
-            for n, v in new_params.items():
-                name_to_param[n]._value = v
-            self._opt_state = new_opt_state
-            self._optimizer._opt_state_tree = new_opt_state
-            name_to_buf = dict(net.named_buffers())
-            for n, v in new_buf.items():
-                if n in name_to_buf and name_to_buf[n] is not None:
-                    name_to_buf[n]._value = v
-            self._optimizer._global_step += 1
-        metrics = self._update_metrics(out_vals, labels_v)
+            state.commit(new_params, new_opt_state, new_buf)
+            if not self._in_fit:
+                # direct train_batch calls keep the public contract:
+                # the Layer tree is current when the call returns.
+                # Inside fit the sync is deferred to the epoch boundary.
+                state.sync_to_layers()
+        metrics = self._apply_metric_stats(mstats, out_vals, labels_v)
         return self._format_loss(loss_val), metrics
 
     def _train_batch_eager(self, inputs_v, labels_v, update=True):
@@ -235,43 +335,98 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
-        inputs_v = self._prepare_data(inputs)
-        labels_v = self._prepare_data(labels)
+        in_list = _to_list(inputs)
+        lb_list = _to_list(labels)
+        vals = to_device_values(in_list + lb_list)
+        inputs_v = vals[:len(in_list)]
+        labels_v = vals[len(in_list):]
         self._n_inputs = len(inputs_v)
         runner = self._mesh_runner()
         if runner is not None and self._loss is not None:
             loss_val, out_vals = runner.eval_step(inputs_v, labels_v)
             metrics = self._update_metrics(out_vals, labels_v)
             return self._format_loss(loss_val), metrics
-        if self._jit_eval_step is None:
-            self._jit_eval_step = self._build_jit_eval_step()
-        net = self.network
-        loss_val, out_vals = self._jit_eval_step(
-            F.param_dict(net), F.frozen_dict(net), F.buffer_dict(net),
-            *inputs_v, *labels_v)
-        metrics = self._update_metrics(out_vals, labels_v)
+        data = (*inputs_v, *labels_v)
+        fn = self._get_step_fn("eval", len(inputs_v), data)
+        state = self._train_state
+        if state is not None:
+            # train state is the canonical copy mid-fit — eval reads it
+            # directly, no Layer-tree sync required
+            state.refresh()
+            params, frozen, buffers = (state.params, state.frozen,
+                                       state.buffers)
+        else:
+            net = self.network
+            params, frozen, buffers = (F.param_dict(net),
+                                       F.frozen_dict(net),
+                                       F.buffer_dict(net))
+        loss_val, out_vals, mstats, new_buf = fn(params, frozen,
+                                                 buffers, *data)
+        self._commit_eval_buffers(new_buf, state)
+        if state is not None and not self._in_fit:
+            # same public contract as direct train_batch: outside fit
+            # the Layer tree (whose buffer arrays were just donated)
+            # is rebound before the call returns
+            state.sync_to_layers()
+        metrics = self._apply_metric_stats(mstats, out_vals, labels_v)
         return self._format_loss(loss_val), metrics
+
+    def _commit_eval_buffers(self, new_buf, state):
+        """The eval jit donates the buffers dict; rebind the returned
+        (aliased) arrays so nothing touches the donated originals."""
+        if state is not None:
+            state.commit_buffers(new_buf)
+            return
+        name_to_buf = dict(self.network.named_buffers())
+        for n, v in new_buf.items():
+            b = name_to_buf.get(n)
+            if b is not None:
+                b._value = v
 
     def predict_batch(self, inputs):
         self.network.eval()
+        self._sync_train_state()
         inputs_v = self._prepare_data(inputs)
         from ..autograd import tape as _tape
         with _tape.no_grad_ctx():
             outs = self.network(*[Tensor(v) for v in inputs_v])
         return [o.numpy() for o in _to_list(outs)]
 
-    def _update_metrics(self, out_vals, labels_v):
-        results = []
+    def _apply_metric_stats(self, mstats, out_vals, labels_v):
+        """One metric dispatch for every execution path.  ``mstats``
+        holds the stat vectors the compiled step already computed
+        (host list appends only); pass ``None`` when no in-step stats
+        exist (runner/eager paths) — device-capable metrics then run
+        their own small jitted update, and metrics without a device
+        path fall back to the numpy update either way."""
+        if not self._metrics:
+            return []
+        rows = 1
+        if out_vals:
+            for s in out_vals[0].shape[:-1]:
+                rows *= int(s)
+        results, mi = [], 0
         for m in self._metrics:
-            pred = Tensor(out_vals[0])
-            lbl = Tensor(labels_v[0]) if labels_v else None
-            corr = m.compute(pred, lbl)
-            r = m.update(corr)
-            results.append(r)
+            device = (getattr(m, "supports_device_update", False)
+                      and out_vals and labels_v)
+            if device and mstats is not None and mi < len(mstats):
+                results.append(m.update_device_stats(mstats[mi], rows))
+                mi += 1
+            elif device:
+                results.append(m.update_device(out_vals[0], labels_v[0]))
+            else:
+                pred = Tensor(out_vals[0])
+                lbl = Tensor(labels_v[0]) if labels_v else None
+                results.append(m.update(m.compute(pred, lbl)))
         return results
 
+    def _update_metrics(self, out_vals, labels_v):
+        return self._apply_metric_stats(None, out_vals, labels_v)
+
     def _format_loss(self, loss_val):
-        return [np.asarray(jax.device_get(loss_val))]
+        # deferred sync: the loss rides the callbacks as a device value;
+        # only a callback that formats it pays the device→host transfer
+        return [LazyScalar(loss_val)]
 
     # -- loops --------------------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
@@ -303,20 +458,29 @@ class Model:
             verbose=verbose, metrics=self._metrics_name())
 
         cbks.on_begin("train")
-        for epoch in range(epochs):
-            if hasattr(train_loader, "batch_sampler") and hasattr(
-                    train_loader.batch_sampler, "set_epoch"):
-                train_loader.batch_sampler.set_epoch(epoch)
-            cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(train_loader, cbks, "train",
-                                       num_iters=num_iters)
-            cbks.on_epoch_end(epoch, logs)
-            if do_eval and epoch % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, verbose=0,
-                                          _callbacks=cbks)
-                logs.update({"eval_" + k: v for k, v in eval_logs.items()})
-            if self.stop_training:
-                break
+        self._in_fit = True
+        try:
+            for epoch in range(epochs):
+                if hasattr(train_loader, "batch_sampler") and hasattr(
+                        train_loader.batch_sampler, "set_epoch"):
+                    train_loader.batch_sampler.set_epoch(epoch)
+                cbks.on_epoch_begin(epoch)
+                logs = self._run_one_epoch(train_loader, cbks, "train",
+                                           num_iters=num_iters)
+                # epoch boundary: Layer tree re-syncs to the
+                # device-resident state before callbacks may read it
+                self._sync_train_state()
+                cbks.on_epoch_end(epoch, logs)
+                if do_eval and epoch % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, verbose=0,
+                                              _callbacks=cbks)
+                    logs.update({"eval_" + k: v
+                                 for k, v in eval_logs.items()})
+                if self.stop_training:
+                    break
+        finally:
+            self._in_fit = False
+            self._sync_train_state()
         cbks.on_end("train")
 
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
@@ -435,6 +599,7 @@ class Model:
 
     # -- persistence --------------------------------------------------------
     def save(self, path, training=True):
+        self._sync_train_state()
         if training:
             _save(self.network.state_dict(), path + ".pdparams")
             if self._optimizer is not None:
@@ -450,12 +615,16 @@ class Model:
         if not reset_optimizer and self._optimizer is not None and \
                 os.path.exists(opt_path):
             self._optimizer.set_state_dict(_load(opt_path))
-        self._opt_state = None  # re-derive from optimizer state lazily
+        # re-derive the device-resident state (and optimizer moments)
+        # lazily from the restored Layer tree
+        self._train_state = None
 
     def parameters(self, *args, **kwargs):
+        self._sync_train_state()
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
+        self._sync_train_state()
         from .summary import summary as _summary
         return _summary(self.network, input_size=input_size)
 

@@ -1,0 +1,189 @@
+"""Device-resident train state for the ``Model.fit`` hot loop.
+
+The async-dispatch contract (DESIGN-PERF.md): inside the hot loop the
+canonical copy of ``params`` / ``opt_state`` / ``buffers`` is this
+``TrainState``, not the ``Layer`` tree.  The compiled train step
+*donates* the state buffers (XLA reuses them for the updated state, so
+a 100M-param model updates in place instead of re-allocating every
+step) and the loop never rebuilds ``F.param_dict`` nor writes back
+``p._value`` per step.  The ``Layer`` tree is re-synced only at
+boundaries — epoch end, save, predict, explicit ``sync_to_layers`` —
+which is also the only moment user code may read the wrappers again:
+between steps the wrappers hold donated (deleted) arrays by design,
+and touching one raises jax's "Array has been deleted" error rather
+than silently reading stale weights.
+
+External in-place writes (``set_state_dict``, checkpoint restore,
+``amp.decorate``) are still honored: ``refresh()`` id-compares every
+wrapper's current ``_value`` against the last synced value and adopts
+any externally replaced leaf before the next compiled step consumes
+the state — the same coherence protocol as
+``DistributedRunner._sync_val_cache``.
+"""
+
+from __future__ import annotations
+
+from ..nn import functional_call as F
+from ..framework.lazy import LazyScalar  # noqa: F401  (re-export)
+
+
+class TrainState:
+    def __init__(self, network, optimizer):
+        self.network = network
+        self.optimizer = optimizer
+        self._param_refs = dict(network.named_parameters())
+        self._buf_refs = dict(network.named_buffers())
+        self.params = F.param_dict(network)
+        self.frozen = F.frozen_dict(network)
+        self.buffers = F.buffer_dict(network)
+        # a checkpoint restored via optimizer.set_state_dict lands in
+        # _opt_state_tree; adopt it when the keys line up (same
+        # contract as DistributedRunner.place)
+        restored = getattr(optimizer, "_opt_state_tree", None)
+        if restored and set(restored) == set(self.params):
+            self.opt_state = restored
+        else:
+            if restored:
+                import warnings
+                warnings.warn(
+                    "TrainState: restored optimizer state keys do not "
+                    "match the network parameters; re-initializing "
+                    "moments")
+            self.opt_state = optimizer.init_state_tree(self.params)
+        # identity snapshot of what each wrapper held at the last sync
+        # — the probe refresh() uses to detect external writes
+        self._wrapper_vals = {n: p._value
+                              for n, p in self._param_refs.items()}
+        self._wrapper_bufs = {n: (b._value if b is not None else None)
+                              for n, b in self._buf_refs.items()}
+        from ..nn import layer as _layer_mod
+        self._structure_version = _layer_mod.structure_version()
+        self._tree_ids = {id(l) for l in
+                          network.sublayers(include_self=True)}
+        self._dirty = False
+
+    # -- coherence -----------------------------------------------------
+    def _reconcile_structure(self):
+        """The Layer tree was structurally mutated (a sub-layer or
+        parameter replaced/added/removed — e.g. ``net.head =
+        nn.Linear(...)`` mid-training): re-walk the tree, adopt new
+        wrappers/values, init fresh moments for new/replaced params,
+        drop removed ones.  Only runs when the nn.layer structure
+        version moved — the per-step cost stays an int compare."""
+        old_refs = self._param_refs
+        self._param_refs = dict(self.network.named_parameters())
+        self._buf_refs = dict(self.network.named_buffers())
+        live = set(self._param_refs)
+        for dct in (self.params, self.frozen, self.opt_state,
+                    self._wrapper_vals):
+            for n in [n for n in dct if n not in live]:
+                dct.pop(n)
+        for n in [n for n in self.buffers if n not in self._buf_refs]:
+            self.buffers.pop(n)
+            self._wrapper_bufs.pop(n, None)
+        for n, p in self._param_refs.items():
+            if n in self._wrapper_vals and old_refs.get(n) is p:
+                continue   # same wrapper: refresh()'s id-compare rules
+            # new or replaced wrapper: adopt its value; a replaced
+            # module must not train on the predecessor's moments
+            self.params.pop(n, None)
+            self.frozen.pop(n, None)
+            tgt = self.frozen if p.stop_gradient else self.params
+            tgt[n] = p._value
+            self._wrapper_vals[n] = p._value
+            if p.stop_gradient:
+                self.opt_state.pop(n, None)
+            else:
+                self.opt_state[n] = self.optimizer.init_state_tree(
+                    {n: p._value})[n]
+        for n, b in self._buf_refs.items():
+            if n not in self._wrapper_bufs:
+                self._wrapper_bufs[n] = None if b is None else b._value
+                if b is not None:
+                    self.buffers[n] = b._value
+        self._tree_ids = {id(l) for l in
+                          self.network.sublayers(include_self=True)}
+
+    def refresh(self):
+        """Adopt external in-place wrapper writes since the last sync
+        (id-compares only — no device work, no host sync)."""
+        from ..nn import layer as _layer_mod
+        ver = _layer_mod.structure_version()
+        if ver != self._structure_version:
+            # only re-walk when a mutation touched THIS tree —
+            # unrelated Layer construction elsewhere stays a cheap
+            # membership check
+            touched = _layer_mod.mutations_since(self._structure_version)
+            if touched is None or any(i in self._tree_ids
+                                      for i in touched):
+                self._reconcile_structure()
+            self._structure_version = ver
+        for n, p in self._param_refs.items():
+            in_train = n in self.params
+            if p.stop_gradient == in_train:
+                # trainability flipped since the state was built: move
+                # the leaf between dicts; a newly trainable param gets
+                # fresh optimizer moments
+                if in_train:
+                    self.frozen[n] = self.params.pop(n)
+                    self.opt_state.pop(n, None)
+                else:
+                    self.params[n] = self.frozen.pop(n)
+                    self.opt_state[n] = self.optimizer.init_state_tree(
+                        {n: p._value})[n]
+            if self._wrapper_vals[n] is not p._value:
+                tgt = self.frozen if p.stop_gradient else self.params
+                tgt[n] = p._value
+                self._wrapper_vals[n] = p._value
+        for n, b in self._buf_refs.items():
+            if b is not None and self._wrapper_bufs[n] is not b._value:
+                self.buffers[n] = b._value
+                self._wrapper_bufs[n] = b._value
+
+    # -- step commit ---------------------------------------------------
+    def commit(self, new_params, new_opt_state, new_buffers):
+        """Adopt one compiled step's outputs.  Reference rebinds only —
+        the old arrays were donated into the step and are already gone.
+        The optimizer's canonical checkpoint slot stays coherent."""
+        self.params = new_params
+        self.opt_state = new_opt_state
+        for n, v in new_buffers.items():
+            if n in self.buffers:
+                self.buffers[n] = v
+        self.optimizer._opt_state_tree = new_opt_state
+        if hasattr(self.optimizer, "_global_step"):
+            self.optimizer._global_step += 1
+        self._dirty = True
+
+    def commit_buffers(self, new_buffers):
+        """Adopt an eval/predict step's pass-through buffers (the one
+        state argument an inference step donates)."""
+        changed = False
+        for n, v in new_buffers.items():
+            if n in self.buffers and self.buffers[n] is not v:
+                self.buffers[n] = v
+                changed = True
+        if changed:
+            self._dirty = True
+
+    # -- boundary sync -------------------------------------------------
+    def sync_to_layers(self):
+        """Write the device-resident state back into the Layer tree —
+        the epoch/save/eval boundary of DESIGN-PERF.md.  Pure reference
+        rebinding: no device transfer happens here."""
+        if not self._dirty:
+            return
+        for n, v in self.params.items():
+            p = self._param_refs[n]
+            p._value = v
+            self._wrapper_vals[n] = v
+        for n, v in self.frozen.items():
+            p = self._param_refs[n]
+            p._value = v
+            self._wrapper_vals[n] = v
+        for n, v in self.buffers.items():
+            b = self._buf_refs.get(n)
+            if b is not None:
+                b._value = v
+                self._wrapper_bufs[n] = v
+        self._dirty = False

@@ -22,6 +22,7 @@ import numpy as np
 from ..tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
+from .staging import stage_batch
 
 
 def default_collate_fn(batch):
@@ -127,17 +128,9 @@ class _DevicePrefetcher:
 
     @staticmethod
     def _stage(item):
-        import jax
-
-        def put(x):
-            if isinstance(x, Tensor):
-                return Tensor(jax.device_put(x._value))
-            if isinstance(x, (list, tuple)):
-                return type(x)(put(v) for v in x)
-            if isinstance(x, dict):
-                return {k: put(v) for k, v in x.items()}
-            return x
-        return put(item)
+        # the single host→device staging path shared with the hapi
+        # Model hot loop (io/staging.py)
+        return stage_batch(item)
 
     def _fill(self):
         while not self._exhausted and len(self._buf) < self._depth:

@@ -1,0 +1,70 @@
+"""Single host→device staging path (DESIGN-PERF.md).
+
+Every host batch enters the device through here: the hapi
+``Model._prepare_data`` hot loop and the DataLoader's device
+double-buffer (``_DevicePrefetcher``) both stage through this module,
+so the H2D story has one owner — one ``np.asarray`` view (zero-copy
+for arrays already in host memory) followed by ONE async
+``jax.device_put``.  The per-element ``jnp.asarray(np.asarray(d))``
+round-trip the seed code did (host → device → trace-time convert) is
+gone.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor
+
+
+def to_device_value(d):
+    """Host value → jax array via one async ``device_put``.
+
+    ``Tensor`` inputs pass their device value through untouched; the
+    put is dispatched asynchronously, so the H2D copy of this batch
+    overlaps the compute of the previous step.
+    """
+    if isinstance(d, Tensor):
+        return d._value
+    import jax
+    if isinstance(d, jax.Array):
+        return d   # already device-resident: no D2H round trip
+    if not isinstance(d, np.ndarray):
+        d = np.asarray(d)
+    return jax.device_put(d)
+
+
+def to_device_values(seq):
+    """Batch variant of :func:`to_device_value`: ONE async
+    ``device_put`` covers every host leaf in the sequence (jax batches
+    the transfers), Tensor leaves pass their device value through."""
+    import jax
+    vals = []
+    host_idx = []
+    for i, d in enumerate(seq):
+        if isinstance(d, Tensor):
+            vals.append(d._value)
+        elif isinstance(d, jax.Array):
+            vals.append(d)   # already device-resident
+        else:
+            host_idx.append(i)
+            vals.append(d if isinstance(d, np.ndarray) else np.asarray(d))
+    if host_idx:
+        placed = jax.device_put([vals[i] for i in host_idx])
+        for i, v in zip(host_idx, placed):
+            vals[i] = v
+    return vals
+
+
+def stage_batch(item):
+    """Tree-map device staging for loader batches: start the async H2D
+    copy for every Tensor leaf (device double-buffering — the transfer
+    of batch N+1 overlaps the compute of batch N)."""
+    import jax
+    if isinstance(item, Tensor):
+        return Tensor(jax.device_put(item._value))
+    if isinstance(item, (list, tuple)):
+        return type(item)(stage_batch(v) for v in item)
+    if isinstance(item, dict):
+        return {k: stage_batch(v) for k, v in item.items()}
+    return item

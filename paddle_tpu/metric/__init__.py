@@ -1,10 +1,20 @@
-"""paddle.metric parity (python/paddle/metric/metrics.py)."""
+"""paddle.metric parity (python/paddle/metric/metrics.py).
+
+Two update paths (DESIGN-PERF.md): the classic numpy ``compute`` /
+``update`` pair (host-side, used for direct calls and metrics without a
+device kernel) and, for metrics flagged ``supports_device_update``, a
+``update_device(pred, label)`` fast path the ``Model.fit`` hot loop
+uses — a small jitted reduction whose correct/total accumulators stay
+ON DEVICE until ``accumulate()`` materializes them at the epoch
+boundary.  The hot loop never pulls predictions to the host.
+"""
 
 from __future__ import annotations
 
 import numpy as np
 
 from ..tensor import Tensor
+from ..framework.lazy import LazyScalar
 
 
 def _np(x):
@@ -12,6 +22,10 @@ def _np(x):
 
 
 class Metric:
+    # metrics that implement update_device(pred, label) set this True;
+    # Model.fit then keeps their accumulators device-resident
+    supports_device_update = False
+
     def reset(self):
         raise NotImplementedError
 
@@ -29,10 +43,13 @@ class Metric:
 
 
 class Accuracy(Metric):
+    supports_device_update = True
+
     def __init__(self, topk=(1,), name=None):
         self.topk = topk if isinstance(topk, (list, tuple)) else (topk,)
         self.maxk = max(self.topk)
         self._name = name or "acc"
+        self._stats_fn = None
         self.reset()
 
     def compute(self, pred, label, *args):
@@ -56,12 +73,67 @@ class Accuracy(Metric):
             accs.append(corr_k / max(num, 1))
         return accs[0] if len(accs) == 1 else accs
 
+    # -- device-resident fast path (Model.fit hot loop) ----------------
+    def device_batch_stats(self):
+        """Pure (pred, label) → stat vector, traceable INSIDE the
+        compiled train step — the per-batch top-k correct counts ride
+        the step's XLA program, so the hot loop dispatches zero extra
+        device ops for metrics."""
+        import jax
+        import jax.numpy as jnp
+        maxk, topk = self.maxk, self.topk
+
+        def stats(pred, label):
+            _, order = jax.lax.top_k(pred, maxk)
+            if label.ndim == pred.ndim:
+                label = (label[..., 0] if label.shape[-1] == 1
+                         else label.argmax(-1))
+            correct = (order == label[..., None]).astype(jnp.float32)
+            flat = correct.reshape(-1, maxk)
+            return jnp.stack([flat[:, :k].sum() for k in topk])
+
+        return stats
+
+    def update_device_stats(self, stat_vec, rows):
+        """Adopt one batch's device-side stat vector: a host list
+        append — no add dispatch, no sync.  Totals materialize in
+        accumulate() at the epoch boundary."""
+        self._dev_pending.append(stat_vec)
+        self._dev_rows += rows
+        if len(self.topk) == 1:
+            return LazyScalar(stat_vec,
+                              lambda c, n=rows: float(c[0]) / max(n, 1))
+        return [LazyScalar(stat_vec,
+                           lambda c, i=i, n=rows: float(c[i]) / max(n, 1))
+                for i in range(len(self.topk))]
+
+    def update_device(self, pred, label):
+        """Standalone device update (eval path): one small jitted
+        reduction, accumulators stay on device until accumulate()."""
+        if self._stats_fn is None:
+            import jax
+            self._stats_fn = jax.jit(self.device_batch_stats())
+        rows = 1
+        for s in pred.shape[:-1]:
+            rows *= int(s)
+        return self.update_device_stats(self._stats_fn(pred, label), rows)
+
     def reset(self):
         self.total = [0.0] * len(self.topk)
         self.count = [0] * len(self.topk)
+        self._dev_pending = []
+        self._dev_rows = 0
 
     def accumulate(self):
-        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        total = list(self.total)
+        count = list(self.count)
+        if self._dev_pending:
+            # epoch-boundary materialization of the device accumulators
+            corr = np.sum(np.asarray(self._dev_pending), axis=0)
+            for i in range(len(self.topk)):
+                total[i] += float(corr[i])
+                count[i] += self._dev_rows
+        res = [t / max(c, 1) for t, c in zip(total, count)]
         return res[0] if len(res) == 1 else res
 
     def name(self):

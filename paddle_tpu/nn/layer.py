@@ -61,6 +61,45 @@ class HookRemoveHelper:
         self._hooks.pop(self._hook_id, None)
 
 
+# Structural-mutation log: bumped whenever ANY Layer gains/loses a
+# Parameter or sub-Layer, recording the id of the mutated layer.  The
+# hapi TrainState snapshots the version and, when it moved, asks
+# ``mutations_since`` whether any mutated layer belongs to ITS tree —
+# unrelated Layer construction mid-fit (a callback building a probe
+# module, a second model) stays a cheap set intersection, and the
+# expensive name→param re-walk only runs for a real mutation of the
+# trained network, e.g. ``net.head = nn.Linear(...)`` mid-training
+# (DESIGN-PERF.md).
+_STRUCTURE_VERSION = 0
+_MUTATION_LOG: List[int] = []   # id(layer) per bump, a bounded window
+_LOG_BASE = 0                   # version number of _MUTATION_LOG[0]
+_MUTATION_LOG_MAX = 4096
+
+
+def bump_structure_version(layer=None):
+    global _STRUCTURE_VERSION, _LOG_BASE
+    _STRUCTURE_VERSION += 1
+    _MUTATION_LOG.append(id(layer))
+    if len(_MUTATION_LOG) > _MUTATION_LOG_MAX:
+        drop = _MUTATION_LOG_MAX // 2
+        del _MUTATION_LOG[:drop]
+        _LOG_BASE += drop
+
+
+def structure_version() -> int:
+    return _STRUCTURE_VERSION
+
+
+def mutations_since(version: int):
+    """ids of layers mutated after ``version``; ``None`` when the log
+    window was trimmed past it (caller must assume its tree was
+    touched)."""
+    start = version - _LOG_BASE
+    if start < 0:
+        return None
+    return _MUTATION_LOG[start:]
+
+
 class Layer:
     def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
         object.__setattr__(self, "_parameters", collections.OrderedDict())
@@ -84,9 +123,11 @@ class Layer:
                 raise RuntimeError("call Layer.__init__ first")
             params[name] = value
             self.__dict__.pop(name, None)
+            bump_structure_version(self)
         elif isinstance(value, Layer):
             layers[name] = value
             self.__dict__.pop(name, None)
+            bump_structure_version(self)
         else:
             if params is not None and name in params:
                 if value is None:
@@ -112,6 +153,7 @@ class Layer:
             d = self.__dict__.get(store)
             if d is not None and name in d:
                 del d[name]
+                bump_structure_version(self)
                 return
         object.__delattr__(self, name)
 
@@ -166,10 +208,12 @@ class Layer:
             self._parameters[name] = None
         else:
             self._parameters[name] = parameter
+        bump_structure_version(self)
         return parameter
 
     def add_sublayer(self, name: str, sublayer: "Layer"):
         self._sub_layers[name] = sublayer
+        bump_structure_version(self)
         return sublayer
 
     def register_buffer(self, name: str, tensor: Optional[Tensor],
@@ -177,6 +221,7 @@ class Layer:
         self._buffers[name] = tensor
         if not persistable:
             self._non_persistable_buffer_names.add(name)
+        bump_structure_version(self)
         return tensor
 
     # -- traversal ----------------------------------------------------------

@@ -131,7 +131,17 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self) -> np.ndarray:
-        return np.asarray(jax.device_get(self._value))
+        a = np.asarray(jax.device_get(self._value))
+        if not a.flags.writeable:
+            # the CPU backend returns a zero-copy READ-ONLY view of the
+            # device buffer; with donated train steps (DESIGN-PERF.md)
+            # that memory is reused for the updated state, so a
+            # snapshot must really be a snapshot — and paddle's
+            # Tensor.numpy() contract is a writable copy.  On TPU
+            # device_get already copies to fresh host memory, so this
+            # branch never pays there.
+            a = a.copy()
+        return a
 
     def __array__(self, dtype=None):
         a = self.numpy()

@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""Static host-sync check for the training hot path (DESIGN-PERF.md).
+
+The async-dispatch contract says the ``Model.fit`` /
+``DistributedRunner`` hot loop may NOT synchronize host and device:
+every ``jax.device_get`` / ``.numpy()`` / ``np.asarray`` /
+``jax.block_until_ready`` on a device value stalls the dispatch queue
+and serializes host with device — exactly the overlap TPUs live on.
+Syncs are allowed only at explicitly whitelisted points (boundary
+materialization, host→device staging of fresh numpy input, public
+APIs that return numpy by contract).
+
+Mirrors ``scripts/check_retry_coverage.py``: enforced structurally as
+a plain test (``tests/test_hapi_hot_path.py``), no CI required.  The
+check is syntactic — it cannot tell a device value from a host value —
+so every allowlisted (module, function) carries its justification here,
+on record.
+
+Exit 0 clean; exit 1 with a violation report otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+# the hot-loop modules under the contract
+HOT_MODULES = [
+    os.path.join("hapi", "model.py"),
+    os.path.join("hapi", "callbacks.py"),
+    os.path.join("hapi", "train_state.py"),
+    os.path.join("distributed", "runner.py"),
+    os.path.join("metric", "__init__.py"),
+    os.path.join("io", "dataloader.py"),
+    os.path.join("io", "staging.py"),
+    os.path.join("framework", "lazy.py"),
+]
+
+# (module, enclosing function) → why this sync point is legitimate
+ALLOWED_SYNC = {
+    ("framework", "lazy.py", "_materialize"):
+        "THE deferred sync point: LazyScalar materializes on first "
+        "host use (callback formatting), not per step",
+    ("hapi", "model.py", "predict_batch"):
+        "public API returns numpy by contract",
+    ("hapi", "model.py", "_cat"):
+        "host-side concat of host loader batches (grad-accum "
+        "grouping happens before staging)",
+    ("hapi", "callbacks.py", "_fmt"):
+        "verbose-interval log formatting (ProgBarLogger) — the "
+        "sanctioned materialization cadence",
+    ("hapi", "callbacks.py", "on_eval_end"):
+        "EarlyStopping decision at the epoch boundary",
+    ("metric", "__init__.py", "_np"):
+        "host-path Metric API: used for direct user calls, never by "
+        "the fit hot loop (which uses device_batch_stats)",
+    ("metric", "__init__.py", "update"):
+        "host-path Metric.update (outside the fit hot loop)",
+    ("metric", "__init__.py", "compute"):
+        "host-path Metric.compute (outside the fit hot loop)",
+    ("metric", "__init__.py", "accumulate"):
+        "epoch-boundary materialization of device accumulators",
+    ("metric", "__init__.py", "accuracy"):
+        "functional host metric (one-shot, not a loop)",
+    ("io", "staging.py", "to_device_value"):
+        "host→device staging (np.asarray views host data, never a "
+        "device value)",
+    ("io", "staging.py", "to_device_values"):
+        "host→device staging (batched device_put of host leaves)",
+    ("io", "dataloader.py", "default_collate_fn"):
+        "collates host sample arrays produced by the dataset",
+}
+
+
+def _sync_kind(call: ast.Call):
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "device_get":
+            return "jax.device_get"
+        if f.attr == "block_until_ready":
+            return "jax.block_until_ready"
+        if f.attr == "numpy" and not call.args and not call.keywords:
+            return ".numpy()"
+        if f.attr == "asarray" and isinstance(f.value, ast.Name) \
+                and f.value.id in ("np", "numpy"):
+            return "np.asarray"
+    elif isinstance(f, ast.Name) and f.id == "device_get":
+        return "jax.device_get"
+    return None
+
+
+def check() -> List[Tuple[str, int, str]]:
+    violations: List[Tuple[str, int, str]] = []
+    seen_funcs = set()
+    for rel in HOT_MODULES:
+        path = os.path.join(PKG, rel)
+        with open(path) as fh:
+            tree = ast.parse(fh.read(), filename=path)
+        parts = tuple(rel.split(os.sep))
+        # enclosing-function chains (innermost last)
+        funcs = [n for n in ast.walk(tree)
+                 if isinstance(n, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        chains = {}
+        for fn in funcs:
+            seen_funcs.add(parts + (fn.name,))
+            for n in ast.walk(fn):
+                chains.setdefault(id(n), []).append(fn)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _sync_kind(node)
+            if kind is None:
+                continue
+            chain = chains.get(id(node), [])
+            if not chain:
+                violations.append(
+                    (rel, node.lineno,
+                     f"module-level {kind} (host sync outside any "
+                     "whitelisted function)"))
+            elif not any(parts + (fn.name,) in ALLOWED_SYNC
+                         for fn in chain):
+                violations.append(
+                    (rel, node.lineno,
+                     f"{kind} in {chain[-1].name}() is not a "
+                     "whitelisted sync point (DESIGN-PERF.md: the hot "
+                     "loop must not stall the dispatch queue)"))
+    # a stale allowlist hides future violations: every entry must
+    # still name a real function
+    for entry, reason in ALLOWED_SYNC.items():
+        if entry not in seen_funcs:
+            violations.append(
+            (os.path.join(*entry[:-1]), 0,
+             f"stale allowlist entry: no function named "
+             f"{entry[-1]!r} ({reason[:40]}...)"))
+    return violations
+
+
+def main() -> int:
+    violations = check()
+    if not violations:
+        print("host-sync coverage OK: hot-loop modules sync only at "
+              "whitelisted points")
+        return 0
+    print("host-sync violations:")
+    for rel, line, msg in violations:
+        print(f"  paddle_tpu/{rel}:{line}: {msg}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,411 @@
+"""Async hot path: device-resident TrainState, donated compiled steps,
+shape-keyed step cache, deferred host syncs (ISSUE 4 / DESIGN-PERF.md).
+
+Covers the acceptance criteria:
+- exactly one compile across a multi-epoch Model.fit (one extra per
+  distinct batch signature),
+- donation verified (re-using a donated params buffer raises),
+- the stale-trace arity bug is fixed (regression test),
+- Model.fit end state is bit-identical to the pre-PR per-step
+  write-back loop on a fixed-seed LeNet run,
+- the static host-sync guard (scripts/check_host_sync.py) passes.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.tensor import Tensor
+
+
+def _mlp():
+    return nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+
+
+def _batches(n, bs=8, din=4, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[rng.rand(bs, din).astype(np.float32),
+             rng.randint(0, classes, (bs,)).astype(np.int64)]
+            for _ in range(n)]
+
+
+def _prepared_model(metrics=None, seed=0):
+    paddle.seed(seed)
+    m = paddle.Model(_mlp())
+    m.prepare(optimizer.Adam(1e-2, parameters=m.parameters()),
+              nn.CrossEntropyLoss(), metrics)
+    return m
+
+
+# -- recompile counting ------------------------------------------------
+
+
+def test_one_compile_across_multi_epoch_fit():
+    m = _prepared_model(paddle.metric.Accuracy())
+    m.fit(_batches(6), epochs=3, verbose=0)
+    stats = m.compile_stats()
+    assert stats == {"entries": 1, "traces": 1}, stats
+
+
+def test_one_extra_compile_per_batch_signature():
+    m = _prepared_model()
+    m.fit(_batches(4, bs=8), epochs=2, verbose=0)
+    assert m.compile_stats()["traces"] == 1
+    # a second distinct batch shape compiles exactly once more
+    m.fit(_batches(4, bs=4), epochs=2, verbose=0)
+    stats = m.compile_stats()
+    assert stats == {"entries": 2, "traces": 2}, stats
+    # re-running both signatures stays fully cached
+    m.fit(_batches(2, bs=8), epochs=1, verbose=0)
+    m.fit(_batches(2, bs=4), epochs=1, verbose=0)
+    assert m.compile_stats()["traces"] == 2
+
+
+# -- donation ----------------------------------------------------------
+
+
+def test_donated_params_buffer_raises_on_reuse():
+    m = _prepared_model()
+    old_vals = [p._value for p in m.network.parameters()]
+    x, y = _batches(1)[0]
+    m.train_batch(x, y)
+    # the pre-step param buffers were donated into the compiled step;
+    # using one afterwards must raise, not silently read stale weights
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(old_vals[0])
+    # while the Layer tree (synced at the call boundary) stays live
+    for p in m.network.parameters():
+        np.asarray(p._value)
+
+
+def test_update_false_does_not_donate_or_update():
+    m = _prepared_model()
+    x, y = _batches(1)[0]
+    m.train_batch(x, y)          # build state + one real update
+    before = {n: np.asarray(v.numpy())
+              for n, v in m.network.state_dict().items()}
+    loss, _ = m.train_batch(x, y, update=False)
+    assert np.isfinite(float(np.asarray(loss[0])))
+    after = {n: np.asarray(v.numpy())
+             for n, v in m.network.state_dict().items()}
+    for n in before:
+        np.testing.assert_array_equal(before[n], after[n])
+
+
+# -- stale-trace arity regression --------------------------------------
+
+
+class _VarSum(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lin = nn.Linear(4, 3)
+
+    def forward(self, *xs):
+        s = xs[0]
+        for x in xs[1:]:
+            s = s + x
+        return self.lin(s)
+
+
+def test_train_batch_arity_change_recompiles_correctly():
+    """Seed bug: the first call baked self._n_inputs into the trace, so
+    a later call with a different input/label split silently reused the
+    stale program (mis-splitting inputs into labels)."""
+    rng = np.random.RandomState(0)
+    x1 = rng.rand(8, 4).astype(np.float32)
+    x2 = rng.rand(8, 4).astype(np.float32)
+    y = rng.randint(0, 3, (8,)).astype(np.int64)
+
+    paddle.seed(7)
+    m = paddle.Model(_VarSum())
+    m.prepare(optimizer.SGD(0.1, parameters=m.parameters()),
+              nn.CrossEntropyLoss())
+    m.train_batch([x1], [y], update=False)
+    loss2, _ = m.train_batch([x1, x2], [y], update=False)
+
+    paddle.seed(7)
+    ref = paddle.Model(_VarSum())
+    ref.prepare(optimizer.SGD(0.1, parameters=ref.parameters()),
+                nn.CrossEntropyLoss())
+    loss_ref, _ = ref.train_batch([x1, x2], [y], update=False)
+
+    np.testing.assert_allclose(np.asarray(loss2), np.asarray(loss_ref),
+                               rtol=1e-6)
+    assert m.compile_stats()["entries"] == 2
+
+
+# -- end-state parity with the pre-PR write-back loop -------------------
+
+
+def _reference_write_back_fit(net, opt, loss_fn, batches, epochs):
+    """Faithful replica of the pre-PR per-step write-back loop: rebuild
+    the param dicts every step, jit WITHOUT donation, write every
+    ``._value`` back after each step, draw the step key eagerly."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.nn import functional_call as F
+    from paddle_tpu.framework import random as _random
+
+    decay, l1, lrs = opt._per_param_coeffs(dict(net.named_parameters()))
+    n_in = 1
+
+    def step(params, frozen, buffers, opt_state, lr, key, *data):
+        inputs = [Tensor(v) for v in data[:n_in]]
+        labels = [Tensor(v) for v in data[n_in:]]
+
+        def loss_of(p):
+            with F.bind(net, p, buffers, frozen) as holder:
+                from paddle_tpu.autograd import tape as _tape
+                with _tape.no_grad_ctx():
+                    with _random.key_provider(
+                            _random.make_split_provider(key)):
+                        outs = net(*inputs)
+                        outs = outs if isinstance(outs, (list, tuple)) \
+                            else [outs]
+                        loss = loss_fn(*outs, *labels)
+            return loss._value.astype(jnp.float32), (
+                [o._value for o in outs], holder.get("buffers", {}))
+
+        (loss_val, (out_vals, new_buf)), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(params)
+        new_p, new_s = opt.apply_gradients_tree(
+            params, grads, opt_state, lr, decay_coeffs=decay,
+            lr_scales=lrs, l1_coeffs=l1)
+        return loss_val, out_vals, new_p, new_s, new_buf
+
+    jit_step = jax.jit(step)
+    opt_state = opt.init_state_tree(F.param_dict(net))
+    for _ in range(epochs):
+        for x, y in batches:
+            params = F.param_dict(net)
+            frozen = F.frozen_dict(net)
+            buffers = F.buffer_dict(net)
+            lr = jnp.asarray(opt.get_lr(), dtype=jnp.float32)
+            key = _random.default_generator().draw_key()
+            _, _, new_p, opt_state, new_buf = jit_step(
+                params, frozen, buffers, opt_state, lr, key,
+                jnp.asarray(x), jnp.asarray(y))
+            ntp = dict(net.named_parameters())
+            for n, v in new_p.items():
+                ntp[n]._value = v
+            ntb = dict(net.named_buffers())
+            for n, v in new_buf.items():
+                if ntb.get(n) is not None:
+                    ntb[n]._value = v
+    return opt_state
+
+
+def test_fit_end_state_bit_identical_to_write_back_loop():
+    from paddle_tpu.vision.models import LeNet
+    rng = np.random.RandomState(0)
+    batches = [[rng.rand(8, 1, 28, 28).astype(np.float32),
+                rng.randint(0, 10, (8,)).astype(np.int64)]
+               for _ in range(4)]
+
+    paddle.seed(0)
+    net_a = LeNet()
+    opt_a = optimizer.Adam(1e-3, parameters=net_a.parameters())
+    model = paddle.Model(net_a)
+    model.prepare(opt_a, nn.CrossEntropyLoss())
+    model.fit(batches, epochs=2, verbose=0)
+
+    paddle.seed(0)
+    net_b = LeNet()
+    opt_b = optimizer.Adam(1e-3, parameters=net_b.parameters())
+    ref_state = _reference_write_back_fit(
+        net_b, opt_b, nn.CrossEntropyLoss(), batches, epochs=2)
+
+    sd_a = net_a.state_dict()
+    sd_b = net_b.state_dict()
+    assert set(sd_a) == set(sd_b)
+    for n in sd_a:
+        np.testing.assert_array_equal(
+            np.asarray(sd_a[n].numpy()), np.asarray(sd_b[n].numpy()),
+            err_msg=f"param {n} diverged from the write-back loop")
+    new_state = model._train_state.opt_state
+    assert set(new_state) == set(ref_state)
+    for n, slots in ref_state.items():
+        for k, v in slots.items():
+            np.testing.assert_array_equal(
+                np.asarray(new_state[n][k]), np.asarray(v),
+                err_msg=f"opt state {n}/{k} diverged")
+
+
+# -- boundary sync semantics -------------------------------------------
+
+
+def test_layer_tree_current_after_fit_and_direct_calls():
+    m = _prepared_model()
+    batches = _batches(4)
+    w0 = np.asarray(m.network.state_dict()["0.weight"].numpy()).copy()
+    m.fit(batches, epochs=1, verbose=0)
+    w1 = np.asarray(m.network.state_dict()["0.weight"].numpy())
+    assert not np.allclose(w0, w1), "fit did not sync updates back"
+    # direct train_batch outside fit syncs at the call boundary
+    m.train_batch(batches[0][0], batches[0][1])
+    w2 = np.asarray(m.network.state_dict()["0.weight"].numpy())
+    assert not np.allclose(w1, w2)
+
+
+def test_external_weight_write_is_adopted_mid_training():
+    m = _prepared_model()
+    x, y = _batches(1)[0]
+    m.train_batch(x, y)   # device-resident state now owns the params
+    zeroed = {k: Tensor(np.zeros_like(np.asarray(v.numpy())))
+              for k, v in m.network.state_dict().items()}
+    m.network.set_state_dict(zeroed)
+    loss, _ = m.train_batch(x, y, update=False)
+    # zero weights + zero bias → uniform logits → CE == ln(3)
+    np.testing.assert_allclose(float(np.asarray(loss[0])),
+                               np.log(3.0), rtol=1e-5)
+
+
+def test_replaced_submodule_trains_mid_loop():
+    """Replacing a sub-layer after training started (seed semantics:
+    param dicts were rebuilt every step) must keep training the NEW
+    module — TrainState detects the structural mutation through the
+    nn.layer structure version and reconciles."""
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.body = nn.Linear(4, 8)
+            self.head = nn.Linear(8, 3)
+
+        def forward(self, x):
+            import paddle_tpu.nn.functional as F_
+            return self.head(F_.relu(self.body(x)))
+
+    paddle.seed(0)
+    m = paddle.Model(Net())
+    m.prepare(optimizer.Adam(1e-2, parameters=m.parameters()),
+              nn.CrossEntropyLoss())
+    x, y = _batches(1)[0]
+    m.train_batch(x, y)
+    m.network.head = nn.Linear(8, 3)   # swap mid-training
+    w0 = np.asarray(m.network.head.weight.numpy()).copy()
+    for _ in range(3):
+        m.train_batch(x, y)
+    w1 = np.asarray(m.network.head.weight.numpy())
+    assert not np.allclose(w0, w1), \
+        "replaced submodule silently stopped training"
+
+
+def test_unrelated_layer_construction_skips_reconcile():
+    """Building OTHER layers mid-loop (a probe module in a callback, a
+    second model) must not trigger the trained network's structural
+    re-walk — the mutation log scopes the probe to this tree."""
+    m = _prepared_model()
+    x, y = _batches(1)[0]
+    m.train_batch(x, y)
+    state = m._train_state
+    calls = []
+    orig = type(state)._reconcile_structure
+    state._reconcile_structure = lambda: calls.append(1)
+    try:
+        nn.Linear(3, 3)   # unrelated construction bumps the version
+        m.train_batch(x, y)
+        assert not calls, "unrelated construction forced a re-walk"
+        m.network.add_sublayer("probe", nn.Linear(4, 4))  # ours: must
+        m.train_batch(x, y)
+        assert calls, "own-tree mutation did not reconcile"
+    finally:
+        state._reconcile_structure = orig.__get__(state)
+
+
+def test_standalone_eval_after_fit_keeps_buffers_live():
+    """eval donates the buffers dict; outside fit the Layer tree must
+    be rebound before eval_batch/evaluate returns (BN running stats
+    readable, save() works)."""
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.ReLU(),
+                        nn.Linear(8, 3))
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(1e-2, parameters=m.parameters()),
+              nn.CrossEntropyLoss())
+    batches = _batches(4)
+    m.fit(batches, epochs=1, verbose=0)
+    m.evaluate(batches, verbose=0)
+    for n, b in net.named_buffers():
+        if b is not None:
+            np.asarray(b.numpy())   # must not be a donated dead array
+
+
+def test_eval_with_batchnorm_buffers_survives_donation():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.ReLU(),
+                        nn.Linear(8, 3))
+    m = paddle.Model(net)
+    m.prepare(optimizer.Adam(1e-2, parameters=m.parameters()),
+              nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    batches = _batches(4)
+    m.fit(batches, epochs=2, verbose=0)
+    r1 = m.evaluate(batches, verbose=0)
+    r2 = m.evaluate(batches, verbose=0)
+    # repeated eval: donated buffer dicts were rebound correctly and
+    # eval-mode BN left the running stats untouched
+    np.testing.assert_allclose(float(np.asarray(r1["loss"][0])),
+                               float(np.asarray(r2["loss"][0])),
+                               rtol=1e-6)
+    assert 0.0 <= r1["acc"] <= 1.0
+
+
+def test_save_mid_pattern_and_load_roundtrip(tmp_path):
+    m = _prepared_model(paddle.metric.Accuracy())
+    batches = _batches(4)
+    m.fit(batches, epochs=1, verbose=0)
+    path = str(tmp_path / "ckpt")
+    m.save(path)
+    m2 = _prepared_model(paddle.metric.Accuracy(), seed=1)
+    m2.load(path)
+    w1 = np.asarray(m.network.state_dict()["0.weight"].numpy())
+    w2 = np.asarray(m2.network.state_dict()["0.weight"].numpy())
+    np.testing.assert_array_equal(w1, w2)
+    # training resumes through the restored optimizer moments
+    m2.fit(batches, epochs=1, verbose=0)
+
+
+# -- lazy scalars -------------------------------------------------------
+
+
+def test_loss_and_metrics_are_lazy_until_formatted():
+    m = _prepared_model(paddle.metric.Accuracy())
+    x, y = _batches(1)[0]
+    loss, mets = m.train_batch(x, y)
+    lazy = loss[0]
+    assert hasattr(lazy, "_materialize")
+    assert lazy._host is None, "loss materialized before host use"
+    # host uses all work and agree
+    f = float(lazy)
+    np.testing.assert_allclose(np.asarray(lazy), f)
+    assert f"{lazy:.4f}" == f"{f:.4f}"
+    assert 0.0 <= float(mets[0]) <= 1.0
+
+
+def test_early_stopping_consumes_lazy_logs():
+    m = _prepared_model(paddle.metric.Accuracy())
+    es = paddle.callbacks.EarlyStopping(monitor="loss", patience=0,
+                                        save_best_model=False)
+    m.fit(_batches(4), eval_data=_batches(4), epochs=4, verbose=0,
+          callbacks=[es])
+    assert es.best is not None
+
+
+# -- static host-sync guard ---------------------------------------------
+
+
+def test_check_host_sync_static_guard():
+    scripts = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        import check_host_sync
+        violations = check_host_sync.check()
+    finally:
+        sys.path.remove(scripts)
+    assert not violations, "\n".join(
+        f"paddle_tpu/{r}:{l}: {m}" for r, l, m in violations)
