@@ -474,7 +474,13 @@ def bench_hapi():
     (BENCH_r05: backend init timeout).  A deliberately tiny fixed-shape
     MLP makes the compiled step ~free; steps/s then tracks the HOST
     side of the hot loop: dispatch, train-state plumbing, metric and
-    logging syncs (DESIGN-PERF.md)."""
+    logging syncs (DESIGN-PERF.md).
+
+    Fold sweep (ISSUE 5): GRAFT_BENCH_HAPI_FOLDS (default "1,8") lists
+    the ``steps_per_dispatch`` values to measure.  All folds run
+    back-to-back inside ONE child, interleaved rep by rep, so the
+    medians-of-3 stay comparable on this noisy shared container.
+    Fold 1 doubles as the no-regression guard against the PR-4 loop."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
@@ -482,6 +488,9 @@ def bench_hapi():
     from paddle_tpu import nn, optimizer
 
     print("devices-ok", jax.devices(), flush=True)
+    folds = [int(f) for f in os.environ.get(
+        "GRAFT_BENCH_HAPI_FOLDS", "1,8").split(",")]
+    reps = int(os.environ.get("GRAFT_BENCH_HAPI_REPS", "3"))
     paddle.seed(0)
     net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
                         nn.Linear(32, 10))
@@ -491,19 +500,36 @@ def bench_hapi():
     rng = np.random.RandomState(0)
     batches = [[rng.rand(16, 16).astype(np.float32),
                 rng.randint(0, 10, (16,)).astype(np.int64)]
-               for _ in range(50)]
+               for _ in range(48)]
     steps = len(batches)
-    model.fit(batches, epochs=1, verbose=0)   # compile + warmup epoch
     epochs = 8
-    t0 = time.perf_counter()
-    model.fit(batches, epochs=epochs, verbose=0)
-    jax.block_until_ready(
-        [p._value for p in model.network.parameters()])
-    dt = time.perf_counter() - t0
-    print("RESULT " + json.dumps({
-        "hapi_fit_steps_per_sec": round(steps * epochs / dt, 1),
-        "hapi_fit_step_ms": round(dt / (steps * epochs) * 1000, 3)}),
-        flush=True)
+    for f in folds:   # compile + warmup epoch per fold entry
+        model.fit(batches, epochs=1, verbose=0, steps_per_dispatch=f)
+    samples = {f: [] for f in folds}
+    for _ in range(reps):
+        for f in folds:   # interleaved: back-to-back medians
+            t0 = time.perf_counter()
+            model.fit(batches, epochs=epochs, verbose=0,
+                      steps_per_dispatch=f)
+            jax.block_until_ready(
+                [p._value for p in model.network.parameters()])
+            dt = time.perf_counter() - t0
+            samples[f].append(steps * epochs / dt)
+    out = {}
+    for f in folds:
+        med = sorted(samples[f])[len(samples[f]) // 2]
+        key = ("hapi_fit_steps_per_sec" if f == 1
+               else f"hapi_fit_steps_per_sec_fold{f}")
+        out[key] = round(med, 1)
+        if f == 1:
+            out["hapi_fit_step_ms"] = round(1000.0 / med, 3)
+    if 1 in folds:
+        base = out["hapi_fit_steps_per_sec"]
+        for f in folds:
+            if f != 1 and base:
+                out[f"hapi_fold{f}_speedup"] = round(
+                    out[f"hapi_fit_steps_per_sec_fold{f}"] / base, 3)
+    print("RESULT " + json.dumps(out), flush=True)
 
 
 def bench_flash_micro():
@@ -633,6 +659,18 @@ def _run_child(mode: str, overall_deadline: float):
 
 
 def main():
+    # `python bench.py --fold [1,8,...]`: run ONLY the hapi fold sweep
+    # and print its record — the cheap CPU path for tracking the
+    # steps/s trend line between full bench rounds
+    if "--fold" in sys.argv:
+        i = sys.argv.index("--fold")
+        if i + 1 < len(sys.argv):
+            os.environ["GRAFT_BENCH_HAPI_FOLDS"] = sys.argv[i + 1]
+        hapi, herr = _run_child("hapi", 300)
+        print(json.dumps(hapi if hapi is not None
+                         else {"error": herr[-1000:]}), flush=True)
+        return
+
     mode = os.environ.get("_GRAFT_BENCH_CHILD")
     if mode == "gpt":
         return bench_gpt()
@@ -682,11 +720,11 @@ def main():
     # perf trajectory of the Model.fit hot path stays measurable with
     # the axon tunnel down (ISSUE 4 satellite)
     if remaining() > 60 and not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
-        hapi, herr = _run_child("hapi", min(120, remaining()))
+        hapi, herr = _run_child("hapi", min(240, remaining()))
         if hapi is not None:
-            out["hapi_fit_steps_per_sec"] = hapi.get(
-                "hapi_fit_steps_per_sec", 0.0)
-            out["hapi_fit_step_ms"] = hapi.get("hapi_fit_step_ms")
+            # the fold sweep's whole record rides along (fold=1 is the
+            # PR-4 regression guard, foldK the step-folding trend line)
+            out.update(hapi)
         else:
             out["hapi_fit_error"] = herr[-500:]
     elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):

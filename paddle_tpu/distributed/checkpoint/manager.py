@@ -89,6 +89,12 @@ class CheckpointManager:
         # RLock: the SIGTERM preemption handler may re-enter save()
         # while the main thread holds the lock lower on the same stack
         self._lock = threading.RLock()
+        # orbax's CheckpointManager is NOT thread-safe: a save racing
+        # another thread's wait_until_finished trips its internal
+        # `_finalize_thread is None` assert.  This leaf lock serializes
+        # every orbax call; lock order is always _lock → _orbax_lock,
+        # and sha256 digesting stays outside both.
+        self._orbax_lock = threading.Lock()
         self._last_payload = None
         self._pending_manifest: List[int] = []
         self._prev_sigterm = None
@@ -126,9 +132,10 @@ class CheckpointManager:
 
                 def _write():
                     _faults.fault_point("checkpoint.save", step=step)
-                    return self._mgr.save(
-                        step, args=ocp.args.StandardSave(payload),
-                        force=force)
+                    with self._orbax_lock:
+                        return self._mgr.save(
+                            step, args=ocp.args.StandardSave(payload),
+                            force=force)
 
                 saved = _retry.retry_call(
                     _write, max_attempts=3, base_delay=0.1,
@@ -163,7 +170,8 @@ class CheckpointManager:
         return bool(saved)
 
     def wait_until_finished(self):
-        self._mgr.wait_until_finished()
+        with self._orbax_lock:
+            self._mgr.wait_until_finished()
         self._flush_manifests()
 
     # -- verification --------------------------------------------------------
@@ -173,20 +181,44 @@ class CheckpointManager:
     def _flush_manifests(self, older_than: Optional[int] = None):
         if not self._pending_manifest:
             return
+        # the swap/filter of the pending queue must be atomic w.r.t.
+        # save()'s append (which runs under the same lock): a
+        # concurrent watchdog force-save landing between the two list
+        # rebuilds used to drop its queued manifest, leaving a good
+        # checkpoint permanently unverified.  Only the queue surgery is
+        # locked — wait_until_finished and the sha256 digesting stay
+        # outside so a long flush can't starve the SIGTERM path.
+        eligible = None
         if self._async and older_than is None:
             # never digest a step whose async write is still in
             # flight — a manifest over half-written files would brand
-            # a good checkpoint corrupt forever.  (With ``older_than``
-            # the caller guarantees those writes have completed.)
-            self._mgr.wait_until_finished()
-        if older_than is None:
-            pending, self._pending_manifest = \
-                self._pending_manifest, []
-        else:
-            pending = [t for t in self._pending_manifest
-                       if t < older_than]
-            self._pending_manifest = [
-                t for t in self._pending_manifest if t >= older_than]
+            # a good checkpoint corrupt forever.  Snapshot the queue
+            # BEFORE the wait: only steps queued by then are proven
+            # committed when it returns; a save() racing the wait
+            # stays queued for the next flush instead of being swapped
+            # out mid-write and dropped as "never appeared".  (With
+            # ``older_than`` the caller guarantees completion.)
+            with self._lock:
+                eligible = set(self._pending_manifest)
+            with self._orbax_lock:
+                self._mgr.wait_until_finished()
+        with self._lock:
+            if older_than is None and eligible is None:
+                pending, self._pending_manifest = \
+                    self._pending_manifest, []
+            elif older_than is None:
+                pending = [t for t in self._pending_manifest
+                           if t in eligible]
+                self._pending_manifest = [
+                    t for t in self._pending_manifest
+                    if t not in eligible]
+            else:
+                pending = [t for t in self._pending_manifest
+                           if t < older_than]
+                self._pending_manifest = [
+                    t for t in self._pending_manifest if t >= older_than]
+        if not pending:
+            return
         kept = None
         for step in pending:
             if os.path.isdir(self._step_dir(step)):
@@ -467,9 +499,11 @@ class CheckpointManager:
     def close(self):
         self.uninstall_preemption_handler()
         try:
-            self._mgr.wait_until_finished()
+            with self._orbax_lock:
+                self._mgr.wait_until_finished()
             self._flush_manifests()
-            self._mgr.close()
+            with self._orbax_lock:
+                self._mgr.close()
         except Exception:
             pass
 

@@ -71,6 +71,12 @@ class DistributedRunner:
         self._step_fn = None
         self._opt_state = None
         self._placed = False
+        # deferred wrapper sync (same boundary protocol as hapi
+        # TrainState): when True, train_step updates only the cached
+        # value dicts and the Layer wrappers re-bind at
+        # sync_to_layers() — hapi Model.fit enables this inside fit
+        self._defer_wrapper_sync = False
+        self._wrappers_dirty = False
 
     # -- sharding assignment -------------------------------------------------
     def _param_spec(self, p) -> P:
@@ -333,9 +339,18 @@ class DistributedRunner:
         loss, new_p, new_s, new_buf, out_vals = self._step_fn(
             params, frozen, bufs,
             self._opt_state, lr, ctr, *inputs_v, *labels_v)
-        for n, v in new_p.items():
-            self._name_to_param[n]._value = v
-            params[n] = v
+        if self._defer_wrapper_sync:
+            # hot-loop mode (hapi fit): the cached value dicts are the
+            # canonical copy; wrapper ._value rebinds wait for the
+            # epoch/save/eval boundary (sync_to_layers) — zero per-step
+            # wrapper writes
+            params.update(new_p)
+            self._wrappers_dirty = True
+        else:
+            for n, v in new_p.items():
+                self._name_to_param[n]._value = v
+                params[n] = v
+                self._wrapper_snap[n] = v
         self._opt_state = new_s
         # keep the optimizer's canonical slots in sync for checkpointing
         self.optimizer._opt_state_tree = new_s
@@ -343,9 +358,14 @@ class DistributedRunner:
             self.optimizer._global_step += 1
         for n, v in new_buf.items():
             b = self._name_to_buf.get(n)
-            if b is not None:
+            if b is None:
+                continue
+            bufs[n] = v
+            if self._defer_wrapper_sync:
+                self._wrappers_dirty = True
+            else:
                 b._value = v
-                bufs[n] = v
+                self._buf_snap[n] = v
         # resilience hooks: the committed step feeds the hang watchdog
         # (progress proof) and the chaos layer (kill-at-step-N plans);
         # both are no-ops unless installed
@@ -359,12 +379,15 @@ class DistributedRunner:
         """Return (params, frozen, buffers) value dicts, kept coherent.
 
         The dicts are cached and updated in place after each step — no
-        per-step rebuild over hundreds of params.  To stay correct under
-        external in-place weight updates (``set_state_dict``,
-        ``CheckpointManager.restore`` writing ``p._value``), every call
-        id-compares each wrapper's current ``_value`` against the cache
-        and re-places any externally replaced leaf with its canonical
-        sharding before the compiled step consumes it.
+        per-step rebuild over hundreds of params.  External in-place
+        weight updates (``set_state_dict``, ``CheckpointManager.restore``
+        writing ``p._value``) are detected by id-comparing each
+        wrapper's current ``_value`` against the *snapshot of what the
+        wrapper held at the last sync* — not against the cache, because
+        under deferred wrapper sync the cache legitimately runs ahead
+        of the wrappers between boundaries.  Any externally replaced
+        leaf is re-placed with its canonical sharding before the
+        compiled step consumes it.
         """
         if getattr(self, "_val_cache", None) is None:
             self._val_cache = (
@@ -374,22 +397,56 @@ class DistributedRunner:
                  if p.stop_gradient},
                 {n: b._value for n, b in self._name_to_buf.items()
                  if b is not None})
+            self._wrapper_snap = {n: p._value
+                                  for n, p in self._name_to_param.items()}
+            self._buf_snap = {n: b._value
+                              for n, b in self._name_to_buf.items()
+                              if b is not None}
             return self._val_cache
         params, frozen, bufs = self._val_cache
         for n, p in self._name_to_param.items():
-            tgt = frozen if p.stop_gradient else params
-            if tgt.get(n) is not p._value:
+            if self._wrapper_snap.get(n) is not p._value:
                 v = self._shard(p._value, self._pspecs.get(n, P()))
                 p._value = v
-                tgt[n] = v
+                self._wrapper_snap[n] = v
+                (frozen if p.stop_gradient else params)[n] = v
+                # trainability may have flipped with the external write
+                (params if p.stop_gradient else frozen).pop(n, None)
         for n, b in self._name_to_buf.items():
-            if b is not None and bufs.get(n) is not b._value:
+            if b is not None and self._buf_snap.get(n) is not b._value:
                 bufs[n] = b._value
+                self._buf_snap[n] = b._value
         return self._val_cache
 
+    def sync_to_layers(self):
+        """Boundary write-back of the deferred wrapper sync (the same
+        protocol as hapi ``TrainState.sync_to_layers``): rebind every
+        Layer wrapper to the cached canonical values — pure reference
+        writes, no device transfer."""
+        if not self._wrappers_dirty or \
+                getattr(self, "_val_cache", None) is None:
+            return
+        params, frozen, bufs = self._val_cache
+        for n, v in params.items():
+            p = self._name_to_param.get(n)
+            if p is not None:
+                p._value = v
+                self._wrapper_snap[n] = v
+        for n, v in bufs.items():
+            b = self._name_to_buf.get(n)
+            if b is not None:
+                b._value = v
+                self._buf_snap[n] = v
+        self._wrappers_dirty = False
+
     def invalidate_cache(self):
-        """Drop cached value dicts (call after bulk external updates)."""
+        """Drop cached value dicts (call after bulk external updates).
+        The caller asserts the wrappers are canonical again (checkpoint
+        restore/reshard just wrote every ``p._value``), so any deferred
+        wrapper sync still pending is DISCARDED, never flushed — the
+        external writes win over superseded step results."""
         self._val_cache = None
+        self._wrappers_dirty = False
 
     # -- eval / predict ------------------------------------------------------
     def _eval_build(self, with_loss: bool, n_in: int):
@@ -458,6 +515,7 @@ class DistributedRunner:
             b = self._name_to_buf.get(n)
             if b is not None:
                 b._value = v
+                self._buf_snap[n] = v
             bufs[n] = v
 
     def eval_step(self, inputs, labels):

@@ -14,12 +14,39 @@ from __future__ import annotations
 import numpy as np
 
 
+class LazyStack:
+    """One device array carrying K per-step values from a single folded
+    dispatch (``Model.fit(steps_per_dispatch=K)`` stacks the K losses /
+    metric stats along axis 0 inside ONE ``lax.scan`` program).  All K
+    per-step ``LazyScalar`` views share this object, so formatting any
+    number of them costs ONE device→host transfer per dispatch group.
+    """
+
+    __slots__ = ("_dev", "_host")
+
+    def __init__(self, dev):
+        self._dev = dev
+        self._host = None
+
+    def _materialize(self):
+        """THE device→host sync point for a fold group's scalars."""
+        if self._host is None:
+            import jax
+            self._host = np.asarray(jax.device_get(self._dev))
+            self._dev = None
+        return self._host
+
+
 class LazyScalar:
     """Device scalar with on-demand host materialization.
 
     ``post`` (optional) is a host-side finisher applied to the fetched
     array — e.g. picking one top-k slot and dividing by the batch count
     — so derived per-batch stats cost zero extra device dispatches.
+
+    ``dev`` may also be a :class:`LazyStack`: the scalar then views one
+    logical step's slice of a folded dispatch and the stack fetches
+    once for all its viewers.
     """
 
     __slots__ = ("_dev", "_post", "_host")
@@ -32,8 +59,11 @@ class LazyScalar:
     def _materialize(self):
         """THE device→host sync point for hot-loop scalars."""
         if self._host is None:
-            import jax
-            h = np.asarray(jax.device_get(self._dev))
+            if isinstance(self._dev, LazyStack):
+                h = self._dev._materialize()
+            else:
+                import jax
+                h = np.asarray(jax.device_get(self._dev))
             if self._post is not None:
                 h = np.asarray(self._post(h))
             self._host = h

@@ -12,15 +12,26 @@ The hot loop is fully asynchronous and device-resident
 (DESIGN-PERF.md): params/opt_state/buffers live in a donated
 ``TrainState`` owned by the loop (the Layer tree re-syncs only at
 epoch/save/eval boundaries), compiled steps are cached per
-(arity, shapes, dtypes, amp) signature, and loss/metric scalars ride
-through the callbacks as ``LazyScalar`` — only a callback that
+(arity, shapes, dtypes, amp, fold) signature, and loss/metric scalars
+ride through the callbacks as ``LazyScalar`` — only a callback that
 actually formats a value pays the device→host sync.
+
+Step folding (DESIGN-PERF.md §Step folding): ``fit(...,
+steps_per_dispatch=K)`` amortizes the remaining per-step host work —
+jit dispatch, ``refresh()``, callback round-trip — over K logical
+steps: K batches stack along a new leading axis through one batched
+``device_put`` and ONE compiled ``lax.scan`` runs the K train steps
+back-to-back on device, carrying the donated state plus the metric
+accumulators.  Per-step PRNG keys derive in-program from
+``(base_key, counter + i)``, so results are bit-identical to K
+single-step dispatches.
 """
 
 from __future__ import annotations
 
 import os
 import time
+import warnings
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -33,10 +44,27 @@ from ..nn import functional_call as F
 from ..metric import Metric
 from ..framework import random as _random
 from ..framework.io import save as _save, load as _load
+from ..framework.lazy import LazyStack
 from ..optimizer.lr import LRScheduler
-from ..io.staging import to_device_values
+from ..io.staging import to_device_values, stack_to_device
 from . import callbacks as cbk_mod
 from .train_state import TrainState, LazyScalar
+
+# default fold factor when fit() may batch dispatches freely (no
+# callback consumes per-step logs); chosen to amortize the ~1 ms of
+# per-step host work without delaying epoch-boundary work noticeably
+_DEFAULT_FOLD = 8
+
+_resilience_mods = None
+
+
+def _resilience():
+    """watchdog/faults hooks, imported lazily (no-ops unless armed)."""
+    global _resilience_mods
+    if _resilience_mods is None:
+        from ..distributed.resilience import faults, watchdog
+        _resilience_mods = (watchdog, faults)
+    return _resilience_mods
 
 
 def _to_list(x):
@@ -62,6 +90,11 @@ class Model:
         self._in_fit = False
         self._runner = None
         self._accumulate = 1
+        # resolved steps_per_dispatch of the current/last fit (0 =
+        # legacy per-step entry, K>=1 = fold engine with groups of K);
+        # logical step counter feeding the resilience hooks
+        self._fold = 0
+        self._fit_step_ctr = 0
         self.stop_training = False
 
     # -- preparation --------------------------------------------------------
@@ -97,6 +130,9 @@ class Model:
             return None
         if self._runner is not None and self._runner.mesh is mesh and \
                 self._runner.accumulate_steps == self._accumulate:
+            # inside fit the runner defers its per-step wrapper
+            # write-back to the same boundaries as TrainState
+            self._runner._defer_wrapper_sync = self._in_fit
             return self._runner
         from ..distributed.runner import DistributedRunner
         self._runner = DistributedRunner(
@@ -104,6 +140,7 @@ class Model:
             accumulate_steps=self._accumulate,
             amp_level=self._amp_level, amp_dtype=self._amp_dtype,
             capture_outputs=True)
+        self._runner._defer_wrapper_sync = self._in_fit
         return self._runner
 
     # -- single-batch APIs --------------------------------------------------
@@ -133,13 +170,15 @@ class Model:
         # np.dtype objects hash — no per-step str() allocation
         return tuple((v.shape, v.dtype) for v in values)
 
-    def _get_step_fn(self, kind, n_in, values, donate=True):
+    def _get_step_fn(self, kind, n_in, values, donate=True, fold=1):
         key = (kind, n_in, self._data_signature(values), donate,
-               self._amp_level, self._amp_dtype)
+               self._amp_level, self._amp_dtype, fold)
         fn = self._step_cache.get(key)
         if fn is None:
             if kind == "train":
                 fn = self._build_jit_train_step(n_in, donate)
+            elif kind == "train_fold":
+                fn = self._build_jit_fold_step(n_in, fold)
             else:
                 fn = self._build_jit_eval_step(n_in)
             self._step_cache[key] = fn
@@ -188,9 +227,13 @@ class Model:
 
     def _sync_train_state(self):
         """Boundary sync: rebind the Layer tree to the device-resident
-        state (reference writes only — no device transfer)."""
+        state (reference writes only — no device transfer).  On the
+        mesh path the DistributedRunner defers its per-step wrapper
+        write-back the same way; its boundary sync rides along here."""
         if self._train_state is not None:
             self._train_state.sync_to_layers()
+        if self._runner is not None:
+            self._runner.sync_to_layers()
 
     def _device_metric_fns(self):
         """Pure per-batch stat fns of the device-capable metrics — they
@@ -250,6 +293,73 @@ class Model:
         # state must survive.
         return jax.jit(step,
                        donate_argnums=(0, 2, 3) if donate else ())
+
+    def _build_jit_fold_step(self, n_in, fold):
+        """ONE compiled program running ``fold`` train steps as a
+        ``lax.scan`` over batches stacked on a new leading axis.  The
+        carry is the donated state (params/buffers/opt_state) plus the
+        device-resident metric accumulators; per-step PRNG keys derive
+        in-program from (base_key, ctr0 + i) — bit-identical to the
+        key sequence the single-step entry consumes."""
+        opt = self._optimizer
+        net = self.network
+        metric_fns = self._device_metric_fns()
+        decay_coeffs, l1_coeffs, lr_scales = \
+            opt._per_param_coeffs(dict(net.named_parameters()))
+
+        def step(params, frozen, buffers, opt_state, macc, lr, base_key,
+                 ctr0, *data):
+            def body(carry, xs):
+                p, bufs, st, acc = carry
+                i, md = xs
+                key = jax.random.fold_in(base_key, ctr0 + i)
+                inputs = [Tensor(v) for v in md[:n_in]]
+                labels = [Tensor(v) for v in md[n_in:]]
+
+                def loss_fn(pp):
+                    with F.bind(net, pp, bufs, frozen) as holder:
+                        from ..autograd import tape as _tape
+                        with _tape.no_grad_ctx():
+                            with _random.key_provider(
+                                    _random.make_split_provider(key)):
+                                loss, outs = self._forward_with_loss(
+                                    inputs, labels)
+                    new_buf = holder.get("buffers", {})
+                    return loss._value.astype(jnp.float32), (
+                        [o._value for o in outs], new_buf)
+
+                (loss_val, (out_vals, new_buf)), grads = \
+                    jax.value_and_grad(loss_fn, has_aux=True)(p)
+                new_p, new_st = opt.apply_gradients_tree(
+                    p, grads, st, lr,
+                    decay_coeffs=decay_coeffs, lr_scales=lr_scales,
+                    l1_coeffs=l1_coeffs)
+                bufs = {**bufs, **new_buf}
+                mstats = (tuple(mf(out_vals[0], md[n_in])
+                                for mf in metric_fns)
+                          if metric_fns and len(md) > n_in and out_vals
+                          else ())
+                if mstats:
+                    acc = tuple(a + s for a, s in zip(acc, mstats))
+                return (new_p, bufs, new_st, acc), (loss_val, mstats)
+
+            # the scan stays ROLLED on purpose: the loop body compiles
+            # once, identically for every fold length, which is what
+            # makes fold=K bit-identical to fold=1 — the fold engine
+            # dispatches scan programs for EVERY group it runs,
+            # including trailing partials (scan-of-P) and fold=1
+            # (scan-of-1), so all of them execute the same body
+            idx = jnp.arange(fold, dtype=jnp.uint32)
+            (new_params, new_buf, new_opt_state, new_acc), \
+                (losses, mstacks) = jax.lax.scan(
+                    body, (params, dict(buffers), opt_state, macc),
+                    (idx, tuple(data)))
+            return (losses, mstacks, new_acc, new_params, new_opt_state,
+                    new_buf)
+
+        # the whole carry is donated: params/buffers/opt_state AND the
+        # metric accumulators update in place across the K steps
+        return jax.jit(step, donate_argnums=(0, 2, 3, 4))
 
     def _build_jit_eval_step(self, n_in):
         net = self.network
@@ -314,13 +424,70 @@ class Model:
                  state.opt_state, lr, base_key, np.uint32(ctr), *data)
         if update:
             state.commit(new_params, new_opt_state, new_buf)
-            if not self._in_fit:
+            if self._in_fit:
+                self._tick_resilience(1)
+            else:
                 # direct train_batch calls keep the public contract:
                 # the Layer tree is current when the call returns.
                 # Inside fit the sync is deferred to the epoch boundary.
                 state.sync_to_layers()
         metrics = self._apply_metric_stats(mstats, out_vals, labels_v)
         return self._format_loss(loss_val), metrics
+
+    def _tick_resilience(self, steps):
+        """One committed dispatch = progress proof for the hang
+        watchdog and a chaos injection site; a folded dispatch advances
+        the logical step count by its fold factor K.  Both hooks are
+        no-ops unless resilience is armed."""
+        self._fit_step_ctr += steps
+        watchdog, faults = _resilience()
+        watchdog.notify_step(self._fit_step_ctr)
+        faults.fault_point("train.step", step=self._fit_step_ctr)
+
+    def _ensure_metric_acc(self, state):
+        """Zero device accumulators at epoch begin (one tiny dispatch
+        per metric per epoch); thereafter the folded scan carries and
+        updates them wholly on device."""
+        if state.metric_acc is None:
+            state.metric_acc = tuple(m.device_acc_init()
+                                     for m in self._metrics)
+        return state.metric_acc
+
+    def _train_batch_folded(self, groups):
+        """ONE compiled ``lax.scan`` dispatch covering ``len(groups)``
+        logical train steps (DESIGN-PERF.md §Step folding).  Returns
+        (losses, metric stacks) as shared-fetch ``LazyStack``s — the
+        per-step callback values are index-sliced views that cost one
+        device→host transfer per dispatch group, only when formatted.
+        """
+        from ..profiler import RecordEvent
+        with RecordEvent("train_batch_folded"):
+            self.network.train()
+            fold = len(groups)
+            n_in = len(groups[0][0])
+            stacked = stack_to_device(
+                [list(ins) + list(lbs) for ins, lbs in groups])
+            state = self._ensure_train_state()
+            state.refresh()
+            fn = self._get_step_fn("train_fold", n_in, stacked,
+                                   fold=fold)
+            lr = self._lr_value()
+            # advance the generator by K without an eager draw; the
+            # scan derives key_i = fold_in(base_key, ctr + i) in-program
+            gen = _random.default_generator()
+            base_key, ctr = self._base_key(gen), gen._counter
+            gen._counter += fold
+            macc = self._ensure_metric_acc(state)
+            losses, mstacks, new_acc, new_params, new_opt_state, \
+                new_buf = fn(state.params, state.frozen, state.buffers,
+                             state.opt_state, macc, lr, base_key,
+                             np.uint32(ctr), *stacked)
+            state.commit(new_params, new_opt_state, new_buf, steps=fold)
+            state.metric_acc = new_acc
+            for m, acc in zip(self._metrics, new_acc):
+                m.adopt_device_acc(acc)
+            self._tick_resilience(fold)
+            return LazyStack(losses), [LazyStack(s) for s in mstacks]
 
     def _train_batch_eager(self, inputs_v, labels_v, update=True):
         inputs = [Tensor(v) for v in inputs_v]
@@ -401,16 +568,12 @@ class Model:
         path fall back to the numpy update either way."""
         if not self._metrics:
             return []
-        rows = 1
-        if out_vals:
-            for s in out_vals[0].shape[:-1]:
-                rows *= int(s)
         results, mi = [], 0
         for m in self._metrics:
             device = (getattr(m, "supports_device_update", False)
                       and out_vals and labels_v)
             if device and mstats is not None and mi < len(mstats):
-                results.append(m.update_device_stats(mstats[mi], rows))
+                results.append(m.update_device_stats(mstats[mi]))
                 mi += 1
             elif device:
                 results.append(m.update_device(out_vals[0], labels_v[0]))
@@ -432,7 +595,21 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+            callbacks=None, accumulate_grad_batches=1, num_iters=None,
+            steps_per_dispatch=None):
+        """``steps_per_dispatch=K`` (step folding, DESIGN-PERF.md):
+        fuse K train steps into ONE compiled ``lax.scan`` dispatch —
+        amortizing the per-step host work that bounds small-model
+        throughput.  Default ``None`` resolves automatically: 1 when a
+        callback consumes per-step logs (verbose progress bar, by-step
+        LR scheduler, any user batch hook), else 8.  Every group —
+        full, trailing partial, and K=1 — runs the same rolled-scan
+        body, so the end state is bit-identical for every K; callbacks
+        still fire per logical step, at dispatch-group granularity,
+        with index-sliced lazy loss/metric values.
+        ``steps_per_dispatch=0`` escapes to the legacy per-step entry
+        (paths the engine cannot run — mesh, eager, host-only metrics —
+        escape automatically)."""
         from ..io import DataLoader, Dataset
         self._accumulate = max(int(accumulate_grad_batches), 1)
         if isinstance(train_data, Dataset):
@@ -456,6 +633,12 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=steps,
             log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
             verbose=verbose, metrics=self._metrics_name())
+
+        self._fold = self._resolve_fold(steps_per_dispatch, cbks)
+        if self._fold > 1 and isinstance(train_loader, DataLoader):
+            # the prefetcher defers per-batch device staging: the fold
+            # engine's stacked device_put is the single H2D point
+            train_loader._fold_hint = self._fold
 
         cbks.on_begin("train")
         self._in_fit = True
@@ -481,7 +664,66 @@ class Model:
         finally:
             self._in_fit = False
             self._sync_train_state()
+            if isinstance(train_loader, DataLoader):
+                train_loader._fold_hint = 1
         cbks.on_end("train")
+
+    def _resolve_fold(self, requested, cbks):
+        """Resolve fit's ``steps_per_dispatch`` into the train dispatch
+        mode: ``0`` = legacy per-step entry (paths that cannot run the
+        fold engine, or an explicit ``steps_per_dispatch=0`` escape);
+        ``K >= 1`` = the fold engine, which dispatches EVERY group —
+        full (scan-of-K), trailing partial (scan-of-P) and K=1
+        (scan-of-1) — through the same rolled-scan body, so the end
+        state is bit-identical for every K.  Auto (``None``) resolves
+        to 1 when a callback consumes per-step logs, else
+        ``_DEFAULT_FOLD``."""
+        if requested is not None and int(requested) <= 0:
+            return 0   # explicit legacy escape
+        if not self._use_jit or self._optimizer is None:
+            return 0
+        if self._mesh_runner() is not None:
+            if requested is not None and int(requested) > 1:
+                warnings.warn(
+                    "fit(steps_per_dispatch>1): the mesh path "
+                    "dispatches through DistributedRunner per step; "
+                    "running unfolded")
+            return 0
+        if any(not getattr(m, "supports_device_update", False)
+               for m in self._metrics):
+            if requested is not None and int(requested) > 1:
+                warnings.warn(
+                    "fit(steps_per_dispatch>1) requires every metric "
+                    "to support device-side accumulation; running "
+                    "unfolded")
+            return 0
+        if any(isinstance(c, cbk_mod.LRSchedulerCallback) and c.by_step
+               for c in cbks.callbacks):
+            # a by-step scheduler needs a FRESH lr every step; a folded
+            # dispatch stages one lr for its whole scan, which would
+            # silently train steps 1..K-1 on a stale rate
+            if requested is not None and int(requested) > 1:
+                warnings.warn(
+                    "fit(steps_per_dispatch>1): a by-step LR scheduler "
+                    "needs a fresh learning rate every step; running "
+                    "steps_per_dispatch=1")
+            return 1
+        if requested is not None:
+            return int(requested)
+        base = cbk_mod.Callback
+        for c in cbks.callbacks:
+            if isinstance(c, cbk_mod.LRSchedulerCallback):
+                continue
+            if isinstance(c, cbk_mod.ProgBarLogger):
+                if c.verbose:
+                    return 1   # per-step console cadence expected
+                continue
+            if any(getattr(type(c), h) is not getattr(base, h)
+                   for h in ("on_batch_begin", "on_batch_end",
+                             "on_train_batch_begin",
+                             "on_train_batch_end")):
+                return 1       # user hook consumes per-step events
+        return _DEFAULT_FOLD
 
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
         self._reset_metrics()
@@ -493,13 +735,58 @@ class Model:
         # XLA program.  A trailing partial group is dropped with a
         # warning (same effect as drop_last for the last step).
         k = self._accumulate if mode == "train" else 1
+        # step folding: buffer up to `fold` logical steps (each already
+        # an accumulate group) and run them as ONE lax.scan dispatch;
+        # fold == 0 selects the legacy per-step entry
+        fold = self._fold if mode == "train" else 0
         pending: List[Any] = []
+        group: List[Any] = []
+        group_sig = [None]   # data signature shared by the open group
 
         def _cat(parts):
             arrs = [[np.asarray(p[i].numpy() if isinstance(p[i], Tensor)
                                 else p[i]) for p in parts]
                     for i in range(len(parts[0]))]
             return [np.concatenate(a, axis=0) for a in arrs]
+
+        def _emit(step, loss, metrics, inputs):
+            logs["loss"] = loss
+            for name, val in zip(self._metrics_name()[1:], metrics):
+                logs[name] = val
+            logs["batch_size"] = (inputs[0].shape[0] if inputs else 0)
+            logs["step"] = step
+            cbks.on_batch_end(mode, step, logs)
+
+        def _group_sig(inputs, labels):
+            return tuple(tuple(v.shape) for v in (*inputs, *labels))
+
+        def _flush_group():
+            """Dispatch the buffered fold group through ONE compiled
+            scan — a trailing partial group runs scan-of-P over the
+            same body, so the end state is bit-identical for every
+            grouping — then replay the per-logical-step callbacks in
+            order with index-sliced lazy values.  Buffered accumulate
+            intermediates (``ins is None``) carry no compute; they
+            replay in order so callbacks see a monotone step series."""
+            if not group:
+                return
+            entries, group[:] = group[:], []
+            logical = [(s, i, l) for s, i, l in entries if i is not None]
+            losses, mstacks = (self._train_batch_folded(
+                [(ins, lbs) for _, ins, lbs in logical])
+                if logical else (None, []))
+            gi = 0
+            for step, ins, lbs in entries:
+                cbks.on_batch_begin(mode, step, logs)
+                if ins is None:
+                    logs["step"] = step
+                    cbks.on_batch_end(mode, step, logs)
+                    continue
+                loss = [LazyScalar(losses, post=lambda a, i=gi: a[i])]
+                metrics = [m.device_step_result(mstacks[j], gi)
+                           for j, m in enumerate(self._metrics)]
+                _emit(step, loss, metrics, ins)
+                gi += 1
 
         for step, data in enumerate(loader):
             if num_iters is not None and step >= num_iters:
@@ -513,28 +800,48 @@ class Model:
                 n_label = 0
             inputs = data[:len(data) - n_label] if n_label else data
             labels = data[len(data) - n_label:] if n_label else []
-            cbks.on_batch_begin(mode, step, logs)
             if mode == "train":
                 if k > 1:
                     pending.append((inputs, labels))
                     if len(pending) < k:
-                        logs["step"] = step
-                        cbks.on_batch_end(mode, step, logs)
+                        if fold >= 1 and group:
+                            # an accumulate intermediate between
+                            # buffered logical steps: defer its
+                            # callbacks too, keeping step order
+                            group.append((step, None, None))
+                        else:
+                            cbks.on_batch_begin(mode, step, logs)
+                            logs["step"] = step
+                            cbks.on_batch_end(mode, step, logs)
                         continue
                     inputs = _cat([p[0] for p in pending])
                     labels = _cat([p[1] for p in pending])
                     pending = []
+                if fold >= 1:
+                    sig = _group_sig(inputs, labels)
+                    n_logical = sum(1 for _, i, _l in group
+                                    if i is not None)
+                    if group and sig != group_sig[0]:
+                        # shape change (uneven trailing batch, bucketed
+                        # loader): scan the homogeneous prefix now — a
+                        # group must stack along one leading axis
+                        _flush_group()
+                        n_logical = 0
+                    if not group:
+                        group_sig[0] = sig
+                    group.append((step, inputs, labels))
+                    if n_logical + 1 >= fold:
+                        _flush_group()
+                    continue
+                cbks.on_batch_begin(mode, step, logs)
                 loss, metrics = self.train_batch(inputs, labels)
-            else:
-                loss, metrics = self.eval_batch(inputs, labels)
-            logs["loss"] = loss
-            for name, val in zip(self._metrics_name()[1:], metrics):
-                logs[name] = val
-            logs["batch_size"] = (inputs[0].shape[0] if inputs else 0)
-            logs["step"] = step
-            cbks.on_batch_end(mode, step, logs)
+                _emit(step, loss, metrics, inputs)
+                continue
+            cbks.on_batch_begin(mode, step, logs)
+            loss, metrics = self.eval_batch(inputs, labels)
+            _emit(step, loss, metrics, inputs)
+        _flush_group()
         if pending:
-            import warnings
             warnings.warn(
                 f"fit(accumulate_grad_batches={k}): dropping trailing "
                 f"group of {len(pending)} batch(es) smaller than k")
@@ -639,3 +946,6 @@ class Model:
     def _reset_metrics(self):
         for m in self._metrics:
             m.reset()
+        if self._train_state is not None:
+            # fresh device accumulators next folded dispatch
+            self._train_state.metric_acc = None

@@ -61,6 +61,11 @@ class TrainState:
         self._tree_ids = {id(l) for l in
                           network.sublayers(include_self=True)}
         self._dirty = False
+        # device-resident metric accumulators (step folding): a tuple
+        # of per-metric stat arrays that rides the donated scan carry —
+        # rebuilt from zeros at each epoch begin, materialized only by
+        # Metric.accumulate() at the epoch boundary
+        self.metric_acc = None
 
     # -- coherence -----------------------------------------------------
     def _reconcile_structure(self):
@@ -141,10 +146,12 @@ class TrainState:
                 self._wrapper_bufs[n] = b._value
 
     # -- step commit ---------------------------------------------------
-    def commit(self, new_params, new_opt_state, new_buffers):
-        """Adopt one compiled step's outputs.  Reference rebinds only —
-        the old arrays were donated into the step and are already gone.
-        The optimizer's canonical checkpoint slot stays coherent."""
+    def commit(self, new_params, new_opt_state, new_buffers, steps=1):
+        """Adopt one compiled dispatch's outputs.  Reference rebinds
+        only — the old arrays were donated into the step and are
+        already gone.  The optimizer's canonical checkpoint slot stays
+        coherent; a folded dispatch advances the logical step count by
+        ``steps`` (= the fold factor K)."""
         self.params = new_params
         self.opt_state = new_opt_state
         for n, v in new_buffers.items():
@@ -152,7 +159,7 @@ class TrainState:
                 self.buffers[n] = v
         self.optimizer._opt_state_tree = new_opt_state
         if hasattr(self.optimizer, "_global_step"):
-            self.optimizer._global_step += 1
+            self.optimizer._global_step += steps
         self._dirty = True
 
     def commit_buffers(self, new_buffers):

@@ -109,9 +109,16 @@ class _DevicePrefetcher:
     ``jax.device_put`` as soon as the host thread produces it, so the
     H2D transfer of batch N+1 overlaps the compute of batch N (jax
     transfers are async; dispatching the put is enough to start it).
-    On CPU the put is a no-op alias — safe everywhere."""
+    On CPU the put is a no-op alias — safe everywhere.
 
-    def __init__(self, inner, depth: int = 2):
+    Under step folding (``Model.fit(steps_per_dispatch=K)`` sets the
+    loader's ``_fold_hint``) per-batch eager staging is skipped
+    (``stage=False``): the fold engine stacks K batches and issues ONE
+    batched ``device_put`` for the whole ``[K, ...]`` group
+    (io/staging.py ``stack_to_device``), so staging each batch here
+    first would just double the transfer dispatches."""
+
+    def __init__(self, inner, depth: int = 2, stage: bool = True):
         import collections
         self._inner = inner
         self._it = iter(inner)
@@ -119,6 +126,7 @@ class _DevicePrefetcher:
         self._depth = max(1, depth)
         self._exhausted = False
         self._pending_err = None
+        self._do_stage = stage
 
     def __getattr__(self, name):
         # transparent wrapper: the inner iterator's surface (native
@@ -126,11 +134,10 @@ class _DevicePrefetcher:
         # reachable
         return getattr(self.__dict__["_inner"], name)
 
-    @staticmethod
-    def _stage(item):
+    def _stage(self, item):
         # the single host→device staging path shared with the hapi
         # Model hot loop (io/staging.py)
-        return stage_batch(item)
+        return stage_batch(item) if self._do_stage else item
 
     def _fill(self):
         while not self._exhausted and len(self._buf) < self._depth:
@@ -256,10 +263,16 @@ class DataLoader:
                 yield item
 
     def __iter__(self):
+        # step folding: the hapi fit loop advertises its fold through
+        # _fold_hint; the prefetcher then keeps batches host-side and
+        # the fold engine's stacked device_put becomes the single H2D
+        # point for the whole K-batch group
+        stage = getattr(self, "_fold_hint", 1) <= 1
         if self._iterable_mode and self.num_workers > 0:
             gen = self._generate_iterable_workers
             return _DevicePrefetcher(
-                _PrefetchIterator(gen, self.prefetch_factor)) \
+                _PrefetchIterator(gen, self.prefetch_factor),
+                stage=stage) \
                 if self.use_buffer_reader else gen()
         if (self.num_workers > 0 and not self._iterable_mode
                 and self.batch_sampler is not None):
@@ -270,11 +283,12 @@ class DataLoader:
                     self.dataset, [list(b) for b in self.batch_sampler],
                     self.collate_fn, self.num_workers,
                     self.prefetch_factor, self.worker_init_fn)
-                return _DevicePrefetcher(it) if self.use_buffer_reader \
-                    else it
+                return _DevicePrefetcher(it, stage=stage) \
+                    if self.use_buffer_reader else it
         if self.use_buffer_reader:
             return _DevicePrefetcher(
-                _PrefetchIterator(self._generate, self.prefetch_factor))
+                _PrefetchIterator(self._generate, self.prefetch_factor),
+                stage=stage)
         return self._generate()
 
     def __len__(self):

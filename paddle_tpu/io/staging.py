@@ -56,6 +56,45 @@ def to_device_values(seq):
     return vals
 
 
+def stack_to_device(groups):
+    """Stack K same-structure batches along a new leading axis — the
+    staging path of the step-folding engine (``Model.fit``'s
+    ``steps_per_dispatch``): each tensor position becomes ONE
+    ``[K, ...]`` stacked device array, and every position whose K
+    leaves are still host memory rides a single batched async
+    ``device_put``.  Positions already device-resident (a prefetcher
+    that staged eagerly, direct Tensor feeds) stack with one
+    ``jnp.stack`` dispatch instead — never a device→host round trip.
+    """
+    import jax
+    import jax.numpy as jnp
+    n = len(groups[0])
+    out = [None] * n
+    host_idx = []
+    for i in range(n):
+        vs = []
+        all_host = True
+        for g in groups:
+            v = g[i]
+            if isinstance(v, Tensor):
+                v = v._value
+            if isinstance(v, jax.Array):
+                all_host = False
+            elif not isinstance(v, np.ndarray):
+                v = np.asarray(v)
+            vs.append(v)
+        if all_host:
+            out[i] = np.stack(vs)
+            host_idx.append(i)
+        else:
+            out[i] = jnp.stack([jnp.asarray(v) for v in vs])
+    if host_idx:
+        placed = jax.device_put([out[i] for i in host_idx])
+        for i, v in zip(host_idx, placed):
+            out[i] = v
+    return out
+
+
 def stage_batch(item):
     """Tree-map device staging for loader batches: start the async H2D
     copy for every Tensor leaf (device double-buffering — the transfer

@@ -3,10 +3,28 @@
 Two update paths (DESIGN-PERF.md): the classic numpy ``compute`` /
 ``update`` pair (host-side, used for direct calls and metrics without a
 device kernel) and, for metrics flagged ``supports_device_update``, a
-``update_device(pred, label)`` fast path the ``Model.fit`` hot loop
-uses — a small jitted reduction whose correct/total accumulators stay
-ON DEVICE until ``accumulate()`` materializes them at the epoch
-boundary.  The hot loop never pulls predictions to the host.
+device fast path the ``Model.fit`` hot loop uses.
+
+Device protocol (step-folding aware):
+
+- ``device_batch_stats()`` returns a pure ``(pred, label) → stat``
+  function that traces INTO the compiled train/eval step.  The stat is
+  **self-contained** (it embeds any row/bin counts it needs), so stats
+  are combinable by plain addition — which is exactly what the folded
+  ``lax.scan`` carry does.
+- ``device_acc_init()`` returns the zero accumulator.  Under step
+  folding the accumulator rides the donated scan carry across steps
+  AND across dispatches; ``adopt_device_acc`` hands the metric the
+  latest carry value (a reference — no sync).
+- ``update_device_stats(stat)`` is the single-step path: one host list
+  append per step, materialized together at ``accumulate()``.
+- ``device_step_result(stack, i)`` builds the per-logical-step log
+  value from a folded dispatch's stacked ``[K, ...]`` stats — a
+  ``LazyScalar`` view over the shared ``LazyStack``, so per-step logs
+  cost one transfer per dispatch group, and only when formatted.
+
+``accumulate()`` merges the host counters, the pending single-step
+stats, and the device accumulator — the ONE epoch-boundary sync.
 """
 
 from __future__ import annotations
@@ -22,7 +40,7 @@ def _np(x):
 
 
 class Metric:
-    # metrics that implement update_device(pred, label) set this True;
+    # metrics that implement the device-stat protocol set this True;
     # Model.fit then keeps their accumulators device-resident
     supports_device_update = False
 
@@ -40,6 +58,63 @@ class Metric:
 
     def compute(self, *args):
         return args
+
+    # -- device-stat protocol (defaults for scalar-result metrics) -----
+    def device_batch_stats(self):
+        raise NotImplementedError
+
+    def device_acc_init(self):
+        raise NotImplementedError
+
+    def _stat_result(self, stat):
+        """Host finisher: one batch's (or slice's) stat → metric value.
+        Runs inside LazyScalar materialization — keep it numpy-cheap."""
+        raise NotImplementedError
+
+    def update_device_stats(self, stat):
+        """Single-step path: adopt one batch's device-side stat vector
+        — a host list append, no sync.  Totals materialize in
+        accumulate() at the epoch boundary."""
+        self._dev_pending.append(stat)
+        return LazyScalar(stat, post=self._stat_result)
+
+    def device_step_result(self, stack, i):
+        """Folded path: the per-logical-step log value, an index-sliced
+        view over the dispatch group's shared LazyStack."""
+        return LazyScalar(stack, post=lambda a, i=i: self._stat_result(a[i]))
+
+    def adopt_device_acc(self, acc):
+        """Folded path: adopt the scan carry's running accumulator (a
+        device reference — accumulation already happened in-program)."""
+        self._dev_acc = acc
+
+    def update_device(self, pred, label):
+        """Standalone device update (runner/eager eval paths): one
+        small jitted reduction, accumulators stay on device until
+        accumulate()."""
+        if getattr(self, "_stats_fn", None) is None:
+            import jax
+            self._stats_fn = jax.jit(self.device_batch_stats())
+        return self.update_device_stats(self._stats_fn(pred, label))
+
+    def _device_stat_sum(self):
+        """Epoch-boundary materialization of pending single-step stats
+        plus the folded-carry accumulator; None when no device updates
+        happened.  The host merge sums in float64, so only the in-carry
+        float32 addition bounds exactness — counts stay exact below
+        2**24 rows per epoch (documented in DESIGN-PERF.md; beyond
+        that, ``steps_per_dispatch=0`` keeps per-batch granularity)."""
+        stats = [np.asarray(v) for v in getattr(self, "_dev_pending", [])]
+        acc = getattr(self, "_dev_acc", None)
+        if acc is not None:
+            stats.append(np.asarray(acc))
+        if not stats:
+            return None
+        return np.sum(np.stack(stats), axis=0, dtype=np.float64)
+
+    def _reset_device_state(self):
+        self._dev_pending = []
+        self._dev_acc = None
 
 
 class Accuracy(Metric):
@@ -76,9 +151,9 @@ class Accuracy(Metric):
     # -- device-resident fast path (Model.fit hot loop) ----------------
     def device_batch_stats(self):
         """Pure (pred, label) → stat vector, traceable INSIDE the
-        compiled train step — the per-batch top-k correct counts ride
-        the step's XLA program, so the hot loop dispatches zero extra
-        device ops for metrics."""
+        compiled train step.  The vector is [corr_k1, ..., corr_kn,
+        rows]: the trailing row count makes the stat self-contained so
+        the folded scan carry accumulates it by plain addition."""
         import jax
         import jax.numpy as jnp
         maxk, topk = self.maxk, self.topk
@@ -90,49 +165,47 @@ class Accuracy(Metric):
                          else label.argmax(-1))
             correct = (order == label[..., None]).astype(jnp.float32)
             flat = correct.reshape(-1, maxk)
-            return jnp.stack([flat[:, :k].sum() for k in topk])
+            counts = [flat[:, :k].sum() for k in topk]
+            counts.append(jnp.asarray(flat.shape[0], jnp.float32))
+            return jnp.stack(counts)
 
         return stats
 
-    def update_device_stats(self, stat_vec, rows):
-        """Adopt one batch's device-side stat vector: a host list
-        append — no add dispatch, no sync.  Totals materialize in
-        accumulate() at the epoch boundary."""
-        self._dev_pending.append(stat_vec)
-        self._dev_rows += rows
-        if len(self.topk) == 1:
-            return LazyScalar(stat_vec,
-                              lambda c, n=rows: float(c[0]) / max(n, 1))
-        return [LazyScalar(stat_vec,
-                           lambda c, i=i, n=rows: float(c[i]) / max(n, 1))
-                for i in range(len(self.topk))]
+    def device_acc_init(self):
+        import jax.numpy as jnp
+        return jnp.zeros(len(self.topk) + 1, jnp.float32)
 
-    def update_device(self, pred, label):
-        """Standalone device update (eval path): one small jitted
-        reduction, accumulators stay on device until accumulate()."""
-        if self._stats_fn is None:
-            import jax
-            self._stats_fn = jax.jit(self.device_batch_stats())
-        rows = 1
-        for s in pred.shape[:-1]:
-            rows *= int(s)
-        return self.update_device_stats(self._stats_fn(pred, label), rows)
+    def _result_views(self, dev, pick):
+        if len(self.topk) == 1:
+            return LazyScalar(
+                dev, post=lambda a: (lambda c: float(c[0])
+                                     / max(float(c[-1]), 1.0))(pick(a)))
+        return [LazyScalar(
+            dev, post=lambda a, j=j: (lambda c: float(c[j])
+                                      / max(float(c[-1]), 1.0))(pick(a)))
+            for j in range(len(self.topk))]
+
+    def update_device_stats(self, stat):
+        self._dev_pending.append(stat)
+        return self._result_views(stat, lambda a: a)
+
+    def device_step_result(self, stack, i):
+        return self._result_views(stack, lambda a, i=i: a[i])
 
     def reset(self):
         self.total = [0.0] * len(self.topk)
         self.count = [0] * len(self.topk)
-        self._dev_pending = []
-        self._dev_rows = 0
+        self._reset_device_state()
 
     def accumulate(self):
         total = list(self.total)
         count = list(self.count)
-        if self._dev_pending:
+        dev = self._device_stat_sum()
+        if dev is not None:
             # epoch-boundary materialization of the device accumulators
-            corr = np.sum(np.asarray(self._dev_pending), axis=0)
             for i in range(len(self.topk)):
-                total[i] += float(corr[i])
-                count[i] += self._dev_rows
+                total[i] += float(dev[i])
+                count[i] += float(dev[-1])
         res = [t / max(c, 1) for t, c in zip(total, count)]
         return res[0] if len(res) == 1 else res
 
@@ -143,6 +216,8 @@ class Accuracy(Metric):
 
 
 class Precision(Metric):
+    supports_device_update = True
+
     def __init__(self, name="precision"):
         self._name = name
         self.reset()
@@ -153,19 +228,49 @@ class Precision(Metric):
         self.tp += int(((p == 1) & (l == 1)).sum())
         self.fp += int(((p == 1) & (l == 0)).sum())
 
+    def device_batch_stats(self):
+        """Stat vector [tp, fp] — bit-exact counts (small integers in
+        float32), so device and host accumulation agree exactly."""
+        import jax.numpy as jnp
+
+        def stats(pred, label):
+            p = pred.reshape(-1) > 0.5
+            l = label.reshape(-1).astype(jnp.int32)
+            tp = jnp.sum((p & (l == 1)).astype(jnp.float32))
+            fp = jnp.sum((p & (l == 0)).astype(jnp.float32))
+            return jnp.stack([tp, fp])
+
+        return stats
+
+    def device_acc_init(self):
+        import jax.numpy as jnp
+        return jnp.zeros(2, jnp.float32)
+
+    def _stat_result(self, stat):
+        denom = float(stat[0]) + float(stat[1])
+        return float(stat[0]) / denom if denom else 0.0
+
     def reset(self):
         self.tp = 0
         self.fp = 0
+        self._reset_device_state()
 
     def accumulate(self):
-        denom = self.tp + self.fp
-        return self.tp / denom if denom else 0.0
+        tp, fp = float(self.tp), float(self.fp)
+        dev = self._device_stat_sum()
+        if dev is not None:
+            tp += float(dev[0])
+            fp += float(dev[1])
+        denom = tp + fp
+        return tp / denom if denom else 0.0
 
     def name(self):
         return self._name
 
 
 class Recall(Metric):
+    supports_device_update = True
+
     def __init__(self, name="recall"):
         self._name = name
         self.reset()
@@ -176,19 +281,61 @@ class Recall(Metric):
         self.tp += int(((p == 1) & (l == 1)).sum())
         self.fn += int(((p == 0) & (l == 1)).sum())
 
+    def device_batch_stats(self):
+        import jax.numpy as jnp
+
+        def stats(pred, label):
+            p = pred.reshape(-1) > 0.5
+            l = label.reshape(-1).astype(jnp.int32)
+            tp = jnp.sum((p & (l == 1)).astype(jnp.float32))
+            fn = jnp.sum((~p & (l == 1)).astype(jnp.float32))
+            return jnp.stack([tp, fn])
+
+        return stats
+
+    def device_acc_init(self):
+        import jax.numpy as jnp
+        return jnp.zeros(2, jnp.float32)
+
+    def _stat_result(self, stat):
+        denom = float(stat[0]) + float(stat[1])
+        return float(stat[0]) / denom if denom else 0.0
+
     def reset(self):
         self.tp = 0
         self.fn = 0
+        self._reset_device_state()
 
     def accumulate(self):
-        denom = self.tp + self.fn
-        return self.tp / denom if denom else 0.0
+        tp, fn = float(self.tp), float(self.fn)
+        dev = self._device_stat_sum()
+        if dev is not None:
+            tp += float(dev[0])
+            fn += float(dev[1])
+        denom = tp + fn
+        return tp / denom if denom else 0.0
 
     def name(self):
         return self._name
 
 
+def _auc_from_hist(pos, neg):
+    """Vectorized trapezoid over descending thresholds — same area the
+    accumulate() loop computes, used for per-batch log values."""
+    tp = np.cumsum(pos[::-1])
+    fp = np.cumsum(neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.0
+    prev_tp = np.concatenate([[0.0], tp[:-1]])
+    prev_fp = np.concatenate([[0.0], fp[:-1]])
+    area = float(np.sum((fp - prev_fp) * (tp + prev_tp) / 2.0))
+    return area / float(tot_pos * tot_neg)
+
+
 class Auc(Metric):
+    supports_device_update = True
+
     def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
         self._name = name
         self.num_thresholds = num_thresholds
@@ -207,25 +354,46 @@ class Auc(Metric):
             else:
                 self._stat_neg[b] += 1
 
+    def device_batch_stats(self):
+        """Stat [2, num_thresholds+1]: positive/negative histogram rows
+        built with one in-step scatter-add each — the bins ride the
+        folded carry like Accuracy's counts do."""
+        import jax.numpy as jnp
+        T = self.num_thresholds
+
+        def stats(pred, label):
+            p = pred
+            if p.ndim == 2 and p.shape[1] == 2:
+                p = p[:, 1]
+            p = p.reshape(-1)
+            lab = (label.reshape(-1) != 0).astype(jnp.float32)
+            bins = jnp.clip((p * T).astype(jnp.int32), 0, T)
+            pos = jnp.zeros(T + 1, jnp.float32).at[bins].add(lab)
+            neg = jnp.zeros(T + 1, jnp.float32).at[bins].add(1.0 - lab)
+            return jnp.stack([pos, neg])
+
+        return stats
+
+    def device_acc_init(self):
+        import jax.numpy as jnp
+        return jnp.zeros((2, self.num_thresholds + 1), jnp.float32)
+
+    def _stat_result(self, stat):
+        return _auc_from_hist(stat[0], stat[1])
+
     def reset(self):
         self._stat_pos = np.zeros(self.num_thresholds + 1)
         self._stat_neg = np.zeros(self.num_thresholds + 1)
+        self._reset_device_state()
 
     def accumulate(self):
-        tot_pos = self._stat_pos.sum()
-        tot_neg = self._stat_neg.sum()
-        if tot_pos == 0 or tot_neg == 0:
-            return 0.0
-        # trapezoid over thresholds descending
-        area = 0.0
-        pos = neg = 0.0
-        prev_pos = prev_neg = 0.0
-        for i in range(self.num_thresholds, -1, -1):
-            pos += self._stat_pos[i]
-            neg += self._stat_neg[i]
-            area += (neg - prev_neg) * (pos + prev_pos) / 2.0
-            prev_pos, prev_neg = pos, neg
-        return area / (tot_pos * tot_neg)
+        stat_pos = self._stat_pos
+        stat_neg = self._stat_neg
+        dev = self._device_stat_sum()
+        if dev is not None:
+            stat_pos = stat_pos + dev[0]
+            stat_neg = stat_neg + dev[1]
+        return _auc_from_hist(stat_pos, stat_neg)
 
     def name(self):
         return self._name

@@ -65,6 +65,9 @@ ALLOWED_SYNC = {
         "host-path Metric.compute (outside the fit hot loop)",
     ("metric", "__init__.py", "accumulate"):
         "epoch-boundary materialization of device accumulators",
+    ("metric", "__init__.py", "_device_stat_sum"):
+        "accumulate()'s helper: one materialization of the pending "
+        "stats + folded-carry accumulator at the epoch boundary",
     ("metric", "__init__.py", "accuracy"):
         "functional host metric (one-shot, not a loop)",
     ("io", "staging.py", "to_device_value"):
@@ -72,6 +75,10 @@ ALLOWED_SYNC = {
         "device value)",
     ("io", "staging.py", "to_device_values"):
         "host→device staging (batched device_put of host leaves)",
+    ("io", "staging.py", "stack_to_device"):
+        "step-folding staging: np.asarray views HOST batch leaves "
+        "before the K-group's single batched device_put; device "
+        "leaves take jnp.stack (no D2H)",
     ("io", "dataloader.py", "default_collate_fn"):
         "collates host sample arrays produced by the dataset",
 }

@@ -106,3 +106,64 @@ def test_restore_empty_dir(tmp_path):
     mgr = CheckpointManager(str(tmp_path / "none"), async_save=False)
     assert mgr.restore(net) == 0
     mgr.close()
+
+
+@pytest.mark.parametrize("async_save", [False, True])
+def test_concurrent_force_save_never_drops_a_manifest(tmp_path,
+                                                      async_save):
+    """Regression (ROADMAP open item): _flush_manifests used to
+    swap/filter _pending_manifest OUTSIDE the lock while save()
+    appends under it — a concurrent watchdog force-save landing
+    between the two list rebuilds lost its queued manifest, leaving a
+    good checkpoint permanently unverified.  The async variant also
+    covers the wait/swap window: a save landing while another thread
+    sits in wait_until_finished must stay queued for the next flush,
+    not be swapped out mid-write and dropped as "never appeared".
+
+    Orbax constraint: ASYNC saves must all be issued from one thread
+    (only the issuing thread may reset orbax's finalize state), so the
+    async variant hammers one saver against concurrent flushers; the
+    sync variant uses two saver threads."""
+    import threading
+
+    paddle.seed(0)
+    net = Net()
+    opt = optimizer.Adam(1e-2, parameters=net.parameters())
+    mgr = CheckpointManager(str(tmp_path / "c"), max_to_keep=100,
+                            async_save=async_save)
+    errs = []
+
+    def saver(offset, n=8):
+        try:
+            for i in range(n):
+                mgr.save(offset + i, net, opt, force=True)
+        except Exception as e:   # pragma: no cover - surfaced below
+            errs.append(e)
+
+    def flusher():
+        try:
+            for _ in range(40):
+                mgr._flush_manifests()
+        except Exception as e:   # pragma: no cover - surfaced below
+            errs.append(e)
+
+    if async_save:
+        threads = [threading.Thread(target=saver, args=(1, 16)),
+                   threading.Thread(target=flusher),
+                   threading.Thread(target=flusher)]
+    else:
+        threads = [threading.Thread(target=saver, args=(1,)),
+                   threading.Thread(target=saver, args=(101,)),
+                   threading.Thread(target=flusher)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    mgr.wait_until_finished()
+    kept = mgr.all_steps()
+    assert kept, "no checkpoints survived"
+    unverified = [s for s in kept if not mgr.verify_step(s)]
+    assert not unverified, \
+        f"steps {unverified} lost their commit manifest"
+    mgr.close()

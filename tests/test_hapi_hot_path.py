@@ -59,9 +59,11 @@ def test_one_extra_compile_per_batch_signature():
     m.fit(_batches(4, bs=4), epochs=2, verbose=0)
     stats = m.compile_stats()
     assert stats == {"entries": 2, "traces": 2}, stats
-    # re-running both signatures stays fully cached
-    m.fit(_batches(2, bs=8), epochs=1, verbose=0)
-    m.fit(_batches(2, bs=4), epochs=1, verbose=0)
+    # re-running both signatures stays fully cached (same epoch length:
+    # under step folding the dispatch-group length is part of the
+    # signature, like the batch shape is)
+    m.fit(_batches(4, bs=8), epochs=1, verbose=0)
+    m.fit(_batches(4, bs=4), epochs=1, verbose=0)
     assert m.compile_stats()["traces"] == 2
 
 
@@ -211,7 +213,11 @@ def test_fit_end_state_bit_identical_to_write_back_loop():
     opt_a = optimizer.Adam(1e-3, parameters=net_a.parameters())
     model = paddle.Model(net_a)
     model.prepare(opt_a, nn.CrossEntropyLoss())
-    model.fit(batches, epochs=2, verbose=0)
+    # steps_per_dispatch=0 pins the legacy per-step entry this test
+    # anchors: the reference loop below dispatches one plain jit per
+    # step, and XLA compiles a rolled-scan body's conv grads ~1 ulp
+    # differently (fold-engine parity has its own test module)
+    model.fit(batches, epochs=2, verbose=0, steps_per_dispatch=0)
 
     paddle.seed(0)
     net_b = LeNet()
