@@ -123,6 +123,11 @@ from . import hub  # noqa
 from .jit import to_static  # noqa
 from .distributed.parallel import DataParallel  # noqa
 
+# opt-in persistent XLA compilation cache (PADDLE_TPU_COMPILE_CACHE):
+# server/bench restarts load compiled programs instead of recompiling
+from .framework import compile_cache as _compile_cache  # noqa
+_compile_cache.enable_from_env()
+
 
 def disable_static(place=None):
     """Back to dygraph (the default mode): stops Program recording."""

@@ -95,6 +95,15 @@ class CheckpointManager:
         # every orbax call; lock order is always _lock → _orbax_lock,
         # and sha256 digesting stays outside both.
         self._orbax_lock = threading.Lock()
+        # orbax additionally requires all ASYNC saves to be issued from
+        # ONE thread (its finalize machinery asserts on cross-thread
+        # issue even when the calls themselves are serialized).  Saves
+        # arriving on any other thread — the HangWatchdog's on_hang
+        # force-save is the real case — are routed through a separate
+        # SYNCHRONOUS side manager instead (see _sync_side_save).
+        self._owner_thread = threading.get_ident()
+        self._sync_mgr = None
+        self.cross_thread_syncs = 0
         self._last_payload = None
         self._pending_manifest: List[int] = []
         self._prev_sigterm = None
@@ -124,7 +133,25 @@ class CheckpointManager:
         every file in the step dir) is written alongside it, making the
         step eligible for :meth:`restore`'s verified scan."""
         import orbax.checkpoint as ocp
-        with self._lock:
+        # orbax cross-thread hazard (ROADMAP resilience follow-up): all
+        # ASYNC saves must be issued from ONE thread.  A save arriving
+        # on any other thread — the HangWatchdog's on_hang force-save —
+        # is routed through a SYNCHRONOUS side manager so it can never
+        # race the owner thread's in-flight async finalize.
+        cross_thread = (self._async and
+                        threading.get_ident() != self._owner_thread)
+        if cross_thread:
+            # bounded wait: if the owner thread is wedged INSIDE save()
+            # (holding the lock), blocking here would also wedge the
+            # watchdog's dump-and-exit path — skip the save instead
+            if not self._lock.acquire(timeout=10.0):
+                warnings.warn(
+                    "CheckpointManager: cross-thread force-save skipped"
+                    " — owner thread holds the save lock (wedged save?)")
+                return False
+        else:
+            self._lock.acquire()
+        try:
             self._in_save = True
             try:
                 self._last_payload = (model, optimizer, extra)
@@ -132,6 +159,9 @@ class CheckpointManager:
 
                 def _write():
                     _faults.fault_point("checkpoint.save", step=step)
+                    if cross_thread:
+                        return self._sync_side_save(step, payload,
+                                                    force)
                     with self._orbax_lock:
                         return self._mgr.save(
                             step, args=ocp.args.StandardSave(payload),
@@ -141,7 +171,7 @@ class CheckpointManager:
                     _write, max_attempts=3, base_delay=0.1,
                     deadline=60.0, retry_on=(OSError,),
                     label="checkpoint.save")
-                if saved:
+                if saved and not cross_thread:
                     # manifest hashing happens OUTSIDE the lock
                     # (below): the data is committed, and holding the
                     # lock across sha256 of a large tree would starve
@@ -149,10 +179,17 @@ class CheckpointManager:
                     self._pending_manifest.append(int(step))
             finally:
                 self._in_save = False
+        finally:
+            self._lock.release()
         if saved:
             from ..resilience import watchdog as _wd
             _wd.notify_step(int(step))  # checkpoint IO is progress
-            if self._async:
+            if cross_thread:
+                # the sync save is fully committed on return; digest
+                # its manifest directly and touch NOTHING of the async
+                # manager from this thread (no wait, no queue surgery)
+                self._commit_manifest(int(step))
+            elif self._async:
                 # rolling flush: orbax serialises saves, so by the
                 # time save(N) returns every pending step < N is fully
                 # committed and safe to digest — without this, a
@@ -168,6 +205,29 @@ class CheckpointManager:
         if deferred is not None and self._sigterm_handler is not None:
             self._sigterm_handler(*deferred)
         return bool(saved)
+
+    def _sync_side_save(self, step: int, payload, force: bool) -> bool:
+        """Cross-thread save path: a SYNCHRONOUS save through a
+        dedicated side manager on the same directory.  The side
+        manager has async checkpointing disabled (the fix the ROADMAP
+        names: "force async_save=False in on_hang"), shares no state
+        with the owner thread's manager, and never deletes (no
+        retention), so it cannot trip orbax's cross-thread finalize
+        assert however the owner thread is mid-save.  The saved step
+        is visible to a fresh process's restore scan immediately; the
+        in-process primary manager learns of it at its next reload."""
+        import orbax.checkpoint as ocp
+        if self._sync_mgr is None:
+            self._sync_mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    save_interval_steps=1,
+                    enable_async_checkpointing=False))
+        saved = self._sync_mgr.save(
+            step, args=ocp.args.StandardSave(payload), force=force)
+        if saved:
+            self.cross_thread_syncs += 1
+        return saved
 
     def wait_until_finished(self):
         with self._orbax_lock:
@@ -318,6 +378,14 @@ class CheckpointManager:
         return self._mgr.latest_step()
 
     def all_steps(self):
+        if self.cross_thread_syncs:
+            # a watchdog-thread side save landed steps the primary
+            # manager has never seen; refresh its directory view
+            try:
+                with self._orbax_lock:
+                    self._mgr.reload()
+            except Exception:
+                pass
         return sorted(self._mgr.all_steps())
 
     def restore(self, model=None, optimizer=None,
@@ -504,6 +572,8 @@ class CheckpointManager:
             self._flush_manifests()
             with self._orbax_lock:
                 self._mgr.close()
+            if self._sync_mgr is not None:
+                self._sync_mgr.close()
         except Exception:
             pass
 

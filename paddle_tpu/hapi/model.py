@@ -904,6 +904,31 @@ class Model:
             result = [np.concatenate(r) for r in result]
         return result
 
+    # -- serving export -----------------------------------------------------
+    def prepare_serving(self, prompt_lengths=None, warmup=True,
+                        start=True, **server_kwargs):
+        """Export the trained network into a continuous-batching
+        generation server (``paddle_tpu.inference.serving.LLMServer``).
+
+        The device-resident train state syncs to the Layer tree, the
+        serving decode params snapshot from it, and (default) the
+        server AOT-compiles its prefill buckets + decode step BEFORE
+        taking traffic — the ROADMAP "warmup before traffic cuts over"
+        contract; the warmup wall-time record stays available via
+        ``server.stats()["warmup"]``.  ``server_kwargs`` forward to
+        :class:`~paddle_tpu.inference.serving.engine.DecodeEngine`
+        (``max_batch``, ``block_size``, ``num_blocks``, ``eos_id``,
+        ...).  Returns the server (started unless ``start=False``)."""
+        self._sync_train_state()
+        from ..inference.serving import LLMServer
+        server = LLMServer(self.network, auto_start=False,
+                           **server_kwargs)
+        if warmup:
+            server.warmup(prompt_lengths)
+        if start:
+            server.start()
+        return server
+
     # -- persistence --------------------------------------------------------
     def save(self, path, training=True):
         self._sync_train_state()

@@ -35,8 +35,21 @@ from ..utils import flatten as _flatten
 
 __all__ = [
     "Config", "Predictor", "Tensor", "create_predictor",
-    "PrecisionType", "PlaceType", "get_version",
+    "PrecisionType", "PlaceType", "get_version", "serving",
 ]
+
+
+def __getattr__(name):
+    # `paddle.inference.serving` loads lazily: the serving subsystem
+    # pulls in io/framework modules that may still be mid-import when
+    # the package initializes, and offline Predictor users never pay
+    # for the server stack
+    if name == "serving":
+        import importlib
+        mod = importlib.import_module(".serving", __name__)
+        globals()["serving"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class PrecisionType:

@@ -1,0 +1,35 @@
+"""paddle_tpu.inference.serving — continuous-batching decode server.
+
+The "millions of users" path (ROADMAP): a persistent compiled decode
+loop over a paged KV cache with ragged batched attention, continuous
+batching with block-budget admission control, and a lazy-streaming
+front door.  See DESIGN-SERVING.md for the architecture and the
+what-recompiles/what-never-does contract.
+
+    from paddle_tpu.inference.serving import LLMServer
+    server = LLMServer(gpt_network, max_batch=8, num_blocks=512)
+    future = server.submit(prompt_ids, max_tokens=64)
+    print(future.result().tokens)
+"""
+
+from .kv_cache import (BlockAllocator, OutOfBlocks, PagedKVCache,
+                       SCRATCH_BLOCK, gather_pages, paged_append,
+                       write_prompt_pages)
+from .ragged_attention import (causal_prefill_attention,
+                               ragged_decode_attention)
+from .decode_model import (ServingModelConfig, decode_forward,
+                           extract_decode_params, prefill_forward,
+                           reference_decode)
+from .scheduler import QueueFull, Request, RequestStats, Scheduler
+from .engine import DecodeEngine, GenerationResult
+from .api import LLMServer
+
+__all__ = [
+    "BlockAllocator", "OutOfBlocks", "PagedKVCache", "SCRATCH_BLOCK",
+    "gather_pages", "paged_append", "write_prompt_pages",
+    "causal_prefill_attention", "ragged_decode_attention",
+    "ServingModelConfig", "decode_forward", "extract_decode_params",
+    "prefill_forward", "reference_decode",
+    "QueueFull", "Request", "RequestStats", "Scheduler",
+    "DecodeEngine", "GenerationResult", "LLMServer",
+]

@@ -1,0 +1,188 @@
+"""LLMServer — the serving front door.
+
+    server = LLMServer(net, max_batch=8, block_size=16,
+                       num_blocks=512, eos_id=eos, auto_start=False)
+    server.warmup([16, 64])          # AOT compile before traffic
+    server.start()
+    fut = server.submit(prompt_ids, max_tokens=64,
+                        stream_cb=on_token)
+    result = fut.result()            # GenerationResult
+
+One daemon pump thread owns the engine: it admits, prefers, and
+dispatches; ``submit`` only touches the (locked) admission queue and
+wakes the pump, so the front door is safe from any thread and never
+blocks on device work.  Streaming callbacks receive ``LazyScalar``
+token views — reading/formatting one is the CONSUMER's device sync;
+an unread stream costs the server nothing (framework/lazy.py).
+
+Backpressure: the admission queue is bounded; ``submit`` raises
+:class:`~.scheduler.QueueFull` at capacity.  Stats: ``stats()``
+reports queue depth, batch occupancy, KV-pool fragmentation, compile
+trace counts, and latency percentiles over the completed-request ring.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence
+
+from ...framework import compile_cache
+from .engine import DecodeEngine
+from .scheduler import QueueFull  # noqa: F401  (re-export: caller API)
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+class LLMServer:
+    """Continuous-batching generation server over a trained network.
+
+    ``network``: a ``GPTForCausalLM`` (weights are snapshot at
+    construction via ``extract_decode_params``; call
+    :meth:`refresh_weights` after further training).  Remaining kwargs
+    go to :class:`DecodeEngine`.
+    """
+
+    def __init__(self, network=None, *, auto_start: bool = True,
+                 idle_wait_s: float = 0.005, **engine_kwargs):
+        # persistent XLA compilation cache (opt-in via env): restarts
+        # of this server skip recompiling the decode/prefill programs
+        compile_cache.enable_from_env()
+        self.engine = DecodeEngine(network, **engine_kwargs)
+        self._idle_wait_s = float(idle_wait_s)
+        self._cond = threading.Condition()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._warmup_record: Optional[Dict] = None
+        if auto_start:
+            self.start()
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "LLMServer":
+        if self.running:
+            return self
+        self._closed = False
+        self._thread = threading.Thread(target=self._pump,
+                                        name="paddle-tpu-llm-server",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self):
+        """Stop the pump.  In-flight and queued requests get their
+        futures failed with RuntimeError — the caller's retry tier
+        decides what survives a server teardown, not the server."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._fail_all(RuntimeError("server closed before completion"))
+
+    def __enter__(self) -> "LLMServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def _pump(self):
+        while True:
+            with self._cond:
+                if self._closed:
+                    return
+            try:
+                busy = self.engine.step()
+            except Exception as e:   # noqa: BLE001 — a dead pump must
+                # not strand callers on futures that never resolve
+                self._fail_all(RuntimeError(
+                    f"serving engine failed: {type(e).__name__}: {e}"))
+                raise
+            if not busy:
+                with self._cond:
+                    if self._closed:
+                        return
+                    self._cond.wait(self._idle_wait_s)
+
+    def _fail_all(self, exc: Exception):
+        eng = self.engine
+        for s, req in enumerate(eng._slots):
+            if req is None:
+                continue
+            # release pool state BEFORE failing the future: leaked
+            # reservations would shrink capacity forever on restart
+            eng.scheduler.finish(req)
+            if req.blocks:
+                eng._kv.allocator.free(req.blocks)
+                req.blocks = []
+            eng._lengths[s] = 0
+            eng._slots[s] = None
+            if not req.future.done():
+                req.future.set_exception(exc)
+        for req in eng.scheduler.drain_waiting():
+            if not req.future.done():
+                req.future.set_exception(exc)
+
+    # -- traffic -------------------------------------------------------------
+    def submit(self, prompt_ids, max_tokens: int, stream_cb=None):
+        """Enqueue a request; returns its ``concurrent.futures.Future``
+        resolving to a :class:`~.engine.GenerationResult`.  Raises
+        :class:`QueueFull` under backpressure."""
+        req = self.engine.submit(prompt_ids, max_tokens,
+                                 stream_cb=stream_cb)
+        with self._cond:
+            self._cond.notify_all()
+        return req.future
+
+    def warmup(self, prompt_lengths: Optional[Sequence[int]] = None):
+        """AOT-compile the serving programs BEFORE traffic (must be
+        called with the pump stopped — construct with
+        ``auto_start=False``).  Returns and records the wall-time
+        breakdown; ``stats()`` re-surfaces it so cold-start cost is a
+        first-class product metric."""
+        if self.running:
+            raise RuntimeError(
+                "warmup() needs exclusive engine access: construct "
+                "LLMServer(auto_start=False), warmup(), then start()")
+        self._warmup_record = self.engine.warmup(prompt_lengths)
+        return self._warmup_record
+
+    def refresh_weights(self, network):
+        """Re-snapshot weights from a (re)trained network.  Pump must
+        be stopped (same exclusivity contract as warmup)."""
+        if self.running:
+            raise RuntimeError("stop the server before refreshing "
+                               "weights")
+        from .decode_model import extract_decode_params
+        self.engine._params = extract_decode_params(network)
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> Dict[str, object]:
+        st = dict(self.engine.stats())
+        # snapshot: the pump thread appends to the ring concurrently
+        # (deque append and list() are each atomic under the GIL)
+        completed = list(self.engine._completed)
+        lat = sorted(s.latency for s in completed
+                     if s.latency is not None)
+        ttft = sorted(s.ttft for s in completed
+                      if s.ttft is not None)
+        st["completed"] = len(lat)
+        st["latency_p50_s"] = round(_percentile(lat, 50), 6)
+        st["latency_p99_s"] = round(_percentile(lat, 99), 6)
+        st["ttft_p50_s"] = round(_percentile(ttft, 50), 6)
+        st["ttft_p99_s"] = round(_percentile(ttft, 99), 6)
+        if self._warmup_record is not None:
+            st["warmup"] = self._warmup_record
+        st["compilation_cache_dir"] = compile_cache.active_cache_dir()
+        return st

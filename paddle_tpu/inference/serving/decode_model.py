@@ -1,0 +1,253 @@
+"""Pure-functional GPT prefill/decode over an extracted weight tree.
+
+The training-side ``GPTForCausalLM`` forward recomputes attention over
+the whole sequence every call — right for training, hopeless for
+serving.  This module lowers the same weights into cache-aware pure
+functions the serving engine can compile once and dispatch forever:
+
+- :func:`extract_decode_params` — Layer tree → plain jax-array pytree
+  (device-resident; passed into the jitted steps as an argument, so a
+  hapi-trained network exports to the server without copies).
+- :func:`prefill_forward` — full-prompt forward at a bucket length,
+  returning per-layer K/V for the page writes, plus the first greedy
+  token.  One compile per prompt bucket (``io/bucketing.py`` sizes).
+- :func:`decode_forward` — ONE token per request across the whole
+  batch against the paged pool; the pool is appended in-place (donated
+  by the caller's jit) and attention runs ragged over the page table.
+  This is the single program the continuous-batching engine dispatches.
+- :func:`reference_decode` — slow per-request sequential decode with a
+  dense cache; the exactness oracle for tests, NOT a serving path.
+
+Numerics mirror the training stack deliberately: LayerNorm statistics
+in f32 (``ops/nn_ops.layer_norm``), tanh-approximate GELU, attention
+scale ``1/sqrt(Dh)``, and the qkv fused projection split in the same
+``[3, H, Dh]`` feature-major order ``GPTAttention.forward`` uses — so
+extracted-weight logits match the training forward to float tolerance.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .kv_cache import gather_pages, paged_append, SCRATCH_BLOCK
+from .ragged_attention import (causal_prefill_attention,
+                               ragged_decode_attention)
+
+
+@dataclass(frozen=True)
+class ServingModelConfig:
+    """Static model geometry baked into the compiled serving steps."""
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    hidden_size: int
+    vocab_size: int
+    max_position: int
+    ln_epsilon: float = 1e-5
+
+    @classmethod
+    def from_gpt_config(cls, cfg) -> "ServingModelConfig":
+        return cls(num_layers=cfg.num_hidden_layers,
+                   num_heads=cfg.num_attention_heads,
+                   head_dim=cfg.hidden_size // cfg.num_attention_heads,
+                   hidden_size=cfg.hidden_size,
+                   vocab_size=cfg.vocab_size,
+                   max_position=cfg.max_position_embeddings,
+                   ln_epsilon=cfg.layer_norm_epsilon)
+
+
+def extract_decode_params(network):
+    """``GPTForCausalLM`` → plain pytree of jax arrays for the compiled
+    serving steps.  Reads the live parameter values (post-training,
+    post-``sync_to_layers``); the returned tree is an ordinary jit
+    argument, so server weights can be refreshed by re-extracting."""
+    net = network
+    if hasattr(net, "gpt"):          # GPTForCausalLM → GPTModel
+        gpt = net.gpt
+    else:
+        raise TypeError(
+            f"serving decode expects a GPTForCausalLM-shaped network "
+            f"(got {type(net).__name__}); wrap custom models in the "
+            "same .gpt/.embeddings/.layers layout")
+    emb = gpt.embeddings
+    params = {
+        "wte": emb.word_embeddings.weight._value,
+        "wpe": emb.position_embeddings.weight._value,
+        "lnf_w": gpt.final_norm.weight._value,
+        "lnf_b": gpt.final_norm.bias._value,
+        "layers": [],
+    }
+    for layer in gpt.layers:
+        params["layers"].append({
+            "ln1_w": layer.ln1.weight._value,
+            "ln1_b": layer.ln1.bias._value,
+            "wqkv": layer.attn.qkv_proj.weight._value,
+            "bqkv": layer.attn.qkv_proj.bias._value,
+            "wo": layer.attn.out_proj.weight._value,
+            "bo": layer.attn.out_proj.bias._value,
+            "ln2_w": layer.ln2.weight._value,
+            "ln2_b": layer.ln2.bias._value,
+            "w1": layer.mlp.fc1.weight._value,
+            "b1": layer.mlp.fc1.bias._value,
+            "w2": layer.mlp.fc2.weight._value,
+            "b2": layer.mlp.fc2.bias._value,
+        })
+    return params
+
+
+def _ln(x, w, b, eps):
+    """f32-statistics LayerNorm matching ``ops/nn_ops.layer_norm``."""
+    orig = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    out = out * w.astype(jnp.float32) + b.astype(jnp.float32)
+    return out.astype(orig)
+
+
+def _split_qkv(qkv, num_heads, head_dim):
+    """Fused projection output → (q, k, v), each ``[..., H, Dh]`` —
+    same ``[3, H, Dh]`` feature-major split as ``GPTAttention``."""
+    lead = qkv.shape[:-1]
+    qkv = qkv.reshape(*lead, 3, num_heads, head_dim)
+    take = lambda i: qkv[..., i, :, :]  # noqa: E731
+    return take(0), take(1), take(2)
+
+
+def _mlp(x, lp, eps):
+    h = _ln(x, lp["ln2_w"], lp["ln2_b"], eps)
+    h = jax.nn.gelu(h @ lp["w1"] + lp["b1"], approximate=True)
+    return h @ lp["w2"] + lp["b2"]
+
+
+# ---------------------------------------------------------------------------
+# prefill
+# ---------------------------------------------------------------------------
+def prefill_forward(params, cfg: ServingModelConfig, ids, length):
+    """Full-prompt forward at a bucket length.
+
+    ``ids`` ``[1, Lb]`` int32 (prompt right-padded to its bucket);
+    ``length`` traced int32 scalar — the real prompt length.  Returns
+    ``(kv, first_token, last_logits)`` where ``kv`` is
+    ``[L, 2, Lb, H, Dh]`` ready for ``write_prompt_pages``,
+    ``first_token`` is the greedy next token after the prompt, and
+    ``last_logits`` ``[V]`` is its distribution (exactness tests).
+
+    Causality makes bucket padding exact for the real positions: a
+    padded row attends only backwards and is never attended to by any
+    real row; its garbage K/V land in pages but are masked by length
+    in every later ragged-decode read.
+    """
+    B, Lb = ids.shape
+    pos = jnp.arange(Lb, dtype=jnp.int32)
+    x = params["wte"][ids] + params["wpe"][pos][None]
+    kvs = []
+    for lp in params["layers"]:
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_epsilon)
+        q, k, v = _split_qkv(h @ lp["wqkv"] + lp["bqkv"],
+                             cfg.num_heads, cfg.head_dim)
+        kvs.append(jnp.stack([k[0], v[0]]))        # [2, Lb, H, Dh]
+        attn = causal_prefill_attention(q, k, v)
+        x = x + attn.reshape(B, Lb, cfg.hidden_size) @ lp["wo"] + lp["bo"]
+        x = x + _mlp(x, lp, cfg.ln_epsilon)
+    x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
+    last = x[0, length - 1]                        # [D]
+    logits = last @ params["wte"].T                # [V]
+    first_token = jnp.argmax(logits).astype(jnp.int32)
+    return jnp.stack(kvs), first_token, logits
+
+
+# ---------------------------------------------------------------------------
+# decode
+# ---------------------------------------------------------------------------
+def decode_forward(params, cfg: ServingModelConfig, pool, page_table,
+                   lengths, tokens, write_ok):
+    """ONE decode token per request over the paged pool.
+
+    ``pool`` ``[L, 2, NB, BS, H, Dh]`` (caller's jit donates it);
+    ``page_table`` ``[B, MAXNB]`` int32; ``lengths`` ``[B]`` int32 —
+    tokens already in cache per request (the new token's position);
+    ``tokens`` ``[B]`` int32 — the input token per request;
+    ``write_ok`` ``[B]`` bool — rows with ``False`` (empty slot, done
+    request) write to the scratch block and their output is garbage
+    the engine masks.  Returns ``(pool, logits [B, V])``.
+    """
+    L, _, NB, BS, H, Dh = pool.shape
+    B, MAXNB = page_table.shape
+    lengths = lengths.astype(jnp.int32)
+    # position of the incoming token; clamp keeps a stale (done but
+    # not yet polled) slot's growing length from indexing out of range
+    pos = jnp.minimum(lengths, cfg.max_position - 1)
+    write_pos = jnp.minimum(lengths, MAXNB * BS - 1)
+    blk_slot = jnp.minimum(write_pos // BS, MAXNB - 1)
+    block_ids = jnp.take_along_axis(
+        page_table, blk_slot[:, None], axis=1)[:, 0]
+    block_ids = jnp.where(write_ok, block_ids, SCRATCH_BLOCK)
+    offsets = write_pos % BS
+    x = params["wte"][tokens] + params["wpe"][pos]          # [B, D]
+    for li, lp in enumerate(params["layers"]):
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_epsilon)
+        q, k, v = _split_qkv(h @ lp["wqkv"] + lp["bqkv"],
+                             cfg.num_heads, cfg.head_dim)
+        pool = paged_append(pool, li, k, v, block_ids, offsets)
+        kp, vp = gather_pages(pool, li, page_table)
+        # context includes the token just appended
+        attn = ragged_decode_attention(q, kp, vp, lengths + 1)
+        x = x + attn.reshape(B, cfg.hidden_size) @ lp["wo"] + lp["bo"]
+        x = x + _mlp(x, lp, cfg.ln_epsilon)
+    x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
+    logits = x @ params["wte"].T                            # [B, V]
+    return pool, logits
+
+
+# ---------------------------------------------------------------------------
+# sequential oracle (tests only)
+# ---------------------------------------------------------------------------
+def reference_decode(params, cfg: ServingModelConfig, prompt_ids,
+                     num_tokens):
+    """Per-request sequential greedy decode with a dense cache.
+
+    ``prompt_ids``: 1-D int sequence.  Returns ``(tokens [num_tokens],
+    logits [num_tokens, V])`` as jax arrays.  Unbatched, unpaged,
+    unjitted — the exactness oracle the ragged batched path is tested
+    against, sharing the same primitive helpers so the only deltas are
+    batching, paging, and padded-axis reduction order.
+    """
+    ids = jnp.asarray(prompt_ids, dtype=jnp.int32)[None]    # [1, Lp]
+    Lp = ids.shape[1]
+    kv, tok, logits = prefill_forward(params, cfg, ids,
+                                      jnp.int32(Lp))
+    caches = [(kv[li, 0], kv[li, 1]) for li in
+              range(cfg.num_layers)]                        # [T, H, Dh]
+    out_toks = [tok]
+    out_logits = [logits]
+    for step in range(1, int(num_tokens)):
+        pos = min(Lp + step - 1, cfg.max_position - 1)
+        x = params["wte"][tok] + params["wpe"][pos]          # [D]
+        x = x[None]                                          # [1, D]
+        new_caches = []
+        for li, lp in enumerate(params["layers"]):
+            h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_epsilon)
+            q, k, v = _split_qkv(h @ lp["wqkv"] + lp["bqkv"],
+                                 cfg.num_heads, cfg.head_dim)
+            ck = jnp.concatenate([caches[li][0], k], axis=0)
+            cv = jnp.concatenate([caches[li][1], v], axis=0)
+            new_caches.append((ck, cv))
+            T = ck.shape[0]
+            attn = ragged_decode_attention(
+                q, ck[None], cv[None],
+                jnp.full((1,), T, dtype=jnp.int32))
+            x = x + attn.reshape(1, cfg.hidden_size) @ lp["wo"] \
+                + lp["bo"]
+            x = x + _mlp(x, lp, cfg.ln_epsilon)
+        caches = new_caches
+        x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
+        lg = (x @ params["wte"].T)[0]
+        tok = jnp.argmax(lg).astype(jnp.int32)
+        out_toks.append(tok)
+        out_logits.append(lg)
+    return jnp.stack(out_toks), jnp.stack(out_logits)

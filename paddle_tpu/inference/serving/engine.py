@@ -1,0 +1,404 @@
+"""Continuous-batching decode engine: one persistent compiled dispatch.
+
+The serving hot loop is the training hot loop's design transplanted to
+decode (DESIGN-PERF.md → DESIGN-SERVING.md): device-resident state,
+donated through a cached compiled step, with host work strictly
+bookkeeping-shaped and *zero* device→host syncs outside two
+whitelisted points (``scripts/check_host_sync.py`` guards this module
+like it guards ``Model.fit``).
+
+Shape-stability is the whole game (arxiv 2604.15464): the decode
+program is compiled ONCE for the engine's geometry —
+
+    (params, pool [L,2,NB,BS,H,Dh], table [B,MAXNB], lengths [B],
+     tokens [B], done [B]) -> (pool, tokens, done)
+
+Requests joining and leaving the running batch mutate page-table
+*data* between dispatches, never a traced shape, so membership churn
+costs no recompiles (test-pinned).  The KV pool is donated and rides
+the dispatch chain; emitted tokens feed back as the next dispatch's
+input entirely on device; per-token streaming hands consumers
+``LazyScalar`` views of a shared per-dispatch ``LazyStack`` — one D2H
+transfer per dispatch, only if somebody actually reads.
+
+Prefill runs per request at bucketed prompt lengths
+(``io/bucketing.shape_bucket``) through one jit whose trace cache
+holds one entry per bucket — the bounded compile set the bucketing
+module exists for.
+
+EOS is detected ON DEVICE (``done`` rides the loop); the host learns
+of it at ``done_poll_interval`` dispatch boundaries via the single
+sanctioned ``_poll_done`` sync.  Between EOS and poll a finished
+request wastes masked lanes — the classic poll-cadence/occupancy
+trade-off, see DESIGN-SERVING.md §EOS.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.lazy import LazyScalar, LazyStack
+from ...io.bucketing import shape_bucket
+from .decode_model import (ServingModelConfig, decode_forward,
+                           extract_decode_params, prefill_forward)
+from .kv_cache import SCRATCH_BLOCK, PagedKVCache
+from .scheduler import Request, Scheduler
+
+
+class GenerationResult:
+    """Resolved value of a request future."""
+
+    __slots__ = ("request_id", "tokens", "stats")
+
+    def __init__(self, request_id, tokens, stats):
+        self.request_id = request_id
+        self.tokens = tokens            # List[int], eos-truncated
+        self.stats = stats              # RequestStats
+
+    def __repr__(self):
+        return (f"GenerationResult(id={self.request_id}, "
+                f"tokens={self.tokens})")
+
+
+def _default_buckets(block_size: int, max_context: int) -> List[int]:
+    """Power-of-two block multiples up to the context limit — few
+    compiles, <= 2x padding waste per prompt.  The top bucket floors
+    to a block multiple: a model whose max_position is not one (e.g.
+    1000 with 16-token blocks) caps prompts at the floored length
+    instead of failing the engine's bucket-alignment check."""
+    top = (max_context // block_size) * block_size
+    buckets, b = [], block_size
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    if not buckets or buckets[-1] != top:
+        buckets.append(top)
+    return buckets
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a paged KV pool.
+
+    Drive it directly (``submit`` + ``step`` / ``run_until_idle``) or
+    through :class:`~paddle_tpu.inference.serving.api.LLMServer`'s
+    pump thread.  All methods except ``submit`` must be called from
+    ONE thread (the pump); ``submit`` is safe from anywhere.
+    """
+
+    def __init__(self, network=None, *, gpt_config=None, params=None,
+                 max_batch: int = 4, block_size: int = 16,
+                 num_blocks: int = 128,
+                 max_blocks_per_seq: Optional[int] = None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, pad_id: int = 0,
+                 done_poll_interval: int = 8, max_queue: int = 64):
+        if network is not None:
+            params = extract_decode_params(network)
+            gpt_config = network.config
+        if params is None or gpt_config is None:
+            raise ValueError("need network= or (params=, gpt_config=)")
+        self._cfg = (gpt_config
+                     if isinstance(gpt_config, ServingModelConfig)
+                     else ServingModelConfig.from_gpt_config(gpt_config))
+        self._params = params
+        cfg = self._cfg
+        self.max_batch = int(max_batch)
+        self.block_size = int(block_size)
+        self.eos_id = eos_id
+        self.pad_id = int(pad_id)
+        self.done_poll_interval = max(1, int(done_poll_interval))
+        if max_blocks_per_seq is None:
+            max_blocks_per_seq = -(-cfg.max_position // block_size)
+        self.max_blocks_per_seq = int(max_blocks_per_seq)
+        self.max_context = min(cfg.max_position,
+                               self.max_blocks_per_seq * block_size)
+        dtype = params["wte"].dtype
+        self._kv = PagedKVCache(cfg.num_layers, num_blocks, block_size,
+                                cfg.num_heads, cfg.head_dim, dtype=dtype)
+        self.scheduler = Scheduler(self._kv.allocator, block_size,
+                                   max_queue=max_queue,
+                                   max_context=self.max_context)
+        if prefill_buckets is None:
+            prefill_buckets = _default_buckets(block_size,
+                                               self.max_context)
+        for b in prefill_buckets:
+            if b % block_size:
+                raise ValueError(
+                    f"prefill bucket {b} is not a multiple of "
+                    f"block_size {block_size}")
+        self._buckets = sorted(int(b) for b in prefill_buckets)
+        # host-side batch state (authoritative; staged per dispatch)
+        self._slots: List[Optional[Request]] = [None] * self.max_batch
+        self._tables = np.full((self.max_batch, self.max_blocks_per_seq),
+                               SCRATCH_BLOCK, dtype=np.int32)
+        self._lengths = np.zeros(self.max_batch, dtype=np.int32)
+        # device-resident loop state
+        self._tokens = jnp.zeros(self.max_batch, dtype=jnp.int32)
+        self._done = jnp.zeros(self.max_batch, dtype=bool)
+        # compiled steps (ONE jit each; trace cache keyed by shape —
+        # decode must stay at exactly one trace, tests pin it)
+        self._decode = self._build_decode_step()
+        self._prefill = jax.jit(self._run_prefill)
+        self._write = jax.jit(
+            lambda pool, kv, blocks: self._write_pages(pool, kv, blocks),
+            donate_argnums=(0,))
+        # NOT donated: the emitted-token array a join rewrites is still
+        # referenced by that dispatch's LazyStack streaming views — a
+        # donation would invalidate tokens a consumer has yet to read
+        self._join = jax.jit(
+            lambda tok, done, i, v: (tok.at[i].set(v),
+                                     done.at[i].set(False)))
+        self._dispatches = 0
+        self._total_tokens = 0
+        self._completed = deque(maxlen=1024)    # RequestStats ring
+
+    # -- compiled steps ------------------------------------------------------
+    def _run_prefill(self, params, ids, length):
+        return prefill_forward(params, self._cfg, ids, length)
+
+    @staticmethod
+    def _write_pages(pool, kv, blocks):
+        from .kv_cache import write_prompt_pages
+        return write_prompt_pages(pool, kv, blocks)
+
+    def _build_decode_step(self):
+        cfg, eos, pad = self._cfg, self.eos_id, self.pad_id
+
+        def step(params, pool, table, lengths, tokens, done):
+            active = (lengths > 0) & jnp.logical_not(done)
+            pool, logits = decode_forward(params, cfg, pool, table,
+                                          lengths, tokens, active)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            emit = jnp.where(active, nxt, jnp.int32(pad))
+            if eos is not None:
+                done = done | (active & (nxt == jnp.int32(eos)))
+            return pool, emit, done
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    # -- front door ----------------------------------------------------------
+    def submit(self, prompt_ids, max_tokens: int,
+               stream_cb=None) -> Request:
+        """Enqueue a generation request (thread-safe).  Returns the
+        :class:`Request`; its ``future`` resolves to a
+        :class:`GenerationResult`.  Raises
+        :class:`~.scheduler.QueueFull` at queue capacity and
+        ``ValueError`` for requests the pool geometry can never run."""
+        req = Request(prompt_ids, max_tokens, stream_cb=stream_cb)
+        if len(req.prompt) > self._buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} exceeds the largest "
+                f"prefill bucket {self._buckets[-1]}")
+        return self.scheduler.submit(req)
+
+    # -- engine loop ---------------------------------------------------------
+    def step(self) -> bool:
+        """Admit waiting requests, then run ONE batched decode
+        dispatch.  Returns True while there is (or may be) work."""
+        self._admit()
+        active = [s for s, r in enumerate(self._slots) if r is not None]
+        if not active:
+            return self.scheduler.queue_depth > 0
+        self._grow_pages(active)
+        # async H2D staging of the (tiny) host-authoritative batch
+        # layout; the decode dispatch itself never syncs
+        table = jax.device_put(self._tables)
+        lengths = jax.device_put(self._lengths)
+        pool, emit, done = self._decode(self._params, self._kv.pool,
+                                        table, lengths, self._tokens,
+                                        self._done)
+        self._kv.swap_pool(pool)
+        self._tokens = emit            # feeds back next dispatch (D2D)
+        self._done = done
+        self._dispatches += 1
+        stack = LazyStack(emit)        # ONE shared fetch, if read
+        now = time.monotonic()
+        to_finish = []
+        for s in active:
+            req = self._slots[s]
+            req.push_token(
+                LazyScalar(stack, post=(lambda a, i=s: a[i])), now)
+            if not req.capped:
+                self._lengths[s] += 1
+            if len(req.lazy_tokens) >= req.max_tokens:
+                to_finish.append(s)
+        for s in to_finish:
+            self._finalize(s)
+        if self.eos_id is not None and \
+                self._dispatches % self.done_poll_interval == 0:
+            self._poll_done()
+        return True
+
+    def run_until_idle(self, max_dispatches: int = 100_000):
+        """Pump :meth:`step` until queue and batch drain (tests/CLI)."""
+        n = 0
+        while self.step():
+            n += 1
+            if n > max_dispatches:
+                raise RuntimeError(
+                    f"run_until_idle: still busy after {n} dispatches")
+        return n
+
+    # -- admission / prefill -------------------------------------------------
+    def _admit(self):
+        free = [s for s, r in enumerate(self._slots) if r is None]
+        if not free:
+            return
+        for req in self.scheduler.pop_admissible(len(free)):
+            self._start_request(free.pop(0), req)
+
+    def _start_request(self, slot: int, req: Request):
+        """Prefill the prompt at its bucket, write its pages, and seat
+        it in the batch.  The first generated token comes out of the
+        prefill program itself (greedy over the last real position)."""
+        Lp = len(req.prompt)
+        bucket = shape_bucket(Lp, self._buckets)
+        ids = np.zeros((1, bucket), dtype=np.int32)
+        ids[0, :Lp] = req.prompt
+        kv, first_tok, _ = self._prefill(self._params,
+                                         jax.device_put(ids),
+                                         np.int32(Lp))
+        nb_needed = self._kv.blocks_for_tokens(Lp)
+        blocks = self._kv.allocator.allocate(nb_needed)
+        blocks_arr = np.full(bucket // self.block_size, SCRATCH_BLOCK,
+                             dtype=np.int32)
+        blocks_arr[:nb_needed] = blocks
+        self._kv.swap_pool(self._write(self._kv.pool, kv,
+                                       jax.device_put(blocks_arr)))
+        req.slot = slot
+        req.blocks = blocks
+        self._slots[slot] = req
+        self._tables[slot, :] = SCRATCH_BLOCK
+        self._tables[slot, :nb_needed] = blocks
+        self._lengths[slot] = Lp
+        self._tokens, self._done = self._join(self._tokens, self._done,
+                                              np.int32(slot), first_tok)
+        req.push_token(LazyScalar(first_tok), time.monotonic())
+        if req.max_tokens == 1:
+            self._finalize(slot)
+
+    def _grow_pages(self, active: List[int]):
+        """Append-allocate the next block for requests whose upcoming
+        write crosses a block boundary.  Reservation-gated admission
+        guarantees success within ``req.reserved_blocks``; a slot at
+        its budget is a device-done request the host has not polled
+        yet — growth (and length advance) stop, its masked writes land
+        in scratch."""
+        for s in active:
+            req = self._slots[s]
+            if req.capped:
+                continue
+            have = len(req.blocks)
+            if int(self._lengths[s]) < have * self.block_size:
+                continue
+            if have >= req.reserved_blocks or \
+                    have >= self.max_blocks_per_seq:
+                req.capped = True
+                continue
+            blk = self._kv.allocator.allocate(1)[0]
+            req.blocks.append(blk)
+            self._tables[s, have] = blk
+
+    # -- completion ----------------------------------------------------------
+    def _poll_done(self):
+        """THE group-boundary sync: fetch the [B] device done-mask so
+        EOS'd requests free their slot/pages.  Runs every
+        ``done_poll_interval`` dispatches, never inside one."""
+        done = np.asarray(jax.device_get(self._done))
+        for s, req in enumerate(self._slots):
+            if req is not None and bool(done[s]):
+                self._finalize(s)
+
+    def _finalize(self, slot: int):
+        """Consumer-boundary materialization: the request is leaving —
+        resolving its future IS the read, so the (single, shared per
+        dispatch-stack) D2H transfers are sanctioned here."""
+        req = self._slots[slot]
+        toks = [int(t) for t in req.lazy_tokens]
+        if self.eos_id is not None and self.eos_id in toks:
+            toks = toks[:toks.index(self.eos_id) + 1]
+        req.stats.finished = time.monotonic()
+        req.stats.generated = len(toks)
+        self.scheduler.finish(req)
+        if req.blocks:
+            self._kv.allocator.free(req.blocks)
+            req.blocks = []
+        self._slots[slot] = None
+        self._lengths[slot] = 0
+        self._tables[slot, :] = SCRATCH_BLOCK
+        self._total_tokens += len(toks)
+        self._completed.append(req.stats)
+        req.future.set_result(
+            GenerationResult(req.id, toks, req.stats))
+
+    # -- warmup / stats ------------------------------------------------------
+    def warmup(self, prompt_lengths: Optional[Sequence[int]] = None
+               ) -> Dict[str, float]:
+        """Ahead-of-time compile of the serving programs (ROADMAP
+        "cold-start as a product metric"): every prefill bucket the
+        given prompt lengths touch (default: all buckets), the page
+        writer, the join op, and THE decode step.  Returns wall-times;
+        call before traffic cuts over — this is the one engine method
+        allowed to block on device completion."""
+        t0 = time.monotonic()
+        buckets = (sorted({shape_bucket(int(n), self._buckets)
+                           for n in prompt_lengths})
+                   if prompt_lengths else list(self._buckets))
+        per_bucket = {}
+        for b in buckets:
+            tb = time.monotonic()
+            ids = np.zeros((1, b), dtype=np.int32)
+            kv, tok, _ = self._prefill(self._params,
+                                       jax.device_put(ids), np.int32(1))
+            blocks_arr = np.full(b // self.block_size, SCRATCH_BLOCK,
+                                 dtype=np.int32)
+            self._kv.swap_pool(self._write(self._kv.pool, kv,
+                                           jax.device_put(blocks_arr)))
+            jax.block_until_ready(tok)
+            per_bucket[b] = round(time.monotonic() - tb, 4)
+        self._tokens, self._done = self._join(
+            self._tokens, self._done, np.int32(0), jnp.int32(0))
+        td = time.monotonic()
+        pool, emit, done = self._decode(
+            self._params, self._kv.pool, jax.device_put(self._tables),
+            jax.device_put(self._lengths), self._tokens, self._done)
+        self._kv.swap_pool(pool)
+        self._tokens, self._done = emit, done
+        jax.block_until_ready(emit)
+        decode_s = time.monotonic() - td
+        return {"warmup_s": round(time.monotonic() - t0, 4),
+                "decode_compile_s": round(decode_s, 4),
+                "prefill_bucket_s": per_bucket,
+                "buckets": buckets}
+
+    @property
+    def active_count(self) -> int:
+        return sum(1 for r in self._slots if r is not None)
+
+    def compile_stats(self) -> Dict[str, int]:
+        """Recompile-pin introspection (mirrors Model.compile_stats):
+        ``decode_traces`` MUST stay 1 across any join/leave pattern."""
+        def _size(fn):
+            try:
+                return fn._cache_size()
+            except Exception:
+                return -1
+        return {"decode_traces": _size(self._decode),
+                "prefill_traces": _size(self._prefill),
+                "write_traces": _size(self._write),
+                "join_traces": _size(self._join)}
+
+    def stats(self) -> Dict[str, object]:
+        st = {"active": self.active_count,
+              "queue_depth": self.scheduler.queue_depth,
+              "dispatches": self._dispatches,
+              "total_tokens": self._total_tokens,
+              "kv": self._kv.allocator.stats()}
+        st.update(self.compile_stats())
+        return st

@@ -1,0 +1,247 @@
+"""Paged KV cache: fixed-size blocks in one preallocated device pool.
+
+The serving decode path (Ragged Paged Attention, PAPERS.md arxiv
+2604.15464) keeps every request's K/V in fixed-size *blocks* drawn from
+a single preallocated pool instead of one contiguous per-request
+buffer.  Mixed-length requests then share ONE compiled decode program:
+the per-request layout lives in an integer page table, which is data,
+not shape — requests joining and leaving the running batch never
+change a traced shape, so nothing recompiles.
+
+Split of responsibilities:
+
+- ``BlockAllocator`` (host): free-list bookkeeping — allocate /
+  append-grow / free plus the worst-case *reservation* accounting the
+  scheduler's admission control uses so a request admitted today can
+  never OOM the pool mid-decode tomorrow.
+- ``PagedKVCache`` (host handle, device pool): owns the pool array
+  ``[L, 2, num_blocks, block_size, H, Dh]`` and the per-request page
+  tables.  The pool array itself is handed to the compiled decode step
+  as a DONATED argument and rides the dispatch chain device-resident;
+  this class only ever swaps its handle for the step's output.
+- pure pool ops (``write_prompt_pages`` / ``paged_append`` /
+  ``gather_pages``): shape-stable jnp functions traced INTO the
+  compiled prefill/decode programs.
+
+Block 0 is the scratch block: it is never allocated, and every masked
+write (inactive slot, done request, bucket-padding tail) is routed to
+it, so the compiled step needs no branch — writes always happen, only
+the target differs.  Nothing ever reads scratch: ragged attention
+masks by per-request length.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+
+#: block id that absorbs masked writes; never allocated, never read
+SCRATCH_BLOCK = 0
+
+
+class OutOfBlocks(RuntimeError):
+    """The pool cannot satisfy an allocation (admission-control bug or
+    an un-reserved caller)."""
+
+
+class BlockAllocator:
+    """Free-list allocator over the block pool (host side).
+
+    Fragmentation-aware in two ways:
+
+    - ``allocate(n)`` first looks for the *smallest contiguous run*
+      that fits (best-fit): contiguous pages let a bucket prefill land
+      as one dense slice write, and keeping the remaining free space
+      in large runs preserves that for later requests.  When no single
+      run fits, it falls back to scattered lowest-index-first blocks —
+      paged attention is layout-indifferent, so fragmentation degrades
+      nothing but the write pattern.
+    - ``stats()`` reports the run structure (``largest_run``,
+      ``fragmentation``) so the serving stats surface can watch decay.
+
+    Reservations: ``reserve(n)`` / ``release(n)`` track the worst-case
+    block need of every admitted request WITHOUT allocating.  Admission
+    control only admits while ``reserved + need <= capacity``; actual
+    ``allocate`` calls then draw lazily (prompt blocks at prefill, one
+    block at a time as decode crosses block boundaries) and can never
+    fail for an admitted request.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need >= 2 blocks (block 0 is scratch)")
+        self._free = sorted(range(1, num_blocks))  # block 0 = scratch
+        self._allocated: set = set()
+        self.capacity = num_blocks - 1
+        self._reserved = 0
+
+    # -- reservations (admission control) -----------------------------------
+    @property
+    def reserved(self) -> int:
+        return self._reserved
+
+    def can_reserve(self, n: int) -> bool:
+        return self._reserved + int(n) <= self.capacity
+
+    def reserve(self, n: int) -> bool:
+        if not self.can_reserve(n):
+            return False
+        self._reserved += int(n)
+        return True
+
+    def release(self, n: int):
+        self._reserved -= int(n)
+        assert self._reserved >= 0, "release() without matching reserve()"
+
+    # -- allocate / free -----------------------------------------------------
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_allocated(self) -> int:
+        return len(self._allocated)
+
+    def _runs(self) -> List[List[int]]:
+        """Maximal contiguous runs of the (sorted) free list."""
+        runs: List[List[int]] = []
+        for b in self._free:
+            if runs and runs[-1][-1] == b - 1:
+                runs[-1].append(b)
+            else:
+                runs.append([b])
+        return runs
+
+    def allocate(self, n: int) -> List[int]:
+        """n block ids — contiguous best-fit, else scattered lowest-first.
+
+        Raises :class:`OutOfBlocks` when the pool cannot satisfy it;
+        under reservation-gated admission that means a caller skipped
+        ``reserve()``.
+        """
+        n = int(n)
+        if n <= 0:
+            return []
+        if n > len(self._free):
+            raise OutOfBlocks(
+                f"allocate({n}): only {len(self._free)} free blocks "
+                f"(capacity {self.capacity}, reserved {self._reserved})")
+        best: Optional[List[int]] = None
+        for run in self._runs():
+            if len(run) >= n and (best is None or len(run) < len(best)):
+                best = run
+        got = best[:n] if best is not None else self._free[:n]
+        got_set = set(got)
+        self._free = [b for b in self._free if b not in got_set]
+        self._allocated |= got_set
+        return got
+
+    def free(self, blocks: Sequence[int]):
+        for b in blocks:
+            b = int(b)
+            if b not in self._allocated:
+                raise ValueError(f"free({b}): block is not allocated")
+            self._allocated.discard(b)
+        merged = sorted(set(self._free) | {int(b) for b in blocks})
+        self._free = merged
+
+    def stats(self) -> Dict[str, float]:
+        runs = self._runs()
+        largest = max((len(r) for r in runs), default=0)
+        free = len(self._free)
+        return {
+            "capacity": self.capacity,
+            "free": free,
+            "allocated": len(self._allocated),
+            "reserved": self._reserved,
+            "free_runs": len(runs),
+            "largest_run": largest,
+            # 0.0 = one contiguous run (or empty), → 1.0 = maximally
+            # scattered free space
+            "fragmentation": (1.0 - largest / free) if free else 0.0,
+        }
+
+
+class PageTable:
+    """Per-request block list + length (host bookkeeping)."""
+
+    __slots__ = ("blocks", "length")
+
+    def __init__(self):
+        self.blocks: List[int] = []
+        self.length = 0
+
+
+class PagedKVCache:
+    """The device pool + host page tables for one serving engine.
+
+    ``pool``: ``[num_layers, 2, num_blocks, block_size, heads, head_dim]``
+    (axis 1 = K/V).  The handle held here is *donated* into every
+    compiled prefill-write and decode dispatch; callers must adopt the
+    returned array via :meth:`swap_pool` — the old buffer is gone.
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 num_heads: int, head_dim: int, dtype=jnp.float32):
+        self.num_layers = int(num_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.pool = jnp.zeros(
+            (num_layers, 2, num_blocks, block_size, num_heads, head_dim),
+            dtype=dtype)
+        self.allocator = BlockAllocator(num_blocks)
+
+    def swap_pool(self, new_pool):
+        self.pool = new_pool
+
+    def blocks_for_tokens(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+
+# ---------------------------------------------------------------------------
+# pure pool ops (traced into the compiled prefill/decode programs)
+# ---------------------------------------------------------------------------
+def write_prompt_pages(pool, kv, block_ids):
+    """Scatter a prefill's K/V into its pages.
+
+    ``kv``: ``[L, 2, Lb, H, Dh]`` with ``Lb = len(block_ids) *
+    block_size`` (prefill buckets are whole blocks).  ``block_ids``
+    ``[nb]`` int32 — tail entries past the prompt's real blocks point
+    at SCRATCH_BLOCK, absorbing the bucket padding.  Duplicate scratch
+    indices make the scatter order-dependent only inside scratch,
+    which is never read.
+    """
+    L, two, Lb, H, Dh = kv.shape
+    nb = block_ids.shape[0]
+    bs = Lb // nb
+    kvp = kv.reshape(L, two, nb, bs, H, Dh)
+    return pool.at[:, :, block_ids].set(kvp)
+
+
+def paged_append(pool, layer, k_new, v_new, block_ids, offsets):
+    """Write one decode token's K/V per request into its current page.
+
+    ``k_new``/``v_new``: ``[B, H, Dh]``; ``block_ids``/``offsets``:
+    ``[B]`` int32 (masked rows target SCRATCH_BLOCK).
+    """
+    pool = pool.at[layer, 0, block_ids, offsets].set(k_new)
+    pool = pool.at[layer, 1, block_ids, offsets].set(v_new)
+    return pool
+
+
+def gather_pages(pool, layer, page_table):
+    """Page-table gather → per-request contiguous K/V views.
+
+    ``page_table`` ``[B, max_blocks]`` int32 → ``(k, v)`` each
+    ``[B, max_blocks * block_size, H, Dh]``.  Unused table tail entries
+    are SCRATCH_BLOCK; whatever they gather is masked by length in
+    ragged attention.
+    """
+    k = pool[layer, 0][page_table]          # [B, nb, bs, H, Dh]
+    v = pool[layer, 1][page_table]
+    B, nb, bs, H, Dh = k.shape
+    return (k.reshape(B, nb * bs, H, Dh),
+            v.reshape(B, nb * bs, H, Dh))

@@ -1,0 +1,93 @@
+"""Ragged batched attention over paged KV (PAPERS.md arxiv 2604.15464).
+
+One compiled program serves a batch of requests whose context lengths
+all differ: K/V come in via the page-table gather (``kv_cache.
+gather_pages``) padded to the table's maximum extent, and a per-request
+``lengths`` vector masks the tail.  The mask arithmetic is built for
+*exactness* against a per-request dense-cache reference:
+
+- masked logits are set to a large finite negative (never ``-inf``):
+  after max-subtraction their ``exp`` underflows to exactly ``0.0``,
+  and an explicit ``where`` pins them to ``0.0`` regardless of
+  magnitude, so padding contributes exact zeros to the softmax sums;
+- the denominator is ``maximum(sum, tiny)``: for any row with at least
+  one valid position the sum is ``>= exp(0) = 1``, so the guard is
+  bit-inert there, while an all-masked row (empty batch slot) yields
+  ``0`` output instead of ``0/0 = NaN`` — NaN in a dead slot would
+  still poison XLA fast-math assumptions and trip ``nan`` debug modes;
+- statistics run in f32 like the training stack's attention
+  (``ops/nn_ops.py _sdpa``), output returns in the input dtype.
+
+Remaining difference vs the sequential reference is reduction order
+over the padded axis (XLA picks the tree by extent) — ~1 ulp on
+logits; greedy token choices match exactly (tests pin both, see
+DESIGN-SERVING.md §Exactness).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+#: large-finite mask value (``-inf`` breeds NaN under 0*inf folding)
+MASK_VALUE = -1e30
+#: denominator guard — bit-inert for any row with >= 1 valid position
+DENOM_TINY = 1e-30
+
+
+def ragged_decode_attention(q, k, v, lengths, scale=None):
+    """Single-token queries against per-request ragged contexts.
+
+    ``q`` ``[B, H, Dh]``; ``k``/``v`` ``[B, T, H, Dh]`` (page-table
+    gather, padded to the common ``T``); ``lengths`` ``[B]`` int32 —
+    request ``b`` attends positions ``t < lengths[b]``.  Returns
+    ``[B, H, Dh]`` in ``q``'s dtype.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    orig = q.dtype
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    logits = jnp.einsum("bhd,bthd->bht", qf, kf) * scale
+    T = k.shape[1]
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < \
+        lengths.astype(jnp.int32)[:, None]               # [B, T]
+    logits = jnp.where(valid[:, None, :], logits, MASK_VALUE)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    w = jnp.exp(logits - m)
+    w = jnp.where(valid[:, None, :], w, 0.0)
+    denom = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), DENOM_TINY)
+    probs = w / denom
+    out = jnp.einsum("bht,bthd->bhd", probs, vf)
+    return out.astype(orig)
+
+
+def causal_prefill_attention(q, k, v, scale=None):
+    """Dense causal attention for the prefill pass.
+
+    ``q``/``k``/``v`` ``[B, S, H, Dh]`` → ``[B, S, H, Dh]``.  Same
+    masked-softmax arithmetic as :func:`ragged_decode_attention` (exact
+    zeros for masked positions, f32 statistics) so a bucket-padded
+    prefill computes bit-identical rows for the real prompt positions:
+    a padded tail row only ever *attends*, it is never attended to by
+    a real row (causal), and its K/V are masked downstream by length.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    orig = q.dtype
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    S = q.shape[1]
+    causal = jnp.tril(jnp.ones((S, S), dtype=bool))
+    logits = jnp.where(causal[None, None], logits, MASK_VALUE)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    w = jnp.exp(logits - m)
+    w = jnp.where(causal[None, None], w, 0.0)
+    denom = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), DENOM_TINY)
+    probs = w / denom
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vf)
+    return out.astype(orig)

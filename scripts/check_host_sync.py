@@ -39,6 +39,14 @@ HOT_MODULES = [
     os.path.join("io", "dataloader.py"),
     os.path.join("io", "staging.py"),
     os.path.join("framework", "lazy.py"),
+    # serving decode hot path (DESIGN-SERVING.md): the persistent
+    # dispatch loop must never stall host↔device — same contract,
+    # same guard, as the training loop
+    os.path.join("inference", "serving", "engine.py"),
+    os.path.join("inference", "serving", "ragged_attention.py"),
+    os.path.join("inference", "serving", "kv_cache.py"),
+    os.path.join("inference", "serving", "decode_model.py"),
+    os.path.join("inference", "serving", "scheduler.py"),
 ]
 
 # (module, enclosing function) → why this sync point is legitimate
@@ -81,6 +89,13 @@ ALLOWED_SYNC = {
         "leaves take jnp.stack (no D2H)",
     ("io", "dataloader.py", "default_collate_fn"):
         "collates host sample arrays produced by the dataset",
+    ("inference", "serving", "engine.py", "_poll_done"):
+        "THE group-boundary sync of the decode loop: one [B] bool "
+        "done-mask fetch every done_poll_interval dispatches, never "
+        "inside one (DESIGN-SERVING.md §EOS)",
+    ("inference", "serving", "engine.py", "warmup"):
+        "AOT compile timing before traffic cuts over — blocking on "
+        "device completion is the point (cold-start metric)",
 }
 
 

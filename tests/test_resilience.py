@@ -529,6 +529,67 @@ def test_async_rolling_manifest_flush(tmp_path):
     assert mgr.verify_step(3)
 
 
+def test_cross_thread_force_save_routes_sync(tmp_path):
+    """ROADMAP resilience follow-up: orbax requires all ASYNC saves to
+    be issued from ONE thread.  A save arriving on another thread —
+    the watchdog's on_hang force-save — while the owner thread has an
+    async save in flight must take the synchronous side-manager path
+    instead of tripping orbax's cross-thread finalize assert."""
+    import threading
+    d = str(tmp_path / "c")
+    paddle.seed(0)
+    net = _Net()
+    opt = optimizer.Adam(1e-2, parameters=net.parameters())
+    mgr = CheckpointManager(d, async_save=True)
+    errs = []
+    mgr.save(1, net, opt, force=True)      # async, possibly in flight
+
+    def other_thread_save():
+        try:
+            mgr.save(2, net, opt, force=True)
+        except Exception as e:             # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=other_thread_save)
+    t.start()
+    t.join(timeout=60)
+    assert not t.is_alive() and not errs, errs
+    assert mgr.cross_thread_syncs == 1
+    # the sync save is committed AND manifested when save() returns
+    assert mgr.verify_step(2)
+    mgr.wait_until_finished()
+    assert set(mgr.verified_steps()) >= {1, 2}
+    mgr.close()
+    # a fresh manager (relaunch) restores the watchdog's step
+    with CheckpointManager(d, async_save=True) as mgr2:
+        assert mgr2.restore(net, opt) == 2
+
+
+def test_watchdog_on_hang_force_save_is_safe(tmp_path):
+    """End-to-end: HangWatchdog fires on ITS thread mid-async-save
+    traffic; the on_hang force-save lands, verified, without touching
+    the owner thread's orbax manager."""
+    d = str(tmp_path / "c")
+    paddle.seed(0)
+    net = _Net()
+    opt = optimizer.Adam(1e-2, parameters=net.parameters())
+    mgr = CheckpointManager(d, async_save=True)
+    mgr.save(1, net, opt, force=True)
+    saved = []
+    wd = HangWatchdog(
+        timeout=0.3, exit_code=None,
+        on_hang=lambda: saved.append(
+            mgr.save(7, net, opt, force=True)))
+    with wd:
+        wd.notify_step(1)
+        time.sleep(0.9)                    # let it fire
+    assert wd.fired and saved == [True]
+    assert mgr.cross_thread_syncs == 1
+    assert mgr.verify_step(7)
+    assert mgr.latest_verified_step() == 7
+    mgr.close()
+
+
 def test_sigterm_handler_restored_on_close():
     import signal
     prev = signal.getsignal(signal.SIGTERM)
