@@ -63,7 +63,7 @@ def _maybe_force_cpu():
 
 
 def _timed_bench(build, steps, pipeline_steps=0, batch_gen=None,
-                 runner_kwargs=None):
+                 runner_kwargs=None, timings=None):
     """Shared scaffold: build (model, opt, loss, data) then time steps.
 
     `build` returns (net, opt, loss_fn, inputs, labels, units_per_step).
@@ -72,7 +72,11 @@ def _timed_bench(build, steps, pipeline_steps=0, batch_gen=None,
     inputs once; when `batch_gen` is given, a second loop feeds FRESH
     host batches through the DataLoader's device double-buffer
     (_DevicePrefetcher) so the number includes real input-pipeline
-    overlap (VERDICT r3 next #8)."""
+    overlap (VERDICT r3 next #8).  ``timings`` (optional dict) receives
+    ``train_compile_s`` — model build + placement + first compiled
+    step, the per-process cold-start cost the training rounds record
+    every round like serving records ``serving_compile_warmup_s``
+    (ROADMAP "compile-time as a product metric")."""
     _maybe_force_cpu()
     import jax
     import paddle_tpu as paddle
@@ -81,6 +85,7 @@ def _timed_bench(build, steps, pipeline_steps=0, batch_gen=None,
     from paddle_tpu.distributed.runner import DistributedRunner
 
     print("devices-ok", jax.devices(), flush=True)
+    t_build0 = time.perf_counter()
     paddle.seed(0)
     net, opt, loss_fn, inputs, labels, units = build()
     mesh = collective.build_mesh({})
@@ -91,6 +96,9 @@ def _timed_bench(build, steps, pipeline_steps=0, batch_gen=None,
     labels = [Tensor(jax.device_put(v)) for v in labels]
 
     float(runner.train_step(inputs, labels))   # compile
+    if timings is not None:
+        timings["train_compile_s"] = round(
+            time.perf_counter() - t_build0, 2)
     print("compiled", flush=True)
     float(runner.train_step(inputs, labels))   # warmup
 
@@ -181,9 +189,10 @@ def bench_gpt():
         return [x], [np.roll(x, -1, axis=1)]
 
     t_child0 = time.time()
+    timings = {}
     res = _timed_bench(build, steps=2 if tiny else 15,
                        pipeline_steps=3 if tiny else 10,
-                       batch_gen=batch_gen)
+                       batch_gen=batch_gen, timings=timings)
     tps, step_ms = res[0], res[1]
     tps_pipe = res[2] if len(res) > 2 else None
 
@@ -228,6 +237,7 @@ def bench_gpt():
         L, d, S = 12, 768, 1024
         flops_tok = 6.0 * n_params + 6.0 * L * d * S
     out = {"tokens_per_sec": tps, "step_ms": round(step_ms, 2)}
+    out.update(timings)        # train_compile_s: cold-start on record
     if tps_pipe:
         out["tokens_per_sec_pipeline"] = round(tps_pipe, 1)
         out["pipeline_overlap_ratio"] = round(tps_pipe / tps, 3)
@@ -311,10 +321,12 @@ def bench_ernie():
         return (net, opt, BertPretrainingCriterion(cfg.vocab_size),
                 [x], [labels.astype(np.int64)], batch * seq)
 
-    tps, step_ms = _timed_bench(build, steps=2 if tiny else 10)
+    timings = {}
+    tps, step_ms = _timed_bench(build, steps=2 if tiny else 10,
+                                timings=timings)
     print("RESULT " + json.dumps({
-        "tokens_per_sec": tps, "step_ms": round(step_ms, 2)}),
-        flush=True)
+        "tokens_per_sec": tps, "step_ms": round(step_ms, 2),
+        **timings}), flush=True)
 
 
 def bench_detector():
@@ -503,8 +515,13 @@ def bench_hapi():
                for _ in range(48)]
     steps = len(batches)
     epochs = 8
+    t_compile0 = time.perf_counter()
     for f in folds:   # compile + warmup epoch per fold entry
         model.fit(batches, epochs=1, verbose=0, steps_per_dispatch=f)
+    # cold-start on record every round, like serving_compile_warmup_s
+    # (ROADMAP "compile-time as a product metric"): first-epoch wall
+    # time across the fold sweep = trace + compile + warmup
+    hapi_compile_warmup_s = round(time.perf_counter() - t_compile0, 2)
     samples = {f: [] for f in folds}
     for _ in range(reps):
         for f in folds:   # interleaved: back-to-back medians
@@ -515,7 +532,7 @@ def bench_hapi():
                 [p._value for p in model.network.parameters()])
             dt = time.perf_counter() - t0
             samples[f].append(steps * epochs / dt)
-    out = {}
+    out = {"hapi_compile_warmup_s": hapi_compile_warmup_s}
     for f in folds:
         med = sorted(samples[f])[len(samples[f]) // 2]
         key = ("hapi_fit_steps_per_sec" if f == 1
@@ -529,6 +546,93 @@ def bench_hapi():
             if f != 1 and base:
                 out[f"hapi_fold{f}_speedup"] = round(
                     out[f"hapi_fit_steps_per_sec_fold{f}"] / base, 3)
+    # auto-K (ISSUE 7): unasked, the tuner must land K>1 on this
+    # host-bound microbench; record the decision alongside the sweep
+    model.fit(batches, epochs=2, verbose=0)
+    if model._fold_tuner is not None and model._fold_tuner.decided:
+        out["hapi_auto_fold"] = model._fold
+        d = model._fold_tuner.decision
+        out["hapi_auto_host_ms_per_step"] = d["host_ms_per_step"]
+        out["hapi_auto_device_ms_per_step"] = d["device_ms_per_step"]
+    print("RESULT " + json.dumps(out), flush=True)
+
+
+def bench_mesh_fold():
+    """DistributedRunner fold sweep on a CPU dp mesh (ISSUE 7): the
+    mesh half of the unified dispatch engine, measured the same way
+    bench_hapi measures the single-chip half.  CPU by DESIGN — 8 fake
+    host devices stand in for a multichip slice; what folding removes
+    is HOST dispatch overhead, which this measures directly.
+
+    fold=1 dispatches scan-of-1 through the unified engine; fold=K
+    dispatches scan-of-K; ``legacy`` is the pre-unification per-step
+    ``train_step`` entry, the no-regression guard.  All variants run
+    interleaved rep by rep in ONE child for comparable medians."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.runner import DistributedRunner
+
+    print("devices-ok", jax.devices(), flush=True)
+    folds = [int(f) for f in os.environ.get(
+        "GRAFT_BENCH_MESH_FOLDS", "1,8").split(",")]
+    reps = int(os.environ.get("GRAFT_BENCH_MESH_REPS", "3"))
+    dp = int(os.environ.get("GRAFT_BENCH_MESH_DP", "2"))
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                        nn.Linear(32, 10))
+    opt = optimizer.Adam(1e-3, parameters=net.parameters())
+    mesh = collective.build_mesh({"dp": dp})
+    collective.set_mesh(mesh)
+    runner = DistributedRunner(net, opt, nn.CrossEntropyLoss(),
+                               mesh=mesh)
+    rng = np.random.RandomState(0)
+    batches = [([rng.rand(16, 16).astype(np.float32)],
+                [rng.randint(0, 10, (16,)).astype(np.int64)])
+               for _ in range(48)]
+    steps, rounds = len(batches), 4
+
+    def run_epoch(f):
+        if f == 0:                       # legacy per-step entry
+            for ins, lbs in batches:
+                runner.train_step(ins, lbs)
+            return
+        for i in range(0, steps, f):
+            runner.train_steps_folded(batches[i:i + f])
+
+    variants = [0] + folds               # 0 = legacy baseline
+    t_compile0 = time.perf_counter()
+    for f in variants:                   # compile + warmup epoch each
+        run_epoch(f)
+    mesh_compile_warmup_s = round(time.perf_counter() - t_compile0, 2)
+    samples = {f: [] for f in variants}
+    for _ in range(reps):
+        for f in variants:               # interleaved medians
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                run_epoch(f)
+            jax.block_until_ready(runner._opt_state)
+            dt = time.perf_counter() - t0
+            samples[f].append(steps * rounds / dt)
+    out = {"mesh_dp": dp,
+           "mesh_compile_warmup_s": mesh_compile_warmup_s}
+    for f in variants:
+        med = sorted(samples[f])[len(samples[f]) // 2]
+        key = ("mesh_fit_steps_per_sec_legacy" if f == 0 else
+               "mesh_fit_steps_per_sec" if f == 1 else
+               f"mesh_fit_steps_per_sec_fold{f}")
+        out[key] = round(med, 1)
+    base = out.get("mesh_fit_steps_per_sec")
+    for f in folds:
+        if f != 1 and base:
+            out[f"mesh_fold{f}_speedup"] = round(
+                out[f"mesh_fit_steps_per_sec_fold{f}"] / base, 3)
     print("RESULT " + json.dumps(out), flush=True)
 
 
@@ -681,6 +785,15 @@ def _run_child(mode: str, overall_deadline: float):
     """Run one workload in a child; return (result_dict|None, err_str)."""
     env = dict(os.environ)
     env["_GRAFT_BENCH_CHILD"] = mode
+    # persistent XLA compile cache ON by default for every bench child
+    # (ROADMAP cold-start item): rounds r03-r05 lost entire workloads
+    # to compile deadlines; a warm repo-local cache turns repeat
+    # compiles into disk loads, and the per-round compile-time metrics
+    # (train_compile_s / *_compile_warmup_s) measure exactly what it
+    # saves.  Opt out with PADDLE_TPU_COMPILE_CACHE=0.
+    env.setdefault("PADDLE_TPU_COMPILE_CACHE",
+                   os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".bench_compile_cache"))
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__)], env=env, text=True,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
@@ -756,6 +869,17 @@ def main():
                          else {"error": serr[-1000:]}), flush=True)
         return
 
+    # `python bench.py --mesh-fold [1,8,...]`: run ONLY the mesh fold
+    # sweep (CPU dp mesh, cheap) — the multichip counterpart of --fold
+    if "--mesh-fold" in sys.argv:
+        i = sys.argv.index("--mesh-fold")
+        if i + 1 < len(sys.argv):
+            os.environ["GRAFT_BENCH_MESH_FOLDS"] = sys.argv[i + 1]
+        mf, merr = _run_child("mesh_fold", 420)
+        print(json.dumps(mf if mf is not None
+                         else {"error": merr[-1000:]}), flush=True)
+        return
+
     mode = os.environ.get("_GRAFT_BENCH_CHILD")
     if mode == "gpt":
         return bench_gpt()
@@ -771,6 +895,8 @@ def main():
         return bench_vit()
     if mode == "hapi":
         return bench_hapi()
+    if mode == "mesh_fold":
+        return bench_mesh_fold()
     if mode == "serving":
         return bench_serving()
 
@@ -797,7 +923,8 @@ def main():
             if k != "tokens_per_sec" and (
                     k.startswith("tokens_per_sec_") or k in
                     ("step_ms", "mfu", "model_tflops_per_sec",
-                     "flops_per_token_m", "pipeline_overlap_ratio")):
+                     "flops_per_token_m", "pipeline_overlap_ratio",
+                     "train_compile_s")):
                 out["gpt_" + k] = gpt[k]
     else:
         out["error"] = err[-2000:]
@@ -816,6 +943,18 @@ def main():
             out["hapi_fit_error"] = herr[-500:]
     elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
         out["hapi_fit_error"] = "skipped: out of budget"
+
+    # mesh fold sweep: the multichip half of the unified dispatch
+    # engine (CPU dp mesh, cheap) — folded mesh steps/s records every
+    # round next to the single-chip sweep
+    if remaining() > 60 and not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        mf, mferr = _run_child("mesh_fold", min(240, remaining()))
+        if mf is not None:
+            out.update(mf)
+        else:
+            out["mesh_fold_error"] = mferr[-500:]
+    elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        out["mesh_fold_error"] = "skipped: out of budget"
 
     # serving loop bench: CPU-only by design and cheap, so the
     # continuous-batching path (tokens/s, p99 latency, compile/warmup

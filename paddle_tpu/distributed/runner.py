@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..tensor import Tensor
 from ..nn import functional_call as F
 from ..framework import random as _random
-from ..io.staging import to_device_values
+from ..io.staging import to_device_values, stack_to_device
 from . import collective as coll
 from .fleet.meta_parallel.sharding_parallel import shard_spec_for
 from .resilience import faults as _faults
@@ -71,6 +71,15 @@ class DistributedRunner:
         self._step_fn = None
         self._opt_state = None
         self._placed = False
+        # folded dispatch (the unified engine, framework/dispatch.py):
+        # one compiled scan program per (fold, metric-arity, shapes)
+        # signature; the base PRNG key is shared with the per-step
+        # entry so both consume the identical key sequence; the device
+        # metric accumulators ride the donated scan carry between
+        # dispatches (owner: hapi Model.fit)
+        self._fold_cache: Dict[Any, Any] = {}
+        self._base_key = None
+        self._metric_acc = None
         # deferred wrapper sync (same boundary protocol as hapi
         # TrainState): when True, train_step updates only the cached
         # value dicts and the Layer wrappers re-bind at
@@ -142,50 +151,65 @@ class DistributedRunner:
         self._placed = True
 
     # -- the compiled step ---------------------------------------------------
-    def _build(self):
+    def _data_pspecs(self, shapes, stacked: bool):
+        """``PartitionSpec`` per data position (None = leave the leaf
+        unconstrained), shared by the per-step entry, the folded entry
+        and the fold-group staging path so all three agree: batch dim
+        on dp/sharding; seq dim (axis 1) on 'sep' when context
+        parallelism is on and the length divides (SURVEY.md §5.7 —
+        the heuristic can be wrong for non-sequence side inputs;
+        ``input_specs={idx: PartitionSpec(...)|None}`` overrides it).
+        ``shapes`` are PER-STEP ``[B, ...]`` shapes; ``stacked``
+        prefixes the (unsharded) fold axis of a ``[K, ...]`` group.
+        Returns None when the mesh gives data nothing to shard."""
+        daxes = _data_axes(self.mesh)
+        sep = int(self.mesh.shape.get("sep", 1))
+        overrides = self.input_specs or {}
+        if not (daxes or sep > 1 or overrides):
+            return None
+        lead = (None,) if stacked else ()
+        out = []
+        for i, shape in enumerate(shapes):
+            if i in overrides:
+                s = overrides[i]
+                out.append(None if s is None else P(*lead, *tuple(s)))
+                continue
+            spec = list(lead) + [daxes if daxes else None]
+            if sep > 1 and len(shape) >= 2 and shape[1] % sep == 0:
+                spec.append("sep")
+            out.append(P(*spec))
+        return out
+
+    def _place_with_specs(self, data, specs):
+        """In-program sharding pin of the step's data leaves."""
+        if specs is None:
+            return data
+        return tuple(
+            d if s is None else jax.lax.with_sharding_constraint(
+                d, NamedSharding(self.mesh, s))
+            for d, s in zip(data, specs))
+
+    def _step_math(self, n_in: int, metric_fns=()):
+        """The ONE per-step train body both compiled entries share —
+        amp/remat, microbatch gradient accumulation, ZeRO grad
+        constraints, canonical-sharding pin on the updated params —
+        so the legacy per-step program and the folded scan body cannot
+        drift apart (their bit-parity is the engine's contract).
+
+        Returns ``per_step(params, frozen, buffers, opt_state, lr,
+        key, md) -> (loss_f32, mstats, out_vals, new_params,
+        new_state, new_buf)``; ``mstats`` are the in-step metric stat
+        vectors (fold path), empty without ``metric_fns``."""
         net = self.network
         loss_layer = self.loss_fn
         mesh = self.mesh
-        daxes = _data_axes(mesh)
         opt = self.optimizer
         stage = self.sharding_stage
         runner = self
-
         acc = max(int(self.accumulate_steps), 1)
+        capture = bool(self.capture_outputs or metric_fns)
 
-        sep = int(mesh.shape.get("sep", 1))
-
-        # base key drawn once; per-step keys derived INSIDE the compiled
-        # program from the step counter (saves two host-dispatched device
-        # ops per step)
-        base_key = _random.default_generator().draw_key()
-
-        def step(params, frozen, buffers, opt_state, lr, ctr, *data):
-            key = jax.random.fold_in(base_key, ctr)
-            n_in = self._n_inputs
-            overrides = runner.input_specs or {}
-            if daxes or sep > 1 or overrides:
-                # batch dim on dp/sharding; seq dim (axis 1) on sep when
-                # context parallelism is on (SURVEY.md §5.7).  The
-                # heuristic can be wrong for non-sequence side inputs —
-                # input_specs={idx: PartitionSpec(...)|None} overrides it.
-                def dspec(i, d):
-                    if i in overrides:
-                        return overrides[i]
-                    spec = [daxes if daxes else None]
-                    if sep > 1 and d.ndim >= 2 and d.shape[1] % sep == 0:
-                        spec.append("sep")
-                    return P(*spec)
-
-                def place(i, d):
-                    s = dspec(i, d)
-                    if s is None:
-                        return d
-                    return jax.lax.with_sharding_constraint(
-                        d, NamedSharding(mesh, s))
-
-                data = tuple(place(i, d) for i, d in enumerate(data))
-
+        def per_step(params, frozen, buffers, opt_state, lr, key, md):
             def loss_of(p, bufs_in, micro_data, micro_key):
                 import contextlib
                 inputs = [Tensor(v) for v in micro_data[:n_in]]
@@ -208,8 +232,7 @@ class DistributedRunner:
                                 loss = loss_layer(*outs, *labels)
                             else:
                                 loss = outs[0]
-                out_vals = ([o._value for o in outs]
-                            if runner.capture_outputs else [])
+                out_vals = ([o._value for o in outs] if capture else [])
                 return loss._value.astype(jnp.float32), (
                     holder.get("buffers", {}), out_vals)
 
@@ -217,8 +240,9 @@ class DistributedRunner:
                 loss_of = jax.checkpoint(loss_of)
 
             if acc == 1:
-                (loss_val, (new_buf, out_vals)), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(params, buffers, data, key)
+                (loss_val, (new_buf, out_vals)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(
+                        params, buffers, md, key)
             else:
                 # gradient accumulation (paddle gradient_merge parity):
                 # microbatch loop compiled as lax.scan, grads averaged;
@@ -226,13 +250,13 @@ class DistributedRunner:
                 # carry so each microbatch sees the previous update
                 micro = tuple(
                     d.reshape((acc, d.shape[0] // acc) + d.shape[1:])
-                    for d in data)
+                    for d in md)
 
                 def body(carry, xs):
                     g_acc, l_acc, bufs_c = carry
-                    md, mk = xs
+                    mdd, mk = xs
                     (l, (nb, ov)), g = jax.value_and_grad(
-                        loss_of, has_aux=True)(params, bufs_c, md, mk)
+                        loss_of, has_aux=True)(params, bufs_c, mdd, mk)
                     bufs_c = {**bufs_c, **nb}
                     g_acc = jax.tree_util.tree_map(
                         lambda a, b: a + b, g_acc, g)
@@ -270,6 +294,33 @@ class DistributedRunner:
                 n: jax.lax.with_sharding_constraint(
                     v, NamedSharding(mesh, runner._pspecs.get(n, P())))
                 for n, v in new_params.items()}
+            mstats = (tuple(mf(out_vals[0], md[n_in])
+                            for mf in metric_fns)
+                      if metric_fns and len(md) > n_in and out_vals
+                      else ())
+            return (loss_val, mstats, out_vals, new_params, new_state,
+                    new_buf)
+
+        return per_step
+
+    def _build(self):
+        runner = self
+
+        # base key drawn once per runner and SHARED with the folded
+        # entry; per-step keys derived INSIDE the compiled program from
+        # the step counter (saves two host-dispatched device ops per
+        # step, and makes fold=K bit-identical to K per-step dispatches)
+        base_key = self._ensure_base_key()
+
+        def step(params, frozen, buffers, opt_state, lr, ctr, *data):
+            key = jax.random.fold_in(base_key, ctr)
+            data = runner._place_with_specs(
+                data, runner._data_pspecs([d.shape for d in data],
+                                          stacked=False))
+            per_step = runner._step_math(runner._n_inputs)
+            loss_val, _mstats, out_vals, new_params, new_state, \
+                new_buf = per_step(params, frozen, buffers, opt_state,
+                                   lr, key, data)
             return loss_val, new_params, new_state, new_buf, out_vals
 
         return jax.jit(step, donate_argnums=(0, 3))
@@ -447,6 +498,153 @@ class DistributedRunner:
         external writes win over superseded step results."""
         self._val_cache = None
         self._wrappers_dirty = False
+
+    # -- folded dispatch (the unified engine, framework/dispatch.py) ---------
+    def _ensure_base_key(self):
+        """Base PRNG key drawn ONCE per runner (at the first compiled-
+        step build) and shared by the per-step and folded entries, so
+        both consume the identical ``fold_in(base_key, ctr)`` key
+        sequence — the parity contract of the unified engine."""
+        if self._base_key is None:
+            self._base_key = _random.default_generator().draw_key()
+        return self._base_key
+
+    def _stacked_shardings(self, sample):
+        """Per-position ``NamedSharding`` for a stacked ``[K, ...]``
+        fold group (the same specs as the in-program placement, via
+        ``_data_pspecs``): leading fold axis unsharded, batch dim on
+        the data axes, seq dim on 'sep' — host staging lands the group
+        directly on its data layout instead of paying an in-program
+        reshard of the whole stack.  None when the mesh has no data
+        axes (nothing to pre-place)."""
+        specs = self._data_pspecs([d.shape for d in sample],
+                                  stacked=True)
+        if specs is None:
+            return None
+        return [NamedSharding(self.mesh, P() if s is None else s)
+                for s in specs]
+
+    def _build_fold(self, fold: int, n_in: int, metric_fns):
+        """The mesh fold program: the shared per-step body
+        (:meth:`_step_math` — the SAME body the legacy entry compiles,
+        so the two cannot drift) wrapped for the scan builder
+        (``framework.dispatch.build_folded_step``), plus the in-step
+        metric stat vectors that ride the folded carry.  Buffers are
+        NOT donated: the runner's cached value dicts alias them across
+        dispatches."""
+        runner = self
+        step_math = self._step_math(n_in, metric_fns)
+
+        def per_step(p, frozen, bufs, st, lr, key, md):
+            loss_val, mstats, _out_vals, new_p, new_st, new_buf = \
+                step_math(p, frozen, bufs, st, lr, key, md)
+            return loss_val, mstats, new_p, new_st, new_buf
+
+        def place_data(data):
+            # stacked [K, ...] layout: specs from the per-step shapes
+            return runner._place_with_specs(
+                data, runner._data_pspecs([d.shape[1:] for d in data],
+                                          stacked=True))
+
+        from ..framework.dispatch import build_folded_step
+        return build_folded_step(per_step, fold, donate_buffers=False,
+                                 place_data=place_data)
+
+    def train_steps_folded(self, groups, metric_fns=(),
+                           metric_acc=None):
+        """ONE rolled scan-of-K dispatch covering ``len(groups)``
+        logical train steps over the mesh — the mesh half of the
+        unified dispatch engine.  ``groups`` is ``[(inputs, labels),
+        ...]``; returns ``(losses, mstacks, new_metric_acc)`` with the
+        per-step losses/metric stats as shared-fetch ``LazyStack``s.
+        The scan carry is the donated SHARDED state (params/opt_state)
+        plus the device metric accumulators; per-step PRNG keys derive
+        from the same ``(base_key, ctr)`` sequence the per-step entry
+        consumes, so the end state is bit-identical for every K —
+        including K=1 against the legacy per-step path."""
+        prev_mesh = coll.get_mesh()
+        coll.set_mesh(self.mesh)
+        try:
+            return self._train_steps_folded_inner(groups, metric_fns,
+                                                  metric_acc)
+        finally:
+            coll.set_mesh(prev_mesh)
+
+    def _train_steps_folded_inner(self, groups, metric_fns, metric_acc):
+        if not self._placed:
+            self.place()
+        fold = len(groups)
+        n_in = len(groups[0][0])
+        if getattr(self, "_n_inputs", None) is None:
+            self._n_inputs = n_in
+        elif self._n_inputs != n_in:
+            raise ValueError(
+                f"DistributedRunner was compiled for {self._n_inputs} "
+                f"inputs, got {n_in}; create a new runner")
+        flat = [list(ins) + list(lbs) for ins, lbs in groups]
+        # ONE batched async H2D put for the whole [K, ...] group,
+        # pre-placed on the data shardings (io/staging.py)
+        stacked = stack_to_device(flat,
+                                  shardings=self._stacked_shardings(
+                                      flat[0]))
+        sig = (fold, len(metric_fns),
+               tuple((v.shape, v.dtype) for v in stacked))
+        fn = self._fold_cache.get(sig)
+        if fn is None:
+            fn = self._fold_cache[sig] = self._build_fold(
+                fold, n_in, metric_fns)
+        params, frozen, bufs = self._sync_val_cache()
+        lr = jnp.asarray(self.optimizer.get_lr(), dtype=jnp.float32)
+        ctr0 = getattr(self, "_step_ctr", 0) + 1
+        macc = tuple(metric_acc) if metric_acc is not None else ()
+        losses, mstacks, new_acc, new_p, new_st, new_buf = fn(
+            params, frozen, bufs, self._opt_state, macc, lr,
+            self._ensure_base_key(), np.uint32(ctr0), *stacked)
+        if self._defer_wrapper_sync:
+            # hot-loop mode (hapi fit): the cached value dicts are the
+            # canonical copy; wrapper rebinds wait for the boundary
+            params.update(new_p)
+            self._wrappers_dirty = True
+        else:
+            for n, v in new_p.items():
+                self._name_to_param[n]._value = v
+                params[n] = v
+                self._wrapper_snap[n] = v
+        self._opt_state = new_st
+        self.optimizer._opt_state_tree = new_st
+        if hasattr(self.optimizer, "_global_step"):
+            self.optimizer._global_step += fold
+        for n, v in new_buf.items():
+            b = self._name_to_buf.get(n)
+            if b is None:
+                continue
+            bufs[n] = v
+            if self._defer_wrapper_sync:
+                self._wrappers_dirty = True
+            else:
+                b._value = v
+                self._buf_snap[n] = v
+        # resilience hooks tick ONCE per dispatch, with the logical
+        # step count advanced by the fold factor K
+        self._step_ctr = ctr0 + fold - 1
+        _watchdog.notify_step(self._step_ctr)
+        _faults.fault_point("train.step", step=self._step_ctr)
+        from ..framework.lazy import LazyStack
+        return (LazyStack(losses), [LazyStack(s) for s in mstacks],
+                tuple(new_acc))
+
+    def compile_stats(self):
+        """Recompile introspection for the folded mesh path (mirrors
+        ``Model.compile_stats``): one fold-cache entry per (fold,
+        metric-arity, shapes, dtypes) signature; growth on a fixed
+        workload means silent retracing."""
+        traces = 0
+        for fn in self._fold_cache.values():
+            try:
+                traces += fn._cache_size()
+            except Exception:
+                pass
+        return {"entries": len(self._fold_cache), "traces": traces}
 
     # -- eval / predict ------------------------------------------------------
     def _eval_build(self, with_loss: bool, n_in: int):

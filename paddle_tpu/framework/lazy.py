@@ -36,6 +36,16 @@ class LazyStack:
             self._dev = None
         return self._host
 
+    def block(self):
+        """Wait for the stack's device value WITHOUT fetching it — the
+        dispatch engine's auto-K calibration probe
+        (framework/dispatch.py) separates host dispatch overhead from
+        device step time this way during the first few groups of a
+        fit.  Not a hot-loop entry point."""
+        if self._dev is not None:
+            import jax
+            jax.block_until_ready(self._dev)
+
 
 class LazyScalar:
     """Device scalar with on-demand host materialization.

@@ -16,7 +16,7 @@ epoch/save/eval boundaries), compiled steps are cached per
 ride through the callbacks as ``LazyScalar`` — only a callback that
 actually formats a value pays the device→host sync.
 
-Step folding (DESIGN-PERF.md §Step folding): ``fit(...,
+Step folding (DESIGN-PERF.md §Unified dispatch engine): ``fit(...,
 steps_per_dispatch=K)`` amortizes the remaining per-step host work —
 jit dispatch, ``refresh()``, callback round-trip — over K logical
 steps: K batches stack along a new leading axis through one batched
@@ -24,7 +24,12 @@ steps: K batches stack along a new leading axis through one batched
 back-to-back on device, carrying the donated state plus the metric
 accumulators.  Per-step PRNG keys derive in-program from
 ``(base_key, counter + i)``, so results are bit-identical to K
-single-step dispatches.
+single-step dispatches.  The engine itself lives in
+``framework/dispatch.py`` and is shared with ``DistributedRunner`` —
+a fit on a device mesh dispatches the same scan-of-K shape, with a
+sharded carry.  K defaults to AUTO: the first few groups measure the
+dispatch-overhead/step-time ratio and pick K to cap host overhead at
+a target fraction (``framework.dispatch.AutoFoldTuner``).
 """
 
 from __future__ import annotations
@@ -47,13 +52,10 @@ from ..framework.io import save as _save, load as _load
 from ..framework.lazy import LazyStack
 from ..optimizer.lr import LRScheduler
 from ..io.staging import to_device_values, stack_to_device
+from ..framework.dispatch import (AutoFoldTuner, GroupDispatcher,
+                                  build_folded_step)
 from . import callbacks as cbk_mod
 from .train_state import TrainState, LazyScalar
-
-# default fold factor when fit() may batch dispatches freely (no
-# callback consumes per-step logs); chosen to amortize the ~1 ms of
-# per-step host work without delaying epoch-boundary work noticeably
-_DEFAULT_FOLD = 8
 
 _resilience_mods = None
 
@@ -91,9 +93,11 @@ class Model:
         self._runner = None
         self._accumulate = 1
         # resolved steps_per_dispatch of the current/last fit (0 =
-        # legacy per-step entry, K>=1 = fold engine with groups of K);
-        # logical step counter feeding the resilience hooks
+        # legacy per-step entry, K>=1 = fold engine with groups of K;
+        # under auto-K the tuner starts at 1 and the decided K lands
+        # here); logical step counter feeding the resilience hooks
         self._fold = 0
+        self._fold_tuner = None
         self._fit_step_ctr = 0
         self.stop_training = False
 
@@ -295,71 +299,50 @@ class Model:
                        donate_argnums=(0, 2, 3) if donate else ())
 
     def _build_jit_fold_step(self, n_in, fold):
-        """ONE compiled program running ``fold`` train steps as a
-        ``lax.scan`` over batches stacked on a new leading axis.  The
-        carry is the donated state (params/buffers/opt_state) plus the
-        device-resident metric accumulators; per-step PRNG keys derive
-        in-program from (base_key, ctr0 + i) — bit-identical to the
-        key sequence the single-step entry consumes."""
+        """The single-chip fold program: the shared engine
+        (``framework/dispatch.py::build_folded_step``) wraps this
+        pure per-step body in the rolled ``lax.scan`` whose carry is
+        the donated state (params/buffers/opt_state) plus the
+        device-resident metric accumulators, with per-step PRNG keys
+        derived in-program from (base_key, ctr0 + i) — bit-identical
+        to the key sequence the single-step entry consumes.  The mesh
+        path (``DistributedRunner._build_fold``) feeds the same engine
+        its sharded step body."""
         opt = self._optimizer
         net = self.network
         metric_fns = self._device_metric_fns()
         decay_coeffs, l1_coeffs, lr_scales = \
             opt._per_param_coeffs(dict(net.named_parameters()))
 
-        def step(params, frozen, buffers, opt_state, macc, lr, base_key,
-                 ctr0, *data):
-            def body(carry, xs):
-                p, bufs, st, acc = carry
-                i, md = xs
-                key = jax.random.fold_in(base_key, ctr0 + i)
-                inputs = [Tensor(v) for v in md[:n_in]]
-                labels = [Tensor(v) for v in md[n_in:]]
+        def per_step(p, frozen, bufs, st, lr, key, md):
+            inputs = [Tensor(v) for v in md[:n_in]]
+            labels = [Tensor(v) for v in md[n_in:]]
 
-                def loss_fn(pp):
-                    with F.bind(net, pp, bufs, frozen) as holder:
-                        from ..autograd import tape as _tape
-                        with _tape.no_grad_ctx():
-                            with _random.key_provider(
-                                    _random.make_split_provider(key)):
-                                loss, outs = self._forward_with_loss(
-                                    inputs, labels)
-                    new_buf = holder.get("buffers", {})
-                    return loss._value.astype(jnp.float32), (
-                        [o._value for o in outs], new_buf)
+            def loss_fn(pp):
+                with F.bind(net, pp, bufs, frozen) as holder:
+                    from ..autograd import tape as _tape
+                    with _tape.no_grad_ctx():
+                        with _random.key_provider(
+                                _random.make_split_provider(key)):
+                            loss, outs = self._forward_with_loss(
+                                inputs, labels)
+                new_buf = holder.get("buffers", {})
+                return loss._value.astype(jnp.float32), (
+                    [o._value for o in outs], new_buf)
 
-                (loss_val, (out_vals, new_buf)), grads = \
-                    jax.value_and_grad(loss_fn, has_aux=True)(p)
-                new_p, new_st = opt.apply_gradients_tree(
-                    p, grads, st, lr,
-                    decay_coeffs=decay_coeffs, lr_scales=lr_scales,
-                    l1_coeffs=l1_coeffs)
-                bufs = {**bufs, **new_buf}
-                mstats = (tuple(mf(out_vals[0], md[n_in])
-                                for mf in metric_fns)
-                          if metric_fns and len(md) > n_in and out_vals
-                          else ())
-                if mstats:
-                    acc = tuple(a + s for a, s in zip(acc, mstats))
-                return (new_p, bufs, new_st, acc), (loss_val, mstats)
+            (loss_val, (out_vals, new_buf)), grads = \
+                jax.value_and_grad(loss_fn, has_aux=True)(p)
+            new_p, new_st = opt.apply_gradients_tree(
+                p, grads, st, lr,
+                decay_coeffs=decay_coeffs, lr_scales=lr_scales,
+                l1_coeffs=l1_coeffs)
+            mstats = (tuple(mf(out_vals[0], md[n_in])
+                            for mf in metric_fns)
+                      if metric_fns and len(md) > n_in and out_vals
+                      else ())
+            return loss_val, mstats, new_p, new_st, new_buf
 
-            # the scan stays ROLLED on purpose: the loop body compiles
-            # once, identically for every fold length, which is what
-            # makes fold=K bit-identical to fold=1 — the fold engine
-            # dispatches scan programs for EVERY group it runs,
-            # including trailing partials (scan-of-P) and fold=1
-            # (scan-of-1), so all of them execute the same body
-            idx = jnp.arange(fold, dtype=jnp.uint32)
-            (new_params, new_buf, new_opt_state, new_acc), \
-                (losses, mstacks) = jax.lax.scan(
-                    body, (params, dict(buffers), opt_state, macc),
-                    (idx, tuple(data)))
-            return (losses, mstacks, new_acc, new_params, new_opt_state,
-                    new_buf)
-
-        # the whole carry is donated: params/buffers/opt_state AND the
-        # metric accumulators update in place across the K steps
-        return jax.jit(step, donate_argnums=(0, 2, 3, 4))
+        return build_folded_step(per_step, fold)
 
     def _build_jit_eval_step(self, n_in):
         net = self.network
@@ -455,11 +438,16 @@ class Model:
 
     def _train_batch_folded(self, groups):
         """ONE compiled ``lax.scan`` dispatch covering ``len(groups)``
-        logical train steps (DESIGN-PERF.md §Step folding).  Returns
-        (losses, metric stacks) as shared-fetch ``LazyStack``s — the
-        per-step callback values are index-sliced views that cost one
-        device→host transfer per dispatch group, only when formatted.
-        """
+        logical train steps (DESIGN-PERF.md §Unified dispatch engine).
+        Returns (losses, metric stacks) as shared-fetch ``LazyStack``s
+        — the per-step callback values are index-sliced views that
+        cost one device→host transfer per dispatch group, only when
+        formatted.  On a device mesh the same dispatch shape runs
+        through ``DistributedRunner.train_steps_folded`` with a
+        sharded carry."""
+        runner = self._mesh_runner()
+        if runner is not None:
+            return self._train_batch_folded_mesh(runner, groups)
         from ..profiler import RecordEvent
         with RecordEvent("train_batch_folded"):
             self.network.train()
@@ -489,6 +477,38 @@ class Model:
             self._tick_resilience(fold)
             return LazyStack(losses), [LazyStack(s) for s in mstacks]
 
+    def _train_batch_folded_mesh(self, runner, groups):
+        """The mesh half of the unified dispatch engine: the runner
+        dispatches ONE scan-of-K program whose carry is the donated
+        SHARDED state plus the device metric accumulators.  The
+        runner owns the commit (deferred wrapper write-back, step
+        counter, watchdog/fault tick advanced by K); fit only tracks
+        the logical step count for its own bookkeeping."""
+        from ..profiler import RecordEvent
+        with RecordEvent("train_batch_folded"):
+            self.network.train()
+            fold = len(groups)
+            if runner._metric_acc is None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                # replicate the zero accumulators on the mesh up
+                # front: the scan returns them mesh-replicated, and a
+                # default-device init would force one retrace when the
+                # sharding flips on the second dispatch
+                rep = NamedSharding(runner.mesh, PartitionSpec())
+                runner._metric_acc = tuple(
+                    jax.device_put(m.device_acc_init(), rep)
+                    for m in self._metrics)
+            losses, mstacks, new_acc = runner.train_steps_folded(
+                groups, metric_fns=self._device_metric_fns(),
+                metric_acc=runner._metric_acc)
+            runner._metric_acc = new_acc
+            for m, acc in zip(self._metrics, new_acc):
+                m.adopt_device_acc(acc)
+            # the runner already ticked the resilience hooks; keep
+            # fit's logical counter aligned for its own consumers
+            self._fit_step_ctr += fold
+            return losses, mstacks
+
     def _train_batch_eager(self, inputs_v, labels_v, update=True):
         inputs = [Tensor(v) for v in inputs_v]
         labels = [Tensor(v) for v in labels_v]
@@ -497,6 +517,10 @@ class Model:
         if update:
             self._optimizer.step()
             self._optimizer.clear_grad()
+            if self._in_fit:
+                # eager fits feed the (default-on) hang watchdog and
+                # the train.step fault site too, like the jit path
+                self._tick_resilience(1)
         metrics = self._update_metrics([o._value for o in outs], labels_v)
         return self._format_loss(loss._value), metrics
 
@@ -635,14 +659,24 @@ class Model:
             verbose=verbose, metrics=self._metrics_name())
 
         self._fold = self._resolve_fold(steps_per_dispatch, cbks)
-        if self._fold > 1 and isinstance(train_loader, DataLoader):
+        if isinstance(train_loader, DataLoader):
             # the prefetcher defers per-batch device staging: the fold
-            # engine's stacked device_put is the single H2D point
-            train_loader._fold_hint = self._fold
+            # engine's stacked device_put is the single H2D point.
+            # Under auto-K the decided fold is not known yet — the
+            # tuner's bound stands in (the hint only picks the staging
+            # strategy, any value > 1 defers)
+            hint = (self._fold_tuner.max_fold
+                    if self._fold_tuner is not None else self._fold)
+            if hint > 1:
+                train_loader._fold_hint = hint
 
-        cbks.on_begin("train")
         self._in_fit = True
+        wd = None
         try:
+            # armed INSIDE the try so a raising on_begin callback can't
+            # leak an installed watchdog past the fit
+            wd = self._arm_fit_watchdog()
+            cbks.on_begin("train")
             for epoch in range(epochs):
                 if hasattr(train_loader, "batch_sampler") and hasattr(
                         train_loader.batch_sampler, "set_epoch"):
@@ -666,6 +700,11 @@ class Model:
             self._sync_train_state()
             if isinstance(train_loader, DataLoader):
                 train_loader._fold_hint = 1
+            if self._fold_tuner is not None and self._fold_tuner.decided:
+                # expose the decided K (bench/test introspection; a
+                # later fit re-resolves from scratch)
+                self._fold = self._fold_tuner.fold
+            self._disarm_fit_watchdog(wd)
         cbks.on_end("train")
 
     def _resolve_fold(self, requested, cbks):
@@ -675,19 +714,16 @@ class Model:
         ``K >= 1`` = the fold engine, which dispatches EVERY group —
         full (scan-of-K), trailing partial (scan-of-P) and K=1
         (scan-of-1) — through the same rolled-scan body, so the end
-        state is bit-identical for every K.  Auto (``None``) resolves
-        to 1 when a callback consumes per-step logs, else
-        ``_DEFAULT_FOLD``."""
+        state is bit-identical for every K.  The mesh path folds too
+        (the runner dispatches the same scan shape with a sharded
+        carry).  Auto (``None``) resolves to 1 when a callback
+        consumes per-step logs; otherwise an ``AutoFoldTuner``
+        calibrates K from the measured dispatch-overhead/step-time
+        ratio during the first few groups."""
+        self._fold_tuner = None
         if requested is not None and int(requested) <= 0:
             return 0   # explicit legacy escape
         if not self._use_jit or self._optimizer is None:
-            return 0
-        if self._mesh_runner() is not None:
-            if requested is not None and int(requested) > 1:
-                warnings.warn(
-                    "fit(steps_per_dispatch>1): the mesh path "
-                    "dispatches through DistributedRunner per step; "
-                    "running unfolded")
             return 0
         if any(not getattr(m, "supports_device_update", False)
                for m in self._metrics):
@@ -723,7 +759,46 @@ class Model:
                              "on_train_batch_begin",
                              "on_train_batch_end")):
                 return 1       # user hook consumes per-step events
-        return _DEFAULT_FOLD
+        # no per-step consumer: let the tuner pick K from measured
+        # dispatch economics (groups start at 1 while calibrating)
+        self._fold_tuner = AutoFoldTuner()
+        return 1
+
+    # -- default fit watchdog ------------------------------------------------
+    def _arm_fit_watchdog(self):
+        """Default-on hang watchdog for fit (ROADMAP availability
+        item): a wedged training loop dumps all-thread stacks instead
+        of stalling silently.  Opt out with
+        ``PADDLE_TPU_FIT_WATCHDOG=0``; timeout via
+        ``PADDLE_TPU_FIT_WATCHDOG_TIMEOUT_S`` (default 1800 s —
+        generous because the first dispatch of each signature
+        compiles).  Diagnostic by default (``exit_code=None`` — dump,
+        don't kill); the full save-and-exit watchdog comes from
+        ``fleet.enable_resilience``, and an already-installed
+        resilience watchdog always wins.  The watchdog's
+        ``train.step`` site ticks once per dispatch with the logical
+        step count advanced by K on both the single-chip and mesh
+        paths (``_tick_resilience`` /
+        ``DistributedRunner.train_steps_folded``)."""
+        if os.environ.get("PADDLE_TPU_FIT_WATCHDOG", "1").lower() in (
+                "0", "false", "no"):
+            return None
+        watchdog, _ = _resilience()
+        if watchdog.current_watchdog() is not None:
+            return None
+        timeout = float(os.environ.get(
+            "PADDLE_TPU_FIT_WATCHDOG_TIMEOUT_S", "1800"))
+        wd = watchdog.HangWatchdog(timeout=timeout, exit_code=None)
+        watchdog.install_watchdog(wd.start())
+        return wd
+
+    def _disarm_fit_watchdog(self, wd):
+        if wd is None:
+            return
+        watchdog, _ = _resilience()
+        wd.stop()
+        if watchdog.current_watchdog() is wd:
+            watchdog.install_watchdog(None)
 
     def _run_one_epoch(self, loader, cbks, mode, num_iters=None):
         self._reset_metrics()
@@ -736,12 +811,11 @@ class Model:
         # warning (same effect as drop_last for the last step).
         k = self._accumulate if mode == "train" else 1
         # step folding: buffer up to `fold` logical steps (each already
-        # an accumulate group) and run them as ONE lax.scan dispatch;
-        # fold == 0 selects the legacy per-step entry
+        # an accumulate group) and run them as ONE lax.scan dispatch
+        # through the shared engine (framework/dispatch.py); fold == 0
+        # selects the legacy per-step entry
         fold = self._fold if mode == "train" else 0
         pending: List[Any] = []
-        group: List[Any] = []
-        group_sig = [None]   # data signature shared by the open group
 
         def _cat(parts):
             arrs = [[np.asarray(p[i].numpy() if isinstance(p[i], Tensor)
@@ -757,24 +831,12 @@ class Model:
             logs["step"] = step
             cbks.on_batch_end(mode, step, logs)
 
-        def _group_sig(inputs, labels):
-            return tuple(tuple(v.shape) for v in (*inputs, *labels))
-
-        def _flush_group():
-            """Dispatch the buffered fold group through ONE compiled
-            scan — a trailing partial group runs scan-of-P over the
-            same body, so the end state is bit-identical for every
-            grouping — then replay the per-logical-step callbacks in
-            order with index-sliced lazy values.  Buffered accumulate
-            intermediates (``ins is None``) carry no compute; they
-            replay in order so callbacks see a monotone step series."""
-            if not group:
-                return
-            entries, group[:] = group[:], []
-            logical = [(s, i, l) for s, i, l in entries if i is not None]
-            losses, mstacks = (self._train_batch_folded(
-                [(ins, lbs) for _, ins, lbs in logical])
-                if logical else (None, []))
+        def _emit_group(entries, losses, mstacks):
+            """Replay the dispatched group's per-logical-step callbacks
+            in order with index-sliced lazy values.  Buffered
+            accumulate intermediates (``ins is None``) carry no
+            compute; they replay in order so callbacks see a monotone
+            step series."""
             gi = 0
             for step, ins, lbs in entries:
                 cbks.on_batch_begin(mode, step, logs)
@@ -787,6 +849,20 @@ class Model:
                            for j, m in enumerate(self._metrics)]
                 _emit(step, loss, metrics, ins)
                 gi += 1
+
+        engine = None
+        if fold >= 1:
+            engine = GroupDispatcher(self._train_batch_folded,
+                                     _emit_group, fold=fold,
+                                     tuner=self._fold_tuner)
+        # under auto-K, fit() primed the loader's fold hint with the
+        # tuner's BOUND; once the tuner decides, re-point the hint at
+        # the actual K so a device-bound K=1 decision restores the
+        # prefetcher's eager per-batch staging overlap
+        from ..io import DataLoader
+        hint_loader = (loader if engine is not None
+                       and self._fold_tuner is not None
+                       and isinstance(loader, DataLoader) else None)
 
         for step, data in enumerate(loader):
             if num_iters is not None and step >= num_iters:
@@ -804,11 +880,11 @@ class Model:
                 if k > 1:
                     pending.append((inputs, labels))
                     if len(pending) < k:
-                        if fold >= 1 and group:
+                        if engine is not None and engine.pending:
                             # an accumulate intermediate between
                             # buffered logical steps: defer its
                             # callbacks too, keeping step order
-                            group.append((step, None, None))
+                            engine.feed_marker(step)
                         else:
                             cbks.on_batch_begin(mode, step, logs)
                             logs["step"] = step
@@ -817,21 +893,13 @@ class Model:
                     inputs = _cat([p[0] for p in pending])
                     labels = _cat([p[1] for p in pending])
                     pending = []
-                if fold >= 1:
-                    sig = _group_sig(inputs, labels)
-                    n_logical = sum(1 for _, i, _l in group
-                                    if i is not None)
-                    if group and sig != group_sig[0]:
-                        # shape change (uneven trailing batch, bucketed
-                        # loader): scan the homogeneous prefix now — a
-                        # group must stack along one leading axis
-                        _flush_group()
-                        n_logical = 0
-                    if not group:
-                        group_sig[0] = sig
-                    group.append((step, inputs, labels))
-                    if n_logical + 1 >= fold:
-                        _flush_group()
+                if engine is not None:
+                    engine.feed(step, inputs, labels)
+                    if hint_loader is not None and \
+                            self._fold_tuner.decided:
+                        hint_loader._fold_hint = max(
+                            1, self._fold_tuner.fold)
+                        hint_loader = None   # write once
                     continue
                 cbks.on_batch_begin(mode, step, logs)
                 loss, metrics = self.train_batch(inputs, labels)
@@ -840,7 +908,8 @@ class Model:
             cbks.on_batch_begin(mode, step, logs)
             loss, metrics = self.eval_batch(inputs, labels)
             _emit(step, loss, metrics, inputs)
-        _flush_group()
+        if engine is not None:
+            engine.flush()
         if pending:
             warnings.warn(
                 f"fit(accumulate_grad_batches={k}): dropping trailing "
@@ -974,3 +1043,5 @@ class Model:
         if self._train_state is not None:
             # fresh device accumulators next folded dispatch
             self._train_state.metric_acc = None
+        if self._runner is not None:
+            self._runner._metric_acc = None

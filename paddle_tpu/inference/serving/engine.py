@@ -30,7 +30,16 @@ EOS is detected ON DEVICE (``done`` rides the loop); the host learns
 of it at ``done_poll_interval`` dispatch boundaries via the single
 sanctioned ``_poll_done`` sync.  Between EOS and poll a finished
 request wastes masked lanes — the classic poll-cadence/occupancy
-trade-off, see DESIGN-SERVING.md §EOS.
+trade-off, see DESIGN-SERVING.md §EOS.  The interval is AUTO-TUNED by
+default from observed dispatch economics, exactly like the training
+engine's fold factor (``framework.dispatch.AutoFoldTuner``): the
+first few polls measure the PURE poll cost (an empty-chain poll —
+queue-drain time is device compute, not poll overhead) and the
+amortized per-dispatch wall time, then the interval is frozen at the
+smallest value whose amortized poll overhead is at most
+``PADDLE_TPU_SERVING_POLL_TARGET`` (default 5%) of the dispatch
+time, bounded by ``PADDLE_TPU_SERVING_POLL_MAX`` (default 64).  An
+explicit ``done_poll_interval=`` stays fixed.
 """
 
 from __future__ import annotations
@@ -97,7 +106,8 @@ class DecodeEngine:
                  max_blocks_per_seq: Optional[int] = None,
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None, pad_id: int = 0,
-                 done_poll_interval: int = 8, max_queue: int = 64):
+                 done_poll_interval: Optional[int] = None,
+                 max_queue: int = 64):
         if network is not None:
             params = extract_decode_params(network)
             gpt_config = network.config
@@ -112,7 +122,23 @@ class DecodeEngine:
         self.block_size = int(block_size)
         self.eos_id = eos_id
         self.pad_id = int(pad_id)
-        self.done_poll_interval = max(1, int(done_poll_interval))
+        # None = auto-tune the poll cadence from measured dispatch
+        # economics — the SAME calibrate/median/clamp policy as the
+        # training engine's fold factor (AutoFoldTuner): start at 8,
+        # calibrate over the first few polls, freeze
+        from ...framework.dispatch import (AutoFoldTuner, _env_float,
+                                           _env_int)
+        self._poll_auto = done_poll_interval is None
+        self.done_poll_interval = (8 if self._poll_auto
+                                   else max(1, int(done_poll_interval)))
+        self._poll_tuner = (AutoFoldTuner(
+            target=_env_float("PADDLE_TPU_SERVING_POLL_TARGET", 0.05),
+            max_fold=_env_int("PADDLE_TPU_SERVING_POLL_MAX", 64),
+            calib_groups=_env_int("PADDLE_TPU_SERVING_POLL_CALIB", 3))
+            if self._poll_auto else None)
+        self._poll_decision: Optional[Dict] = None
+        self._last_poll_end: Optional[float] = None
+        self._last_poll_dispatches = 0
         if max_blocks_per_seq is None:
             max_blocks_per_seq = -(-cfg.max_position // block_size)
         self.max_blocks_per_seq = int(max_blocks_per_seq)
@@ -232,7 +258,7 @@ class DecodeEngine:
             self._finalize(s)
         if self.eos_id is not None and \
                 self._dispatches % self.done_poll_interval == 0:
-            self._poll_done()
+            self._timed_poll()
         return True
 
     def run_until_idle(self, max_dispatches: int = 100_000):
@@ -306,6 +332,50 @@ class DecodeEngine:
             self._tables[s, have] = blk
 
     # -- completion ----------------------------------------------------------
+    def _timed_poll(self):
+        """Auto-tune wrapper around the poll site (auto mode only;
+        a fixed explicit interval, or a decided one, goes straight to
+        the poll).  While calibrating it polls TWICE: the first poll's
+        wall time is dominated by draining the queued dispatch chain —
+        that is device compute the loop pays either way, not poll
+        overhead — so the cost fed to the tuner is the SECOND,
+        empty-chain poll (pure sync + [B] fetch; ``_poll_done`` is
+        idempotent).  The per-dispatch unit time is the full inter-poll
+        wall including the drain.  The shared
+        :class:`~paddle_tpu.framework.dispatch.AutoFoldTuner` then
+        freezes ``done_poll_interval`` at the smallest cadence whose
+        amortized sync overhead is at most ``target`` of the dispatch
+        time — a device-bound loop correctly stays near the tight
+        cadence instead of saturating at the bound, keeping the
+        EOS→reclaim occupancy loss small (DESIGN-SERVING.md §EOS)."""
+        tuner = self._poll_tuner
+        if tuner is None or tuner.decided:
+            self._poll_done()
+            return
+        t0 = time.monotonic()
+        self._poll_done()            # drains the in-flight chain
+        t1 = time.monotonic()
+        self._poll_done()            # chain empty: pure poll cost
+        t2 = time.monotonic()
+        n = self._dispatches - self._last_poll_dispatches
+        if self._last_poll_end is not None and n > 0:
+            tuner.observe(1, t2 - t1, (t1 - self._last_poll_end) / n)
+        else:
+            # first poll: compile/warmup-shaped, tuner discards it
+            tuner.observe(1, t2 - t1, t1 - t0)
+        self._last_poll_end = t2
+        self._last_poll_dispatches = self._dispatches
+        if tuner.decided:
+            self.done_poll_interval = tuner.fold
+            d = tuner.decision
+            self._poll_decision = {
+                "done_poll_interval": self.done_poll_interval,
+                "poll_cost_ms": d["host_ms_per_step"],
+                "dispatch_ms": d["device_ms_per_step"],
+                "target": d["overhead_target"],
+                "max": d["max_fold"],
+            }
+
     def _poll_done(self):
         """THE group-boundary sync: fetch the [B] device done-mask so
         EOS'd requests free their slot/pages.  Runs every
@@ -399,6 +469,9 @@ class DecodeEngine:
               "queue_depth": self.scheduler.queue_depth,
               "dispatches": self._dispatches,
               "total_tokens": self._total_tokens,
+              "done_poll_interval": self.done_poll_interval,
               "kv": self._kv.allocator.stats()}
+        if self._poll_decision is not None:
+            st["done_poll_decision"] = dict(self._poll_decision)
         st.update(self.compile_stats())
         return st

@@ -56,15 +56,22 @@ def to_device_values(seq):
     return vals
 
 
-def stack_to_device(groups):
+def stack_to_device(groups, shardings=None):
     """Stack K same-structure batches along a new leading axis — the
-    staging path of the step-folding engine (``Model.fit``'s
-    ``steps_per_dispatch``): each tensor position becomes ONE
+    staging path of the step-folding engine (the unified
+    ``framework/dispatch.py`` path under ``Model.fit`` and
+    ``DistributedRunner``): each tensor position becomes ONE
     ``[K, ...]`` stacked device array, and every position whose K
     leaves are still host memory rides a single batched async
     ``device_put``.  Positions already device-resident (a prefetcher
     that staged eagerly, direct Tensor feeds) stack with one
     ``jnp.stack`` dispatch instead — never a device→host round trip.
+
+    ``shardings`` (mesh path): per-position ``NamedSharding`` (or None)
+    the host leaves are placed with directly, so the folded mesh
+    dispatch consumes batch arrays already laid out on their data axes
+    instead of paying an in-program reshard of the whole ``[K, ...]``
+    stack.
     """
     import jax
     import jax.numpy as jnp
@@ -89,7 +96,12 @@ def stack_to_device(groups):
         else:
             out[i] = jnp.stack([jnp.asarray(v) for v in vs])
     if host_idx:
-        placed = jax.device_put([out[i] for i in host_idx])
+        if shardings is not None:
+            placed = jax.device_put(
+                [out[i] for i in host_idx],
+                [shardings[i] for i in host_idx])
+        else:
+            placed = jax.device_put([out[i] for i in host_idx])
         for i, v in zip(host_idx, placed):
             out[i] = v
     return out

@@ -39,6 +39,10 @@ HOT_MODULES = [
     os.path.join("io", "dataloader.py"),
     os.path.join("io", "staging.py"),
     os.path.join("framework", "lazy.py"),
+    # the unified dispatch engine (DESIGN-PERF.md §Unified dispatch
+    # engine): grouping + auto-K sit directly on the hot loop for
+    # both the single-chip and mesh paths
+    os.path.join("framework", "dispatch.py"),
     # serving decode hot path (DESIGN-SERVING.md): the persistent
     # dispatch loop must never stall host↔device — same contract,
     # same guard, as the training loop
@@ -54,6 +58,14 @@ ALLOWED_SYNC = {
     ("framework", "lazy.py", "_materialize"):
         "THE deferred sync point: LazyScalar materializes on first "
         "host use (callback formatting), not per step",
+    ("framework", "lazy.py", "block"):
+        "auto-K calibration probe ONLY: waits on the device value "
+        "without fetching it, during the first calib_groups "
+        "dispatches of a fit — never steady state",
+    ("framework", "dispatch.py", "_calibration_block"):
+        "auto-K calibration ONLY: splits host dispatch overhead from "
+        "device step time over the first calib_groups dispatches; "
+        "the steady-state hot loop never enters it",
     ("hapi", "model.py", "predict_batch"):
         "public API returns numpy by contract",
     ("hapi", "model.py", "_cat"):

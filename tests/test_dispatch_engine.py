@@ -1,0 +1,237 @@
+"""Unified dispatch engine (ISSUE 7 / DESIGN-PERF.md §Unified
+dispatch engine): the mesh path dispatches the same folded scan-of-K
+programs as the single-chip path, bit-identically for every K, and
+the auto-K tuner picks the fold factor from measured dispatch
+economics.
+
+Covers the acceptance criteria:
+- ``Model.fit`` on a dp mesh at fold=1 is bit-identical to the legacy
+  per-step runner path,
+- the end state is bit-identical across K ∈ {1, 3, 8} on a dp mesh,
+- full groups + trailing partials reuse one compiled program per
+  group length on the mesh path (recompile pin),
+- auto-K math: bounds, saturation, device-bound degradation,
+  explicit ``steps_per_dispatch`` override.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import collective
+from paddle_tpu.framework.dispatch import AutoFoldTuner
+
+
+@pytest.fixture(autouse=True)
+def _clean_mesh():
+    collective.set_mesh(None)
+    yield
+    collective.set_mesh(None)
+
+
+def _need_devices(n):
+    import jax
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+
+
+def _dp_mesh(dp=2):
+    return collective.build_mesh({"dp": dp})
+
+
+def _batches(n, bs=8, din=4, classes=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [[rng.rand(bs, din).astype(np.float32),
+             rng.randint(0, classes, (bs,)).astype(np.int64)]
+            for _ in range(n)]
+
+
+def _prepared(seed=0, metrics=None):
+    paddle.seed(seed)
+    m = paddle.Model(nn.Sequential(
+        nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3)))
+    m.prepare(optimizer.Adam(1e-2, parameters=m.parameters()),
+              nn.CrossEntropyLoss(), metrics)
+    return m
+
+
+def _params(m):
+    return {n: np.asarray(p.numpy())
+            for n, p in m.network.named_parameters()}
+
+
+def _fit_state(steps_per_dispatch, n_batches=6, epochs=1):
+    collective.set_mesh(_dp_mesh())
+    m = _prepared()
+    m.fit(_batches(n_batches), epochs=epochs, verbose=0,
+          steps_per_dispatch=steps_per_dispatch)
+    return m, _params(m)
+
+
+# -- mesh fold parity --------------------------------------------------
+
+
+def test_mesh_fold1_matches_legacy_per_step_runner():
+    """fold=1 dispatches scan-of-1 programs through the unified
+    engine; steps_per_dispatch=0 is the legacy per-step runner entry.
+    Same seed, same batches -> bit-identical end state."""
+    _need_devices(2)
+    m_legacy, legacy = _fit_state(steps_per_dispatch=0)
+    m_fold, folded = _fit_state(steps_per_dispatch=1)
+    assert legacy.keys() == folded.keys()
+    for n in legacy:
+        np.testing.assert_array_equal(legacy[n], folded[n], err_msg=n)
+
+
+def test_mesh_end_state_identical_across_K():
+    """The rolled scan body is fold-length-invariant: K=1, K=3
+    (full groups + trailing partials) and K=8 (one scan-of-6 group)
+    must land the exact same weights."""
+    _need_devices(2)
+    states = {k: _fit_state(steps_per_dispatch=k)[1] for k in (1, 3, 8)}
+    for k in (3, 8):
+        for n in states[1]:
+            np.testing.assert_array_equal(
+                states[1][n], states[k][n], err_msg=f"K={k} {n}")
+
+
+def test_mesh_recompile_pin_full_and_partial_groups():
+    """5 steps/epoch at K=3 is scan-of-3 + scan-of-2 per epoch: two
+    fold-cache entries, each compiled exactly once across epochs.
+    The metric rides along so the device accumulators' mesh placement
+    is covered (a default-device init would retrace dispatch 2)."""
+    _need_devices(2)
+    collective.set_mesh(_dp_mesh())
+    m = _prepared(metrics=paddle.metric.Accuracy())
+    m.fit(_batches(5), epochs=3, verbose=0, steps_per_dispatch=3)
+    stats = m._runner.compile_stats()
+    assert stats == {"entries": 2, "traces": 2}, stats
+
+
+def test_mesh_explicit_override_and_fold_resolution():
+    """An explicit steps_per_dispatch wins over auto-K on the mesh
+    path too (no tuner armed), and the runner's logical step counter
+    advances by the fold factor per dispatch."""
+    _need_devices(2)
+    collective.set_mesh(_dp_mesh())
+    m = _prepared()
+    m.fit(_batches(6), epochs=1, verbose=0, steps_per_dispatch=3)
+    assert m._fold == 3 and m._fold_tuner is None
+    assert m._runner._step_ctr == 6
+
+
+def test_mesh_auto_K_engages():
+    """Auto (no per-step consumer) arms the tuner on the mesh path —
+    the pre-unification behavior was to silently run unfolded."""
+    _need_devices(2)
+    collective.set_mesh(_dp_mesh())
+    m = _prepared()
+    m.fit(_batches(8), epochs=1, verbose=0)
+    assert m._fold_tuner is not None and m._fold_tuner.decided
+    assert 1 <= m._fold <= m._fold_tuner.max_fold
+
+
+# -- auto-K decision math ----------------------------------------------
+
+
+def _tuned(host_ms, device_ms, **kw):
+    t = AutoFoldTuner(target=0.05, max_fold=32, calib_groups=3, **kw)
+    t.observe(1, 99.0, 99.0)     # compile dispatch: discarded
+    for _ in range(3):
+        t.observe(1, host_ms * 1e-3, device_ms * 1e-3)
+    assert t.decided
+    return t
+
+
+def test_auto_fold_picks_smallest_K_within_budget():
+    # 1 ms host / 4 ms device: K = ceil(1 / (0.05 * 4)) = 5
+    t = _tuned(host_ms=1.0, device_ms=4.0)
+    assert t.fold == 5
+    assert t.decision["fold"] == 5
+
+
+def test_auto_fold_device_bound_stays_at_1():
+    # 0.01 ms host / 10 ms device: overhead already under target
+    assert _tuned(host_ms=0.01, device_ms=10.0).fold == 1
+
+
+def test_auto_fold_host_bound_saturates_at_max():
+    # device time unmeasurably small: saturate at the bound
+    assert _tuned(host_ms=1.0, device_ms=0.0).fold == 32
+    # host overhead beyond what max_fold can amortize: same
+    assert _tuned(host_ms=100.0, device_ms=0.1).fold == 32
+
+
+def test_auto_fold_env_bounds(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FOLD_MAX", "4")
+    monkeypatch.setenv("PADDLE_TPU_FOLD_OVERHEAD_TARGET", "0.25")
+    t = AutoFoldTuner()
+    assert t.max_fold == 4 and t.target == 0.25
+    t.observe(1, 1.0, 1.0)       # compile: discarded
+    for _ in range(t.calib_groups):
+        t.observe(1, 1.0, 1e-9)  # host-bound
+    assert t.decided and t.fold == 4
+
+
+def test_auto_fold_single_chip_respects_max(monkeypatch):
+    """End-to-end: the tuner's bound caps the decided K on a real
+    (host-bound, tiny) fit."""
+    monkeypatch.setenv("PADDLE_TPU_FOLD_MAX", "3")
+    m = _prepared()
+    m.fit(_batches(10), epochs=1, verbose=0)
+    assert m._fold_tuner is not None and m._fold_tuner.decided
+    assert m._fold == 3
+
+
+# -- default fit watchdog / resilience ticks ---------------------------
+
+
+def test_fit_arms_default_watchdog(monkeypatch):
+    """Model.fit installs a diagnostic hang watchdog by default and
+    removes it at the end; PADDLE_TPU_FIT_WATCHDOG=0 opts out; an
+    already-installed (resilience) watchdog wins."""
+    from paddle_tpu.distributed.resilience import watchdog as wd
+
+    installs = []
+    orig = wd.install_watchdog
+    monkeypatch.setattr(wd, "install_watchdog",
+                        lambda w: (installs.append(w), orig(w))[1])
+    m = _prepared()
+    m.fit(_batches(2), epochs=1, verbose=0)
+    assert len(installs) == 2
+    assert installs[0] is not None and installs[1] is None
+    assert wd.current_watchdog() is None
+
+    installs.clear()
+    monkeypatch.setenv("PADDLE_TPU_FIT_WATCHDOG", "0")
+    m.fit(_batches(2), epochs=1, verbose=0)
+    assert not installs
+
+    monkeypatch.delenv("PADDLE_TPU_FIT_WATCHDOG")
+    pre = wd.HangWatchdog(timeout=60.0, exit_code=None)
+    orig(pre.start())
+    try:
+        installs.clear()
+        m.fit(_batches(2), epochs=1, verbose=0)
+        assert not installs          # resilience watchdog wins
+        assert wd.current_watchdog() is pre
+    finally:
+        pre.stop()
+        orig(None)
+
+
+def test_mesh_watchdog_ticks_once_per_dispatch_advancing_by_K(
+        monkeypatch):
+    """The runner's train.step site ticks ONCE per folded dispatch
+    with the logical step count advanced by K."""
+    _need_devices(2)
+    from paddle_tpu.distributed.resilience import watchdog as wd
+
+    steps = []
+    monkeypatch.setattr(wd, "notify_step",
+                        lambda s=None: steps.append(s))
+    collective.set_mesh(_dp_mesh())
+    m = _prepared()
+    m.fit(_batches(6), epochs=1, verbose=0, steps_per_dispatch=3)
+    assert steps == [3, 6]

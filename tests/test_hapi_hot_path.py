@@ -45,25 +45,42 @@ def _prepared_model(metrics=None, seed=0):
 
 
 def test_one_compile_across_multi_epoch_fit():
+    # explicit K: 6 steps/epoch at K=8 is one scan-of-6 group per
+    # epoch — ONE signature, compiled exactly once across 3 epochs
     m = _prepared_model(paddle.metric.Accuracy())
-    m.fit(_batches(6), epochs=3, verbose=0)
+    m.fit(_batches(6), epochs=3, verbose=0, steps_per_dispatch=8)
     stats = m.compile_stats()
     assert stats == {"entries": 1, "traces": 1}, stats
 
 
+def test_auto_k_compile_profile_across_multi_epoch_fit():
+    # default auto-K: the calibration dispatches all share ONE
+    # scan-of-1 signature, then the decided K adds the epoch-1 tail
+    # (scan-of-2) and the steady-state group (scan-of-6) — a bounded,
+    # one-time set; epochs 2..N reuse the steady-state program
+    m = _prepared_model(paddle.metric.Accuracy())
+    m.fit(_batches(6), epochs=3, verbose=0)
+    assert m._fold_tuner.decided
+    # host-bound tiny model: the tuner saturates well above the epoch
+    # length, so the group lengths (hence signatures) are deterministic
+    assert m._fold >= 6, m._fold_tuner.decision
+    stats = m.compile_stats()
+    assert stats == {"entries": 3, "traces": 3}, stats
+
+
 def test_one_extra_compile_per_batch_signature():
     m = _prepared_model()
-    m.fit(_batches(4, bs=8), epochs=2, verbose=0)
+    m.fit(_batches(4, bs=8), epochs=2, verbose=0, steps_per_dispatch=8)
     assert m.compile_stats()["traces"] == 1
     # a second distinct batch shape compiles exactly once more
-    m.fit(_batches(4, bs=4), epochs=2, verbose=0)
+    m.fit(_batches(4, bs=4), epochs=2, verbose=0, steps_per_dispatch=8)
     stats = m.compile_stats()
     assert stats == {"entries": 2, "traces": 2}, stats
     # re-running both signatures stays fully cached (same epoch length:
     # under step folding the dispatch-group length is part of the
     # signature, like the batch shape is)
-    m.fit(_batches(4, bs=8), epochs=1, verbose=0)
-    m.fit(_batches(4, bs=4), epochs=1, verbose=0)
+    m.fit(_batches(4, bs=8), epochs=1, verbose=0, steps_per_dispatch=8)
+    m.fit(_batches(4, bs=4), epochs=1, verbose=0, steps_per_dispatch=8)
     assert m.compile_stats()["traces"] == 2
 
 

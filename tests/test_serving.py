@@ -458,3 +458,33 @@ def test_compilation_cache_off_by_default():
     from paddle_tpu.framework import compile_cache
     if not os.environ.get(compile_cache.ENV_VAR, "").strip():
         assert compile_cache.active_cache_dir() is None
+
+
+def test_done_poll_interval_auto_tunes(tiny_net):
+    """Default (no explicit done_poll_interval): the engine calibrates
+    the poll cadence from observed dispatch latency over the first few
+    polls and freezes a bounded decision (ISSUE 7: the serving
+    analogue of auto-K)."""
+    net, cfg = tiny_net
+    eng = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       eos_id=999_999)   # never emitted: pure decode
+    assert eng._poll_auto and eng.done_poll_interval == 8
+    for p in ([1, 2, 3], [4, 5, 6]):
+        eng.submit(p, max_tokens=64)
+    eng.run_until_idle()
+    assert eng._poll_decision is not None
+    d = eng._poll_decision
+    assert 1 <= d["done_poll_interval"] <= eng._poll_tuner.max_fold
+    assert eng.done_poll_interval == d["done_poll_interval"]
+    assert eng.stats()["done_poll_decision"] == d
+
+
+def test_done_poll_interval_explicit_stays_fixed(tiny_net):
+    net, cfg = tiny_net
+    eng = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=64,
+                       eos_id=999_999, done_poll_interval=2)
+    assert not eng._poll_auto
+    eng.submit([1, 2, 3], max_tokens=48)
+    eng.run_until_idle()
+    assert eng.done_poll_interval == 2
+    assert eng._poll_decision is None
