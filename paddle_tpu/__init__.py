@@ -100,6 +100,7 @@ from . import jit  # noqa
 from . import static  # noqa
 from . import distributed  # noqa
 from . import framework  # noqa
+from . import observability  # noqa
 from . import profiler  # noqa
 from . import incubate  # noqa
 from . import device  # noqa

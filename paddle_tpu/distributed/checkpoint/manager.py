@@ -22,6 +22,7 @@ import json
 import os
 import signal
 import threading
+import time
 import warnings
 from typing import Any, Dict, List, Optional
 
@@ -29,6 +30,8 @@ import numpy as np
 import jax
 
 from ...tensor import Tensor
+from ...observability import metrics as _obs_metrics
+from ...observability import trace as _obs_trace
 from ..resilience import faults as _faults
 from ..resilience import retry as _retry
 
@@ -132,6 +135,26 @@ class CheckpointManager:
         committed a verification manifest (sizes + sha256 digests of
         every file in the step dir) is written alongside it, making the
         step eligible for :meth:`restore`'s verified scan."""
+        t0 = time.monotonic()
+        with _obs_trace.span("checkpoint.save",
+                             args=({"step": int(step)}
+                                   if _obs_trace.enabled() else None)):
+            saved = self._save_impl(step, model, optimizer, extra,
+                                    force)
+        if saved:
+            reg = _obs_metrics.registry()
+            reg.counter("checkpoint_saves_total",
+                        "committed checkpoint saves").inc()
+            reg.histogram("checkpoint_save_s",
+                          "checkpoint save host wall time"
+                          ).observe(time.monotonic() - t0)
+            reg.gauge("checkpoint_last_saved_step",
+                      "step of the last committed save"
+                      ).set(int(step))
+        return saved
+
+    def _save_impl(self, step: int, model, optimizer, extra,
+                   force: bool) -> bool:
         import orbax.checkpoint as ocp
         # orbax cross-thread hazard (ROADMAP resilience follow-up): all
         # ASYNC saves must be issued from ONE thread.  A save arriving
@@ -409,6 +432,24 @@ class CheckpointManager:
         ``_quarantined/``, never deleted — so the resumed run can
         re-save those step numbers while the bytes stay
         recoverable."""
+        t0 = time.monotonic()
+        with _obs_trace.span("checkpoint.restore"):
+            restored = self._restore_scan(model, optimizer, step,
+                                          verified_only)
+        reg = _obs_metrics.registry()
+        reg.counter("checkpoint_restores_total",
+                    "checkpoint restore attempts that returned"
+                    ).inc()
+        reg.histogram("checkpoint_restore_s",
+                      "checkpoint restore host wall time"
+                      ).observe(time.monotonic() - t0)
+        reg.gauge("checkpoint_last_restored_step",
+                  "step returned by the last restore (0 = none)"
+                  ).set(int(restored))
+        return restored
+
+    def _restore_scan(self, model, optimizer, step,
+                      verified_only: bool) -> int:
         if step is not None:
             return self._restore_step(int(step), model, optimizer)
         self._flush_manifests()

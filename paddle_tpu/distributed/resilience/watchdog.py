@@ -8,7 +8,11 @@ stall) burns its whole reservation: the launch master only reacts to
 checks progress, and when no step lands within ``timeout`` seconds it
 
 1. dumps all-thread Python stacks (``faulthandler``) to stderr and
-   ``dump_path`` — the post-mortem for *where* it wedged,
+   ``dump_path``, plus the live observability span stack of every
+   traced thread (``observability.trace.live_spans``) — the
+   post-mortem names both *where* (Python frames) and *which phase*
+   (``dispatch.group`` / ``serving.prefill`` / ``checkpoint.save``…)
+   it wedged in,
 2. runs ``on_hang`` (typically force-save a checkpoint), and
 3. ``os._exit(exit_code)`` so the launch watchdog sees a dead rank,
    kills the pod, and relaunches with checkpoint-resume.
@@ -99,6 +103,7 @@ class HangWatchdog:
         msg = (f"[watchdog] no training step for {stalled:.1f}s "
                f"(timeout {self.timeout}s, last step "
                f"{self._last_step}); dumping all thread stacks\n")
+        msg += self._span_dump()
         sys.stderr.write(msg)
         sys.stderr.flush()
         try:
@@ -113,6 +118,25 @@ class HangWatchdog:
                     faulthandler.dump_traceback(file=f, all_threads=True)
             except OSError:
                 pass
+
+    @staticmethod
+    def _span_dump() -> str:
+        """The live observability span stack per thread — phase
+        attribution for the hang ("wedged inside dispatch.group", not
+        just a Python frame in jax internals).  Reads only host state
+        (the recorder's live lists); a wedged device can't wedge the
+        dump.  Empty when tracing is disabled or nothing is open."""
+        try:
+            from ...observability import trace as _obs_trace
+            live = _obs_trace.live_spans()
+        except Exception:
+            return ""
+        if not live:
+            return ""
+        lines = ["[watchdog] live trace spans (outermost -> innermost):"]
+        for thread_label, stack in sorted(live.items()):
+            lines.append(f"  {thread_label}: " + " > ".join(stack))
+        return "\n".join(lines) + "\n"
 
 
 # -- process-global hookup (the runner notifies whoever is installed) --------

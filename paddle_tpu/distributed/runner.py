@@ -21,6 +21,7 @@ and bench.py.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
@@ -36,6 +37,20 @@ from . import collective as coll
 from .fleet.meta_parallel.sharding_parallel import shard_spec_for
 from .resilience import faults as _faults
 from .resilience import watchdog as _watchdog
+from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
+
+
+def _observe_mesh_steps(n_steps: int, wall_s: float):
+    """Always-on mesh dispatch profiling: host wall time + step count
+    per compiled dispatch (host floats only — no device sync)."""
+    reg = _obs_metrics.registry()
+    reg.counter("mesh_steps_total",
+                "logical train steps dispatched on the mesh"
+                ).inc(n_steps)
+    reg.histogram("mesh_dispatch_wall_s",
+                  "host wall time per mesh dispatch (device work is "
+                  "async)").observe(wall_s)
 
 
 _data_axes = coll.data_axes
@@ -333,7 +348,11 @@ class DistributedRunner:
         prev_mesh = coll.get_mesh()
         coll.set_mesh(self.mesh)
         try:
-            return self._train_step_inner(inputs, labels)
+            t0 = time.perf_counter()
+            with _obs_trace.span("mesh.dispatch"):
+                out = self._train_step_inner(inputs, labels)
+            _observe_mesh_steps(1, time.perf_counter() - t0)
+            return out
         finally:
             coll.set_mesh(prev_mesh)
 
@@ -565,8 +584,16 @@ class DistributedRunner:
         prev_mesh = coll.get_mesh()
         coll.set_mesh(self.mesh)
         try:
-            return self._train_steps_folded_inner(groups, metric_fns,
-                                                  metric_acc)
+            t0 = time.perf_counter()
+            with _obs_trace.span(
+                    "mesh.dispatch_folded",
+                    args=({"k": len(groups)}
+                          if _obs_trace.enabled() else None)):
+                out = self._train_steps_folded_inner(
+                    groups, metric_fns, metric_acc)
+            _observe_mesh_steps(len(groups),
+                                time.perf_counter() - t0)
+            return out
         finally:
             coll.set_mesh(prev_mesh)
 
@@ -584,9 +611,10 @@ class DistributedRunner:
         flat = [list(ins) + list(lbs) for ins, lbs in groups]
         # ONE batched async H2D put for the whole [K, ...] group,
         # pre-placed on the data shardings (io/staging.py)
-        stacked = stack_to_device(flat,
-                                  shardings=self._stacked_shardings(
-                                      flat[0]))
+        with _obs_trace.span("mesh.stage"):
+            stacked = stack_to_device(flat,
+                                      shardings=self._stacked_shardings(
+                                          flat[0]))
         sig = (fold, len(metric_fns),
                tuple((v.shape, v.dtype) for v in stacked))
         fn = self._fold_cache.get(sig)

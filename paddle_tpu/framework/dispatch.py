@@ -43,8 +43,28 @@ import time
 from typing import Any, Callable, List, Optional, Tuple
 
 from .lazy import LazyStack
+from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
 
 logger = logging.getLogger("paddle_tpu.dispatch")
+
+
+def _observe_dispatch(n_steps: int, wall_s: float):
+    """Always-on step-time profiling (DESIGN-OBSERVABILITY.md): every
+    dispatch group records its host wall time and step count into the
+    process-wide registry — host floats only, never a device value, so
+    the hot loop stays sync-free.  Instruments are fetched from the
+    registry per call (a dict hit under a lock) so a test-time
+    ``registry().reset()`` cannot orphan them."""
+    reg = _obs_metrics.registry()
+    reg.counter("dispatch_groups_total",
+                "compiled dispatch groups issued").inc()
+    reg.counter("dispatch_steps_total",
+                "logical train steps dispatched").inc(n_steps)
+    reg.histogram("dispatch_wall_s",
+                  "host wall time per dispatch group (dispatch + "
+                  "callback replay; device work is async)"
+                  ).observe(wall_s)
 
 
 def _env_float(name: str, default: float) -> float:
@@ -209,6 +229,17 @@ class AutoFoldTuner:
                     "-> steps_per_dispatch=%d (target %.0f%%, max %d)",
                     host * 1e3, step * 1e3, self.fold,
                     self.target * 100, self.max_fold)
+        # calibration numbers used to die here (ISSUE 8 motivation);
+        # now they land on the registry for any scrape to read
+        reg = _obs_metrics.registry()
+        reg.gauge("dispatch_auto_fold",
+                  "auto-tuned steps_per_dispatch K").set(self.fold)
+        reg.gauge("dispatch_host_ms_per_step",
+                  "measured host overhead per step (calibration)"
+                  ).set(round(host * 1e3, 4))
+        reg.gauge("dispatch_device_ms_per_step",
+                  "measured device time per step (calibration)"
+                  ).set(round(step * 1e3, 4))
 
 
 # -- host-side grouping ---------------------------------------------------
@@ -287,18 +318,27 @@ class GroupDispatcher:
             self._emit(entries, None, [])
             return
         tuner = self.tuner
+        sp = _obs_trace.span(
+            "dispatch.group",
+            args=({"steps": len(logical), "fold": self.fold}
+                  if _obs_trace.enabled() else None))
         if tuner is not None and not tuner.decided:
-            t0 = time.perf_counter()
-            losses, mstacks = self._run(logical)
-            t1 = time.perf_counter()
-            self._calibration_block(losses)
-            t2 = time.perf_counter()
-            self._emit(entries, losses, mstacks)
-            t3 = time.perf_counter()
+            with sp:
+                t0 = time.perf_counter()
+                losses, mstacks = self._run(logical)
+                t1 = time.perf_counter()
+                self._calibration_block(losses)
+                t2 = time.perf_counter()
+                self._emit(entries, losses, mstacks)
+                t3 = time.perf_counter()
             tuner.observe(len(logical), (t1 - t0) + (t3 - t2), t2 - t1)
+            _observe_dispatch(len(logical), t3 - t0)
             return
-        losses, mstacks = self._run(logical)
-        self._emit(entries, losses, mstacks)
+        t0 = time.perf_counter()
+        with sp:
+            losses, mstacks = self._run(logical)
+            self._emit(entries, losses, mstacks)
+        _observe_dispatch(len(logical), time.perf_counter() - t0)
 
     @staticmethod
     def _calibration_block(losses):

@@ -54,6 +54,8 @@ from ..optimizer.lr import LRScheduler
 from ..io.staging import to_device_values, stack_to_device
 from ..framework.dispatch import (AutoFoldTuner, GroupDispatcher,
                                   build_folded_step)
+from ..observability import metrics as _obs_metrics
+from ..observability import trace as _obs_trace
 from . import callbacks as cbk_mod
 from .train_state import TrainState, LazyScalar
 
@@ -234,10 +236,11 @@ class Model:
         state (reference writes only — no device transfer).  On the
         mesh path the DistributedRunner defers its per-step wrapper
         write-back the same way; its boundary sync rides along here."""
-        if self._train_state is not None:
-            self._train_state.sync_to_layers()
-        if self._runner is not None:
-            self._runner.sync_to_layers()
+        with _obs_trace.span("fit.sync_boundary"):
+            if self._train_state is not None:
+                self._train_state.sync_to_layers()
+            if self._runner is not None:
+                self._runner.sync_to_layers()
 
     def _device_metric_fns(self):
         """Pure per-batch stat fns of the device-capable metrics — they
@@ -381,6 +384,11 @@ class Model:
             runner = self._mesh_runner() if update else None
             if runner is not None:
                 loss_val, out_vals = runner.train_step(inputs_v, labels_v)
+                if self._in_fit:
+                    # runner owns the resilience hooks; fit's always-on
+                    # progress counter and loss gauge tick here
+                    self._observe_fit_steps(1)
+                    self._observe_loss(loss_val)
                 metrics = self._update_metrics(out_vals, labels_v)
                 return self._format_loss(loss_val), metrics
             if self._use_jit:
@@ -409,6 +417,7 @@ class Model:
             state.commit(new_params, new_opt_state, new_buf)
             if self._in_fit:
                 self._tick_resilience(1)
+                self._observe_loss(loss_val)
             else:
                 # direct train_batch calls keep the public contract:
                 # the Layer tree is current when the call returns.
@@ -423,6 +432,7 @@ class Model:
         the logical step count by its fold factor K.  Both hooks are
         no-ops unless resilience is armed."""
         self._fit_step_ctr += steps
+        self._observe_fit_steps(steps)
         watchdog, faults = _resilience()
         watchdog.notify_step(self._fit_step_ctr)
         faults.fault_point("train.step", step=self._fit_step_ctr)
@@ -475,7 +485,9 @@ class Model:
             for m, acc in zip(self._metrics, new_acc):
                 m.adopt_device_acc(acc)
             self._tick_resilience(fold)
-            return LazyStack(losses), [LazyStack(s) for s in mstacks]
+            stack = LazyStack(losses)
+            self._observe_loss(stack)
+            return stack, [LazyStack(s) for s in mstacks]
 
     def _train_batch_folded_mesh(self, runner, groups):
         """The mesh half of the unified dispatch engine: the runner
@@ -505,9 +517,30 @@ class Model:
             for m, acc in zip(self._metrics, new_acc):
                 m.adopt_device_acc(acc)
             # the runner already ticked the resilience hooks; keep
-            # fit's logical counter aligned for its own consumers
+            # fit's logical counter + always-on metrics aligned
             self._fit_step_ctr += fold
+            self._observe_fit_steps(fold)
+            self._observe_loss(losses)
             return losses, mstacks
+
+    def _observe_fit_steps(self, steps):
+        """Always-on fit progress counter (``fit_steps_total``) —
+        ticked on EVERY dispatch path, including the mesh paths where
+        the runner owns the resilience hooks."""
+        _obs_metrics.registry().counter(
+            "fit_steps_total", "committed logical train steps "
+            "(Model.fit, all dispatch paths)").inc(steps)
+
+    def _observe_loss(self, losses):
+        """Latest train loss onto the metrics registry as a LAZY view
+        of the dispatch's shared loss stack: the gauge holds the
+        device value and a scrape pays the (single, shared) D2H sync —
+        the hot loop never does (DESIGN-OBSERVABILITY.md)."""
+        _obs_metrics.registry().gauge(
+            "fit_loss", "last committed train-step loss "
+            "(lazy; synced at scrape)").set(
+                LazyScalar(losses, post=lambda a: (
+                    a if getattr(a, "ndim", 0) == 0 else a[-1])))
 
     def _train_batch_eager(self, inputs_v, labels_v, update=True):
         inputs = [Tensor(v) for v in inputs_v]
@@ -521,6 +554,7 @@ class Model:
                 # eager fits feed the (default-on) hang watchdog and
                 # the train.step fault site too, like the jit path
                 self._tick_resilience(1)
+                self._observe_loss(loss._value)
         metrics = self._update_metrics([o._value for o in outs], labels_v)
         return self._format_loss(loss._value), metrics
 
@@ -677,24 +711,38 @@ class Model:
             # leak an installed watchdog past the fit
             wd = self._arm_fit_watchdog()
             cbks.on_begin("train")
-            for epoch in range(epochs):
-                if hasattr(train_loader, "batch_sampler") and hasattr(
-                        train_loader.batch_sampler, "set_epoch"):
-                    train_loader.batch_sampler.set_epoch(epoch)
-                cbks.on_epoch_begin(epoch)
-                logs = self._run_one_epoch(train_loader, cbks, "train",
-                                           num_iters=num_iters)
-                # epoch boundary: Layer tree re-syncs to the
-                # device-resident state before callbacks may read it
-                self._sync_train_state()
-                cbks.on_epoch_end(epoch, logs)
-                if do_eval and epoch % eval_freq == 0:
-                    eval_logs = self.evaluate(eval_loader, verbose=0,
-                                              _callbacks=cbks)
-                    logs.update({"eval_" + k: v
-                                 for k, v in eval_logs.items()})
-                if self.stop_training:
-                    break
+            with _obs_trace.span(
+                    "fit", args=({"epochs": epochs}
+                                 if _obs_trace.enabled() else None)):
+                for epoch in range(epochs):
+                    if hasattr(train_loader, "batch_sampler") and \
+                            hasattr(train_loader.batch_sampler,
+                                    "set_epoch"):
+                        train_loader.batch_sampler.set_epoch(epoch)
+                    cbks.on_epoch_begin(epoch)
+                    with _obs_trace.span(
+                            "fit.epoch",
+                            args=({"epoch": epoch}
+                                  if _obs_trace.enabled() else None)):
+                        logs = self._run_one_epoch(
+                            train_loader, cbks, "train",
+                            num_iters=num_iters)
+                        # epoch boundary: Layer tree re-syncs to the
+                        # device-resident state before callbacks may
+                        # read it
+                        self._sync_train_state()
+                    _obs_metrics.registry().counter(
+                        "fit_epochs_total",
+                        "completed train epochs").inc()
+                    cbks.on_epoch_end(epoch, logs)
+                    if do_eval and epoch % eval_freq == 0:
+                        eval_logs = self.evaluate(eval_loader,
+                                                  verbose=0,
+                                                  _callbacks=cbks)
+                        logs.update({"eval_" + k: v
+                                     for k, v in eval_logs.items()})
+                    if self.stop_training:
+                        break
         finally:
             self._in_fit = False
             self._sync_train_state()

@@ -18,7 +18,10 @@ an unread stream costs the server nothing (framework/lazy.py).
 Backpressure: the admission queue is bounded; ``submit`` raises
 :class:`~.scheduler.QueueFull` at capacity.  Stats: ``stats()``
 reports queue depth, batch occupancy, KV-pool fragmentation, compile
-trace counts, and latency percentiles over the completed-request ring.
+trace counts, and latency/TTFT percentiles — all read back from the
+engine's children on the process-wide metrics registry
+(``paddle_tpu.observability``), so ``scrape()`` and this adapter see
+the same numbers.
 """
 
 from __future__ import annotations
@@ -29,15 +32,6 @@ from typing import Dict, Optional, Sequence
 from ...framework import compile_cache
 from .engine import DecodeEngine
 from .scheduler import QueueFull  # noqa: F401  (re-export: caller API)
-
-
-def _percentile(sorted_vals, q: float) -> float:
-    """Nearest-rank percentile of an already-sorted list."""
-    if not sorted_vals:
-        return 0.0
-    k = max(0, min(len(sorted_vals) - 1,
-                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[k]
 
 
 class LLMServer:
@@ -78,10 +72,15 @@ class LLMServer:
         self._thread.start()
         return self
 
-    def close(self):
+    def close(self, unregister_metrics: bool = False):
         """Stop the pump.  In-flight and queued requests get their
         futures failed with RuntimeError — the caller's retry tier
-        decides what survives a server teardown, not the server."""
+        decides what survives a server teardown, not the server.
+
+        The engine's registry children survive close by default
+        (Prometheus semantics: a post-mortem scrape still answers);
+        a churny caller that builds many short-lived servers passes
+        ``unregister_metrics=True`` to reclaim them."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -89,6 +88,8 @@ class LLMServer:
             self._thread.join(timeout=10.0)
             self._thread = None
         self._fail_all(RuntimeError("server closed before completion"))
+        if unregister_metrics:
+            self.engine.unregister_metrics()
 
     def __enter__(self) -> "LLMServer":
         return self
@@ -169,19 +170,21 @@ class LLMServer:
 
     # -- observability -------------------------------------------------------
     def stats(self) -> Dict[str, object]:
-        st = dict(self.engine.stats())
-        # snapshot: the pump thread appends to the ring concurrently
-        # (deque append and list() are each atomic under the GIL)
-        completed = list(self.engine._completed)
-        lat = sorted(s.latency for s in completed
-                     if s.latency is not None)
-        ttft = sorted(s.ttft for s in completed
-                      if s.ttft is not None)
-        st["completed"] = len(lat)
-        st["latency_p50_s"] = round(_percentile(lat, 50), 6)
-        st["latency_p99_s"] = round(_percentile(lat, 99), 6)
-        st["ttft_p50_s"] = round(_percentile(ttft, 50), 6)
-        st["ttft_p99_s"] = round(_percentile(ttft, 99), 6)
+        """Serving stats, read back FROM the process-wide metrics
+        registry (DESIGN-OBSERVABILITY.md): the engine records
+        latency/TTFT into its per-engine histogram children and this
+        adapter keeps the public dict shape — percentiles are
+        histogram-quantile estimates (interpolated within the landing
+        bucket) instead of an exact private ring, and the same numbers
+        are visible to ``paddle_tpu.observability.scrape()`` and the
+        Prometheus dump."""
+        eng = self.engine
+        st = dict(eng.stats())
+        st["completed"] = int(eng._h_latency.collect()["count"])
+        st["latency_p50_s"] = round(eng._h_latency.quantile(0.50), 6)
+        st["latency_p99_s"] = round(eng._h_latency.quantile(0.99), 6)
+        st["ttft_p50_s"] = round(eng._h_ttft.quantile(0.50), 6)
+        st["ttft_p99_s"] = round(eng._h_ttft.quantile(0.99), 6)
         if self._warmup_record is not None:
             st["warmup"] = self._warmup_record
         st["compilation_cache_dir"] = compile_cache.active_cache_dir()

@@ -44,8 +44,9 @@ explicit ``done_poll_interval=`` stays fixed.
 
 from __future__ import annotations
 
+import itertools
 import time
-from collections import deque
+import weakref
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -54,10 +55,18 @@ import jax.numpy as jnp
 
 from ...framework.lazy import LazyScalar, LazyStack
 from ...io.bucketing import shape_bucket
+from ...observability import metrics as _obs_metrics
+from ...observability import trace as _obs_trace
 from .decode_model import (ServingModelConfig, decode_forward,
                            extract_decode_params, prefill_forward)
 from .kv_cache import SCRATCH_BLOCK, PagedKVCache
 from .scheduler import Request, Scheduler
+
+# synthetic Chrome-trace track ids for request lifecycle spans: one
+# lane per (engine, batch slot), so concurrent requests render as
+# parallel tracks instead of interleaving on the pump thread's row
+_REQ_LANE_BASE = 1 << 40
+_engine_ids = itertools.count()
 
 
 class GenerationResult:
@@ -180,9 +189,86 @@ class DecodeEngine:
         self._join = jax.jit(
             lambda tok, done, i, v: (tok.at[i].set(v),
                                      done.at[i].set(False)))
-        self._dispatches = 0
-        self._total_tokens = 0
-        self._completed = deque(maxlen=1024)    # RequestStats ring
+        self._init_observability()
+
+    def _init_observability(self):
+        """Per-engine children on the process-wide metrics registry
+        (DESIGN-OBSERVABILITY.md): latency/TTFT as fixed-bucket
+        histograms, queue depth / occupancy / fragmentation as
+        COLLECT-TIME function gauges (zero hot-path cost; weakref so a
+        dead engine scrapes as absent, not stale), token/dispatch
+        counters on the hot path as plain host adds.  ``LLMServer.
+        stats()`` reads these back — the registry is the source of
+        truth, the ad-hoc dicts are gone.  Children persist after the
+        engine dies (counters/histograms are process-lifetime, like
+        any Prometheus client); a churny caller that builds many
+        engines reclaims them with :meth:`unregister_metrics`."""
+        ordinal = next(_engine_ids)
+        self._obs_id = f"e{ordinal}"
+        # synthetic-lane base: the process-unique ordinal (not a hash)
+        # keys the lane range, so two live engines can never interleave
+        # request spans on one track
+        self._obs_lane_base = _REQ_LANE_BASE + (ordinal << 16)
+        self._obs_labels = {"engine": self._obs_id}
+        labels = self._obs_labels
+        reg = _obs_metrics.registry()
+        self._c_dispatches = reg.counter(
+            "serving_dispatches_total",
+            "batched decode dispatches", labels=labels)
+        self._c_tokens = reg.counter(
+            "serving_tokens_total",
+            "generated tokens (eos-truncated)", labels=labels)
+        self._c_requests = reg.counter(
+            "serving_requests_completed_total",
+            "finalized requests", labels=labels)
+        self._h_latency = reg.histogram(
+            "serving_latency_s", "request submit→finish latency",
+            labels=labels)
+        self._h_ttft = reg.histogram(
+            "serving_ttft_s", "request submit→first-token latency",
+            labels=labels)
+        self._h_queue_time = reg.histogram(
+            "serving_queue_time_s", "request submit→admission wait",
+            labels=labels)
+        wr = weakref.ref(self)
+
+        def _gauge_fn(getter):
+            def fn():
+                eng = wr()
+                return None if eng is None else getter(eng)
+            return fn
+
+        reg.gauge("serving_queue_depth", "waiting requests",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: e.scheduler.queue_depth))
+        reg.gauge("serving_active", "requests in the running batch",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: e.active_count))
+        reg.gauge("serving_kv_fragmentation",
+                  "KV block-pool fragmentation [0,1]",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: e._kv.allocator.stats()
+                      ["fragmentation"]))
+        reg.gauge("serving_done_poll_interval",
+                  "dispatches between EOS polls (auto-tuned)",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: e.done_poll_interval))
+        self._obs_metric_names = (
+            "serving_dispatches_total", "serving_tokens_total",
+            "serving_requests_completed_total", "serving_latency_s",
+            "serving_ttft_s", "serving_queue_time_s",
+            "serving_queue_depth", "serving_active",
+            "serving_kv_fragmentation", "serving_done_poll_interval")
+
+    def unregister_metrics(self):
+        """Reclaim this engine's labeled children from the process-wide
+        registry.  Engine-churn hygiene: children are process-lifetime
+        by default (Prometheus semantics), so a caller that builds many
+        short-lived engines calls this when an engine is retired to
+        keep scrape output and registry memory bounded."""
+        reg = _obs_metrics.registry()
+        for name in self._obs_metric_names:
+            reg.unregister(name, labels=self._obs_labels)
 
     # -- compiled steps ------------------------------------------------------
     def _run_prefill(self, params, ids, length):
@@ -232,17 +318,21 @@ class DecodeEngine:
         if not active:
             return self.scheduler.queue_depth > 0
         self._grow_pages(active)
-        # async H2D staging of the (tiny) host-authoritative batch
-        # layout; the decode dispatch itself never syncs
-        table = jax.device_put(self._tables)
-        lengths = jax.device_put(self._lengths)
-        pool, emit, done = self._decode(self._params, self._kv.pool,
-                                        table, lengths, self._tokens,
-                                        self._done)
+        with _obs_trace.span(
+                "serving.dispatch",
+                args=({"active": len(active)}
+                      if _obs_trace.enabled() else None)):
+            # async H2D staging of the (tiny) host-authoritative batch
+            # layout; the decode dispatch itself never syncs
+            table = jax.device_put(self._tables)
+            lengths = jax.device_put(self._lengths)
+            pool, emit, done = self._decode(self._params, self._kv.pool,
+                                            table, lengths, self._tokens,
+                                            self._done)
         self._kv.swap_pool(pool)
         self._tokens = emit            # feeds back next dispatch (D2D)
         self._done = done
-        self._dispatches += 1
+        self._c_dispatches.inc()
         stack = LazyStack(emit)        # ONE shared fetch, if read
         now = time.monotonic()
         to_finish = []
@@ -257,7 +347,7 @@ class DecodeEngine:
         for s in to_finish:
             self._finalize(s)
         if self.eos_id is not None and \
-                self._dispatches % self.done_poll_interval == 0:
+                self._dispatch_count % self.done_poll_interval == 0:
             self._timed_poll()
         return True
 
@@ -287,9 +377,13 @@ class DecodeEngine:
         bucket = shape_bucket(Lp, self._buckets)
         ids = np.zeros((1, bucket), dtype=np.int32)
         ids[0, :Lp] = req.prompt
-        kv, first_tok, _ = self._prefill(self._params,
-                                         jax.device_put(ids),
-                                         np.int32(Lp))
+        with _obs_trace.span(
+                "serving.prefill",
+                args=({"bucket": bucket, "prompt_len": Lp}
+                      if _obs_trace.enabled() else None)):
+            kv, first_tok, _ = self._prefill(self._params,
+                                             jax.device_put(ids),
+                                             np.int32(Lp))
         nb_needed = self._kv.blocks_for_tokens(Lp)
         blocks = self._kv.allocator.allocate(nb_needed)
         blocks_arr = np.full(bucket // self.block_size, SCRATCH_BLOCK,
@@ -357,14 +451,14 @@ class DecodeEngine:
         t1 = time.monotonic()
         self._poll_done()            # chain empty: pure poll cost
         t2 = time.monotonic()
-        n = self._dispatches - self._last_poll_dispatches
+        n = self._dispatch_count - self._last_poll_dispatches
         if self._last_poll_end is not None and n > 0:
             tuner.observe(1, t2 - t1, (t1 - self._last_poll_end) / n)
         else:
             # first poll: compile/warmup-shaped, tuner discards it
             tuner.observe(1, t2 - t1, t1 - t0)
         self._last_poll_end = t2
-        self._last_poll_dispatches = self._dispatches
+        self._last_poll_dispatches = self._dispatch_count
         if tuner.decided:
             self.done_poll_interval = tuner.fold
             d = tuner.decision
@@ -380,7 +474,8 @@ class DecodeEngine:
         """THE group-boundary sync: fetch the [B] device done-mask so
         EOS'd requests free their slot/pages.  Runs every
         ``done_poll_interval`` dispatches, never inside one."""
-        done = np.asarray(jax.device_get(self._done))
+        with _obs_trace.span("serving.poll"):
+            done = np.asarray(jax.device_get(self._done))
         for s, req in enumerate(self._slots):
             if req is not None and bool(done[s]):
                 self._finalize(s)
@@ -402,10 +497,44 @@ class DecodeEngine:
         self._slots[slot] = None
         self._lengths[slot] = 0
         self._tables[slot, :] = SCRATCH_BLOCK
-        self._total_tokens += len(toks)
-        self._completed.append(req.stats)
+        self._observe_finalize(slot, req, len(toks))
         req.future.set_result(
             GenerationResult(req.id, toks, req.stats))
+
+    def _observe_finalize(self, slot: int, req: Request, n_toks: int):
+        """Registry + timeline record of one finished request.  The
+        lifecycle spans (queued→prefill→decode-groups→done) are
+        reconstructed RETROACTIVELY from the RequestStats milestones —
+        same monotonic clock as the live spans — on a synthetic
+        per-slot track, so Perfetto shows concurrent requests as
+        parallel lanes without the hot loop carrying span objects."""
+        st = req.stats
+        self._c_requests.inc()
+        self._c_tokens.inc(n_toks)
+        if st.latency is not None:
+            self._h_latency.observe(st.latency)
+        if st.ttft is not None:
+            self._h_ttft.observe(st.ttft)
+        if st.queue_time is not None:
+            self._h_queue_time.observe(st.queue_time)
+        if not _obs_trace.enabled():
+            return
+        lane = self._obs_lane_base + slot
+        _obs_trace.set_track_name(
+            lane, f"serving-{self._obs_id}-slot{slot}")
+        args = {"request_id": req.id, "prompt_len": st.prompt_len,
+                "generated": st.generated}
+        _obs_trace.add_span("request", st.submitted, st.finished,
+                            tid=lane, args=args)
+        if st.admitted is not None:
+            _obs_trace.add_span("request.queued", st.submitted,
+                                st.admitted, tid=lane)
+            if st.first_token is not None:
+                _obs_trace.add_span("request.prefill", st.admitted,
+                                    st.first_token, tid=lane)
+                _obs_trace.add_span("request.decode-groups",
+                                    st.first_token, st.finished,
+                                    tid=lane)
 
     # -- warmup / stats ------------------------------------------------------
     def warmup(self, prompt_lengths: Optional[Sequence[int]] = None
@@ -451,6 +580,14 @@ class DecodeEngine:
     def active_count(self) -> int:
         return sum(1 for r in self._slots if r is not None)
 
+    @property
+    def _dispatch_count(self) -> int:
+        """Dispatch count read back from the registry counter — the ONE
+        copy of this state (stats(), poll cadence, tuner deltas).
+        Always incremented with host ints, so the host-only read is
+        exact and sync-free."""
+        return int(self._c_dispatches.collect(materialize=False))
+
     def compile_stats(self) -> Dict[str, int]:
         """Recompile-pin introspection (mirrors Model.compile_stats):
         ``decode_traces`` MUST stay 1 across any join/leave pattern."""
@@ -467,8 +604,9 @@ class DecodeEngine:
     def stats(self) -> Dict[str, object]:
         st = {"active": self.active_count,
               "queue_depth": self.scheduler.queue_depth,
-              "dispatches": self._dispatches,
-              "total_tokens": self._total_tokens,
+              "dispatches": self._dispatch_count,
+              "total_tokens": int(
+                  self._c_tokens.collect(materialize=False)),
               "done_poll_interval": self.done_poll_interval,
               "kv": self._kv.allocator.stats()}
         if self._poll_decision is not None:

@@ -1,0 +1,68 @@
+"""paddle_tpu.observability — unified tracing, metrics, export
+(DESIGN-OBSERVABILITY.md).
+
+One subsystem answers "where did this step/request spend its time" on
+a live system:
+
+- :mod:`.trace`   — low-overhead span recorder (monotonic-clock ring
+  buffer, thread-aware, ~zero cost when disabled; arm with
+  ``PADDLE_TPU_TRACE=1`` or ``trace.enable()``); exports
+  Chrome/Perfetto ``trace_event`` JSON and a compact summary.
+- :mod:`.metrics` — process-wide registry of counters/gauges/
+  histograms whose hot-path instruments accept lazy device scalars
+  and defer the device→host sync to scrape time.
+- :mod:`.export`  — JSON snapshot + Prometheus text dump.
+
+Quickstart::
+
+    import paddle_tpu as paddle
+    paddle.observability.trace.enable()       # or PADDLE_TPU_TRACE=1
+    model.fit(...)                            # spans record as it runs
+    paddle.observability.trace.dump_chrome_trace("fit_trace.json")
+    print(paddle.observability.scrape())      # all metrics, one dict
+
+The training/serving hot loops are instrumented unconditionally —
+dispatch spans, auto-K gauges, request lifecycle spans, checkpoint IO
+— but record nothing until armed; step/dispatch wall-time histograms
+and counters are ALWAYS on (host floats, no device syncs).
+"""
+
+from __future__ import annotations
+
+import os as _os
+
+from . import trace  # noqa: F401
+from . import metrics  # noqa: F401
+from . import export  # noqa: F401
+from .metrics import registry  # noqa: F401
+
+__all__ = ["trace", "metrics", "export", "registry", "scrape",
+           "scrape_prometheus"]
+
+
+def scrape(materialize: bool = True):
+    """ONE dict over every metric in the process-wide registry —
+    dispatch, fit, mesh, serving, checkpoint.  ``materialize=True``
+    pays the deferred device→host syncs of lazy-valued metrics here
+    (the sanctioned sync point); the instrumented loops never sync."""
+    return export.snapshot(materialize=materialize)
+
+
+def scrape_prometheus() -> str:
+    """The registry in Prometheus text exposition format."""
+    return export.to_prometheus_text()
+
+
+# PADDLE_TPU_TRACE=1 arms the span recorder at import — i.e. before
+# any instrumented module dispatches — so "trace this run" is an env
+# var, not a code change.  Capacity knob: PADDLE_TPU_TRACE_CAPACITY.
+if _os.environ.get("PADDLE_TPU_TRACE", "").lower() in ("1", "true",
+                                                       "yes", "on"):
+    try:
+        _cap = int(_os.environ.get(
+            "PADDLE_TPU_TRACE_CAPACITY", "0") or 0)
+    except ValueError:        # malformed knob must not kill the import
+        _cap = 0
+    # nonpositive values (unset, 0, or e.g. -1) keep the default ring
+    trace.enable(capacity=_cap if _cap > 0 else None)
+    del _cap

@@ -1,0 +1,106 @@
+"""Metric + trace export surfaces (DESIGN-OBSERVABILITY.md).
+
+- :func:`snapshot` — one dict over every registered instrument, keyed
+  ``name{label="v"}``; this is what ``paddle_tpu.observability
+  .scrape()`` returns.  ``materialize=True`` (the default) pays the
+  deferred device→host syncs of lazy-valued instruments HERE — the
+  scrape is the sanctioned sync point, the instrumented loops never
+  sync.
+- :func:`to_prometheus_text` — Prometheus text exposition format
+  (``# HELP``/``# TYPE``, cumulative ``le`` buckets) for anything
+  that scrapes text endpoints.
+- :func:`dump_json` — snapshot + trace summary in one JSON file, the
+  compact per-run record bench rounds attach.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Any, Dict, Optional
+
+from . import trace as _trace
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .metrics import _escape_label_value
+from .metrics import registry as _registry
+
+__all__ = ["snapshot", "to_prometheus_text", "dump_json"]
+
+
+def snapshot(reg: Optional[MetricsRegistry] = None,
+             materialize: bool = True) -> Dict[str, Dict[str, Any]]:
+    """Scrape every instrument into one plain dict.
+
+    ``materialize=True`` flushes deferred lazy values (the ONE
+    device→host sync point of the metrics pipeline);
+    ``materialize=False`` reads only already-host state — e.g. the
+    watchdog dumping from a hung process must not block on device."""
+    reg = reg or _registry()
+    out: Dict[str, Dict[str, Any]] = {}
+    for inst in reg.instruments():
+        entry: Dict[str, Any] = {"type": inst.kind, "help": inst.help}
+        if isinstance(inst, Histogram):
+            entry.update(inst.collect(materialize=materialize))
+        else:
+            entry["value"] = inst.collect(materialize=materialize)
+        if inst.pending_dropped:
+            entry["pending_dropped"] = inst.pending_dropped
+        out[inst.key()] = entry
+    return out
+
+
+def _prom_num(v) -> str:
+    if v is None:
+        return "NaN"
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def to_prometheus_text(reg: Optional[MetricsRegistry] = None,
+                       materialize: bool = True) -> str:
+    """Prometheus text exposition of the registry."""
+    reg = reg or _registry()
+    lines = []
+    seen_header = set()
+    for inst in sorted(reg.instruments(), key=lambda i: i.key()):
+        if inst.name not in seen_header:
+            seen_header.add(inst.name)
+            if inst.help:
+                lines.append(f"# HELP {inst.name} {inst.help}")
+            lines.append(f"# TYPE {inst.name} {inst.kind}")
+        suffix = inst.labels_suffix()
+        if isinstance(inst, Histogram):
+            data = inst.collect(materialize=materialize)
+            base = dict(inst.labels)
+            for le, cum in data["buckets"]:
+                lbl = ",".join(
+                    [f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(base.items())]
+                    + [f'le="{_prom_num(le)}"'])
+                lines.append(
+                    f"{inst.name}_bucket{{{lbl}}} {cum}")
+            lines.append(f"{inst.name}_sum{suffix} "
+                         f"{_prom_num(data['sum'])}")
+            lines.append(f"{inst.name}_count{suffix} {data['count']}")
+        else:
+            v = inst.collect(materialize=materialize)
+            if v is None:
+                # valueless (dead-engine fn, unset, failed lazy):
+                # absent sample, not a NaN series forever
+                continue
+            lines.append(f"{inst.name}{suffix} {_prom_num(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def dump_json(path: str, reg: Optional[MetricsRegistry] = None) -> str:
+    """Metrics snapshot + per-span trace summary in one JSON file."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    payload = {"metrics": snapshot(reg),
+               "trace_summary": _trace.summary()}
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    return path

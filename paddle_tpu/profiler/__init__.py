@@ -1,10 +1,16 @@
 """paddle.profiler facade (parity: python/paddle/profiler/ —
-SURVEY.md §5.1).
+SURVEY.md §5.1), re-backed onto the unified observability recorder
+(DESIGN-OBSERVABILITY.md).
 
 Device side: jax.profiler → XPlane/TensorBoard (replacing CUPTI).
-Host side: the native C++ tracer (paddle_tpu/native/src/host_tracer.cc,
-replacing the reference's C++ host tracer) collects RecordEvent spans
-and exports a chrome://tracing JSON via ``export_chrome_tracing``."""
+Host side: ``Profiler`` start/stop arm :mod:`paddle_tpu.observability
+.trace` — the SAME ring buffer the dispatch engine, fit loop, mesh
+runner, serving engine and checkpoint IO record into — so a profiled
+run exports ONE timeline carrying both user ``RecordEvent``
+annotations and the framework's own spans.  ``export_chrome_tracing``
+dumps that unified timeline.  ``RecordEvent`` additionally feeds the
+native C++ tracer (paddle_tpu/native/src/host_tracer.cc) when it is
+armed, keeping the pre-existing native export path alive."""
 
 from __future__ import annotations
 
@@ -17,6 +23,7 @@ from typing import Callable, Iterable, Optional
 import jax
 
 from ..native import host_tracer as _host_tracer
+from ..observability import trace as _obs_trace
 
 
 class ProfilerTarget(enum.Enum):
@@ -56,13 +63,16 @@ def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
 
 
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
-    """on_trace_ready handler writing the host-side chrome trace
-    collected by the native tracer."""
+    """on_trace_ready handler writing the UNIFIED chrome trace — the
+    observability recorder's timeline, which carries the profiled
+    run's ``RecordEvent`` annotations alongside the framework's own
+    dispatch/fit/serving/checkpoint spans on one clock."""
     def handler(prof):
         prof._log_dir = dir_name
         os.makedirs(dir_name, exist_ok=True)
         name = worker_name or f"worker_{os.getpid()}"
-        _host_tracer.dump(os.path.join(dir_name, f"{name}.json"))
+        _obs_trace.dump_chrome_trace(
+            os.path.join(dir_name, f"{name}.json"))
     return handler
 
 
@@ -80,9 +90,23 @@ class Profiler:
         self._active = False
         self._step_times = []
         self._last_ts = None
+        self._armed_recorder = False
 
     def start(self):
         if not self._timer_only:
+            # delegate the host timeline to the unified recorder: the
+            # profiled window records into the SAME ring as the
+            # framework's own instrumentation (one timeline, ISSUE 8).
+            # Remember whether WE armed it so stop() doesn't disable a
+            # recorder the user armed via PADDLE_TPU_TRACE.
+            self._armed_recorder = not _obs_trace.enabled()
+            if self._armed_recorder:
+                # fresh window when WE arm: back-to-back profiler
+                # sessions must not leak spans into each other's
+                # export (parity with the native tracer, which
+                # cleared its buffer on every enable)
+                _obs_trace.clear()
+            _obs_trace.enable()
             _host_tracer.enable()
             try:
                 jax.profiler.start_trace(self._log_dir)
@@ -101,11 +125,19 @@ class Profiler:
         if self._on_trace_ready is not None:
             self._on_trace_ready(self)
         _host_tracer.disable()
+        if self._armed_recorder:
+            # stop recording but KEEP the ring: export and summary()
+            # read the profiled window after stop()
+            _obs_trace.disable()
+            self._armed_recorder = False
 
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
         if self._last_ts is not None:
             self._step_times.append(now - self._last_ts)
+        if _obs_trace.enabled():
+            _obs_trace.instant("profiler.step",
+                               args={"step": self._step})
         self._last_ts = now
         self._step += 1
 
@@ -117,10 +149,15 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
-        """Print step timing + host-span table aggregated from the
-        native tracer (upstream: op/kernel summary tables)."""
+        """Print step timing + host-span table (upstream: op/kernel
+        summary tables) aggregated from the unified recorder's
+        timeline, merged with any spans the native tracer still
+        holds."""
         print(self.step_info())
-        stats = host_span_stats()
+        stats = dict(_obs_trace.summary())
+        for name, s in host_span_stats().items():
+            if name not in stats:
+                stats[name] = s
         if not stats:
             return
         name_w = max(len(n) for n in stats) + 2
@@ -178,16 +215,24 @@ def host_span_stats():
 
 
 class RecordEvent:
-    """Host-side trace annotation: spans go to BOTH the native host
-    tracer (chrome trace, ~100ns when enabled) and
-    jax.profiler.TraceAnnotation (XPlane correlation)."""
+    """Host-side trace annotation: spans go to the unified
+    observability recorder (the ONE timeline, when armed), the native
+    host tracer (when enabled), and jax.profiler.TraceAnnotation
+    (XPlane correlation)."""
 
     def __init__(self, name: str, event_type=None):
         self._name = name
         self._ctx = None
         self._native = False
+        self._uspan = None
 
     def begin(self):
+        # begin() twice without end() would overwrite (and leak) the
+        # previous span/annotation window — close it first
+        if self._uspan is not None or self._ctx is not None:
+            self.end()
+        self._uspan = _obs_trace.span(self._name)
+        self._uspan.__enter__()
         if _host_tracer.enabled():
             _host_tracer.begin(self._name)
             self._native = True
@@ -201,6 +246,9 @@ class RecordEvent:
         if self._native:
             _host_tracer.end()
             self._native = False
+        if self._uspan is not None:
+            self._uspan.__exit__(None, None, None)
+            self._uspan = None
 
     def __enter__(self):
         self.begin()

@@ -51,6 +51,16 @@ HOT_MODULES = [
     os.path.join("inference", "serving", "kv_cache.py"),
     os.path.join("inference", "serving", "decode_model.py"),
     os.path.join("inference", "serving", "scheduler.py"),
+    # observability subsystem (DESIGN-OBSERVABILITY.md): it lives
+    # INSIDE every hot loop above, so it is held to the same contract
+    # — instruments hold lazy device values and defer the sync to
+    # scrape (metrics._materialize is a float() call, deliberately
+    # not a whitelisted jax sync: a device value pays its sync via
+    # the LazyScalar.__float__ sanctioned path)
+    os.path.join("observability", "__init__.py"),
+    os.path.join("observability", "trace.py"),
+    os.path.join("observability", "metrics.py"),
+    os.path.join("observability", "export.py"),
 ]
 
 # (module, enclosing function) → why this sync point is legitimate

@@ -232,7 +232,7 @@ def test_join_leave_across_groups_zero_recompiles(tiny_net):
     eng.run_until_idle()
     assert all(f.done() for f in (f2, f3, f4))
     assert eng.compile_stats()["decode_traces"] == 1
-    assert eng._dispatches >= 9
+    assert eng._dispatch_count >= 9
     # pool fully reclaimed after the churn
     st = eng._kv.allocator.stats()
     assert st["allocated"] == 0 and st["reserved"] == 0
@@ -336,7 +336,7 @@ def test_eos_truncates_and_frees_slot_early(tiny_net):
     assert eng.active_count == 0
     # fewer dispatches than max_tokens would have needed: the done
     # poll reclaimed the slot within done_poll_interval of the EOS
-    assert eng._dispatches <= cut + 1 + 2
+    assert eng._dispatch_count <= cut + 1 + 2
 
 
 def test_server_threaded_end_to_end(tiny_net):
