@@ -41,6 +41,25 @@ RETRY_ONLY_BEFORE_S = 240  # retry only if attempt 1 failed early
 AXON_HOST, AXON_PORT = "127.0.0.1", 8103
 
 
+def _emit_result(mode: str, out: dict):
+    """Print the child's RESULT record with the process-wide
+    observability snapshot attached (ROADMAP observability follow-up):
+    instead of each workload hand-rolling its own stats dict, the full
+    metrics registry + trace summary land in one
+    ``observability.export.dump_json`` file per child, and the RESULT
+    record carries its path — so a bench round's record can answer
+    anything the registry can (dispatch counts, checkpoint IO,
+    serving histograms), not just the headline numbers."""
+    try:
+        from paddle_tpu.observability import export as _obs_export
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            ".bench_obs", f"{mode}.json")
+        out[f"obs_snapshot_{mode}"] = _obs_export.dump_json(path)
+    except Exception as e:  # a metrics failure must not eat the result
+        out[f"obs_snapshot_{mode}_error"] = f"{type(e).__name__}: {e}"
+    print("RESULT " + json.dumps(out), flush=True)
+
+
 def _probe_axon(timeout=5.0):
     """Pre-flight TCP probe of the axon TPU tunnel (VERDICT r4 weak #2):
     a 0.0 bench record must distinguish tunnel-outage from code
@@ -248,7 +267,7 @@ def bench_gpt():
         out["model_tflops_per_sec"] = round(tps * flops_tok / 1e12, 2)
         out["mfu"] = round(tps * flops_tok / (peak * 1e12), 4)
         out["flops_per_token_m"] = round(flops_tok / 1e6, 1)
-    print("RESULT " + json.dumps(out), flush=True)
+    _emit_result("gpt", out)
 
 
 def bench_resnet():
@@ -280,9 +299,9 @@ def bench_resnet():
     # ResNet-50 fwd flops ~4.1 GFLOP/image at 224x224; train ~3x
     flops_img = 3.0 * 4.1e9
     peak = float(os.environ.get("GRAFT_TPU_PEAK_TFLOPS", "197"))
-    print("RESULT " + json.dumps({
+    _emit_result("resnet", {
         "images_per_sec": ips, "step_ms": round(step_ms, 2),
-        "mfu": round(ips * flops_img / (peak * 1e12), 4)}), flush=True)
+        "mfu": round(ips * flops_img / (peak * 1e12), 4)})
 
 
 def bench_ernie():
@@ -324,9 +343,9 @@ def bench_ernie():
     timings = {}
     tps, step_ms = _timed_bench(build, steps=2 if tiny else 10,
                                 timings=timings)
-    print("RESULT " + json.dumps({
+    _emit_result("ernie", {
         "tokens_per_sec": tps, "step_ms": round(step_ms, 2),
-        **timings}), flush=True)
+        **timings})
 
 
 def bench_detector():
@@ -398,10 +417,10 @@ def bench_detector():
         n += batch
     float(loss)
     dt = time.perf_counter() - t0
-    print("RESULT " + json.dumps({
+    _emit_result("detector", {
         "images_per_sec": n / dt,
         "step_ms": round(dt / steps * 1000.0, 2),
-        "buckets": list(sizes)}), flush=True)
+        "buckets": list(sizes)})
 
 
 def bench_vit():
@@ -474,10 +493,10 @@ def bench_vit():
         n += batch
     float(loss)
     dt = time.perf_counter() - t0
-    print("RESULT " + json.dumps({
+    _emit_result("vit", {
         "images_per_sec": n / dt,
         "step_ms": round(dt / steps * 1000.0, 2),
-        "buckets": list(sizes)}), flush=True)
+        "buckets": list(sizes)})
 
 
 def bench_hapi():
@@ -582,7 +601,7 @@ def bench_hapi():
         d = model._fold_tuner.decision
         out["hapi_auto_host_ms_per_step"] = d["host_ms_per_step"]
         out["hapi_auto_device_ms_per_step"] = d["device_ms_per_step"]
-    print("RESULT " + json.dumps(out), flush=True)
+    _emit_result("hapi", out)
 
 
 def bench_mesh_fold():
@@ -661,7 +680,7 @@ def bench_mesh_fold():
         if f != 1 and base:
             out[f"mesh_fold{f}_speedup"] = round(
                 out[f"mesh_fit_steps_per_sec_fold{f}"] / base, 3)
-    print("RESULT " + json.dumps(out), flush=True)
+    _emit_result("mesh_fold", out)
 
 
 def bench_serving():
@@ -722,7 +741,7 @@ def bench_serving():
     ttfts = sorted(r.stats.ttft for r in results)
     from paddle_tpu.inference.serving.api import _percentile as pct
 
-    print("RESULT " + json.dumps({
+    _emit_result("serving", {
         "serving_tokens_per_sec": round(total_tokens / wall, 1),
         "serving_requests_per_sec": round(n_requests / wall, 1),
         "serving_p50_latency_ms": round(pct(lats, 50) * 1e3, 1),
@@ -737,7 +756,7 @@ def bench_serving():
         "serving_decode_traces": stats["decode_traces"],
         "serving_kv_fragmentation": round(
             stats["kv"]["fragmentation"], 3),
-    }), flush=True)
+    })
 
 
 def bench_flash_micro():
@@ -799,7 +818,7 @@ def bench_flash_micro():
             n_lo, n_hi = (1, 5) if s >= 4096 else (2, 12)
             per = (chain(n_hi) - chain(n_lo)) / (n_hi - n_lo)
             out[f"flash_{tag}_s{s}_ms"] = round(per * 1000, 2)
-    print("RESULT " + json.dumps(out), flush=True)
+    _emit_result("flash", out)
 
 
 def _parse_result(line):

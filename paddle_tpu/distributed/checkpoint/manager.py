@@ -39,6 +39,100 @@ from ..resilience import retry as _retry
 #: whose on-disk bytes still match it (torn/corrupt dirs are skipped)
 MANIFEST_NAME = "RESILIENCE_MANIFEST.json"
 
+# -- digest policy (DESIGN-RESILIENCE.md: chunked/sampled digests) ----------
+#: files up to this size keep the legacy whole-file sha256 entry
+#: ({"size", "sha256"}), so manifests stay readable by older trees;
+#: larger files record per-chunk digests ({"size", "chunk_bytes",
+#: "chunks": {index: sha256}}) that verify by seeking — a multi-GB
+#: shard no longer forces one monolithic full-file hash pass.
+_DIGEST_CHUNK_ENV = "PADDLE_TPU_CKPT_DIGEST_CHUNK_MB"
+#: optional sampling policy: cap how many chunks of a large file are
+#: digested (0 = all chunks, the default — sampling is opt-in because
+#: it trades corruption coverage for speed).  The size check ALWAYS
+#: stays: truncation is caught regardless of which chunks sampled.
+_DIGEST_SAMPLE_ENV = "PADDLE_TPU_CKPT_DIGEST_SAMPLE_CHUNKS"
+
+
+def _digest_policy():
+    """(chunk_bytes | None, sample_chunks): ``None`` chunk size means
+    chunking is disabled (every file takes the legacy whole-file
+    digest) — both env knobs treat 0/negative as "off"."""
+    chunk_mb = float(os.environ.get(_DIGEST_CHUNK_ENV, "64") or 64)
+    sample = int(os.environ.get(_DIGEST_SAMPLE_ENV, "0") or 0)
+    chunk_bytes = max(1, int(chunk_mb * (1 << 20))) if chunk_mb > 0 \
+        else None
+    return chunk_bytes, max(0, sample)
+
+
+def _sample_indices(n_chunks: int, max_chunks: int) -> List[int]:
+    """Deterministic sampled-chunk selection: first and last chunk
+    always (header/footer corruption is the common torn-write shape),
+    the rest evenly spaced — same file size → same chunks, so
+    re-verification needs no stored policy."""
+    if max_chunks <= 0 or n_chunks <= max_chunks:
+        return list(range(n_chunks))
+    # the first+last invariant needs at least two slots on a
+    # multi-chunk file — a budget of 1 would silently stop covering
+    # footer corruption, the torn-write shape sampling exists for
+    max_chunks = max(2, max_chunks)
+    if n_chunks <= max_chunks:
+        return list(range(n_chunks))
+    picked = {round(i * (n_chunks - 1) / (max_chunks - 1))
+              for i in range(max_chunks)}
+    return sorted(picked)
+
+
+def _chunk_digest(path: str, chunk_bytes: int,
+                  indices: List[int]) -> Dict[str, str]:
+    """sha256 of the selected chunks, streamed with seeks (never the
+    whole file in memory, never bytes outside the sample)."""
+    out: Dict[str, str] = {}
+    with open(path, "rb") as f:
+        for idx in indices:
+            f.seek(idx * chunk_bytes)
+            h = hashlib.sha256()
+            remaining = chunk_bytes
+            while remaining > 0:
+                piece = f.read(min(1 << 20, remaining))
+                if not piece:
+                    break
+                h.update(piece)
+                remaining -= len(piece)
+            out[str(idx)] = h.hexdigest()
+    return out
+
+
+def _file_digest_entry(path: str) -> Dict[str, Any]:
+    """Manifest entry for one file under the current digest policy."""
+    size = os.path.getsize(path)
+    chunk_bytes, sample = _digest_policy()
+    if chunk_bytes is None or size <= chunk_bytes:
+        return {"size": size, "sha256": CheckpointManager._digest(path)}
+    n_chunks = -(-size // chunk_bytes)
+    indices = _sample_indices(n_chunks, sample)
+    return {"size": size, "chunk_bytes": chunk_bytes,
+            "chunks": _chunk_digest(path, chunk_bytes, indices)}
+
+
+def _verify_file_entry(path: str, meta: Dict[str, Any]) -> bool:
+    """True iff the on-disk bytes match a manifest entry — either the
+    legacy whole-file form or the chunked/sampled form (both remain
+    readable forever; the size check runs for both)."""
+    try:
+        if os.path.getsize(path) != meta["size"]:
+            return False
+        if "sha256" in meta:
+            return CheckpointManager._digest(path) == meta["sha256"]
+        if "chunks" in meta:
+            chunk_bytes = int(meta["chunk_bytes"])
+            indices = sorted(int(i) for i in meta["chunks"])
+            actual = _chunk_digest(path, chunk_bytes, indices)
+            return all(actual.get(str(i)) == meta["chunks"][str(i)]
+                       for i in indices)
+    except (OSError, KeyError, ValueError):
+        return False
+    return False  # unknown entry shape: never trust it
+
 
 def _to_arrays(tree):
     if isinstance(tree, Tensor):
@@ -343,8 +437,7 @@ class CheckpointManager:
         return out
 
     def _scan_files(self, step: int) -> Dict[str, Dict[str, Any]]:
-        return {rel: {"size": os.path.getsize(p),
-                      "sha256": self._digest(p)}
+        return {rel: _file_digest_entry(p)
                 for rel, p in self._walk_step_files(step).items()}
 
     def _commit_manifest(self, step: int):
@@ -378,13 +471,7 @@ class CheckpointManager:
         if set(expected) - set(actual):
             return False  # files missing (truncated dir)
         for rel, meta in expected.items():
-            p = actual[rel]
-            try:
-                if os.path.getsize(p) != meta["size"]:
-                    return False
-                if self._digest(p) != meta["sha256"]:
-                    return False
-            except OSError:
+            if not _verify_file_entry(actual[rel], meta):
                 return False
         return True
 
@@ -500,6 +587,19 @@ class CheckpointManager:
         # *read* (transient outage) are left untouched.
         self._quarantine_steps(corrupt)
         return 0
+
+    def rollback_to(self, step: int):
+        """Quarantine every saved step NEWER than ``step`` — the
+        membership-reform contract (DESIGN-RESILIENCE.md §Single-rank
+        replacement): after a promotion the survivors roll their state
+        back to the agreed resume point and will re-save those step
+        numbers; orbax refuses to overwrite an existing step dir, so
+        the newer dirs must leave the step namespace first (bytes
+        preserved in ``_quarantined/``, exactly like the torn-commit
+        path)."""
+        self._flush_manifests()
+        self._quarantine_steps(
+            [s for s in self.all_steps() if s > int(step)])
 
     def _quarantine_steps(self, steps: List[int]):
         """Move unusable step dirs aside (``_quarantined/``): clears

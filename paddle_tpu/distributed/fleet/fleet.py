@@ -146,15 +146,39 @@ class Fleet:
           through a :class:`CheckpointManager`), and exits nonzero so
           the launch master relaunches with checkpoint-resume instead
           of wedging the pod.
+        * Rank-elastic beacon: when the process was spawned by the
+          rank-elastic launch controller (``PADDLE_MEMBER_ID`` +
+          ``PADDLE_ELASTIC_SERVER`` in env), an
+          :class:`ElasticRankContext` is installed so every committed
+          step publishes the data-plane progress beacon the
+          controller's wedged-chip cross-check watches
+          (``beacon_min_interval`` rate-limits the KV PUTs).
 
         Returns the started :class:`HangWatchdog` (or None).
         """
-        from ..resilience import (faults, HangWatchdog,
+        from ..resilience import (elastic_rank, faults, HangWatchdog,
                                   install_watchdog)
         # lazy env pickup: installs PADDLE_FAULT_PLAN only when no
         # injector is active, so a programmatically installed plan
         # (faults.install) is never clobbered by an empty env
         faults.active_plan()
+        if elastic_rank.current_context() is None:
+            try:
+                ctx = elastic_rank.ElasticRankContext.from_env()
+            except Exception:
+                ctx = None  # malformed env must not break training
+            if ctx is not None and ctx.rank is not None:
+                ctx.beacon_min_interval = 0.25
+                try:
+                    elastic_rank.install_context(ctx.register())
+                except Exception as e:  # noqa: BLE001
+                    # an unreachable registry degrades liveness
+                    # reporting; it must never kill training itself
+                    import warnings
+                    warnings.warn(
+                        "enable_resilience: could not register the "
+                        f"rank beacon context ({type(e).__name__}: "
+                        f"{e}); continuing without beacons")
         if not hang_timeout:
             return None
         from ..resilience import current_watchdog

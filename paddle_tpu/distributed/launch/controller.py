@@ -1,0 +1,400 @@
+"""Rank-elastic launch controller: hot-spare promotion instead of
+whole-pod restart (DESIGN-RESILIENCE.md §Single-rank replacement).
+
+``python -m paddle_tpu.distributed.launch --nproc_per_node N
+--spares S script.py`` runs this supervisor instead of the classic
+kill-the-pod watchdog loop in ``main.py``:
+
+* N **active rank** processes are spawned with the usual paddle env
+  contract, plus the rank-elastic keys (``PADDLE_RANK_ROLE=rank``,
+  ``PADDLE_MEMBER_ID``, ``PADDLE_ELASTIC_SERVER``); S **spare**
+  processes are spawned from the *same* training script with
+  ``PADDLE_RANK_ROLE=spare`` — the worker parks in
+  ``ElasticRankContext.wait_for_promotion()`` until needed.
+* Rank failure is judged three ways, every tick:
+  1. **process exit** — nonzero return code (preemption, OOM-kill);
+  2. **heartbeat loss** — the control-plane ``FailureDetector`` over
+     the per-member KV heartbeats (host unreachable / partitioned);
+  3. **beacon stall** — the data-plane ``BeaconMonitor`` cross-check:
+     heartbeat alive but the per-step progress beacon frozen past
+     ``--beacon_timeout`` means the chip is wedged; the controller
+     SIGKILLs the zombie (only the process watchdog inside it could
+     see the wedge before; now the *outside* does too).
+* On failure the dead rank is **quarantined** (killed if still up,
+  recorded, its beacon history dropped) and a spare is **promoted**:
+  the controller writes a ``PromotionTicket`` and bumps the epoch
+  record.  Healthy ranks notice the epoch bump at their next step
+  boundary (they are already stalled in the data-plane barrier the
+  dead member abandoned), meet the promoted spare at the reform
+  barrier, agree on the newest commonly-restorable checkpoint step,
+  roll state back in-process and resume — **their processes are
+  never restarted**, which is the whole point: recovery cost is one
+  checkpoint interval on one rank, not a pod-wide relaunch.
+* Promotion routes through the ``member.promote`` fault site, so a
+  chaos plan can fail the promotion path itself; a failed attempt
+  leaves the rank queued and is retried next tick (possibly on the
+  next spare).
+
+Every decision lands on the observability registry
+(``resilience_promotions_total`` / ``resilience_quarantines_total`` /
+``resilience_wedged_total``, heartbeat/beacon lag gauges, a
+``resilience.promote`` span), so one ``scrape()`` on the controller
+answers "how degraded is this job".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ...observability import metrics as _obs_metrics
+from ...observability import trace as _obs_trace
+from ..resilience import faults as _faults
+from ..resilience.elastic_rank import PromotionTicket, kv_key
+from ..resilience.failure_detector import BeaconMonitor, FailureDetector
+
+
+@dataclass
+class _Member:
+    member_id: str
+    proc: subprocess.Popen
+    log_path: str
+    rank: Optional[int] = None     # None: parked spare
+    finished: bool = False
+    quarantined: bool = False
+
+
+@dataclass
+class _JobState:
+    epoch: int = 0
+    members: Dict[int, _Member] = field(default_factory=dict)  # rank →
+    spares: List[_Member] = field(default_factory=list)
+    quarantined: List[_Member] = field(default_factory=list)
+    pending_failures: List[int] = field(default_factory=list)  # rank ids
+
+
+class RankController:
+    """Supervises one node's active ranks + spare pool (see module
+    docstring for the protocol)."""
+
+    def __init__(self, args, client, server_endpoint: str,
+                 nproc: int, spares: int,
+                 beacon_timeout: float = 10.0,
+                 heartbeat_grace: float = 2.0,
+                 tick: float = 0.25):
+        self.args = args
+        self.client = client
+        self.server_endpoint = server_endpoint
+        self.nproc = int(nproc)
+        self.n_spares = int(spares)
+        self.beacon_timeout = float(beacon_timeout)
+        self.tick = float(tick)
+        self.state = _JobState()
+        self.job_id = args.job_id
+        # per-launch nonce: namespaces every mutable protocol key so a
+        # re-run of the same job_id against a long-lived external
+        # registry can never consume run N's stale promotion tickets,
+        # shutdown flag, epoch record, or barrier arrivals
+        self.run_id = f"{int(time.time() * 1000):x}-{os.getpid():x}"
+        self.beacons = BeaconMonitor(timeout=self.beacon_timeout)
+        self.detector = FailureDetector(
+            self._rank_heartbeat_members, np_min=1,
+            grace=heartbeat_grace)
+        self._reg = _obs_metrics.registry()
+        self._promotions = self._reg.counter(
+            "resilience_promotions_total",
+            "hot-spare promotions into a dead rank id")
+        self._quarantines = self._reg.counter(
+            "resilience_quarantines_total",
+            "ranks quarantined (exit/heartbeat/beacon)")
+        self._wedged = self._reg.counter(
+            "resilience_wedged_total",
+            "ranks killed by the beacon cross-check (heartbeat "
+            "alive, data plane frozen)")
+
+    # -- spawn ---------------------------------------------------------------
+    def _kv_key(self, *parts: str) -> str:
+        return kv_key(self.job_id, *parts, run_id=self.run_id)
+
+    def _base_env(self, endpoints: List[str], master: str) -> dict:
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINERS_NUM": str(self.nproc),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_MASTER": master,
+            "PADDLE_JOB_ID": self.job_id,
+            "PADDLE_ELASTIC_SERVER": self.server_endpoint,
+            "PADDLE_ELASTIC_RUN_ID": self.run_id,
+        })
+        return env
+
+    def _spawn(self, member_id: str, role: str, rank: Optional[int],
+               endpoints: List[str], master: str,
+               log_name: str) -> _Member:
+        _faults.fault_point("launch.spawn", member=member_id,
+                            role=role, rank=rank)
+        env = self._base_env(endpoints, master)
+        env.update({
+            "PADDLE_RANK_ROLE": role,
+            "PADDLE_MEMBER_ID": member_id,
+            "PADDLE_TRAINER_ID": str(rank if rank is not None else -1),
+        })
+        if rank is not None:
+            env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+            env["FLAGS_selected_tpus"] = str(rank)
+        log_path = os.path.join(self.args.log_dir, log_name)
+        log_f = open(log_path, "a")
+        cmd = [sys.executable, self.args.training_script] + \
+            self.args.training_script_args
+        proc = subprocess.Popen(cmd, env=env, stdout=log_f,
+                                stderr=subprocess.STDOUT)
+        return _Member(member_id=member_id, proc=proc,
+                       log_path=log_path, rank=rank)
+
+    def _publish_epoch(self):
+        rec = {"epoch": self.state.epoch,
+               "members": {str(r): m.member_id
+                           for r, m in self.state.members.items()}}
+        self.client.put(self._kv_key("epoch"), json.dumps(rec))
+
+    # -- liveness feeds ------------------------------------------------------
+    def _rank_heartbeat_members(self) -> List[str]:
+        pfx = f"{self.job_id}/"
+        return [k[len(pfx):] for k in self.client.members(pfx)]
+
+    def _poll_beacons(self):
+        now = time.monotonic()
+        for rank, m in self.state.members.items():
+            if m.finished or m.quarantined:
+                continue
+            try:
+                val = self.client.get(
+                    self._kv_key("beacon", str(rank)))
+            except Exception:
+                continue  # registry blip: no judgment this tick
+            self.beacons.observe(m.member_id, val, now=now)
+            lag = self.beacons.lag(m.member_id, now=now)
+            if lag is not None:
+                self._reg.gauge(
+                    "resilience_beacon_lag_s",
+                    "seconds since this member's progress beacon "
+                    "last changed",
+                    labels={"member": m.member_id}).set(lag)
+
+    def _poll_heartbeats(self) -> List[str]:
+        """One detector poll; also exports per-member heartbeat lag
+        (time since last seen alive).  Returns members declared
+        lost."""
+        try:
+            snap = self._rank_heartbeat_members()
+        except Exception:
+            return []  # registry outage: absence of evidence
+        events = self.detector.poll(snap)
+        now = time.time()  # detector timestamps are wall-clock
+        for m in self.state.members.values():
+            if m.finished or m.quarantined:
+                continue
+            last = self.detector.last_seen(m.member_id)
+            if last is not None:
+                self._reg.gauge(
+                    "resilience_heartbeat_lag_s",
+                    "seconds since this member's KV heartbeat was "
+                    "last observed alive",
+                    labels={"member": m.member_id}).set(now - last)
+        return [e.member for e in events if e.kind == "lost"]
+
+    # -- failure handling ----------------------------------------------------
+    def _queue_failure(self, rank: int, reason: str):
+        m = self.state.members.get(rank)
+        if m is None or m.finished or m.quarantined:
+            return
+        print(f"launch: rank {rank} ({m.member_id}) failed: {reason}",
+              file=sys.stderr, flush=True)
+        self._quarantine(m, reason)
+        if rank not in self.state.pending_failures:
+            self.state.pending_failures.append(rank)
+
+    def _quarantine(self, m: _Member, reason: str):
+        """Take the member out of service: kill what's left of its
+        process, drop its liveness history, keep the record (bytes on
+        disk and logs stay for the post-mortem — parity with the
+        checkpoint quarantine policy: remove from service, never
+        destroy evidence)."""
+        m.quarantined = True
+        if m.proc.poll() is None:
+            try:
+                m.proc.kill()   # SIGKILL: a wedged chip ignores TERM
+            except OSError:
+                pass
+        self.beacons.forget(m.member_id)
+        self.state.quarantined.append(m)
+        self._quarantines.inc()
+        if reason == "beacon":
+            self._wedged.inc()
+
+    def _try_promote(self, rank: int) -> bool:
+        """Promote the first live spare into ``rank``.  Returns True
+        when a ticket was published; the failed rank stays queued
+        otherwise (no spare live, or the promotion path itself was
+        chaos-injected) and is retried next tick."""
+        spare = next((s for s in self.state.spares
+                      if s.proc.poll() is None and not s.quarantined),
+                     None)
+        if spare is None:
+            return False
+        new_epoch = self.state.epoch + 1
+        try:
+            with _obs_trace.span("resilience.promote",
+                                 args=({"rank": rank,
+                                        "spare": spare.member_id}
+                                       if _obs_trace.enabled()
+                                       else None)):
+                _faults.fault_point("member.promote", rank=rank,
+                                    spare=spare.member_id,
+                                    epoch=new_epoch)
+                self.client.put(
+                    self._kv_key("promote", spare.member_id),
+                    PromotionTicket(rank=rank,
+                                    epoch=new_epoch).to_json())
+        except Exception as e:  # noqa: BLE001 — injected or registry
+            print(f"launch: promoting {spare.member_id} into rank "
+                  f"{rank} failed ({type(e).__name__}: {e}); will "
+                  "retry", file=sys.stderr, flush=True)
+            return False
+        self.state.spares.remove(spare)
+        spare.rank = rank
+        self.state.members[rank] = spare
+        self.state.epoch = new_epoch
+        self._publish_epoch()
+        self._promotions.inc()
+        print(f"launch: promoted spare {spare.member_id} into rank "
+              f"{rank} (epoch {new_epoch}); healthy ranks re-form at "
+              "the barrier and resume — no process restart",
+              flush=True)
+        return True
+
+    # -- main loop -----------------------------------------------------------
+    def run(self) -> int:
+        os.makedirs(self.args.log_dir, exist_ok=True)
+        # one endpoint per rank off a private base port (loopback
+        # contract identical to the classic controller)
+        from .main import _free_port
+        base_port = _free_port()
+        endpoints = [f"127.0.0.1:{base_port + i}"
+                     for i in range(self.nproc)]
+        master = self.server_endpoint
+        for r in range(self.nproc):
+            self.state.members[r] = self._spawn(
+                f"rank-{r}", "rank", r, endpoints, master,
+                f"workerlog.{r}")
+        for s in range(self.n_spares):
+            self.state.spares.append(self._spawn(
+                f"spare-{s}", "spare", None, endpoints, master,
+                f"sparelog.{s}"))
+        self._publish_epoch()
+        self.detector.poll()  # seed baseline
+        try:
+            return self._watch_loop()
+        finally:
+            self._shutdown()
+
+    def _watch_loop(self) -> int:
+        while True:
+            # 1. process exits
+            for rank, m in list(self.state.members.items()):
+                if m.finished or m.quarantined:
+                    continue
+                rc = m.proc.poll()
+                if rc is None:
+                    continue
+                if rc == 0:
+                    m.finished = True
+                    # a finished rank stops beaconing by design
+                    self.beacons.forget(m.member_id)
+                else:
+                    self._queue_failure(rank, f"exit rc={rc}")
+            # 2. control-plane heartbeat loss (host gone / partition)
+            for member in self._poll_heartbeats():
+                for rank, m in self.state.members.items():
+                    if m.member_id == member and m.proc.poll() is None:
+                        self._queue_failure(rank, "heartbeat lost")
+            # 3. data-plane cross-check: heartbeat alive, beacon frozen
+            self._poll_beacons()
+            for member in self.beacons.stalled():
+                for rank, m in list(self.state.members.items()):
+                    if m.member_id != member or m.finished:
+                        continue
+                    print("launch: data-plane cross-check: rank "
+                          f"{rank} ({member}) beacon stalled >"
+                          f" {self.beacon_timeout}s with heartbeat "
+                          "alive — wedged chip, replacing",
+                          file=sys.stderr, flush=True)
+                    self._queue_failure(rank, "beacon")
+            # 4. promotions for everything queued
+            for rank in list(self.state.pending_failures):
+                if self._try_promote(rank):
+                    self.state.pending_failures.remove(rank)
+                elif not any(s.proc.poll() is None
+                             for s in self.state.spares):
+                    print(f"launch: rank {rank} lost with no live "
+                          "spare left — job cannot re-form",
+                          file=sys.stderr, flush=True)
+                    return 1
+            # 5. completion: every rank finished cleanly
+            live = [m for m in self.state.members.values()
+                    if not m.finished]
+            if not live and not self.state.pending_failures:
+                print(f"launch: job {self.job_id} finished OK "
+                      f"(epoch {self.state.epoch}, "
+                      f"{int(self._promotions.collect())} promotions)",
+                      flush=True)
+                return 0
+            time.sleep(self.tick)
+
+    def _shutdown(self):
+        try:
+            self.client.put(self._kv_key("shutdown"), "1")
+        except Exception:
+            pass
+        for m in [*self.state.spares, *self.state.members.values()]:
+            if m.proc.poll() is None:
+                try:
+                    m.proc.send_signal(signal.SIGTERM)
+                except OSError:
+                    pass
+        deadline = time.time() + 10
+        for m in [*self.state.spares, *self.state.members.values()]:
+            while m.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if m.proc.poll() is None:
+                try:
+                    m.proc.kill()
+                except OSError:
+                    pass
+
+
+def run_rank_elastic(args) -> int:
+    """Entry point used by ``launch/main.py`` when ``--spares`` > 0."""
+    from ..fleet.elastic import KVClient, KVServer
+    nproc = args.nproc_per_node or 1
+    server = None
+    endpoint = args.elastic_server or \
+        os.environ.get("PADDLE_ELASTIC_SERVER")
+    if not endpoint or endpoint == "auto":
+        server = KVServer().start()
+        endpoint = server.endpoint
+    client = KVClient(endpoint)
+    ctl = RankController(
+        args, client, endpoint, nproc=nproc, spares=args.spares,
+        beacon_timeout=args.beacon_timeout)
+    try:
+        return ctl.run()
+    finally:
+        if server is not None:
+            server.stop()

@@ -44,6 +44,12 @@ def parse_args(argv=None):
                    dest="devices")
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--elastic_server", type=str, default=None)
+    # rank-elastic mode (DESIGN-RESILIENCE.md §Single-rank
+    # replacement): keep S hot-spare processes parked; a dead/wedged
+    # rank is quarantined and a spare promoted into its rank id
+    # WITHOUT restarting the healthy ranks.
+    p.add_argument("--spares", type=int, default=0)
+    p.add_argument("--beacon_timeout", type=float, default=10.0)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -90,6 +96,18 @@ def _kill_pod(procs: List[subprocess.Popen]):
 
 def main(argv=None):
     args = parse_args(argv)
+    if args.spares > 0:
+        # rank-elastic supervision: hot-spare promotion instead of the
+        # kill-the-pod watchdog below (controller.py).  Single-node
+        # only today — silently shrinking a multi-node request to one
+        # node would run at half the asked-for world size
+        if str(args.nnodes).split(":")[0] != "1":
+            print("launch: --spares supports single-node jobs only "
+                  f"(got --nnodes {args.nnodes}); multi-node spare "
+                  "pools are a documented follow-up", file=sys.stderr)
+            return 1
+        from .controller import run_rank_elastic
+        return run_rank_elastic(args)
     np_parts = str(args.nnodes).split(":")
     nnodes = int(np_parts[0])
     nproc = args.nproc_per_node or 1
@@ -211,6 +229,11 @@ def main(argv=None):
             if relaunch:
                 continue  # membership change doesn't count as a failure
             restarts += 1
+            from ...observability import metrics as _obs_metrics
+            _obs_metrics.registry().counter(
+                "resilience_restarts_total",
+                "whole-pod restarts by the classic launch watchdog"
+                ).inc()
             if restarts > args.max_restart:
                 print(f"launch: job failed after {restarts - 1} restarts",
                       file=sys.stderr)

@@ -10,21 +10,26 @@ the recovery paths claim to handle can be injected deterministically
 """
 
 from .faults import (FaultPlan, FaultRule, FaultInjector, InjectedFault,
-                     fault_point, should_drop, install, install_from_env,
-                     active_plan, clear)
+                     KNOWN_SITES, fault_point, should_drop, install,
+                     install_from_env, active_plan, clear)
 from .retry import (RetryExhausted, retry_call, retryable, retry_stats,
                     reset_retry_stats)
 from .watchdog import (HangWatchdog, install_watchdog, notify_step,
                        current_watchdog)
-from .failure_detector import FailureDetector, MemberEvent
+from .failure_detector import BeaconMonitor, FailureDetector, MemberEvent
+from .elastic_rank import (ElasticRankContext, PromotionTicket,
+                           current_context, install_context)
 
 __all__ = [
     "FaultPlan", "FaultRule", "FaultInjector", "InjectedFault",
+    "KNOWN_SITES",
     "fault_point", "should_drop", "install", "install_from_env",
     "active_plan", "clear",
     "RetryExhausted", "retry_call", "retryable", "retry_stats",
     "reset_retry_stats",
     "HangWatchdog", "install_watchdog", "notify_step",
     "current_watchdog",
-    "FailureDetector", "MemberEvent",
+    "BeaconMonitor", "FailureDetector", "MemberEvent",
+    "ElasticRankContext", "PromotionTicket", "current_context",
+    "install_context",
 ]

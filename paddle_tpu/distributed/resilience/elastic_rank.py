@@ -1,0 +1,384 @@
+"""Elastic single-rank replacement: the worker-side rank protocol
+(DESIGN-RESILIENCE.md §Single-rank replacement).
+
+The launch controller's rank supervisor (``launch/controller.py``)
+keeps a pool of hot-spare processes next to the active ranks.  This
+module is the *worker half* of that contract — everything a training
+process (active rank or parked spare) speaks over the elastic KV
+registry:
+
+* **heartbeat** — control-plane liveness, one per member, through the
+  existing :class:`ElasticManager` thread (TTL-evicted server-side).
+* **beacon** — data-plane liveness: a per-step progress record
+  (``beat``/``step``/``ckpt_step``/``phase``) PUT next to the
+  heartbeat.  A rank whose heartbeat is alive but whose beacon value
+  stops changing has a wedged chip — the controller's
+  :class:`~..resilience.failure_detector.BeaconMonitor` cross-checks
+  exactly this (the process-local ``HangWatchdog`` only sees its own
+  process; the beacon makes the wedge visible from *outside*).
+  Publishing routes through the droppable ``beacon.publish`` fault
+  site so chaos plans can freeze one rank's beacon while its
+  heartbeat lives on.
+* **promotion tickets** — a parked spare polls
+  ``promote/<member_id>``; the controller writes a ticket naming the
+  rank id the spare must become and the new membership epoch.
+* **epoch records** — the controller's published membership view
+  (``epoch`` key: epoch number + rank→member map).  Active ranks poll
+  it at step boundaries; an epoch bump means "membership changed —
+  park at the reform barrier".
+* **reform barrier** — after a promotion every member of the new
+  epoch meets at ``barrier/<epoch>/<rank>``, each proposing the
+  newest checkpoint step it can restore bit-exact; the agreed resume
+  point is the **min** over proposals, computed identically by every
+  member (no coordinator).  Healthy ranks roll their *state* back
+  in-process — their processes are never restarted.  Entry routes
+  through the ``barrier.reform`` fault site.
+* **step barrier** — the data-plane lockstep proxy used by chaos
+  runs on hosts without cross-process collectives: ranks wait for
+  each other at every step exactly like a dp gradient all-reduce
+  would make them, so a dead member stalls the survivors *in the
+  barrier*, where they poll the epoch key and notice the reform.
+  On a real pod the collective itself provides the stall; the
+  barrier is the CPU-sim stand-in with identical control flow.
+
+A process-global context (``install_context`` / ``notify_step``)
+mirrors the watchdog hookup: ``DistributedRunner`` feeds committed
+steps to whichever context is installed, and the context turns them
+into rate-limited beacon publishes — no-ops when nothing is
+installed, so single-process training pays one ``is None`` check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from . import faults as _faults
+
+
+def kv_key(job_id: str, *parts: str, run_id: Optional[str] = None
+           ) -> str:
+    """THE key layout of the rank-replacement protocol — built here
+    and imported by the launch controller, so the two halves can
+    never drift apart.  ``run_id`` (a per-launch nonce minted by the
+    controller, delivered via ``PADDLE_ELASTIC_RUN_ID``) namespaces
+    every mutable key: re-running a job_id against a long-lived
+    external registry must not let run N's leftover promotion
+    tickets / shutdown flag / epoch record / barrier arrivals leak
+    into run N+1.  Heartbeats stay job-scoped on purpose (TTL evicts
+    them, and a same-named member refreshes the same key)."""
+    ns = f"{job_id}@{run_id}" if run_id else job_id
+    return "/k/" + "/".join([ns, *parts])
+
+
+@dataclass
+class PromotionTicket:
+    """Controller → spare: become ``rank`` in membership ``epoch``."""
+    rank: int
+    epoch: int
+
+    def to_json(self) -> str:
+        return json.dumps({"rank": self.rank, "epoch": self.epoch})
+
+    @classmethod
+    def from_json(cls, text: str) -> "PromotionTicket":
+        d = json.loads(text)
+        return cls(rank=int(d["rank"]), epoch=int(d["epoch"]))
+
+
+class ElasticRankContext:
+    """One training process's view of the rank-replacement protocol.
+
+    ``role`` is ``"rank"`` (active trainer, ``rank`` set) or
+    ``"spare"`` (parked; ``rank`` assigned at promotion).  All state
+    lives in the job's KV registry, so a context can be rebuilt from
+    env in any incarnation (:meth:`from_env`).
+    """
+
+    def __init__(self, server: str, job_id: str, member_id: str,
+                 role: str = "rank", rank: Optional[int] = None,
+                 heartbeat_interval: float = 0.5,
+                 poll_interval: float = 0.05,
+                 beacon_min_interval: float = 0.0,
+                 run_id: Optional[str] = None):
+        from ..fleet.elastic import ElasticManager, KVClient
+        self.job_id = job_id
+        self.run_id = run_id
+        self.member_id = member_id
+        self.role = role
+        self.rank = rank
+        self.client = KVClient(server)
+        self.manager = ElasticManager(
+            server=server, job_id=job_id, node_id=member_id,
+            np="1", heartbeat_interval=heartbeat_interval)
+        self.poll_interval = float(poll_interval)
+        self.beacon_min_interval = float(beacon_min_interval)
+        self._beat = 0
+        self._last_beacon_t = 0.0
+        self._last_step = 0
+        self._last_ckpt_step = 0
+        self._reform_joined: Dict[int, bool] = {}
+        self._pending_reform_epoch: Optional[int] = None
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None
+                 ) -> Optional["ElasticRankContext"]:
+        """Build from the launch controller's env contract; None when
+        the process was not spawned under rank-elastic supervision."""
+        env = env or os.environ
+        server = env.get("PADDLE_ELASTIC_SERVER")
+        member = env.get("PADDLE_MEMBER_ID")
+        if not server or not member:
+            return None
+        role = env.get("PADDLE_RANK_ROLE", "rank")
+        rank_s = env.get("PADDLE_TRAINER_ID", "-1")
+        rank = int(rank_s) if rank_s not in ("", "-1") else None
+        return cls(server=server,
+                   job_id=env.get("PADDLE_JOB_ID", "default"),
+                   member_id=member, role=role, rank=rank,
+                   run_id=env.get("PADDLE_ELASTIC_RUN_ID") or None)
+
+    # -- key layout ----------------------------------------------------------
+    def _key(self, *parts: str) -> str:
+        return kv_key(self.job_id, *parts, run_id=self.run_id)
+
+    def _get_json(self, key: str) -> Optional[dict]:
+        raw = self.client.get(key)
+        if not raw:
+            return None
+        try:
+            return json.loads(raw)
+        except ValueError:
+            return None  # torn write: treat as absent, next poll retries
+
+    # -- control-plane liveness ---------------------------------------------
+    def register(self):
+        """Start heartbeating as this member (idempotent)."""
+        self.manager.register(payload=self.role)
+        return self
+
+    def exit(self):
+        self.manager.exit()
+
+    # -- data-plane liveness (beacon) ---------------------------------------
+    def publish_beacon(self, step: Optional[int] = None,
+                       ckpt_step: Optional[int] = None,
+                       phase: str = "train") -> bool:
+        """PUT this rank's progress beacon (monotone ``beat`` counter,
+        last committed ``step``, last saved ``ckpt_step``).  Returns
+        False when a ``beacon.publish`` drop rule ate it (the chaos
+        model of a wedged chip whose heartbeat thread still runs)."""
+        if self.rank is None:
+            return False
+        with self._lock:
+            self._beat += 1
+            if step is not None:
+                self._last_step = int(step)
+            if ckpt_step is not None:
+                self._last_ckpt_step = int(ckpt_step)
+            payload = {"beat": self._beat, "step": self._last_step,
+                       "ckpt_step": self._last_ckpt_step,
+                       "phase": phase, "member": self.member_id}
+        if _faults.should_drop("beacon.publish", member=self.member_id,
+                               rank=self.rank, step=payload["step"]):
+            return False
+        self._last_beacon_t = time.monotonic()
+        try:
+            self.client.put(self._key("beacon", str(self.rank)),
+                            json.dumps(payload))
+        except Exception:
+            return False  # registry blip: the next beat retries
+        return True
+
+    def notify_step(self, step: int, ckpt_step: Optional[int] = None):
+        """Rate-limited beacon feed for hot training loops: publishes
+        at most once per ``beacon_min_interval`` seconds (always when
+        the interval is 0)."""
+        now = time.monotonic()
+        if (self.beacon_min_interval > 0.0
+                and now - self._last_beacon_t < self.beacon_min_interval):
+            with self._lock:
+                self._last_step = int(step)
+                if ckpt_step is not None:
+                    self._last_ckpt_step = int(ckpt_step)
+            return
+        self.publish_beacon(step=step, ckpt_step=ckpt_step)
+
+    # -- membership ----------------------------------------------------------
+    def read_epoch(self) -> Optional[dict]:
+        """The controller's current membership record:
+        ``{"epoch": int, "members": {"<rank>": member_id}}``."""
+        return self._get_json(self._key("epoch"))
+
+    def shutdown_requested(self) -> bool:
+        return self.client.get(self._key("shutdown")) is not None
+
+    # -- spare side ----------------------------------------------------------
+    def wait_for_promotion(self, timeout: Optional[float] = None
+                           ) -> Optional[PromotionTicket]:
+        """Park until the controller promotes this spare (ticket) or
+        declares the job done (None).  Spares heartbeat while parked
+        so the controller can tell a live pool from a dead one."""
+        deadline = None if timeout is None else \
+            time.monotonic() + float(timeout)
+        key = self._key("promote", self.member_id)
+        while True:
+            raw = self.client.get(key)
+            if raw:
+                ticket = PromotionTicket.from_json(raw)
+                self.rank = ticket.rank
+                self.role = "rank"
+                # a ticket ALWAYS implies a reform: until the caller
+                # runs reform_barrier for this epoch, step_barrier
+                # refuses to proceed (see there) — a promoted worker
+                # that goes straight to training would otherwise sail
+                # through its dead predecessor's pre-paid step
+                # arrivals while the survivors park at the reform
+                # barrier, deadlocking the job on two different
+                # barriers
+                self._pending_reform_epoch = ticket.epoch
+                return ticket
+            if self.shutdown_requested():
+                return None
+            if deadline is not None and time.monotonic() > deadline:
+                return None
+            time.sleep(self.poll_interval)
+
+    # -- reform barrier ------------------------------------------------------
+    def reform_barrier(self, epoch: int, members: List[int],
+                       propose_step: int,
+                       timeout: float = 60.0) -> int:
+        """Meet every member of ``epoch`` at the reform barrier and
+        agree on the resume point: each member proposes the newest
+        checkpoint step it can restore bit-exact, and the barrier
+        returns ``min(proposals)`` — computed identically by every
+        member, no coordinator round-trip.  Healthy ranks call this
+        from their *running* process (state rolls back, the process
+        does not)."""
+        _faults.fault_point("barrier.reform", epoch=int(epoch),
+                            rank=self.rank, member=self.member_id)
+        self._reform_joined[int(epoch)] = True
+        if self._pending_reform_epoch is not None and \
+                int(epoch) >= self._pending_reform_epoch:
+            self._pending_reform_epoch = None
+        self.client.put(self._key("barrier", str(epoch), str(self.rank)),
+                        json.dumps({"propose": int(propose_step),
+                                    "member": self.member_id}))
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            proposals: Dict[int, int] = {}
+            for r in members:
+                d = self._get_json(
+                    self._key("barrier", str(epoch), str(r)))
+                if d is not None:
+                    proposals[int(r)] = int(d["propose"])
+            if len(proposals) == len(members):
+                return min(proposals.values())
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"reform barrier epoch={epoch}: only "
+                    f"{sorted(proposals)} of {sorted(members)} arrived")
+            # parked-at-barrier is progress, not a wedge: keep the
+            # beacon moving so the cross-check never replaces a rank
+            # that is merely waiting for its peers
+            self.publish_beacon(phase="barrier")
+            time.sleep(self.poll_interval)
+
+    # -- data-plane lockstep proxy ------------------------------------------
+    def step_barrier(self, step: int, epoch: int,
+                     timeout: float = 120.0) -> Optional[dict]:
+        """Wait for every member of ``epoch`` to arrive at ``step`` —
+        the stand-in for the dp gradient collective on hosts without
+        cross-process collectives.  Returns None once all peers
+        arrived, or the NEW epoch record if membership changed while
+        waiting (the caller must run the reform barrier).  Arrival
+        keys are per-rank, so a promoted successor inherits its
+        predecessor's already-passed steps and catches up through
+        them without re-blocking the survivors."""
+        self.client.put(self._key("steps", str(step), str(self.rank)),
+                        json.dumps({"member": self.member_id,
+                                    "epoch": int(epoch)}))
+        deadline = time.monotonic() + float(timeout)
+        while True:
+            rec = self.read_epoch()
+            if rec is None:
+                # registry blip / controller not yet published: no
+                # judgment — a barrier must never collapse to "just
+                # me" on missing evidence
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"step barrier {step}: no epoch record")
+                time.sleep(self.poll_interval)
+                continue
+            if int(rec.get("epoch", -1)) != int(epoch):
+                return rec
+            # a promoted member MUST reform before it may step: its
+            # dead predecessor's step arrivals are already on the
+            # registry, so without this gate it would sail through
+            # the step barriers while the survivors park at the
+            # reform barrier — two different barriers, deadlock
+            # (found by the /verify user-script drive)
+            if self._pending_reform_epoch is not None and \
+                    int(epoch) >= self._pending_reform_epoch:
+                return rec
+            members = [int(r) for r in rec.get("members", {})]
+            # best-effort half of the same handshake for survivors: a
+            # peer parked at the reform barrier of THIS epoch while
+            # we never joined it means the membership re-formed
+            # without us — hand control to the caller's reform path
+            if int(epoch) > 0 and not self._reform_joined.get(
+                    int(epoch)):
+                for r in members:
+                    if r == self.rank:
+                        continue
+                    if self._get_json(self._key(
+                            "barrier", str(epoch), str(r))) is not None:
+                        return rec
+            arrived = 0
+            for r in members:
+                if self._get_json(self._key(
+                        "steps", str(step), str(r))) is not None:
+                    arrived += 1
+            if arrived == len(members):
+                # beat once at barrier exit: the cross-check's frozen
+                # window for a healthy rank then spans only the step
+                # itself (incl. its first-dispatch compile), not the
+                # preceding wait
+                self.publish_beacon(phase="step_begin")
+                return None
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"step barrier {step}: {arrived}/{len(members)}")
+            self.publish_beacon(phase="step_barrier")
+            time.sleep(self.poll_interval)
+
+
+# -- process-global hookup (the runner notifies whoever is installed) --------
+_current: Optional[ElasticRankContext] = None
+
+
+def install_context(ctx: Optional[ElasticRankContext]
+                    ) -> Optional[ElasticRankContext]:
+    """Register ``ctx`` as the process rank context fed by
+    ``DistributedRunner``'s committed steps (None uninstalls)."""
+    global _current
+    _current = ctx
+    return ctx
+
+
+def current_context() -> Optional[ElasticRankContext]:
+    return _current
+
+
+def notify_step(step: Optional[int] = None,
+                ckpt_step: Optional[int] = None):
+    """Hot-loop feed: one global ``is None`` check when no context is
+    installed, a rate-limited KV PUT when one is."""
+    ctx = _current
+    if ctx is not None and step is not None:
+        ctx.notify_step(step, ckpt_step=ckpt_step)

@@ -94,6 +94,11 @@ class FailureDetector:
     def suspects(self) -> List[str]:
         return sorted(self._suspected)
 
+    def last_seen(self, member: str) -> Optional[float]:
+        """Wall-clock time the member was last observed alive (None
+        if unknown/already declared lost) — the heartbeat-lag feed."""
+        return self._known.get(member)
+
     def quorum(self) -> bool:
         return len(self._known) >= self.np_min
 
@@ -107,3 +112,62 @@ class FailureDetector:
         if not self.quorum():
             return "hold"
         return "restart"
+
+
+class BeaconMonitor:
+    """Data-plane liveness cross-check over per-step progress beacons
+    (DESIGN-RESILIENCE.md §Single-rank replacement).
+
+    The heartbeat only proves the *process* is alive; a rank whose
+    chip is wedged (collective desync, device hang) keeps
+    heartbeating from its daemon thread while making zero training
+    progress.  Each rank therefore publishes a progress *beacon* —
+    an opaque value that changes on every committed step (and on
+    every barrier beat while legitimately parked).  The monitor
+    tracks when each member's beacon value last **changed**; a member
+    observed for longer than ``timeout`` with a frozen value is
+    declared stalled.  Judgment is by value change on the observer's
+    clock, so no cross-host clock sync is needed and a parked-but-
+    beating rank is never a false positive.
+
+    Pure polling, same shape as :class:`FailureDetector`:
+    ``observe()`` each tick, ``stalled()`` for the verdict.
+    """
+
+    def __init__(self, timeout: float = 10.0):
+        self.timeout = float(timeout)
+        self._last_value: Dict[str, str] = {}
+        self._last_change: Dict[str, float] = {}
+
+    def observe(self, member: str, value: Optional[str],
+                now: Optional[float] = None):
+        """Record one poll of ``member``'s beacon.  ``value=None``
+        (beacon never published yet) is not evidence of a wedge — a
+        member is only judged once it has published at least once."""
+        if value is None:
+            return
+        now = time.monotonic() if now is None else now
+        if self._last_value.get(member) != value:
+            self._last_value[member] = value
+            self._last_change[member] = now
+
+    def lag(self, member: str, now: Optional[float] = None
+            ) -> Optional[float]:
+        """Seconds since the member's beacon last changed (None if it
+        never published)."""
+        if member not in self._last_change:
+            return None
+        now = time.monotonic() if now is None else now
+        return now - self._last_change[member]
+
+    def stalled(self, now: Optional[float] = None) -> List[str]:
+        """Members whose beacon has been frozen past ``timeout``."""
+        now = time.monotonic() if now is None else now
+        return sorted(m for m, t in self._last_change.items()
+                      if now - t >= self.timeout)
+
+    def forget(self, member: str):
+        """Drop a member's history (it was quarantined/replaced; the
+        successor starts a fresh judgment window)."""
+        self._last_value.pop(member, None)
+        self._last_change.pop(member, None)

@@ -36,6 +36,28 @@ from typing import Any, Dict, List, Optional
 
 FAULT_PLAN_ENV = "PADDLE_FAULT_PLAN"
 
+#: The central registry of injection sites wired into production code.
+#: ``scripts/check_fault_sites.py`` (run as a plain test, like the
+#: retry-coverage checker) enforces both directions: every literal
+#: ``fault_point``/``should_drop`` site in ``paddle_tpu/`` must appear
+#: here, and every name here must be wired somewhere — a typo'd site
+#: string on either side is an injection point that silently never
+#: fires, which is how a "chaos-tested" recovery path quietly stops
+#: being tested.
+KNOWN_SITES = frozenset({
+    "kv.request",          # KVClient request path (client side)
+    "kv.server",           # KV registry server handler
+    "kv.heartbeat",        # droppable: lost heartbeat on the wire
+    "checkpoint.save",     # orbax save entry
+    "checkpoint.commit",   # manifest write, strictly after data
+    "checkpoint.restore",  # orbax restore entry
+    "train.step",          # after each committed train step
+    "launch.spawn",        # pod/rank spawn in the launch controller
+    "member.promote",      # controller promotes a hot spare
+    "barrier.reform",      # member enters the membership reform barrier
+    "beacon.publish",      # droppable: rank progress beacon (wedged chip)
+})
+
 
 class InjectedFault(ConnectionError):
     """Raised by an ``error`` rule.  Subclasses ConnectionError so the

@@ -52,6 +52,18 @@ def _bump(label: str, key: str, by: int = 1):
         d = _stats.setdefault(
             label, {"attempts": 0, "retries": 0, "exhausted": 0})
         d[key] += by
+    # mirror onto the process-wide observability registry so one
+    # scrape() answers "how degraded are we" — retry traffic is IO
+    # (network / checkpoint disk), never a hot compiled loop, so the
+    # registry lookup cost is irrelevant here
+    try:
+        from ...observability import metrics as _obs_metrics
+        _obs_metrics.registry().counter(
+            f"resilience_retry_{key}_total",
+            f"retry-layer {key} by call-site label",
+            labels={"site": label}).inc(by)
+    except Exception:
+        pass  # a metrics failure must never break the retry path
 
 
 def retry_stats(label: Optional[str] = None):

@@ -35,6 +35,7 @@ from ..framework import random as _random
 from ..io.staging import to_device_values, stack_to_device
 from . import collective as coll
 from .fleet.meta_parallel.sharding_parallel import shard_spec_for
+from .resilience import elastic_rank as _elastic
 from .resilience import faults as _faults
 from .resilience import watchdog as _watchdog
 from ..observability import metrics as _obs_metrics
@@ -440,6 +441,7 @@ class DistributedRunner:
         # (progress proof) and the chaos layer (kill-at-step-N plans);
         # both are no-ops unless installed
         _watchdog.notify_step(self._step_ctr)
+        _elastic.notify_step(self._step_ctr)
         _faults.fault_point("train.step", step=self._step_ctr)
         if self.capture_outputs:
             return loss, out_vals
@@ -517,6 +519,36 @@ class DistributedRunner:
         external writes win over superseded step results."""
         self._val_cache = None
         self._wrappers_dirty = False
+        # a mid-run checkpoint restore (optimizer.set_state_dict)
+        # rebuilds optimizer._opt_state_tree, but the compiled step
+        # consumes self._opt_state — without re-adoption the resumed
+        # trajectory silently trains on STALE moments (found by the
+        # single-rank-replacement reform e2e: loss off by 1e-3, not
+        # bit-identical).  Identity-compare is sound because every
+        # committed step re-binds _opt_state_tree to _opt_state.
+        restored = getattr(self.optimizer, "_opt_state_tree", None)
+        if (self._placed and restored is not None
+                and restored is not self._opt_state):
+            if set(restored) == set(self._pspecs):
+                placed = {}
+                for n, st in restored.items():
+                    pspec = self._pspecs.get(n, P())
+                    placed[n] = {
+                        k: self._shard(v, self._state_spec(pspec, v))
+                        for k, v in st.items()}
+                self._opt_state = placed
+                self.optimizer._opt_state_tree = placed
+            else:
+                # mirror place()'s loud behavior: silently keeping the
+                # pre-restore device moments is exactly the stale-
+                # moments divergence this re-adoption exists to close
+                import warnings
+                diff = sorted(set(restored) ^ set(self._pspecs))[:8]
+                warnings.warn(
+                    "DistributedRunner.invalidate_cache: externally "
+                    "restored optimizer state keys do not match this "
+                    "network's parameters; keeping the current device "
+                    f"moments (key diff sample: {diff})")
 
     # -- folded dispatch (the unified engine, framework/dispatch.py) ---------
     def _ensure_base_key(self):
@@ -656,6 +688,7 @@ class DistributedRunner:
         # step count advanced by the fold factor K
         self._step_ctr = ctr0 + fold - 1
         _watchdog.notify_step(self._step_ctr)
+        _elastic.notify_step(self._step_ctr)
         _faults.fault_point("train.step", step=self._step_ctr)
         from ..framework.lazy import LazyStack
         return (LazyStack(losses), [LazyStack(s) for s in mstacks],

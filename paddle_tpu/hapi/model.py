@@ -63,11 +63,13 @@ _resilience_mods = None
 
 
 def _resilience():
-    """watchdog/faults hooks, imported lazily (no-ops unless armed)."""
+    """watchdog/faults/beacon hooks, imported lazily (no-ops unless
+    armed)."""
     global _resilience_mods
     if _resilience_mods is None:
-        from ..distributed.resilience import faults, watchdog
-        _resilience_mods = (watchdog, faults)
+        from ..distributed.resilience import (elastic_rank, faults,
+                                              watchdog)
+        _resilience_mods = (watchdog, faults, elastic_rank)
     return _resilience_mods
 
 
@@ -433,8 +435,9 @@ class Model:
         no-ops unless resilience is armed."""
         self._fit_step_ctr += steps
         self._observe_fit_steps(steps)
-        watchdog, faults = _resilience()
+        watchdog, faults, elastic = _resilience()
         watchdog.notify_step(self._fit_step_ctr)
+        elastic.notify_step(self._fit_step_ctr)
         faults.fault_point("train.step", step=self._fit_step_ctr)
 
     def _ensure_metric_acc(self, state):
@@ -831,7 +834,7 @@ class Model:
         if os.environ.get("PADDLE_TPU_FIT_WATCHDOG", "1").lower() in (
                 "0", "false", "no"):
             return None
-        watchdog, _ = _resilience()
+        watchdog, _, _elastic = _resilience()
         if watchdog.current_watchdog() is not None:
             return None
         timeout = float(os.environ.get(
@@ -843,7 +846,7 @@ class Model:
     def _disarm_fit_watchdog(self, wd):
         if wd is None:
             return
-        watchdog, _ = _resilience()
+        watchdog, _, _elastic = _resilience()
         wd.stop()
         if watchdog.current_watchdog() is wd:
             watchdog.install_watchdog(None)

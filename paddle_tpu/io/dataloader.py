@@ -145,6 +145,11 @@ class _DevicePrefetcher:
                 self._buf.append(self._stage(next(self._it)))
             except StopIteration:
                 self._exhausted = True
+            except BaseException:
+                # an iterator that raised is finished (iterator
+                # protocol); never pull it again
+                self._exhausted = True
+                raise
 
     def __iter__(self):
         return self
@@ -163,8 +168,15 @@ class _DevicePrefetcher:
             self._fill()   # start the next H2D now
         except BaseException as e:
             # don't lose the good batch already popped: surface the
-            # producer's error at ITS position, on the next call
+            # producer's error at ITS position, on the next call.
+            # The inner iterator has RAISED — per the iterator
+            # protocol it is finished; pulling it again would yield
+            # undefined results (the native reader, for one, drains
+            # its closed queue and masks the real error with "lost
+            # batches"), so mark exhausted to pin the next _fill to a
+            # no-op.
             self._pending_err = e
+            self._exhausted = True
         return out
 
 

@@ -155,6 +155,7 @@ class NativeMapIterator:
         self._next_out = 0
         self._stash = {}
         self._done_workers = 0
+        self._closed = False
         # if the iterator is dropped without exhausting/close(), unblock
         # and terminate the workers
         self._finalizer = weakref.finalize(self, queue.close)
@@ -170,6 +171,11 @@ class NativeMapIterator:
         return self
 
     def __next__(self):
+        if self._closed:
+            # the iterator already terminated (error raised or
+            # exhausted); draining the closed queue here would
+            # deliver out-of-order leftovers
+            raise StopIteration
         while True:
             if self._next_out in self._stash:
                 arrays, skel = self._stash.pop(self._next_out)
@@ -195,6 +201,7 @@ class NativeMapIterator:
             self._stash[key] = (arrays, payload)
 
     def close(self):
+        self._closed = True
         self._queue.close()
 
     def stats(self):
