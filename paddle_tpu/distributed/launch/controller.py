@@ -40,6 +40,28 @@ Every decision lands on the observability registry
 ``resilience_wedged_total``, heartbeat/beacon lag gauges, a
 ``resilience.promote`` span), so one ``scrape()`` on the controller
 answers "how degraded is this job".
+
+Distributed observability plane (DESIGN-OBSERVABILITY.md
+§Distributed plane): with ``--metrics_port BASE`` (or
+``PADDLE_TPU_METRICS_PORT``) the controller serves its OWN registry
+on ``BASE`` — promotions, quarantines, spare pool, straggler verdicts
+— while every rank *r* serves its own on ``BASE+1+r`` (the env
+contract the workers inherit).  The controller additionally scrapes
+every member's ``/metrics.json`` each scrape interval and serves the
+fleet merge on ``/fleet/metrics`` (+ ``.json``) — counters summed,
+gauges rank-labeled, histograms bucket-merged — and ``/fleet/trace``
+merges the ranks' span rings onto one pid-per-rank Chrome timeline
+on demand.  A straggler detector turns the beacon records the
+controller already polls into per-rank step-time
+(``fleet_rank_step_time_s{rank=…}``); a rank slower than
+``--straggler_factor`` × the fleet median raises
+``fleet_straggler{rank=…}`` and a controller log line — PR 9's
+liveness data, promoted to performance attribution.
+
+Spare-pool replenishment (ROADMAP PR-9 follow-up): a successful
+promotion respawns a replacement spare, so the pool no longer drains
+to zero after the first failure; ``resilience_spares_available``
+gauges the live pool on the controller's endpoint.
 """
 
 from __future__ import annotations
@@ -49,10 +71,14 @@ import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ...observability import aggregate as _obs_aggregate
+from ...observability import http as _obs_http
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
 from ..resilience import faults as _faults
@@ -87,7 +113,11 @@ class RankController:
                  nproc: int, spares: int,
                  beacon_timeout: float = 10.0,
                  heartbeat_grace: float = 2.0,
-                 tick: float = 0.25):
+                 tick: float = 0.25,
+                 metrics_port: int = 0,
+                 straggler_factor: Optional[float] = None,
+                 scrape_interval: float = 1.0,
+                 respawn_spares: bool = True):
         self.args = args
         self.client = client
         self.server_endpoint = server_endpoint
@@ -97,6 +127,37 @@ class RankController:
         self.tick = float(tick)
         self.state = _JobState()
         self.job_id = args.job_id
+        # distributed observability plane: BASE for the controller,
+        # BASE+1+r per rank (see module docstring).  0 = disarmed.
+        if not metrics_port:
+            try:
+                metrics_port = int(os.environ.get(
+                    "PADDLE_TPU_METRICS_PORT", "0") or 0)
+            except ValueError:
+                metrics_port = 0
+        self.metrics_base = max(int(metrics_port), 0)
+        self.scrape_interval = float(scrape_interval)
+        if straggler_factor is None:
+            try:
+                straggler_factor = float(os.environ.get(
+                    "PADDLE_TPU_STRAGGLER_FACTOR", "2.0") or 2.0)
+            except ValueError:
+                straggler_factor = 2.0
+        self.straggler = _obs_aggregate.StragglerDetector(
+            factor=straggler_factor,
+            window_s=max(10.0, 4 * self.beacon_timeout))
+        self._flagged_stragglers: set = set()
+        self._straggler_series: set = set()   # ranks with live gauges
+        self.respawn_spares = bool(respawn_spares)
+        self._spare_seq = int(spares)    # next fresh spare member id
+        self._endpoints: Optional[List[str]] = None
+        self._master: Optional[str] = None
+        self._http: Optional[_obs_http.ObservabilityHTTPServer] = None
+        self._own_http = False
+        self._fleet_lock = threading.Lock()
+        self._fleet_snapshot: Dict[str, dict] = {}
+        self._scrape_stop = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
         # per-launch nonce: namespaces every mutable protocol key so a
         # re-run of the same job_id against a long-lived external
         # registry can never consume run N's stale promotion tickets,
@@ -117,6 +178,15 @@ class RankController:
             "resilience_wedged_total",
             "ranks killed by the beacon cross-check (heartbeat "
             "alive, data plane frozen)")
+        self._spares_gauge = self._reg.gauge(
+            "resilience_spares_available",
+            "live parked spare processes (replenished after "
+            "promotion)")
+        self._spares_gauge.set(self.n_spares)
+        self._scrape_errors = self._reg.counter(
+            "fleet_scrape_errors_total",
+            "failed member /metrics.json scrapes (absent rank this "
+            "round, not a judgment)")
 
     # -- spawn ---------------------------------------------------------------
     def _kv_key(self, *parts: str) -> str:
@@ -132,6 +202,10 @@ class RankController:
             "PADDLE_ELASTIC_SERVER": self.server_endpoint,
             "PADDLE_ELASTIC_RUN_ID": self.run_id,
         })
+        if self.metrics_base:
+            # one env var, N endpoints: rank r offsets to BASE+1+r
+            # inside observability.http; spares arm at promotion
+            env["PADDLE_TPU_METRICS_PORT"] = str(self.metrics_base)
         return env
 
     def _spawn(self, member_id: str, role: str, rank: Optional[int],
@@ -186,6 +260,197 @@ class RankController:
                     "seconds since this member's progress beacon "
                     "last changed",
                     labels={"member": m.member_id}).set(lag)
+            if val:
+                # the same beacon record feeds straggler attribution:
+                # its committed-step counter against the poll clock
+                try:
+                    step = json.loads(val).get("step")
+                except ValueError:
+                    step = None
+                self.straggler.observe(rank, step, now=now)
+
+    def _clear_rank_observability(self, rank: Optional[int]):
+        """Reset a departed rank's straggler state AND its exported
+        gauges.  Forgetting only the detector window would freeze the
+        last verdict on the registry forever (no fresh estimate ⇒
+        `_judge_stragglers` never rewrites the series): a promoted
+        successor would inherit its dead predecessor's straggler=1.
+        Unregistering makes the series ABSENT until the successor
+        earns its own verdict — same absent-not-stale policy as the
+        dead-engine function gauges."""
+        if rank is None:
+            return
+        self.straggler.forget(rank)
+        self._flagged_stragglers.discard(rank)
+        self._straggler_series.discard(rank)
+        for name in ("fleet_straggler", "fleet_rank_step_time_s"):
+            self._reg.unregister(name, labels={"rank": str(rank)})
+
+    def _judge_stragglers(self):
+        """Per-rank step-time vs the fleet median, from the beacon
+        records `_poll_beacons` already fetched — exported as gauges
+        and logged on transition, so "which rank is slow" is
+        answerable from the controller's /metrics without touching
+        any worker."""
+        verdicts = self.straggler.judge()
+        # a LIVE rank whose window expired (legitimately parked: long
+        # checkpoint, re-form barrier) drops out of the verdict set —
+        # its series must go ABSENT with it, not freeze at the last
+        # value (same absent-not-stale policy as departed ranks)
+        for rank in list(self._straggler_series - set(verdicts)):
+            self._straggler_series.discard(rank)
+            self._flagged_stragglers.discard(rank)
+            for name in ("fleet_straggler", "fleet_rank_step_time_s"):
+                self._reg.unregister(name, labels={"rank": str(rank)})
+        for rank, v in verdicts.items():
+            self._straggler_series.add(rank)
+            lbl = {"rank": str(rank)}
+            self._reg.gauge(
+                "fleet_rank_step_time_s",
+                "per-rank seconds per committed step, derived from "
+                "progress beacons", labels=lbl).set(v["step_time_s"])
+            self._reg.gauge(
+                "fleet_straggler",
+                "1 when this rank's step-time exceeds straggler_"
+                "factor x the fleet median", labels=lbl).set(
+                    1.0 if v["straggler"] else 0.0)
+            if v["straggler"] and rank not in self._flagged_stragglers:
+                self._flagged_stragglers.add(rank)
+                print(f"launch: straggler: rank {rank} step-time "
+                      f"{v['step_time_s']:.3f}s vs fleet median "
+                      f"{v['median_s']:.3f}s "
+                      f"(>{self.straggler.factor:g}x)",
+                      file=sys.stderr, flush=True)
+            elif not v["straggler"]:
+                self._flagged_stragglers.discard(rank)
+
+    # -- fleet scrape plane --------------------------------------------------
+    def _member_metrics_port(self, rank: int) -> int:
+        return self.metrics_base + 1 + int(rank)
+
+    def _scrape_member(self, rank: int, path: str,
+                       timeout: float = 0.5) -> Optional[dict]:
+        url = (f"http://127.0.0.1:{self._member_metrics_port(rank)}"
+               f"{path}")
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return json.loads(r.read().decode("utf-8"))
+        except Exception:
+            self._scrape_errors.inc()
+            return None   # absent this round — never a failure verdict
+
+    def _live_ranks(self) -> List[int]:
+        # list() snapshot: read from the scrape thread while the
+        # watch loop mutates membership
+        return [r for r, m in list(self.state.members.items())
+                if not m.finished and not m.quarantined]
+
+    def _scrape_fleet(self):
+        """Scrape every live member's /metrics.json and cache the
+        merged fleet snapshot for /fleet/metrics.  Runs on its OWN
+        daemon thread every ``scrape_interval`` — N serial urlopen
+        timeouts against wedged member endpoints must never delay the
+        4 Hz watch loop's failure detection (the same reasoning that
+        keeps these scrapes out of the retry layer)."""
+        if not self.metrics_base:
+            return
+        snaps = {}
+        for rank in self._live_ranks():
+            payload = self._scrape_member(rank, "/metrics.json")
+            if payload and isinstance(payload.get("metrics"), dict):
+                snaps[rank] = payload["metrics"]
+        try:
+            merged = _obs_aggregate.merge_snapshots(snaps)
+        except (TypeError, ValueError) as e:
+            print(f"launch: fleet metrics merge failed: {e}",
+                  file=sys.stderr, flush=True)
+            return
+        with self._fleet_lock:
+            self._fleet_snapshot = merged
+
+    def _fleet_metrics_route(self):
+        with self._fleet_lock:
+            snap = dict(self._fleet_snapshot)
+        text = _obs_aggregate.snapshot_to_prometheus_text(snap)
+        return 200, _obs_http.PROM_CONTENT_TYPE, text.encode("utf-8")
+
+    def _fleet_metrics_json_route(self):
+        with self._fleet_lock:
+            snap = dict(self._fleet_snapshot)
+        return (200, _obs_http.JSON_CONTENT_TYPE,
+                json.dumps(_obs_http.json_safe(snap),
+                           allow_nan=False,
+                           default=str).encode("utf-8"))
+
+    def _fleet_trace_route(self):
+        """On-demand (traces are ~MB-sized rings; scraping them every
+        interval would dwarf the metrics plane): fetch every live
+        member's /trace NOW and merge onto one pid-per-rank
+        timeline."""
+        traces = {}
+        for rank in self._live_ranks():
+            t = self._scrape_member(rank, "/trace", timeout=2.0)
+            if t is not None:
+                traces[rank] = t
+        merged = _obs_aggregate.merge_traces(traces)
+        return (200, _obs_http.JSON_CONTENT_TYPE,
+                json.dumps(merged).encode("utf-8"))
+
+    def _arm_metrics_server(self):
+        """Serve the controller's own registry on BASE with the
+        /fleet/* routes mounted.  Reuses the env-armed per-process
+        endpoint when the package import already bound it (same
+        port); binding failure degrades to no endpoint, never a dead
+        job."""
+        if not self.metrics_base:
+            return
+        routes = {
+            "/fleet/metrics": self._fleet_metrics_route,
+            "/fleet/metrics.json": self._fleet_metrics_json_route,
+            "/fleet/trace": self._fleet_trace_route,
+        }
+        srv = _obs_http.active_server()
+        if srv is not None and srv.port != self.metrics_base:
+            # env-armed singleton on a DIFFERENT port (e.g. env says
+            # 9000, --metrics_port says 8000): the flag wins — the
+            # documented contract is controller on BASE, and workers
+            # were told BASE, so mounting /fleet/* on the env port
+            # would leave BASE refusing connections
+            srv = None
+        if srv is None:
+            try:
+                srv = _obs_http.serve(self.metrics_base)
+            except Exception as e:  # noqa: BLE001 — busy port,
+                # out-of-range port: observability degrades, the job
+                # never dies for it
+                print("launch: could not bind metrics port "
+                      f"{self.metrics_base} ({e}); fleet endpoints "
+                      "disabled", file=sys.stderr, flush=True)
+                return
+            self._own_http = True
+        for path, fn in routes.items():
+            srv.add_route(path, fn)
+        self._http = srv
+        self._scrape_thread = threading.Thread(
+            target=self._scrape_loop, name="fleet-scrape",
+            daemon=True)
+        self._scrape_thread.start()
+        print(f"launch: observability plane up: controller "
+              f"http://127.0.0.1:{srv.port}/metrics (+/fleet/*), "
+              f"ranks on {self.metrics_base + 1}+rank", flush=True)
+
+    def _scrape_loop(self):
+        # floor the cadence: scrape_interval=0 means "no gating for
+        # direct calls" (tests), not a busy loop here
+        while not self._scrape_stop.wait(
+                max(self.scrape_interval, 0.05)):
+            try:
+                self._scrape_fleet()
+            except Exception as e:  # noqa: BLE001 — the scrape
+                # thread must outlive any one bad round
+                print(f"launch: fleet scrape round failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
 
     def _poll_heartbeats(self) -> List[str]:
         """One detector poll; also exports per-member heartbeat lag
@@ -233,6 +498,7 @@ class RankController:
             except OSError:
                 pass
         self.beacons.forget(m.member_id)
+        self._clear_rank_observability(m.rank)
         self.state.quarantined.append(m)
         self._quarantines.inc()
         if reason == "beacon":
@@ -277,7 +543,32 @@ class RankController:
               f"{rank} (epoch {new_epoch}); healthy ranks re-form at "
               "the barrier and resume — no process restart",
               flush=True)
+        self._respawn_spare()
         return True
+
+    def _respawn_spare(self):
+        """Replenish the pool after a promotion (ROADMAP PR-9
+        follow-up): without this the pool drains monotonically and
+        the (n_spares+1)-th failure fails the job even on an
+        otherwise-healthy host.  Fresh member id — the promoted
+        spare's ticket key must never be consumed twice.  A spawn
+        failure leaves the pool short and is reported, not fatal:
+        the job still has its active ranks."""
+        if not self.respawn_spares or self._endpoints is None:
+            return
+        member_id = f"spare-{self._spare_seq}"
+        try:
+            m = self._spawn(member_id, "spare", None, self._endpoints,
+                            self._master, f"sparelog.{self._spare_seq}")
+        except Exception as e:  # noqa: BLE001 — injected or OS
+            print(f"launch: could not respawn replacement spare "
+                  f"{member_id} ({type(e).__name__}: {e}); pool "
+                  "stays short", file=sys.stderr, flush=True)
+            return
+        self._spare_seq += 1
+        self.state.spares.append(m)
+        print(f"launch: respawned replacement spare {member_id} "
+              f"(pool: {len(self.state.spares)})", flush=True)
 
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:
@@ -289,6 +580,8 @@ class RankController:
         endpoints = [f"127.0.0.1:{base_port + i}"
                      for i in range(self.nproc)]
         master = self.server_endpoint
+        self._endpoints, self._master = endpoints, master
+        self._arm_metrics_server()
         for r in range(self.nproc):
             self.state.members[r] = self._spawn(
                 f"rank-{r}", "rank", r, endpoints, master,
@@ -315,8 +608,11 @@ class RankController:
                     continue
                 if rc == 0:
                     m.finished = True
-                    # a finished rank stops beaconing by design
+                    # a finished rank stops beaconing by design, and
+                    # its straggler series must not freeze at the
+                    # last verdict
                     self.beacons.forget(m.member_id)
+                    self._clear_rank_observability(m.rank)
                 else:
                     self._queue_failure(rank, f"exit rc={rc}")
             # 2. control-plane heartbeat loss (host gone / partition)
@@ -326,6 +622,13 @@ class RankController:
                         self._queue_failure(rank, "heartbeat lost")
             # 3. data-plane cross-check: heartbeat alive, beacon frozen
             self._poll_beacons()
+            # 3b. observability plane: straggler attribution from the
+            # beacons just polled + spare-pool gauge (the fleet HTTP
+            # scrape runs on its own thread — see _scrape_loop)
+            self._judge_stragglers()
+            self._spares_gauge.set(sum(
+                1 for s in self.state.spares
+                if s.proc.poll() is None and not s.quarantined))
             for member in self.beacons.stalled():
                 for rank, m in list(self.state.members.items()):
                     if m.member_id != member or m.finished:
@@ -358,6 +661,20 @@ class RankController:
             time.sleep(self.tick)
 
     def _shutdown(self):
+        self._scrape_stop.set()
+        if self._scrape_thread is not None:
+            self._scrape_thread.join(timeout=5.0)
+            self._scrape_thread = None
+        if self._http is not None and self._own_http:
+            # a server we bound ourselves goes down with the job; the
+            # env-armed package singleton outlives us (post-mortem
+            # scrapes of the controller registry still answer until
+            # the process exits)
+            try:
+                self._http.close()
+            except Exception:
+                pass
+            self._http = None
         try:
             self.client.put(self._kv_key("shutdown"), "1")
         except Exception:
@@ -392,7 +709,9 @@ def run_rank_elastic(args) -> int:
     client = KVClient(endpoint)
     ctl = RankController(
         args, client, endpoint, nproc=nproc, spares=args.spares,
-        beacon_timeout=args.beacon_timeout)
+        beacon_timeout=args.beacon_timeout,
+        metrics_port=getattr(args, "metrics_port", 0),
+        straggler_factor=getattr(args, "straggler_factor", None))
     try:
         return ctl.run()
     finally:

@@ -50,6 +50,12 @@ def parse_args(argv=None):
     # WITHOUT restarting the healthy ranks.
     p.add_argument("--spares", type=int, default=0)
     p.add_argument("--beacon_timeout", type=float, default=10.0)
+    # distributed observability plane (DESIGN-OBSERVABILITY.md
+    # §Distributed plane): controller registry on BASE (+ /fleet/*
+    # aggregation), rank r on BASE+1+r.  Routes supervision through
+    # the rank controller (single-node), like --spares.
+    p.add_argument("--metrics_port", type=int, default=0)
+    p.add_argument("--straggler_factor", type=float, default=None)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -96,16 +102,39 @@ def _kill_pod(procs: List[subprocess.Popen]):
 
 def main(argv=None):
     args = parse_args(argv)
-    if args.spares > 0:
+    single_node = str(args.nnodes).split(":")[0] == "1"
+    # NOTE: a PADDLE_TPU_METRICS_PORT env var does NOT route here —
+    # it arms the per-rank endpoints through plain env inheritance
+    # (workers offset BASE+1+rank themselves) but must never change
+    # supervision semantics: a profile-exported observability knob
+    # silently dropping --max_restart pod recovery would be a trap.
+    # The controller fleet plane (/fleet/*, straggler attribution)
+    # is an explicit ask: --metrics_port or --spares.
+    if args.spares > 0 or args.metrics_port > 0:
         # rank-elastic supervision: hot-spare promotion instead of the
-        # kill-the-pod watchdog below (controller.py).  Single-node
-        # only today — silently shrinking a multi-node request to one
-        # node would run at half the asked-for world size
-        if str(args.nnodes).split(":")[0] != "1":
-            print("launch: --spares supports single-node jobs only "
-                  f"(got --nnodes {args.nnodes}); multi-node spare "
-                  "pools are a documented follow-up", file=sys.stderr)
+        # kill-the-pod watchdog below (controller.py).  --metrics_port
+        # routes here too: the fleet observability plane (per-rank
+        # /metrics, /fleet/* aggregation, straggler attribution) lives
+        # in the rank controller.  Single-node only today — silently
+        # shrinking a multi-node request to one node would run at
+        # half the asked-for world size
+        if not single_node:
+            print("launch: --spares/--metrics_port support "
+                  f"single-node jobs only (got --nnodes "
+                  f"{args.nnodes}); multi-node spare pools and fleet "
+                  "scrape are a documented follow-up", file=sys.stderr)
             return 1
+        if args.spares <= 0:
+            # recovery semantics change and the user should know:
+            # rank-elastic supervision recovers by PROMOTION, so with
+            # an empty spare pool a rank death fails the job instead
+            # of the classic pod restart (--max_restart is not used
+            # on this path)
+            print("launch: --metrics_port routes supervision through "
+                  "the rank controller; without --spares a rank "
+                  "failure fails the job (no --max_restart pod "
+                  "restarts) — add --spares S for single-rank "
+                  "replacement", file=sys.stderr)
         from .controller import run_rank_elastic
         return run_rank_elastic(args)
     np_parts = str(args.nnodes).split(":")

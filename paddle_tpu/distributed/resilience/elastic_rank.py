@@ -242,6 +242,16 @@ class ElasticRankContext:
                 # barrier, deadlocking the job on two different
                 # barriers
                 self._pending_reform_epoch = ticket.epoch
+                # late-arm the observability endpoint: a parked spare
+                # had no rank at import so env arming skipped it; it
+                # now owns its dead predecessor's port (BASE+1+rank,
+                # freed by the controller's SIGKILL).  Best-effort —
+                # a bind race must never block the promotion.
+                try:
+                    from ...observability import http as _obs_http
+                    _obs_http.serve_for_rank(ticket.rank)
+                except Exception:
+                    pass
                 return ticket
             if self.shutdown_requested():
                 return None

@@ -55,13 +55,26 @@ def _bump(label: str, key: str, by: int = 1):
     # mirror onto the process-wide observability registry so one
     # scrape() answers "how degraded are we" — retry traffic is IO
     # (network / checkpoint disk), never a hot compiled loop, so the
-    # registry lookup cost is irrelevant here
+    # registry lookup cost is irrelevant here.  Names are spelled as
+    # literals per key: scripts/check_metric_names.py rejects
+    # computed instrument names (a name must be grep-able from code
+    # to dashboard).
     try:
         from ...observability import metrics as _obs_metrics
-        _obs_metrics.registry().counter(
-            f"resilience_retry_{key}_total",
-            f"retry-layer {key} by call-site label",
-            labels={"site": label}).inc(by)
+        reg = _obs_metrics.registry()
+        site = {"site": label}
+        if key == "attempts":
+            reg.counter("resilience_retry_attempts_total",
+                        "retry-layer attempts by call-site label",
+                        labels=site).inc(by)
+        elif key == "retries":
+            reg.counter("resilience_retry_retries_total",
+                        "retry-layer retries by call-site label",
+                        labels=site).inc(by)
+        else:
+            reg.counter("resilience_retry_exhausted_total",
+                        "retry-layer exhaustions by call-site label",
+                        labels=site).inc(by)
     except Exception:
         pass  # a metrics failure must never break the retry path
 

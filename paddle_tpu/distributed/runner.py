@@ -52,6 +52,13 @@ def _observe_mesh_steps(n_steps: int, wall_s: float):
     reg.histogram("mesh_dispatch_wall_s",
                   "host wall time per mesh dispatch (device work is "
                   "async)").observe(wall_s)
+    # per-rank step pace as a first-class level metric: the fleet
+    # scrape reads it off every rank's /metrics, cross-checking the
+    # controller's beacon-derived straggler attribution with the
+    # rank's own measurement (host float — no device sync)
+    reg.gauge("mesh_step_time_s",
+              "host wall seconds per logical step in the last mesh "
+              "dispatch").set(wall_s / max(int(n_steps), 1))
 
 
 _data_axes = coll.data_axes

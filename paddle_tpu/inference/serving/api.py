@@ -44,7 +44,8 @@ class LLMServer:
     """
 
     def __init__(self, network=None, *, auto_start: bool = True,
-                 idle_wait_s: float = 0.005, **engine_kwargs):
+                 idle_wait_s: float = 0.005,
+                 metrics_port: Optional[int] = None, **engine_kwargs):
         # persistent XLA compilation cache (opt-in via env): restarts
         # of this server skip recompiling the decode/prefill programs
         compile_cache.enable_from_env()
@@ -54,8 +55,23 @@ class LLMServer:
         self._closed = False
         self._thread: Optional[threading.Thread] = None
         self._warmup_record: Optional[Dict] = None
+        # serving deployments arm the HTTP scrape plane in one arg
+        # (DESIGN-OBSERVABILITY.md §Distributed plane): /metrics,
+        # /metrics.json, /trace, /healthz over the process-wide
+        # registry this engine already records into.  0 = ephemeral
+        # port (read it back via `metrics_port`); None = off.
+        self._metrics_server = None
+        if metrics_port is not None:
+            from ...observability import http as _obs_http
+            self._metrics_server = _obs_http.serve(int(metrics_port))
         if auto_start:
             self.start()
+
+    @property
+    def metrics_port(self) -> Optional[int]:
+        """The bound scrape port (None when not armed)."""
+        return (None if self._metrics_server is None
+                else self._metrics_server.port)
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -88,6 +104,11 @@ class LLMServer:
             self._thread.join(timeout=10.0)
             self._thread = None
         self._fail_all(RuntimeError("server closed before completion"))
+        if self._metrics_server is not None:
+            # the endpoint dies with the server: a scraper must see
+            # connection-refused (target down), never a frozen scrape
+            self._metrics_server.close()
+            self._metrics_server = None
         if unregister_metrics:
             self.engine.unregister_metrics()
 

@@ -34,10 +34,12 @@ import os as _os
 from . import trace  # noqa: F401
 from . import metrics  # noqa: F401
 from . import export  # noqa: F401
+from . import aggregate  # noqa: F401
+from . import http  # noqa: F401
 from .metrics import registry  # noqa: F401
 
-__all__ = ["trace", "metrics", "export", "registry", "scrape",
-           "scrape_prometheus"]
+__all__ = ["trace", "metrics", "export", "aggregate", "http",
+           "registry", "scrape", "scrape_prometheus"]
 
 
 def scrape(materialize: bool = True):
@@ -66,3 +68,11 @@ if _os.environ.get("PADDLE_TPU_TRACE", "").lower() in ("1", "true",
     # nonpositive values (unset, 0, or e.g. -1) keep the default ring
     trace.enable(capacity=_cap if _cap > 0 else None)
     del _cap
+
+# PADDLE_TPU_METRICS_PORT=<base> arms the per-process HTTP scrape
+# endpoint the same way (DESIGN-OBSERVABILITY.md §Distributed plane):
+# rank r serves base+1+r, a rank-less process serves base.  Unset/0
+# creates NOTHING — no socket, no thread (zero-overhead contract,
+# pinned in tests).  Parked spares arm at promotion instead
+# (http.serve_for_rank).
+http.maybe_serve_from_env()

@@ -1,0 +1,289 @@
+"""Multi-rank observability merge (DESIGN-OBSERVABILITY.md
+§Distributed plane).
+
+Every rank answers for itself over :mod:`.http`; this module turns N
+per-rank answers into ONE fleet answer:
+
+- :func:`merge_snapshots` — N ``export.snapshot()`` dicts → one dict
+  with Prometheus-shaped semantics: **counters sum** across ranks
+  (``fit_steps_total`` of the fleet is the sum of the ranks'),
+  **gauges gain a ``rank`` label** (a last-write-wins value has no
+  meaningful cross-rank sum — ``fit_loss{rank="1"}`` stays
+  attributable), **histograms merge bucket-wise** (same fixed edges →
+  cumulative bucket counts, sum and count add; conflicting edges
+  raise exactly like the registry's explicit-edges conflict).  A name
+  that changes *kind* across ranks raises ``TypeError`` like the
+  registry's kind conflict — a name means one thing fleet-wide.
+- :func:`merge_traces` — N per-rank Chrome traces → one fleet
+  timeline: every rank becomes its own ``pid`` with a
+  ``process_name`` metadata event (``rank0``, ``rank1``, …), and
+  per-process relative timestamps are aligned onto one clock via the
+  ``epochUnixNs`` anchor each exporter embeds (ranks whose traces
+  lack the anchor merge unshifted).
+- :func:`snapshot_to_prometheus_text` — re-render a (merged) snapshot
+  dict as Prometheus text, so the controller's ``/fleet/metrics``
+  serves the same exposition format as every per-rank ``/metrics``.
+- :class:`StragglerDetector` — per-rank step-time from the beacon
+  records the controller already polls (PR 9's liveness data):
+  seconds-per-step over a sliding window, judged against the fleet
+  median.  A rank slower than ``factor ×`` the median is a straggler
+  — the controller exports ``fleet_straggler{rank=…}`` and logs the
+  attribution.  (A rank making *zero* progress is the BeaconMonitor's
+  wedge domain, not a straggler — no fresh window, no verdict.)
+
+Everything here is host-side dict/list work on ALREADY-MATERIALIZED
+snapshots — no device values, no syncs (the same contract
+``scripts/check_host_sync.py`` enforces on the modules feeding it).
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional
+
+from .export import _prom_num
+from .metrics import _escape_label_value
+
+__all__ = ["merge_snapshots", "merge_traces",
+           "snapshot_to_prometheus_text", "StragglerDetector"]
+
+
+def _edge_list(buckets) -> List[Any]:
+    """Bucket edges normalized for comparison: a snapshot that
+    crossed the /metrics.json wire spells the +Inf edge ``"+Inf"``
+    (RFC-8259 JSON has no Infinity token) while a local snapshot
+    holds ``float('inf')`` — both must merge."""
+    out = []
+    for b in buckets:
+        try:
+            out.append(float(b[0]))
+        except (TypeError, ValueError):
+            out.append(b[0])
+    return out
+
+
+def _with_label(key: str, label: str, value: Any) -> str:
+    """Append one label to a ``name{k="v"}``-shaped snapshot key
+    textually — existing label values may contain escaped quotes, so
+    splicing before the closing brace is the only safe edit that
+    needs no parser."""
+    lbl = f'{label}="{_escape_label_value(str(value))}"'
+    if key.endswith("}"):
+        return key[:-1] + "," + lbl + "}"
+    return key + "{" + lbl + "}"
+
+
+def merge_snapshots(snaps: Mapping[Any, Mapping[str, dict]],
+                    rank_label: str = "rank") -> Dict[str, dict]:
+    """Merge ``{rank_id: snapshot}`` into one fleet snapshot.
+
+    ``rank_id`` keys become the ``rank`` label value for gauges (and
+    any untyped entry); iteration is in sorted-key order so the merge
+    is deterministic regardless of scrape arrival order."""
+    out: Dict[str, dict] = {}
+    kinds: Dict[str, str] = {}
+    for rid in sorted(snaps, key=str):
+        snap = snaps[rid]
+        for key, entry in snap.items():
+            kind = entry.get("type", "untyped")
+            prev = kinds.get(key)
+            if prev is not None and prev != kind:
+                raise TypeError(
+                    f"fleet merge: metric {key!r} is {prev} on one "
+                    f"rank and {kind} on rank {rid!r} — a name means "
+                    "one thing fleet-wide")
+            kinds[key] = kind
+            if kind == "counter":
+                tgt = out.get(key)
+                if tgt is None:
+                    out[key] = dict(entry)
+                else:
+                    tgt["value"] = (tgt.get("value") or 0.0) + (
+                        entry.get("value") or 0.0)
+                    if entry.get("pending_dropped"):
+                        tgt["pending_dropped"] = (
+                            tgt.get("pending_dropped", 0)
+                            + entry["pending_dropped"])
+            elif kind == "histogram":
+                tgt = out.get(key)
+                if tgt is None:
+                    out[key] = {**entry,
+                                "buckets": [list(b) for b in
+                                            entry.get("buckets", [])]}
+                else:
+                    edges_a = _edge_list(tgt["buckets"])
+                    edges_b = _edge_list(entry.get("buckets", []))
+                    if edges_a != edges_b:
+                        raise ValueError(
+                            f"fleet merge: histogram {key!r} bucket "
+                            f"edges differ across ranks ({edges_a} vs "
+                            f"{edges_b} on rank {rid!r})")
+                    # cumulative-of-sum == sum-of-cumulative, so the
+                    # exported cumulative counts add elementwise
+                    for b, (_, cum) in zip(tgt["buckets"],
+                                           entry["buckets"]):
+                        b[1] += cum
+                    tgt["sum"] = tgt.get("sum", 0.0) + entry.get(
+                        "sum", 0.0)
+                    tgt["count"] = tgt.get("count", 0) + entry.get(
+                        "count", 0)
+            else:
+                # gauge (and anything untyped): per-rank attribution,
+                # never a cross-rank sum
+                out[_with_label(key, rank_label, rid)] = dict(entry)
+    return out
+
+
+def snapshot_to_prometheus_text(snap: Mapping[str, dict]) -> str:
+    """Prometheus text exposition of a snapshot dict (the merged-
+    fleet counterpart of ``export.to_prometheus_text``, which renders
+    live registries)."""
+    lines: List[str] = []
+    seen_header = set()
+    for key in sorted(snap):
+        entry = snap[key]
+        name, brace, labels = key.partition("{")
+        suffix = brace + labels           # "" or '{k="v",...}'
+        inner = labels[:-1] if suffix else ""   # drop trailing "}"
+        if name not in seen_header:
+            seen_header.add(name)
+            if entry.get("help"):
+                lines.append(f"# HELP {name} {entry['help']}")
+            lines.append(f"# TYPE {name} {entry.get('type', 'untyped')}")
+        if entry.get("type") == "histogram":
+            for le, cum in entry.get("buckets", []):
+                lbl = (inner + "," if inner else "") + \
+                    f'le="{_prom_num(le)}"'
+                lines.append(f"{name}_bucket{{{lbl}}} {cum}")
+            lines.append(f"{name}_sum{suffix} "
+                         f"{_prom_num(entry.get('sum', 0.0))}")
+            lines.append(f"{name}_count{suffix} "
+                         f"{entry.get('count', 0)}")
+        else:
+            v = entry.get("value")
+            if v is None:
+                continue                  # absent, not NaN-forever
+            lines.append(f"{name}{suffix} {_prom_num(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def merge_traces(traces: Mapping[Any, Mapping[str, Any]]
+                 ) -> Dict[str, Any]:
+    """Merge ``{rank_id: chrome_trace_dict}`` into one fleet timeline
+    — rank *r*'s events land on ``pid=r`` with a ``process_name``
+    metadata event, so Perfetto renders the fleet as parallel process
+    groups (the ROADMAP's pid-keyed Chrome trace item).
+
+    Timestamp alignment: each exporter embeds ``epochUnixNs`` (the
+    wall-clock anchor of its relative ``ts=0``); when every input has
+    it, each rank's events are shifted so all ranks share the EARLIEST
+    anchor as ts=0 — cross-rank span overlap then reads true on one
+    timeline.  Any input lacking the anchor merges unshifted."""
+    events: List[Dict[str, Any]] = []
+    ids = sorted(traces, key=str)
+    anchors = {rid: traces[rid].get("epochUnixNs") for rid in ids}
+    have_all = ids and all(isinstance(a, int) for a in anchors.values())
+    t0 = min(anchors.values()) if have_all else None
+    for idx, rid in enumerate(ids):
+        try:
+            pid = int(rid)
+        except (TypeError, ValueError):
+            pid = idx
+        shift_us = ((anchors[rid] - t0) / 1e3) if have_all else 0.0
+        for ev in traces[rid].get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            if shift_us and "ts" in ev:
+                ev["ts"] = ev["ts"] + shift_us
+            events.append(ev)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "tid": 0, "args": {"name": f"rank{rid}"}})
+        events.append({"name": "process_sort_index", "ph": "M",
+                       "pid": pid, "tid": 0,
+                       "args": {"sort_index": pid}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+class StragglerDetector:
+    """Per-rank step-time attribution from progress-beacon polls.
+
+    ``observe(rank, step)`` each controller tick with the step the
+    rank's beacon reports; the detector keeps a sliding window of
+    (time, step) points per rank and estimates seconds-per-step as
+    the window's endpoints slope.  ``judge()`` compares every rank
+    against the fleet median: slower than ``factor ×`` median ⇒
+    straggler.  Judgment needs ≥2 ranks with estimates (a fleet of
+    one has no peer to lag) and each estimate needs ≥2 distinct steps
+    inside the window (a frozen rank is the BeaconMonitor's wedge
+    domain — absence of an estimate is not a straggler verdict).
+    """
+
+    def __init__(self, factor: float = 2.0, window_s: float = 30.0,
+                 max_points: int = 64):
+        self.factor = float(factor)
+        self.window_s = float(window_s)
+        self.max_points = int(max_points)
+        self._points: Dict[Any, deque] = {}   # rank -> (t, step)
+
+    def observe(self, rank, step: Optional[int],
+                now: Optional[float] = None):
+        if step is None:
+            return
+        now = time.monotonic() if now is None else now
+        dq = self._points.setdefault(
+            rank, deque(maxlen=self.max_points))
+        # one point per step VALUE: polling faster than the rank
+        # steps must not flatten the slope
+        if dq and dq[-1][1] == int(step):
+            return
+        dq.append((now, int(step)))
+
+    def forget(self, rank):
+        self._points.pop(rank, None)
+
+    def step_time(self, rank, now: Optional[float] = None
+                  ) -> Optional[float]:
+        """Estimated seconds per step over the window (None without
+        ≥2 distinct in-window step observations)."""
+        dq = self._points.get(rank)
+        if not dq:
+            return None
+        now = time.monotonic() if now is None else now
+        pts = [(t, s) for t, s in dq if now - t <= self.window_s]
+        if len(pts) < 2:
+            return None
+        dt = pts[-1][0] - pts[0][0]
+        dstep = pts[-1][1] - pts[0][1]
+        if dstep <= 0 or dt <= 0:
+            return None
+        return dt / dstep
+
+
+    def judge(self, now: Optional[float] = None
+              ) -> Dict[Any, Dict[str, Any]]:
+        """``{rank: {"step_time_s", "median_s", "straggler"}}`` for
+        every rank with an estimate this window."""
+        now = time.monotonic() if now is None else now
+        times = {r: st for r in self._points
+                 if (st := self.step_time(r, now=now)) is not None}
+        if len(times) < 2:
+            return {r: {"step_time_s": st, "median_s": None,
+                        "straggler": False}
+                    for r, st in times.items()}
+        # LOWER median: with an even fleet the plain median averages
+        # the two middles, so in a 2-rank fleet the straggler itself
+        # drags the bar halfway toward its own step-time and can never
+        # exceed 2x it; the lower median encodes the healthy-majority
+        # assumption and degenerates to "the healthy rank's pace" at
+        # fleet size 2
+        med = statistics.median_low(sorted(times.values()))
+        return {r: {"step_time_s": st, "median_s": med,
+                    "straggler": bool(med > 0
+                                      and st > self.factor * med)}
+                for r, st in times.items()}
+
+    def stragglers(self, now: Optional[float] = None) -> List[Any]:
+        return sorted((r for r, v in self.judge(now=now).items()
+                       if v["straggler"]), key=str)

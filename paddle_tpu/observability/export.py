@@ -25,7 +25,34 @@ from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .metrics import _escape_label_value
 from .metrics import registry as _registry
 
-__all__ = ["snapshot", "to_prometheus_text", "dump_json"]
+__all__ = ["snapshot", "to_prometheus_text", "dump_json",
+           "json_safe"]
+
+
+def json_safe(obj):
+    """Recursively replace non-finite floats with their Prometheus
+    string spellings (``"+Inf"``/``"-Inf"``/``"NaN"``).  Python's
+    ``json.dumps`` emits bare ``Infinity`` tokens for them — valid to
+    ``json.loads`` but rejected by RFC-8259 parsers (jq, JS
+    ``JSON.parse``, Go), and every histogram snapshot carries a
+    ``+Inf`` bucket edge, so an unsanitized export would be
+    unreadable by exactly the external tooling it exists for.
+    ``float("+Inf")`` round-trips, so numeric consumers stay one cast
+    away.  Used by the HTTP endpoints AND :func:`dump_json` — wire
+    and file exports speak the same dialect."""
+    if isinstance(obj, float):
+        if obj != obj:
+            return "NaN"
+        if obj == math.inf:
+            return "+Inf"
+        if obj == -math.inf:
+            return "-Inf"
+        return obj
+    if isinstance(obj, dict):
+        return {k: json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [json_safe(v) for v in obj]
+    return obj
 
 
 def snapshot(reg: Optional[MetricsRegistry] = None,
@@ -51,17 +78,46 @@ def snapshot(reg: Optional[MetricsRegistry] = None,
 
 
 def _prom_num(v) -> str:
+    """Prometheus number rendering, shared with the fleet-merge
+    re-renderer (aggregate.py).  Accepts the JSON-safe string
+    spellings ("+Inf"/"-Inf"/"NaN") a snapshot picks up crossing the
+    /metrics.json wire — `float` round-trips them."""
     if v is None:
         return "NaN"
-    if v == math.inf:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "NaN"
+    if f != f:
+        return "NaN"
+    if f == math.inf:
         return "+Inf"
-    f = float(v)
+    if f == -math.inf:
+        return "-Inf"
     return str(int(f)) if f == int(f) else repr(f)
 
 
+def _label_suffix(labels: Dict[str, str]) -> str:
+    """``{k="v",...}`` rendering (sorted, escaped); empty string for
+    no labels — shared by the registry exporter and the fleet-merge
+    re-renderer in :mod:`.aggregate`."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
 def to_prometheus_text(reg: Optional[MetricsRegistry] = None,
-                       materialize: bool = True) -> str:
-    """Prometheus text exposition of the registry."""
+                       materialize: bool = True,
+                       extra_labels: Optional[Dict[str, str]] = None
+                       ) -> str:
+    """Prometheus text exposition of the registry.
+
+    ``extra_labels`` are merged into every sample's label set — the
+    per-rank HTTP endpoint serves with ``{"rank": "<r>"}`` so a
+    fleet-wide scraper can tell N identical processes apart without
+    relabeling config on its side."""
     reg = reg or _registry()
     lines = []
     seen_header = set()
@@ -71,10 +127,12 @@ def to_prometheus_text(reg: Optional[MetricsRegistry] = None,
             if inst.help:
                 lines.append(f"# HELP {inst.name} {inst.help}")
             lines.append(f"# TYPE {inst.name} {inst.kind}")
-        suffix = inst.labels_suffix()
+        base = dict(inst.labels)
+        if extra_labels:
+            base.update(extra_labels)
+        suffix = _label_suffix(base)
         if isinstance(inst, Histogram):
             data = inst.collect(materialize=materialize)
-            base = dict(inst.labels)
             for le, cum in data["buckets"]:
                 lbl = ",".join(
                     [f'{k}="{_escape_label_value(v)}"'
@@ -102,5 +160,6 @@ def dump_json(path: str, reg: Optional[MetricsRegistry] = None) -> str:
     payload = {"metrics": snapshot(reg),
                "trace_summary": _trace.summary()}
     with open(path, "w") as f:
-        json.dump(payload, f, indent=1, default=str)
+        json.dump(json_safe(payload), f, indent=1, allow_nan=False,
+                  default=str)
     return path

@@ -1,0 +1,309 @@
+"""Per-process observability HTTP endpoint (DESIGN-OBSERVABILITY.md
+§Distributed plane).
+
+PR 8 made every process answer ``scrape()`` *from inside*; this module
+makes it answer from *outside*: a stdlib ``ThreadingHTTPServer`` on a
+loopback port serving
+
+- ``/metrics``       — Prometheus text exposition (the registry, with
+  the process's ``rank`` merged into every sample's labels);
+- ``/metrics.json``  — the ``export.dump_json`` shape (metrics
+  snapshot + trace summary) the fleet aggregator consumes;
+- ``/trace``         — Chrome/Perfetto ``trace_event`` JSON of the
+  span ring (empty ``traceEvents`` when tracing is disarmed);
+- ``/healthz``       — liveness probe; answers from already-host
+  state only, so it stays responsive even while a ``/metrics`` scrape
+  is wedged on a device materialization (each request runs on its own
+  daemon thread).
+
+Arming contract (mirrors ``PADDLE_TPU_TRACE``):
+
+- **Off by default, zero overhead when disarmed.**  With
+  ``PADDLE_TPU_METRICS_PORT`` unset/empty/``0`` no thread and no
+  socket is ever created — ``maybe_serve_from_env()`` returns None
+  without touching the network stack (pinned in tests).
+- **Per-rank port offsetting.**  N ranks on one host inherit the SAME
+  env; each binds its own port so they never collide:
+  ``base`` for a process without a rank (single-process training, or
+  the launch controller), ``base + 1 + rank`` for rank *r* (the
+  ``PADDLE_TRAINER_ID`` env the launch controllers already set).
+  Parked spares (``PADDLE_RANK_ROLE=spare``) do not serve — they have
+  no rank yet; :func:`serve_for_rank` arms them at promotion time,
+  on their dead predecessor's (now free) port.
+- **Scrape-time-only materialization.**  The handler calls the same
+  ``export`` surfaces as in-process ``scrape()`` — deferred lazy
+  device values pay their D2H sync inside the request, which IS the
+  sanctioned sync point of the host-sync contract
+  (``scripts/check_host_sync.py`` guards this module like the hot
+  loops feeding the registry).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import warnings
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from . import export as _export
+from . import trace as _trace
+from .export import json_safe  # noqa: F401 — re-export: the wire-
+# dialect helper lives in export.py (dump_json uses it too)
+from .metrics import MetricsRegistry
+
+__all__ = ["ObservabilityHTTPServer", "serve", "serve_for_rank",
+           "maybe_serve_from_env", "active_server", "resolve_port",
+           "json_safe"]
+
+# Route handler: () -> (status, content_type, body_bytes)
+RouteFn = Callable[[], Tuple[int, str, bytes]]
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+JSON_CONTENT_TYPE = "application/json; charset=utf-8"
+
+
+def _rank_from_env(env) -> Optional[int]:
+    raw = env.get("PADDLE_TRAINER_ID", "")
+    try:
+        rank = int(raw)
+    except (TypeError, ValueError):
+        return None
+    return rank if rank >= 0 else None
+
+
+def resolve_port(env=None) -> Optional[int]:
+    """The port THIS process should serve on, or None when disarmed.
+
+    Layout (one env var, N processes, zero collisions):
+    ``base`` when the process has no rank identity — single-process
+    training, or a launch controller/supervisor; ``base + 1 + r`` for
+    rank ``r``.  A parked spare resolves to None (no rank yet — see
+    :func:`serve_for_rank`)."""
+    env = env or os.environ
+    raw = (env.get("PADDLE_TPU_METRICS_PORT") or "").strip()
+    if not raw:
+        return None
+    try:
+        base = int(raw)
+    except ValueError:
+        return None
+    if base <= 0:
+        return None
+    if env.get("PADDLE_RANK_ROLE") == "spare":
+        return None
+    rank = _rank_from_env(env)
+    return base if rank is None else base + 1 + rank
+
+
+class ObservabilityHTTPServer:
+    """One process's scrape endpoint.  ``port=0`` binds an ephemeral
+    port (tests); ``registry=None`` serves THE process-wide registry.
+    ``extra_routes`` lets a supervisor (the launch controller) mount
+    additional paths — ``/fleet/metrics`` et al. — on the same
+    server; :meth:`add_route` mounts them after construction."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 registry: Optional[MetricsRegistry] = None,
+                 extra_labels: Optional[Dict[str, str]] = None,
+                 extra_routes: Optional[Dict[str, RouteFn]] = None):
+        self.registry = registry
+        self.extra_labels = dict(extra_labels or {})
+        self._routes: Dict[str, RouteFn] = {
+            "/metrics": self._metrics,
+            "/metrics.json": self._metrics_json,
+            "/trace": self._trace,
+            "/healthz": self._healthz,
+        }
+        self._routes.update(extra_routes or {})
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            # scrapes are machine traffic: no per-request stderr lines
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                fn = outer._routes.get(path)
+                if fn is None:
+                    status, ctype, body = 404, "text/plain", b"not found\n"
+                else:
+                    try:
+                        status, ctype, body = fn()
+                    except Exception as e:  # noqa: BLE001 — one bad
+                        # scrape (failed lazy, mid-merge error) must
+                        # answer 500, not kill the handler thread
+                        status, ctype = 500, "text/plain"
+                        body = (f"{type(e).__name__}: {e}\n"
+                                ).encode("utf-8", "replace")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass  # impatient scraper; nothing to clean up
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        # a handler wedged mid-materialization must never block
+        # process exit or close(): daemon handler threads, and close
+        # does not join them
+        self._httpd.daemon_threads = True
+        self._httpd.block_on_close = False
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"paddle-tpu-metrics-{self.port}", daemon=True)
+        self._thread.start()
+
+    # -- route handlers ------------------------------------------------------
+    def _metrics(self):
+        text = _export.to_prometheus_text(
+            self.registry, extra_labels=self.extra_labels or None)
+        return 200, PROM_CONTENT_TYPE, text.encode("utf-8")
+
+    def _metrics_json(self):
+        payload = {"metrics": _export.snapshot(self.registry),
+                   "trace_summary": _trace.summary()}
+        return (200, JSON_CONTENT_TYPE,
+                json.dumps(json_safe(payload), allow_nan=False,
+                           default=str).encode("utf-8"))
+
+    def _trace(self):
+        return (200, JSON_CONTENT_TYPE,
+                json.dumps(_trace.to_chrome_trace()).encode("utf-8"))
+
+    def _healthz(self):
+        # host state ONLY — must answer while a /metrics scrape is
+        # blocked on a device sync (liveness ≠ scrapability)
+        payload = {"status": "ok", "pid": os.getpid()}
+        rank = self.extra_labels.get("rank")
+        if rank is not None:
+            payload["rank"] = rank
+        return (200, JSON_CONTENT_TYPE,
+                json.dumps(payload).encode("utf-8"))
+
+    # -- lifecycle -----------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def host(self) -> str:
+        return self._httpd.server_address[0]
+
+    def add_route(self, path: str, fn: RouteFn):
+        """Mount an extra GET route (e.g. the controller's /fleet/*)
+        on the running server."""
+        self._routes[str(path)] = fn
+
+    def close(self):
+        """Stop accepting and release the socket.  In-flight handler
+        threads are daemons and are not joined — a wedged scrape
+        cannot wedge teardown."""
+        try:
+            self._httpd.shutdown()
+        finally:
+            self._httpd.server_close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def serve(port: int, host: str = "127.0.0.1",
+          registry: Optional[MetricsRegistry] = None,
+          extra_labels: Optional[Dict[str, str]] = None,
+          extra_routes: Optional[Dict[str, RouteFn]] = None
+          ) -> ObservabilityHTTPServer:
+    """Start an endpoint explicitly (``LLMServer(metrics_port=...)``,
+    tests).  ``port=0`` = ephemeral.  The caller owns close()."""
+    return ObservabilityHTTPServer(port, host=host, registry=registry,
+                                   extra_labels=extra_labels,
+                                   extra_routes=extra_routes)
+
+
+# -- env-armed process singleton ---------------------------------------------
+_active: Optional[ObservabilityHTTPServer] = None
+_active_lock = threading.Lock()
+
+
+def active_server() -> Optional[ObservabilityHTTPServer]:
+    """The env-armed per-process endpoint (None when disarmed) — the
+    launch controller reuses it for its /fleet/* routes instead of
+    binding a second port."""
+    return _active
+
+
+def maybe_serve_from_env(env=None) -> Optional[ObservabilityHTTPServer]:
+    """Arm the per-process endpoint iff ``PADDLE_TPU_METRICS_PORT``
+    resolves to a port (idempotent).  Disarmed mode creates NOTHING —
+    no socket, no thread.  A bind failure warns and leaves the
+    process serving nothing: observability must never kill training."""
+    global _active
+    port = resolve_port(env)
+    if port is None:
+        return None
+    with _active_lock:
+        if _active is not None:
+            return _active
+        rank = _rank_from_env(env or os.environ)
+        labels = {"rank": str(rank)} if rank is not None else None
+        try:
+            _active = serve(port, extra_labels=labels)
+        except Exception as e:  # noqa: BLE001 — OSError on a busy
+            # port, OverflowError on an out-of-range one: an armed-
+            # but-unbindable endpoint must degrade, never kill the
+            # package import that armed it
+            warnings.warn(
+                f"observability: could not bind metrics port {port} "
+                f"({type(e).__name__}: {e}); /metrics disabled for "
+                "this process")
+            return None
+        return _active
+
+
+def serve_for_rank(rank: int, env=None
+                   ) -> Optional[ObservabilityHTTPServer]:
+    """Late arming for a promoted spare: it had no rank at import, so
+    env arming skipped it; at promotion it takes over its dead
+    predecessor's port (``base + 1 + rank`` — the predecessor was
+    SIGKILLed by the controller, so the port is free).  No-op when the
+    env is disarmed or an endpoint is already up."""
+    global _active
+    env = env or os.environ
+    raw = (env.get("PADDLE_TPU_METRICS_PORT") or "").strip()
+    try:
+        base = int(raw) if raw else 0
+    except ValueError:
+        base = 0
+    if base <= 0:
+        return None
+    with _active_lock:
+        if _active is not None:
+            return _active
+        try:
+            _active = serve(base + 1 + int(rank),
+                            extra_labels={"rank": str(int(rank))})
+        except Exception as e:  # noqa: BLE001 — same degradation
+            # contract as maybe_serve_from_env
+            warnings.warn(
+                "observability: promoted rank could not bind metrics "
+                f"port {base + 1 + int(rank)} ({type(e).__name__}: "
+                f"{e}); /metrics disabled for this process")
+            return None
+        return _active
+
+
+def _reset_for_tests():
+    """Close and forget the env-armed singleton (test isolation)."""
+    global _active
+    with _active_lock:
+        if _active is not None:
+            _active.close()
+            _active = None

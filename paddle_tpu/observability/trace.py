@@ -59,6 +59,10 @@ _DEFAULT_CAPACITY = 1 << 16
 _enabled: bool = False
 _ring: deque = deque(maxlen=_DEFAULT_CAPACITY)
 _epoch_ns: int = time.monotonic_ns()
+# wall-clock anchor of the monotonic epoch, captured back-to-back with
+# it: exported so a multi-rank merge (aggregate.merge_traces) can
+# shift each process's relative timestamps onto ONE fleet timeline
+_epoch_unix_ns: int = time.time_ns()
 # tid -> list[(name, t0_ns)] — the LIVE stack per thread, read by the
 # hang watchdog; list append/pop are atomic under the GIL
 _live: Dict[int, List] = {}
@@ -273,7 +277,11 @@ def to_chrome_trace() -> Dict[str, Any]:
             "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
             "args": {"name": thread_names.get(tid, f"thread-{tid}")},
         })
-    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms",
+            # wall-clock anchor of ts=0 (extra top-level keys are
+            # ignored by chrome://tracing and Perfetto; the multi-rank
+            # merge uses it to align per-process timelines)
+            "epochUnixNs": _epoch_unix_ns}
 
 
 def dump_chrome_trace(path: str) -> str:

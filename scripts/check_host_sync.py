@@ -61,6 +61,15 @@ HOT_MODULES = [
     os.path.join("observability", "trace.py"),
     os.path.join("observability", "metrics.py"),
     os.path.join("observability", "export.py"),
+    # distributed observability plane (DESIGN-OBSERVABILITY.md
+    # §Distributed plane): the HTTP handlers and the fleet merge run
+    # next to live training/serving processes — materialization is
+    # allowed ONLY inside a scrape request (which rides the same
+    # metrics._materialize float() path as in-process scrape), and
+    # the aggregator works on already-materialized snapshot dicts, so
+    # neither module may contain a direct jax/numpy sync call at all
+    os.path.join("observability", "http.py"),
+    os.path.join("observability", "aggregate.py"),
 ]
 
 # (module, enclosing function) → why this sync point is legitimate

@@ -35,6 +35,13 @@ PKG = os.path.join(REPO, "paddle_tpu")
 URLOPEN_ALLOWLIST = {
     # the retry layer itself obviously sits below retry_call
     os.path.join("distributed", "resilience", "retry.py"),
+    # the controller's fleet metrics scrape is best-effort BY DESIGN:
+    # a failed member scrape means "absent this round" (counted on
+    # fleet_scrape_errors_total), never a judgment, and the next
+    # scrape interval retries naturally — blocking the 4 Hz watch
+    # loop on urlopen retries would delay the failure detection the
+    # loop exists for (DESIGN-OBSERVABILITY.md §Distributed plane)
+    os.path.join("distributed", "launch", "controller.py"),
 }
 
 CHECKPOINT_MANAGER = os.path.join("distributed", "checkpoint",

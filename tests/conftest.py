@@ -47,6 +47,106 @@ jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Capability probe: can this jaxlib run MULTIPROCESS computations on
+# the CPU backend?  Some container jaxlibs cannot ("Multiprocess
+# computations aren't implemented on the CPU backend" — the known
+# drift failures in ROADMAP): those tests then burn ~35 s of the
+# tier-1 870 s wall clock per run failing identically.  The probe
+# runs the minimal failing shape once (two children rendezvous and
+# jit one cross-process sum) and CACHES the verdict per jax/jaxlib
+# version, so every later suite run answers from disk in ~0 s; on a
+# capable container the probe says yes once and the tests run
+# normally forever after.
+# ---------------------------------------------------------------------------
+_MULTIPROC_PROBE_CACHE = os.path.join(
+    os.path.dirname(__file__), ".multiproc_probe.json")
+
+_MULTIPROC_PROBE_CHILD = """
+import sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(sys.argv[1], num_processes=2,
+                           process_id=int(sys.argv[2]))
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()), ("x",))
+local = jax.device_put(np.array([1.0], np.float32),
+                       jax.local_devices()[0])
+arr = jax.make_array_from_single_device_arrays(
+    (2,), NamedSharding(mesh, P("x")), [local])
+total = float(jax.jit(jnp.sum,
+                      out_shardings=NamedSharding(mesh, P()))(arr))
+assert total == 2.0, total
+print("PROBE-OK")
+"""
+
+
+def cpu_multiprocess_supported() -> bool:
+    import json as _json
+    import socket as _socket
+    import subprocess as _sp
+    import sys as _sys
+    try:
+        import jaxlib
+        key = f"{jax.__version__}/{jaxlib.__version__}"
+    except Exception:
+        key = jax.__version__
+    try:
+        with open(_MULTIPROC_PROBE_CACHE) as f:
+            d = _json.load(f)
+        if d.get("key") == key:
+            return bool(d["supported"])
+    except Exception:
+        pass
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [_sp.Popen([_sys.executable, "-c",
+                        _MULTIPROC_PROBE_CHILD, coord, str(r)],
+                       env=env, stdout=_sp.PIPE, stderr=_sp.STDOUT,
+                       text=True)
+             for r in (0, 1)]
+    supported = True
+    saw_capability_error = False
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=90)
+        except _sp.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            supported = False
+            continue
+        if p.returncode != 0 or "PROBE-OK" not in out:
+            supported = False
+            if "Multiprocess computations" in (out or ""):
+                saw_capability_error = True
+    # Cache positive verdicts always; cache a NEGATIVE verdict only
+    # when the probe saw the actual capability error — a timeout or
+    # crash on a loaded container must not permanently disable the
+    # multiprocess coverage on a capable jaxlib (it just re-probes
+    # next run).
+    if supported or saw_capability_error:
+        try:
+            with open(_MULTIPROC_PROBE_CACHE, "w") as f:
+                _json.dump({"key": key, "supported": supported}, f)
+        except OSError:
+            pass  # unwritable tree: probe again next run
+    return supported
+
+
+def require_cpu_multiprocess():
+    """Shared skip guard for the cross-process rendezvous/training
+    tests (test_spawn, test_launch_multiproc)."""
+    if not cpu_multiprocess_supported():
+        pytest.skip("this jaxlib cannot run multiprocess "
+                    "computations on the CPU backend (cached "
+                    "capability probe; ROADMAP container drift)")
+
 
 @pytest.fixture(autouse=True)
 def _reset_state():

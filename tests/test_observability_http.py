@@ -1,0 +1,552 @@
+"""Distributed observability plane tests (ISSUE 10): the per-rank
+HTTP endpoint (content types, label escaping over the wire, /healthz
+liveness during a wedged scrape, the zero-overhead disarmed pin, the
+rank port layout), the fleet merge (counter sum, gauge rank-labeling,
+histogram bucket merge, kind/edge conflicts, pid-per-rank trace
+merge), straggler attribution, the metric-name static check, and the
+slow-marked multi-process acceptance e2e: a live ``launch --nproc 2
+--metrics_port`` run answered entirely over HTTP from outside.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.observability import aggregate as obs_aggregate
+from paddle_tpu.observability import export as obs_export
+from paddle_tpu.observability import http as obs_http
+from paddle_tpu.observability import metrics as obs_metrics
+from paddle_tpu.observability import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _plane_isolation():
+    """The env-armed endpoint is a process singleton; every test
+    starts and ends with it disarmed and the recorder clean."""
+    obs_http._reset_for_tests()
+    trace.disable()
+    trace.clear()
+    yield
+    obs_http._reset_for_tests()
+    trace.disable()
+    trace.clear()
+
+
+def _get(url, timeout=5):
+    return urllib.request.urlopen(url, timeout=timeout)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# arming contract: zero overhead disarmed, rank port layout
+# ---------------------------------------------------------------------------
+def test_disarmed_env_creates_no_thread_and_no_socket():
+    """THE zero-overhead pin (acceptance criterion): with
+    PADDLE_TPU_METRICS_PORT unset/empty/0 no server object, no thread
+    and no socket exist — exactly like PADDLE_TPU_TRACE=0."""
+    before = set(threading.enumerate())
+    for env in ({}, {"PADDLE_TPU_METRICS_PORT": ""},
+                {"PADDLE_TPU_METRICS_PORT": "0"},
+                {"PADDLE_TPU_METRICS_PORT": "-5"},
+                {"PADDLE_TPU_METRICS_PORT": "junk"}):
+        assert obs_http.maybe_serve_from_env(env) is None
+        assert obs_http.resolve_port(env) is None
+    assert obs_http.active_server() is None
+    new = [t for t in set(threading.enumerate()) - before
+           if "metrics" in t.name]
+    assert new == []
+
+
+def test_resolve_port_rank_layout():
+    """One env var, N processes: BASE for a rank-less process (the
+    controller), BASE+1+r for rank r, None for a parked spare (it
+    arms at promotion instead)."""
+    assert obs_http.resolve_port(
+        {"PADDLE_TPU_METRICS_PORT": "9100"}) == 9100
+    assert obs_http.resolve_port(
+        {"PADDLE_TPU_METRICS_PORT": "9100",
+         "PADDLE_TRAINER_ID": "0"}) == 9101
+    assert obs_http.resolve_port(
+        {"PADDLE_TPU_METRICS_PORT": "9100",
+         "PADDLE_TRAINER_ID": "3"}) == 9104
+    assert obs_http.resolve_port(
+        {"PADDLE_TPU_METRICS_PORT": "9100",
+         "PADDLE_TRAINER_ID": "-1",
+         "PADDLE_RANK_ROLE": "spare"}) is None
+
+
+def test_env_armed_singleton_is_idempotent_and_resettable():
+    port = _free_port()
+    env = {"PADDLE_TPU_METRICS_PORT": str(port),
+           "PADDLE_TRAINER_ID": "0"}
+    srv = obs_http.maybe_serve_from_env(env)
+    assert srv is not None and srv.port == port + 1
+    assert obs_http.maybe_serve_from_env(env) is srv   # idempotent
+    assert obs_http.active_server() is srv
+    # the rank label rides every sample of the text exposition
+    reg = obs_metrics.registry()
+    reg.counter("fit_steps_total", "steps").inc(0)
+    text = _get(f"http://127.0.0.1:{srv.port}/metrics"
+                ).read().decode()
+    assert 'rank="0"' in text
+    obs_http._reset_for_tests()
+    assert obs_http.active_server() is None
+
+
+def test_serve_for_rank_arms_promoted_spare_on_predecessor_port():
+    port = _free_port()
+    env = {"PADDLE_TPU_METRICS_PORT": str(port)}
+    srv = obs_http.serve_for_rank(1, env=env)
+    assert srv is not None and srv.port == port + 2
+    h = json.load(_get(f"http://127.0.0.1:{srv.port}/healthz"))
+    assert h["rank"] == "1"
+    # disarmed env: promotion arms nothing
+    obs_http._reset_for_tests()
+    assert obs_http.serve_for_rank(1, env={}) is None
+
+
+# ---------------------------------------------------------------------------
+# in-process scrape e2e over a private registry
+# ---------------------------------------------------------------------------
+def test_endpoint_scrape_e2e_content_types_and_payloads():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("fit_steps_total", "steps").inc(5)
+    reg.gauge("fit_loss", "loss").set(1.25)
+    reg.histogram("dispatch_wall_s", "wall").observe(0.004)
+    trace.enable()
+    with trace.span("step"):
+        pass
+    with obs_http.serve(0, registry=reg,
+                        extra_labels={"rank": "7"}) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        r = _get(base + "/metrics")
+        assert r.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        text = r.read().decode()
+        assert 'fit_steps_total{rank="7"} 5' in text
+        assert 'fit_loss{rank="7"} 1.25' in text
+        assert "# TYPE dispatch_wall_s histogram" in text
+        r = _get(base + "/metrics.json")
+        assert r.headers["Content-Type"].startswith(
+            "application/json")
+        body = r.read().decode()
+        # STRICT RFC-8259: Python json would happily emit a bare
+        # Infinity for the histogram's +Inf bucket edge, which jq/JS/
+        # Go parsers all reject — parse_constant fails the test if
+        # any such token is on the wire
+        payload = json.loads(body, parse_constant=lambda c: (
+            pytest.fail(f"non-RFC-8259 token {c!r} on the wire")))
+        # the dump_json shape: metrics snapshot + trace summary
+        assert payload["metrics"]["fit_steps_total"]["value"] == 5
+        assert "step" in payload["trace_summary"]
+        # the +Inf edge survives as its string spelling, one float()
+        # away from numeric again
+        top_edge = payload["metrics"]["dispatch_wall_s"][
+            "buckets"][-1][0]
+        assert top_edge == "+Inf" and float(top_edge) == float("inf")
+        tr = json.load(_get(base + "/trace"))
+        assert {e["name"] for e in tr["traceEvents"]} >= {"step"}
+        assert isinstance(tr["epochUnixNs"], int)
+        h = json.load(_get(base + "/healthz"))
+        assert h == {"status": "ok", "pid": os.getpid(), "rank": "7"}
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/nope")
+        assert ei.value.code == 404
+    # closed: a scraper sees target-down, not a hang
+    with pytest.raises(urllib.error.URLError):
+        _get(f"http://127.0.0.1:{srv.port}/healthz", timeout=1)
+
+
+def test_prometheus_label_escaping_over_the_wire():
+    """A hostile label value (quotes, backslashes, newlines) must
+    arrive escaped — one bad label corrupting the whole payload is
+    the classic exposition-format failure."""
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("fit_steps_total", "steps",
+                labels={"job": 'a"b\\c\nd'}).inc(1)
+    with obs_http.serve(0, registry=reg) as srv:
+        text = _get(f"http://127.0.0.1:{srv.port}/metrics"
+                    ).read().decode()
+    line = [l for l in text.splitlines()
+            if l.startswith("fit_steps_total{")]
+    assert line == ['fit_steps_total{job="a\\"b\\\\c\\nd"} 1']
+
+
+def test_healthz_answers_while_scrape_is_wedged():
+    """Liveness =/= scrapability: a /metrics request blocked inside a
+    (function-gauge) materialization must not take /healthz down —
+    every request runs on its own handler thread."""
+    reg = obs_metrics.MetricsRegistry()
+    release = threading.Event()
+    entered = threading.Event()
+
+    def wedged():
+        entered.set()
+        release.wait(timeout=30)
+        return 1.0
+
+    reg.gauge("fit_loss", "wedged gauge").set_function(wedged)
+    with obs_http.serve(0, registry=reg) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        got = {}
+
+        def scrape():
+            got["text"] = _get(base + "/metrics",
+                               timeout=30).read().decode()
+
+        t = threading.Thread(target=scrape, daemon=True)
+        t.start()
+        assert entered.wait(timeout=10), "scrape never started"
+        # the scrape is parked inside the gauge; healthz still answers
+        h = json.load(_get(base + "/healthz", timeout=5))
+        assert h["status"] == "ok"
+        release.set()
+        t.join(timeout=10)
+        assert "fit_loss 1" in got["text"]
+
+
+def test_scrape_error_returns_500_not_a_dead_server():
+    reg = obs_metrics.MetricsRegistry()
+
+    class Bomb(obs_metrics.Gauge):
+        def collect(self, materialize=True):
+            raise RuntimeError("boom")
+
+    reg._instruments[("fit_loss", ())] = Bomb("fit_loss")
+    with obs_http.serve(0, registry=reg) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + "/metrics")
+        assert ei.value.code == 500
+        # the server survives the failed scrape
+        assert json.load(_get(base + "/healthz"))["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# fleet merge semantics
+# ---------------------------------------------------------------------------
+def _snap(build):
+    reg = obs_metrics.MetricsRegistry()
+    build(reg)
+    return obs_export.snapshot(reg)
+
+
+def test_merge_snapshots_counters_sum_gauges_rank_label():
+    s0 = _snap(lambda r: (r.counter("fit_steps_total", "s").inc(10),
+                          r.gauge("fit_loss", "l").set(0.5)))
+    s1 = _snap(lambda r: (r.counter("fit_steps_total", "s").inc(32),
+                          r.gauge("fit_loss", "l").set(0.25)))
+    m = obs_aggregate.merge_snapshots({0: s0, 1: s1})
+    assert m["fit_steps_total"]["value"] == 42
+    assert m['fit_loss{rank="0"}']["value"] == 0.5
+    assert m['fit_loss{rank="1"}']["value"] == 0.25
+    assert "fit_loss" not in m          # the bare gauge key is gone
+
+
+def test_merge_snapshots_labeled_series_and_existing_labels():
+    s0 = _snap(lambda r: r.counter(
+        "serving_tokens_total", "t", labels={"engine": "e0"}).inc(3))
+    s1 = _snap(lambda r: (
+        r.counter("serving_tokens_total", "t",
+                  labels={"engine": "e0"}).inc(4),
+        r.gauge("serving_queue_depth", "q",
+                labels={"engine": "e0"}).set(2)))
+    m = obs_aggregate.merge_snapshots({0: s0, 1: s1})
+    assert m['serving_tokens_total{engine="e0"}']["value"] == 7
+    # the rank label lands NEXT TO existing labels, not instead
+    assert m['serving_queue_depth{engine="e0",rank="1"}'][
+        "value"] == 2
+
+
+def test_merge_snapshots_histograms_merge_bucketwise():
+    s0 = _snap(lambda r: [r.histogram("dispatch_wall_s", "w"
+                                      ).observe(v)
+                          for v in (0.0002, 0.3)])
+    s1 = _snap(lambda r: r.histogram("dispatch_wall_s", "w"
+                                     ).observe(0.0002))
+    m = obs_aggregate.merge_snapshots({"a": s0, "b": s1})
+    h = m["dispatch_wall_s"]
+    assert h["count"] == 3
+    assert abs(h["sum"] - 0.3004) < 1e-9
+    by_edge = dict((e, c) for e, c in h["buckets"])
+    assert by_edge[0.00025] == 2        # both tiny observations
+    assert by_edge[float("inf")] == 3   # cumulative of the sum
+    # a snapshot that crossed the /metrics.json wire spells the top
+    # edge "+Inf" (RFC-8259) — it must merge with a local float(inf)
+    # snapshot, and the mixed result must still render as text
+    import copy
+    s1_wire = copy.deepcopy(s1)
+    s1_wire["dispatch_wall_s"]["buckets"][-1][0] = "+Inf"
+    m2 = obs_aggregate.merge_snapshots({"a": s0, "b": s1_wire})
+    assert m2["dispatch_wall_s"]["count"] == 3
+    assert 'dispatch_wall_s_bucket{le="+Inf"} 3' in \
+        obs_aggregate.snapshot_to_prometheus_text(m2)
+    # conflicting edges raise like the registry's explicit-edges rule
+    s2 = _snap(lambda r: r.histogram("dispatch_wall_s", "w",
+                                     edges=(1.0, 2.0)).observe(1.5))
+    with pytest.raises(ValueError, match="edges differ"):
+        obs_aggregate.merge_snapshots({"a": s0, "c": s2})
+
+
+def test_merge_snapshots_kind_conflict_raises():
+    s0 = _snap(lambda r: r.counter("fit_steps_total", "s").inc())
+    s1 = _snap(lambda r: r.gauge("fit_steps_total", "s").set(1))
+    with pytest.raises(TypeError, match="one thing fleet-wide"):
+        obs_aggregate.merge_snapshots({0: s0, 1: s1})
+
+
+def test_merged_snapshot_renders_as_prometheus_text():
+    s0 = _snap(lambda r: (r.counter("fit_steps_total", "s").inc(2),
+                          r.gauge("fit_loss", "l").set(1.0),
+                          r.histogram("dispatch_wall_s", "w"
+                                      ).observe(0.01)))
+    s1 = _snap(lambda r: r.counter("fit_steps_total", "s").inc(3))
+    text = obs_aggregate.snapshot_to_prometheus_text(
+        obs_aggregate.merge_snapshots({0: s0, 1: s1}))
+    assert "fit_steps_total 5" in text
+    assert 'fit_loss{rank="0"} 1' in text
+    assert "# TYPE dispatch_wall_s histogram" in text
+    assert 'dispatch_wall_s_bucket{le="+Inf"} 1' in text
+    assert "dispatch_wall_s_count 1" in text
+
+
+def test_merge_traces_assigns_pid_per_rank_and_aligns_clocks():
+    trace.enable()
+    with trace.span("work"):
+        pass
+    tr = trace.to_chrome_trace()
+    # rank 1's recorder epoch started 5ms later on the wall clock
+    tr_late = dict(tr, epochUnixNs=tr["epochUnixNs"] + 5_000_000)
+    merged = obs_aggregate.merge_traces({0: tr, 1: tr_late})
+    by_pid = {}
+    for ev in merged["traceEvents"]:
+        by_pid.setdefault(ev["pid"], []).append(ev)
+    assert sorted(by_pid) == [0, 1]
+    names = {ev["pid"]: ev["args"]["name"]
+             for ev in merged["traceEvents"]
+             if ev.get("name") == "process_name"}
+    assert names == {0: "rank0", 1: "rank1"}
+    ts0 = [e["ts"] for e in by_pid[0] if e.get("ph") == "X"]
+    ts1 = [e["ts"] for e in by_pid[1] if e.get("ph") == "X"]
+    # same relative events, shifted by the 5ms anchor delta (in us)
+    assert abs((ts1[0] - ts0[0]) - 5000.0) < 1e-6
+    json.dumps(merged)                  # serializable
+    # without anchors: merge unshifted instead of failing
+    bare = {"traceEvents": tr["traceEvents"]}
+    merged2 = obs_aggregate.merge_traces({0: bare, 1: bare})
+    assert {e["pid"] for e in merged2["traceEvents"]} == {0, 1}
+
+
+# ---------------------------------------------------------------------------
+# straggler attribution
+# ---------------------------------------------------------------------------
+def test_straggler_detector_flags_lagging_rank():
+    d = obs_aggregate.StragglerDetector(factor=2.0, window_s=60.0)
+    t0 = time.monotonic()
+    for i in range(8):
+        d.observe(0, i, now=t0 + i * 0.1)
+        d.observe(1, i, now=t0 + i * 0.5)
+    j = d.judge(now=t0 + 4.0)
+    assert j[1]["straggler"] and not j[0]["straggler"]
+    assert abs(j[0]["step_time_s"] - 0.1) < 1e-6
+    assert d.stragglers(now=t0 + 4.0) == [1]
+
+
+def test_straggler_detector_needs_progress_and_peers():
+    d = obs_aggregate.StragglerDetector(window_s=60.0)
+    t0 = time.monotonic()
+    # a frozen rank (same step forever) yields NO estimate — that is
+    # the BeaconMonitor's wedge domain, not a straggler verdict
+    for i in range(5):
+        d.observe(0, 3, now=t0 + i)
+        d.observe(1, i, now=t0 + i)
+    assert d.step_time(0, now=t0 + 5) is None
+    assert d.stragglers(now=t0 + 5) == []
+    # a single rank has no peer to lag
+    d2 = obs_aggregate.StragglerDetector(window_s=60.0)
+    for i in range(5):
+        d2.observe(0, i, now=t0 + i)
+    assert d2.judge(now=t0 + 5)[0]["straggler"] is False
+    # stale points expire out of the window
+    d3 = obs_aggregate.StragglerDetector(window_s=1.0)
+    d3.observe(0, 1, now=t0)
+    d3.observe(0, 2, now=t0 + 0.5)
+    assert d3.step_time(0, now=t0 + 0.6) is not None
+    assert d3.step_time(0, now=t0 + 10.0) is None
+    d3.forget(0)
+    assert d3.step_time(0, now=t0 + 0.6) is None
+
+
+# ---------------------------------------------------------------------------
+# static checks: metric-name convention, host-sync coverage
+# ---------------------------------------------------------------------------
+def test_static_metric_name_convention():
+    """Every registry instrument in production code obeys the naming
+    convention (counters _total, histograms unit-suffixed, snake_case
+    everywhere) and is a string LITERAL — run exactly like the retry/
+    fault-site/host-sync checks."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_metric_names as cmn
+    finally:
+        sys.path.pop(0)
+    violations, sites = cmn.check()
+    assert not violations, "\n".join(
+        f"{r}:{l}: {m}" for r, l, m in violations)
+    assert sites >= cmn.MIN_EXPECTED_SITES
+    # and the rules themselves reject what they must
+    assert cmn._check_name("counter", "fit_steps")        # no _total
+    assert cmn._check_name("histogram", "dispatch_wall")  # no unit
+    assert cmn._check_name("gauge", "queue_total")        # fake total
+    assert cmn._check_name("counter", "Bad-Name_total")   # not snake
+    assert not cmn._check_name("counter", "fit_steps_total")
+    assert not cmn._check_name("histogram", "dispatch_wall_s")
+    assert not cmn._check_name("gauge", "serving_queue_depth")
+
+
+def test_check_host_sync_covers_http_and_aggregate():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_host_sync as chs
+    finally:
+        sys.path.pop(0)
+    mods = set(chs.HOT_MODULES)
+    assert os.path.join("observability", "http.py") in mods
+    assert os.path.join("observability", "aggregate.py") in mods
+    assert chs.check() == []
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e (slow): a LIVE launch --nproc 2 answered over HTTP
+# ---------------------------------------------------------------------------
+def _fleet_worker_script():
+    """ONE canonical beacon-publishing worker, owned by bench.py
+    (`bench.py --fleet` runs the same scenario between rounds) — a
+    protocol change must not let the bench and the acceptance test
+    silently diverge."""
+    sys.path.insert(0, REPO)
+    try:
+        from bench import _FLEET_WORKER
+    finally:
+        sys.path.pop(0)
+    return _FLEET_WORKER
+
+
+@pytest.mark.dist
+@pytest.mark.slow
+def test_e2e_two_rank_launch_answers_over_http(tmp_path):
+    """THE acceptance scenario (ISSUE 10): per-rank /metrics scrapes
+    return Prometheus text with the rank label, the controller's
+    /fleet/trace merges both ranks onto distinct pids in one valid
+    Chrome trace, and the straggler gauge identifies the artificially
+    slowed rank — all from OUTSIDE the job, over HTTP."""
+    base = _free_port()
+    stop_file = tmp_path / "stop"
+    script = tmp_path / "fleet_worker.py"
+    script.write_text(_fleet_worker_script())
+    env = dict(os.environ)
+    env.update({
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        "JAX_PLATFORMS": "cpu",
+        "PADDLE_TPU_TRACE": "1",
+        "FLEET_STEP_SLEEP": "0.05,0.25",    # rank 1 lags >2x median
+        "FLEET_STOP_FILE": str(stop_file),
+    })
+    env.pop("PADDLE_TPU_METRICS_PORT", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--metrics_port", str(base),
+         "--job_id", "obs-e2e", "--log_dir", str(tmp_path / "log"),
+         str(script)],
+        env=env, cwd=str(tmp_path), text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+    def get_json(port, path, timeout=2.0):
+        with _get(f"http://127.0.0.1:{port}{path}",
+                  timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    try:
+        deadline = time.monotonic() + 120
+        fleet = ctl_snap = None
+        while time.monotonic() < deadline:
+            time.sleep(0.5)
+            assert proc.poll() is None, (
+                f"launch died early rc={proc.returncode}:\n"
+                f"{proc.stderr.read()[-3000:]}")
+            try:
+                fleet = get_json(base, "/fleet/metrics.json")
+                ctl_snap = get_json(base, "/metrics.json")["metrics"]
+            except (OSError, ValueError):
+                continue
+            if (fleet.get("fit_steps_total", {}).get("value", 0) >= 20
+                    and ctl_snap.get('fleet_straggler{rank="1"}',
+                                     {}).get("value") == 1.0):
+                break
+        else:
+            pytest.fail("fleet plane never converged in 120s")
+        # 1. per-rank /metrics: Prometheus text, rank label on wire
+        for r in (0, 1):
+            resp = _get(f"http://127.0.0.1:{base + 1 + r}/metrics")
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            text = resp.read().decode()
+            assert f'fit_steps_total{{rank="{r}"}}' in text
+        # 2. /fleet/metrics: counters summed across ranks, served as
+        # Prometheus text too.  The fleet cache refreshes every
+        # scrape_interval while the ranks keep stepping, so compare
+        # against per-rank values read FIRST and poll the (monotone)
+        # fleet sum until it catches up — a point-in-time >= between
+        # two moving counters is a race, not an invariant.
+        per_rank = [get_json(base + 1 + r, "/metrics.json")["metrics"]
+                    ["fit_steps_total"]["value"] for r in (0, 1)]
+        catchup = time.monotonic() + 30
+        while fleet["fit_steps_total"]["value"] < max(per_rank):
+            assert time.monotonic() < catchup, (
+                fleet["fit_steps_total"], per_rank)
+            time.sleep(0.5)
+            fleet = get_json(base, "/fleet/metrics.json")
+        fleet_text = _get(f"http://127.0.0.1:{base}/fleet/metrics"
+                          ).read().decode()
+        assert "fit_steps_total " in fleet_text
+        # 3. /fleet/trace: both ranks on distinct pids, named, valid
+        tr = get_json(base, "/fleet/trace", timeout=15.0)
+        pids = {e["pid"] for e in tr["traceEvents"]}
+        assert pids == {0, 1}
+        names = {e["args"]["name"] for e in tr["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names == {"rank0", "rank1"}
+        assert any(e.get("name") == "train.step"
+                   for e in tr["traceEvents"])
+        json.dumps(tr)
+        # 4. straggler attribution: the slowed rank, and only it
+        assert ctl_snap['fleet_straggler{rank="1"}']["value"] == 1.0
+        assert ctl_snap['fleet_straggler{rank="0"}']["value"] == 0.0
+        assert ctl_snap['fleet_rank_step_time_s{rank="1"}'][
+            "value"] > 2 * ctl_snap[
+                'fleet_rank_step_time_s{rank="0"}']["value"]
+    finally:
+        stop_file.write_text("1")
+        try:
+            out, err = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+    assert proc.returncode == 0, err[-3000:]
+    assert "launch: straggler: rank 1" in err
+    assert "observability plane up" in out

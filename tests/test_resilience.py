@@ -1062,6 +1062,125 @@ def test_controller_no_spare_left_reports_failure(server):
     assert ctl._try_promote(0) is False
 
 
+def test_controller_respawns_spare_after_promotion(server):
+    """ISSUE 10 satellite (ROADMAP PR-9 follow-up): a successful
+    promotion respawns a replacement spare with a FRESH member id, so
+    the pool no longer drains to zero; the live pool is exported as
+    ``resilience_spares_available``."""
+    from paddle_tpu.distributed.launch.controller import _Member
+    ctl = _stub_controller(server, job_id="ctl-respawn")
+    spawned = []
+    ctl._endpoints = ["127.0.0.1:1", "127.0.0.1:2"]
+    ctl._master = server.endpoint
+
+    def fake_spawn(member_id, role, rank, endpoints, master, log_name):
+        spawned.append((member_id, role, rank))
+        return _Member(member_id, _StubProc(), "", rank=rank)
+
+    ctl._spawn = fake_spawn
+    assert ctl._spares_gauge.collect() == 1.0     # initial pool
+    ctl._queue_failure(1, "exit rc=1")
+    assert ctl._try_promote(1) is True
+    # spare-0 was promoted; a replacement with a fresh id (its
+    # predecessor's promotion-ticket key must never be reused) joined
+    # the pool
+    assert spawned == [("spare-1", "spare", None)]
+    assert [s.member_id for s in ctl.state.spares] == ["spare-1"]
+    assert ctl.state.members[1].member_id == "spare-0"
+    # a second failure is survivable with the replenished pool
+    ctl._queue_failure(0, "exit rc=1")
+    assert ctl._try_promote(0) is True
+    assert ctl.state.members[0].member_id == "spare-1"
+    assert [s.member_id for s in ctl.state.spares] == ["spare-2"]
+
+
+def test_controller_respawn_can_be_disabled_and_survives_failure(
+        server):
+    from paddle_tpu.distributed.launch.controller import _Member
+    ctl = _stub_controller(server, job_id="ctl-norespawn")
+    ctl.respawn_spares = False
+    ctl._endpoints = ["127.0.0.1:1", "127.0.0.1:2"]
+    spawned = []
+    ctl._spawn = lambda *a, **kw: spawned.append(a)
+    ctl._queue_failure(1, "exit rc=1")
+    assert ctl._try_promote(1) is True
+    assert spawned == [] and ctl.state.spares == []
+    # respawn failure is reported, never fatal (pool stays short)
+    ctl2 = _stub_controller(server, job_id="ctl-failspawn")
+    ctl2._endpoints = ["127.0.0.1:1", "127.0.0.1:2"]
+
+    def bad_spawn(*a, **kw):
+        raise OSError("fork failed")
+
+    ctl2._spawn = bad_spawn
+    ctl2._queue_failure(0, "exit rc=1")
+    assert ctl2._try_promote(0) is True
+    assert ctl2.state.spares == []
+
+
+def test_controller_straggler_gauge_fires_on_injected_latency(
+        server, capsys):
+    """ISSUE 10: the controller turns the beacon records it already
+    polls into per-rank step-time; a rank lagging the fleet median
+    beyond the factor raises ``fleet_straggler{rank=…}`` on the
+    controller registry plus a log line, and recovery clears it."""
+    import json as _json
+    ctl = _stub_controller(server, job_id="ctl-straggler")
+    t0 = time.monotonic()
+    # rank 0 steps every 0.1s, rank 1 every 0.5s (injected latency)
+    for i in range(8):
+        ctl.client.put(ctl._kv_key("beacon", "0"),
+                       _json.dumps({"beat": i, "step": i}))
+        ctl.client.put(ctl._kv_key("beacon", "1"),
+                       _json.dumps({"beat": i, "step": i}))
+        ctl.straggler.observe(0, i, now=t0 + i * 0.1)
+        ctl.straggler.observe(1, i, now=t0 + i * 0.5)
+    ctl._poll_beacons()          # the production feed path runs too
+    ctl._judge_stragglers()
+    reg = ctl._reg
+    assert reg.gauge("fleet_straggler",
+                     labels={"rank": "1"}).collect() == 1.0
+    assert reg.gauge("fleet_straggler",
+                     labels={"rank": "0"}).collect() == 0.0
+    assert reg.gauge("fleet_rank_step_time_s",
+                     labels={"rank": "1"}).collect() > \
+        2 * reg.gauge("fleet_rank_step_time_s",
+                      labels={"rank": "0"}).collect()
+    err = capsys.readouterr().err
+    assert "straggler: rank 1" in err
+    # recovery: the lagging rank speeds back up -> flag drops (and
+    # the log line does not repeat while flagged)
+    for i in range(8, 30):
+        ctl.straggler.observe(1, i, now=t0 + 4.0 + (i - 8) * 0.1)
+        ctl.straggler.observe(0, i, now=t0 + 4.0 + (i - 8) * 0.1)
+    ctl._judge_stragglers()
+    assert reg.gauge("fleet_straggler",
+                     labels={"rank": "1"}).collect() == 0.0
+    # a LIVE rank whose estimate window expires (parked at a
+    # barrier/checkpoint) scrapes ABSENT, not frozen at the last
+    # verdict — drain the window to simulate expiry (the test's
+    # synthetic timestamps sit in the future, so shrinking window_s
+    # cannot age them out)
+    saved_points = dict(ctl.straggler._points)
+    ctl.straggler._points.clear()
+    ctl._judge_stragglers()
+    from paddle_tpu.observability import export as _oe
+    snap_now = _oe.snapshot(materialize=False)
+    assert 'fleet_straggler{rank="0"}' not in snap_now
+    assert 'fleet_straggler{rank="1"}' not in snap_now
+    ctl.straggler._points.update(saved_points)   # estimates return
+    ctl._judge_stragglers()
+    # quarantine clears BOTH the window and the exported series — a
+    # promoted successor must not inherit its predecessor's verdict
+    # (absent until it earns its own, not stale)
+    from paddle_tpu.observability import export as obs_export
+    ctl._queue_failure(1, "exit rc=1")
+    snap = obs_export.snapshot(materialize=False)
+    assert 'fleet_straggler{rank="1"}' not in snap
+    assert 'fleet_rank_step_time_s{rank="1"}' not in snap
+    assert 'fleet_straggler{rank="0"}' in snap
+
+
 def test_controller_beacon_poll_feeds_monitor(server):
     ctl = _stub_controller(server, job_id="ctl3")
     ctl.beacons.timeout = 0.3

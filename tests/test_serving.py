@@ -362,6 +362,33 @@ def test_server_threaded_end_to_end(tiny_net):
     assert not srv.running
 
 
+def test_server_metrics_port_serves_and_close_tears_down(tiny_net):
+    """LLMServer(metrics_port=...) arms the HTTP scrape plane
+    (ISSUE 10 satellite): /healthz answers, /metrics carries the
+    engine's registry children, and close() tears the endpoint down
+    so a scraper sees target-down, never a frozen scrape."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+    net, cfg = tiny_net
+    srv = LLMServer(net, max_batch=1, block_size=8, num_blocks=64,
+                    auto_start=False, metrics_port=0)   # ephemeral
+    port = srv.metrics_port
+    assert port and port > 0
+    base = f"http://127.0.0.1:{port}"
+    h = _json.load(urllib.request.urlopen(base + "/healthz",
+                                          timeout=5))
+    assert h["status"] == "ok" and h["pid"] == os.getpid()
+    text = urllib.request.urlopen(base + "/metrics",
+                                  timeout=5).read().decode()
+    assert "serving_queue_depth{engine=" in text
+    assert "# TYPE serving_dispatches_total counter" in text
+    srv.close()
+    assert srv.metrics_port is None
+    with pytest.raises(urllib.error.URLError):
+        urllib.request.urlopen(f"{base}/healthz", timeout=2)
+
+
 def test_server_close_fails_pending_futures(tiny_net):
     net, cfg = tiny_net
     srv = LLMServer(net, max_batch=1, block_size=8, num_blocks=64,

@@ -33,6 +33,8 @@ def _worker(tag):
 
 
 def test_spawn_two_ranks_collective():
+    from conftest import require_cpu_multiprocess
+    require_cpu_multiprocess()
     from paddle_tpu.distributed import spawn
     ctx = spawn(_worker, args=("t1",), nprocs=2, join=True)
     assert all(p.exitcode == 0 for p in ctx.processes)
