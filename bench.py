@@ -683,6 +683,211 @@ def bench_mesh_fold():
     _emit_result("mesh_fold", out)
 
 
+def _hlo_dp_collective_bytes(hlo_text, mesh):
+    """Bytes-moved proxy from the COMPILED program: per-device WIRE
+    bytes of every collective whose replica group spans the dp axis.
+    A collective's result size is not its wire cost, so each opcode is
+    normalized to the ring/tiled wire volume for its group size W:
+    all-reduce = 2*(W-1)/W * result, all-gather = (W-1)/W * result,
+    reduce-scatter = (W-1) * result (the per-device result is 1/W of
+    the input), collective-permute = result (one hop's payload).
+    With that normalization every variant cross-checks the analytic
+    `dp_comm_bytes_per_step` model within a few percent;
+    `tests/test_hlo_collective_audit` asserts it under pytest."""
+    import re
+    import numpy as np
+
+    dtype_bytes = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8,
+                   "s32": 4, "u64": 8, "u32": 4, "s8": 1, "u8": 1,
+                   "pred": 1, "s16": 2, "u16": 2}
+
+    def decode_groups(attr):
+        attr = attr.strip()
+        m = re.match(r"\[(\d+),(\d+)\]<=\[([\d,]+)\]"
+                     r"(?:T\(([\d,]+)\))?", attr)
+        if m:
+            g, s = int(m.group(1)), int(m.group(2))
+            dims = [int(x) for x in m.group(3).split(",")]
+            x = np.arange(int(np.prod(dims))).reshape(dims)
+            if m.group(4):
+                x = x.transpose([int(p) for p in m.group(4).split(",")])
+            return x.reshape(g, s).tolist()
+        if attr.startswith("{"):
+            return [[int(v) for v in grp.split(",")]
+                    for grp in re.findall(r"\{([\d,\s]+)\}", attr)
+                    if grp.strip()]
+        raise ValueError(f"unparsed replica_groups: {attr!r}")
+
+    def result_bytes(line):
+        m = re.search(
+            r"=\s*(.*?)\s*(?:all-reduce|reduce-scatter|all-gather|"
+            r"collective-permute|all-to-all)(?:-start|-done)?\(", line)
+        if not m:
+            return 0
+        total = 0
+        for dt, shp in re.findall(r"(\w+)\[([\d,]*)\]", m.group(1)):
+            if dt not in dtype_bytes:
+                continue
+            n = 1
+            for d in shp.split(","):
+                if d:
+                    n *= int(d)
+            total += n * dtype_bytes[dt]
+        return total
+
+    axis_names = list(mesh.axis_names)
+    dp_axis = axis_names.index("dp")
+    coord_of = {i: np.unravel_index(i, mesh.devices.shape)
+                for i in range(mesh.devices.size)}
+
+    def spans_dp(device_ids):
+        coords = [coord_of[d] for d in device_ids]
+        return len({c[dp_axis] for c in coords}) > 1
+
+    def wire_factor(line, group_size):
+        w = max(group_size, 2)
+        if "all-reduce" in line:
+            return 2.0 * (w - 1) / w
+        if "all-gather" in line:
+            return (w - 1) / w
+        if "reduce-scatter" in line:
+            return float(w - 1)
+        return 1.0                        # collective-permute: one hop
+
+    total = 0.0
+    for line in hlo_text.splitlines():
+        if "replica_groups=" in line:
+            mg = re.search(
+                r"replica_groups=(\{\{[^}]*\}[^)]*\}|\[[^ ]+)", line)
+            if not mg:
+                continue
+            try:
+                groups = decode_groups(mg.group(1))
+            except ValueError:
+                continue
+            if spans_dp(groups[0]):
+                total += result_bytes(line) * wire_factor(
+                    line, len(groups[0]))
+        elif "source_target_pairs=" in line:
+            # the explicit ring's hops: one collective-permute per hop,
+            # its result IS the wire payload of that hop
+            pairs = re.findall(r"\{(\d+),(\d+)\}", line)
+            if pairs and any(spans_dp([int(a), int(b)])
+                             for a, b in pairs):
+                total += result_bytes(line)
+    return int(total)
+
+
+def bench_dp_compressed():
+    """Compressed + sharded dp gradient path on the CPU mesh
+    (ISSUE 11 / DESIGN-DCN.md §Strategy knobs): sweep
+    {off, bits=16, bits=8} x {sharded update on/off}, recording per
+    variant: steps/s (interleaved medians, like the mesh-fold sweep),
+    the modeled per-device dp wire bytes per step AND the compiled-HLO
+    bytes-moved proxy that cross-checks it, per-replica opt_state
+    bytes (the 1/dp memory win), a bits=16-vs-off end-loss parity bit,
+    and the DESIGN-DCN simulated scaling efficiency at 256 chips for
+    each wire format (the >=90% north-star gate)."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.runner import DistributedRunner
+
+    print("devices-ok", jax.devices(), flush=True)
+    dp = int(os.environ.get("GRAFT_BENCH_DP", "2"))
+    reps = int(os.environ.get("GRAFT_BENCH_DP_REPS", "3"))
+    # leaves >> the 256-elt quantization block so block padding is
+    # negligible and the HLO bytes proxy is comparable to the model
+    def build():
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(256, 512), nn.ReLU(),
+                            nn.Linear(512, 64))
+        opt = optimizer.Adam(1e-3, parameters=net.parameters())
+        return net, opt
+
+    rng = np.random.RandomState(0)
+    batches = [([rng.rand(16, 256).astype(np.float32)],
+                [rng.randint(0, 64, (16,)).astype(np.int64)])
+               for _ in range(24)]
+    variants = [(0, False), (16, False), (8, False),
+                (0, True), (16, True), (8, True)]
+    runners, final_loss, audits = {}, {}, {}
+    mesh = collective.build_mesh({"dp": dp})
+    collective.set_mesh(mesh)
+    t0 = time.perf_counter()
+    for bits, shard in variants:
+        net, opt = build()
+        r = DistributedRunner(net, opt, nn.CrossEntropyLoss(),
+                              mesh=mesh, dp_compress_bits=bits,
+                              dp_shard_update=shard)
+        hlo = r.lower_step(*batches[0]).compile().as_text()
+        audits[(bits, shard)] = _hlo_dp_collective_bytes(hlo, mesh)
+        for ins, lbs in batches:                  # warmup epoch
+            loss = r.train_step(ins, lbs)
+        final_loss[(bits, shard)] = float(loss)
+        runners[(bits, shard)] = r
+    compile_warmup_s = round(time.perf_counter() - t0, 2)
+
+    samples = {v: [] for v in variants}
+    for _ in range(reps):
+        for v in variants:                        # interleaved medians
+            r = runners[v]
+            t0 = time.perf_counter()
+            for ins, lbs in batches:
+                r.train_step(ins, lbs)
+            jax.block_until_ready(r._opt_state)
+            samples[v].append(len(batches) /
+                              (time.perf_counter() - t0))
+
+    # simulated scaling efficiency (scripts/scaling_projection.py's
+    # grounded model, GPT-2-small measured step time) per wire format
+    import importlib.util as _ilu
+    spec = _ilu.spec_from_file_location(
+        "scaling_projection",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "scripts", "scaling_projection.py"))
+    proj = _ilu.module_from_spec(spec)
+    spec.loader.exec_module(proj)
+
+    grad_elems = sum(int(np.prod(p.shape))
+                     for p in runners[(0, False)].network.parameters()
+                     if not p.stop_gradient)
+    out = {"dp_compressed_dp": dp,
+           "dp_compressed_compile_warmup_s": compile_warmup_s,
+           "dp_compressed_grad_elems": grad_elems,
+           "dp_compressed_bits16_end_loss_parity": (
+               final_loss[(16, False)] == final_loss[(0, False)]),
+           "dp_compressed_bits8_end_loss_delta": round(
+               abs(final_loss[(8, False)] - final_loss[(0, False)]),
+               6)}
+    for wire, label in (("f32", "off"), ("int8", "int8")):
+        out[f"dp_sim_scaling_eff_256chips_{label}"] = round(
+            proj.efficiency(132.0, 124e6, 256, wire), 4)
+    for (bits, shard), vals in samples.items():
+        tag = f"b{bits}_{'sharded' if shard else 'replicated'}"
+        med = sorted(vals)[len(vals) // 2]
+        out[f"dp_steps_per_sec_{tag}"] = round(med, 1)
+        out[f"dp_hlo_bytes_{tag}"] = audits[(bits, shard)]
+        # the runner's own per-leaf model (replicated-fallback leaves
+        # modeled as the full all-reduce they actually run)
+        r = runners[(bits, shard)]
+        out[f"dp_model_bytes_{tag}"] = \
+            r._dp_comm_info["bytes_per_step"]
+        st_bytes = 0
+        for st in r._opt_state.values():
+            for v in st.values():
+                st_bytes += max(
+                    s.data.nbytes for s in v.addressable_shards)
+        out[f"dp_opt_state_bytes_per_rank_{tag}"] = st_bytes
+    _emit_result("dp_compressed", out)
+
+
 def bench_serving():
     """Continuous-batching decode server under Poisson arrivals
     (ISSUE 6) — CPU by DESIGN like bench_hapi, so the number stays
@@ -1099,6 +1304,15 @@ def main():
                          else {"error": merr[-1000:]}), flush=True)
         return
 
+    # `python bench.py --dp-compressed`: run ONLY the compressed +
+    # sharded dp sweep (CPU dp mesh, cheap) — the dp gradient-path
+    # counterpart of --mesh-fold (ISSUE 11)
+    if "--dp-compressed" in sys.argv:
+        dpc, derr = _run_child("dp_compressed", 420)
+        print(json.dumps(dpc if dpc is not None
+                         else {"error": derr[-1000:]}), flush=True)
+        return
+
     mode = os.environ.get("_GRAFT_BENCH_CHILD")
     if mode == "gpt":
         return bench_gpt()
@@ -1116,6 +1330,8 @@ def main():
         return bench_hapi()
     if mode == "mesh_fold":
         return bench_mesh_fold()
+    if mode == "dp_compressed":
+        return bench_dp_compressed()
     if mode == "serving":
         return bench_serving()
     if mode == "fleet":
@@ -1176,6 +1392,18 @@ def main():
             out["mesh_fold_error"] = mferr[-500:]
     elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
         out["mesh_fold_error"] = "skipped: out of budget"
+
+    # compressed + sharded dp sweep (CPU dp mesh, cheap): wire-format
+    # x update-sharding matrix with bytes proxy + opt-state memory —
+    # the dp gradient path's trend line records every round (ISSUE 11)
+    if remaining() > 60 and not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        dpc, dperr = _run_child("dp_compressed", min(240, remaining()))
+        if dpc is not None:
+            out.update(dpc)
+        else:
+            out["dp_compressed_error"] = dperr[-500:]
+    elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        out["dp_compressed_error"] = "skipped: out of budget"
 
     # fleet observability plane e2e (CPU, cheap): a 2-rank launch
     # answered over HTTP — merged fleet snapshot + straggler

@@ -18,10 +18,23 @@ ppermute), testable on the virtual CPU mesh:
   each hop (the EQuARX "dequant-accumulate-requant" pipeline), so the
   error is O(W) quantization noise, not compounding bias: stochastic
   rounding keeps it zero-mean.
+- ``bits=16`` runs the SAME ring with a bit-exact payload: each fp32
+  element crosses as two 16-bit wire words (its raw high/low halves)
+  and is reassembled exactly.  No bandwidth win (32 bits on the wire)
+  — this mode exists as the *parity anchor* of the explicit-collective
+  machinery: at dp=2 the single-hop sum is order-invariant, so a
+  training run through the explicit ring is bit-identical to the
+  implicit XLA all-reduce, isolating bits=8's deviation to the
+  quantizer alone (pinned by ``tests/test_dp_compressed.py``).
+- ``ring_reduce_scatter(x, axis_name, shard_axis, bits)``: the
+  reduce-scatter half on its own — the gradient side of the
+  cross-replica sharded weight update (PAPERS.md arxiv 2004.13336):
+  rank r keeps only shard r of the summed tensor, quantizable with the
+  same wire modes.
 - ``bf16_all_reduce``: the cheap 2x variant (upstream DistributedStrategy
   ``fp16_allreduce`` analog; bf16 on TPU).
 
-Both are pure jax functions usable inside any shard_map over the target
+All are pure jax functions usable inside any shard_map over the target
 mesh axis; `hybrid dp = (dcn_dp, ici_dp)` meshes apply them on the
 outer axis only (see DESIGN-DCN.md for the placement rules and the
 scaling-efficiency model).
@@ -29,6 +42,7 @@ scaling-efficiency model).
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -59,6 +73,74 @@ def _block_dequant(q, scale):
             scale.astype(jnp.float32)).reshape(-1)
 
 
+def _split16(x):
+    """Lossless fp32 → two 16-bit wire words (raw high/low halves of
+    the bit pattern).  The high half IS the bf16 truncation of x; the
+    low half carries the remaining mantissa bits, so ``_merge16``
+    reassembles the exact fp32 value.  bits=16's payload codec."""
+    u = lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    return ((u >> 16).astype(jnp.uint16),
+            (u & jnp.uint32(0xFFFF)).astype(jnp.uint16))
+
+
+def _merge16(hi, lo):
+    u = (hi.astype(jnp.uint32) << 16) | lo.astype(jnp.uint32)
+    return lax.bitcast_convert_type(u, jnp.float32)
+
+
+def _encode_hop(x, bits, block, key):
+    """One hop's wire payload for fp32 ``x``: a tuple of arrays that
+    cross the link (everything else stays local).  bits=8: int8 blocks
+    + bf16 scales (lossy, stochastic-rounded); bits=16: exact
+    high/low 16-bit halves (lossless)."""
+    if bits == 16:
+        return _split16(x)
+    q, sc = _block_quant(x, block, bits, key)
+    return (q, sc)
+
+
+def _decode_hop(payload, bits, shape):
+    if bits == 16:
+        return _merge16(*payload).reshape(shape)
+    q, sc = payload
+    return _block_dequant(q, sc).reshape(shape)
+
+
+def _ppermute_payload(payload, axis_name, perm):
+    return tuple(lax.ppermute(p, axis_name, perm) for p in payload)
+
+
+def wire_bits_per_element(bits: int, block: int = 256) -> float:
+    """Wire cost of one fp32 element on one hop under a mode: 8 →
+    int8 + amortized bf16 block scale; 16 → the exact 2x16-bit split
+    (no win — the parity anchor); 0/None → plain fp32."""
+    if bits == 8:
+        return 8.0 + 16.0 / block
+    return 32.0
+
+
+def dp_comm_bytes_per_step(n_elems: int, world: int, bits: int,
+                           sharded_update: bool,
+                           block: int = 256) -> int:
+    """Modeled per-device dp-axis bytes for one train step (the
+    quantity `dp_allreduce_bytes_total` counts and the bench's
+    bytes-moved proxy cross-checks against compiled HLO):
+
+    - unsharded: ring all-reduce of N grad elements = reduce-scatter +
+      all-gather, both at the mode's wire width;
+    - sharded update: reduce-scatter of grads at the mode's wire width
+      + all-gather of the updated params at full fp32 (weights are
+      state — persistent error is not zero-mean like grad noise, so
+      the param gather is never quantized)."""
+    if world <= 1:
+        return 0
+    hops = (world - 1) / world * n_elems
+    grad_bits = wire_bits_per_element(bits or 0, block)
+    if sharded_update:
+        return int(hops * (grad_bits + 32.0) / 8)
+    return int(2 * hops * grad_bits / 8)
+
+
 def _scatter_row(arr, idx, row):
     return arr.at[idx].set(row)     # idx may be a traced axis_index
 
@@ -74,11 +156,13 @@ def _pad_to(x, mult):
 
 def quantized_all_reduce(x, axis_name: str, bits: int = 8,
                          block: int = 256, key=None):
-    """Sum-all-reduce over `axis_name` with int`bits` wire format.
+    """Sum-all-reduce over `axis_name` with a 16-or-8-bit-word wire
+    format (bits=8: lossy int8 blocks; bits=16: exact — the parity
+    anchor, see the module docstring).
 
     Must run inside shard_map/pmap binding `axis_name`.  The ring:
     W-1 reduce-scatter hops (each rank owns chunk r at the end) then
-    W-1 all-gather hops; every payload crosses the link quantized.
+    W-1 all-gather hops; every payload crosses the link encoded.
     Returns fp32 of x's shape (cast back to x.dtype)."""
     from .shard_map_compat import axis_size
     W = axis_size(axis_name)
@@ -93,6 +177,7 @@ def quantized_all_reduce(x, axis_name: str, bits: int = 8,
     flat, n = _pad_to(x.astype(jnp.float32), block * W)
     chunks = flat.reshape(W, -1)          # [W, C]
     perm = [(i, (i + 1) % W) for i in range(W)]
+    cshape = chunks[0].shape
 
     # ring reduce-scatter: step s sends the partial for chunk
     # (r - s) mod W; after W-1 steps rank r holds the full sum of
@@ -104,24 +189,78 @@ def quantized_all_reduce(x, axis_name: str, bits: int = 8,
         idx = (r - s) % W
         part = jnp.take(chunks, idx, axis=0) + acc
         key, sub = jax.random.split(key)
-        q, sc = _block_quant(part, block, bits, sub)
-        q = lax.ppermute(q, axis_name, perm)
-        sc = lax.ppermute(sc, axis_name, perm)
-        acc = _block_dequant(q, sc)
+        payload = _encode_hop(part, bits, block, sub)
+        payload = _ppermute_payload(payload, axis_name, perm)
+        acc = _decode_hop(payload, bits, cshape)
     own = (r + 1) % W
     final = jnp.take(chunks, own, axis=0) + acc   # my chunk's full sum
 
-    # ring all-gather of the quantized final chunks (own chunk exact)
+    # ring all-gather of the encoded final chunks.  The owner scatters
+    # the DECODED copy of its own payload — not the exact sum — so
+    # every rank reconstructs the identical (once-quantized) value:
+    # keeping the owner's chunk exact would leave each rank's params
+    # a slightly different array, a silent cross-replica divergence
+    # that random-walks the "replicated" weights apart step by step
+    # (masked by check_vma=False in the runner's shard_map).
     key, sub = jax.random.split(key)
-    q, sc = _block_quant(final, block, bits, sub)
+    payload = _encode_hop(final, bits, block, sub)
     out = jnp.zeros((W,) + final.shape, jnp.float32)
-    out = _scatter_row(out, own, final)
+    out = _scatter_row(out, own, _decode_hop(payload, bits, cshape))
     for s in range(W - 1):
-        q = lax.ppermute(q, axis_name, perm)
-        sc = lax.ppermute(sc, axis_name, perm)
+        payload = _ppermute_payload(payload, axis_name, perm)
         src = (r - s) % W                 # owner of the arriving chunk
-        out = _scatter_row(out, src, _block_dequant(q, sc))
+        out = _scatter_row(out, src, _decode_hop(payload, bits, cshape))
     return out.reshape(-1)[:n].reshape(x.shape).astype(orig_dtype)
+
+
+def ring_reduce_scatter(x, axis_name: str, shard_axis: int = 0,
+                        bits: int = 8, block: int = 256, key=None):
+    """Ring reduce-scatter with the compressed wire format: sums ``x``
+    over ``axis_name`` and returns rank r's shard r along
+    ``shard_axis`` (the same shard ``lax.psum_scatter(...,
+    tiled=True)`` would own, so the result drops straight onto a
+    ``PartitionSpec`` that shards ``shard_axis`` on the same mesh
+    axis).  The axis size W must divide ``x.shape[shard_axis]``.
+
+    This is the gradient half of the cross-replica sharded weight
+    update: every partial crosses the link encoded (int8 blocks at
+    bits=8, exact 16-bit halves at bits=16), the accumulate happens in
+    fp32 after each decode."""
+    from .shard_map_compat import axis_size
+    W = axis_size(axis_name)
+    if W == 1:
+        return x
+    r = lax.axis_index(axis_name)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    key = jax.random.fold_in(key, r)
+
+    orig_dtype = x.dtype
+    xf = jnp.moveaxis(x.astype(jnp.float32), shard_axis, 0)
+    lead = xf.shape[0]
+    assert lead % W == 0, (x.shape, shard_axis, W)
+    rows = xf.reshape(W, lead // W, *xf.shape[1:])     # [W, shard...]
+    shard_shape = rows.shape[1:]
+    size = math.prod(shard_shape)
+    per = -(-size // block) * block      # block-pad each chunk row
+    chunks = jnp.zeros((W, per), jnp.float32)
+    chunks = chunks.at[:, :size].set(rows.reshape(W, -1))
+    perm = [(i, (i + 1) % W) for i in range(W)]
+    cshape = chunks[0].shape
+
+    # step s: send the running partial for chunk (r - s - 1) mod W;
+    # after W-1 hops rank r holds the full sum of its OWN chunk r
+    acc = jnp.zeros_like(chunks[0])
+    for s in range(W - 1):
+        idx = (r - s - 1) % W
+        part = jnp.take(chunks, idx, axis=0) + acc
+        key, sub = jax.random.split(key)
+        payload = _encode_hop(part, bits, block, sub)
+        payload = _ppermute_payload(payload, axis_name, perm)
+        acc = _decode_hop(payload, bits, cshape)
+    own_sum = jnp.take(chunks, r, axis=0) + acc
+    shard = own_sum[:size].reshape(shard_shape)
+    return jnp.moveaxis(shard, 0, shard_axis).astype(orig_dtype)
 
 
 def bf16_all_reduce(x, axis_name: str):
@@ -133,17 +272,22 @@ def bf16_all_reduce(x, axis_name: str):
     return lax.psum(x.astype(jnp.bfloat16), axis_name).astype(x.dtype)
 
 
-def compressed_psum_tree(tree, axis_name: str, mode: str = "int8",
+def compressed_psum_tree(tree, axis_name: str, mode="int8",
                          key=None, **kw):
     """Apply the compressed all-reduce across a pytree of gradients.
-    mode: 'int8' (EQuARX ring), 'bf16', or 'none' (exact psum)."""
+    mode: 'int8'/8 (EQuARX ring), 'exact16'/16 (bit-exact ring, the
+    parity anchor), 'bf16', or 'none' (exact psum)."""
     if mode == "none":
         return jax.tree_util.tree_map(
             lambda g: lax.psum(g, axis_name), tree)
     if mode == "bf16":
         return jax.tree_util.tree_map(
             lambda g: bf16_all_reduce(g, axis_name), tree)
-    if mode != "int8":
+    if mode in ("int8", 8):
+        bits = 8
+    elif mode in ("exact16", "int16", 16):
+        bits = 16
+    else:
         raise ValueError(f"unknown compressed allreduce mode {mode!r}")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if key is None:
@@ -151,5 +295,6 @@ def compressed_psum_tree(tree, axis_name: str, mode: str = "int8",
     out = []
     for i, leaf in enumerate(leaves):
         out.append(quantized_all_reduce(
-            leaf, axis_name, key=jax.random.fold_in(key, i), **kw))
+            leaf, axis_name, bits=bits,
+            key=jax.random.fold_in(key, i), **kw))
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -76,6 +76,19 @@ class DistributedStrategy:
         self.lamb = False
         self.lamb_configs = {"lamb_weight_decay": 0.01,
                              "exclude_from_weight_decay": []}
+        # dp gradient-path knobs (DESIGN-DCN.md): quantized_allreduce
+        # selects the wire format of the dp gradient reduction —
+        # 0 = off (implicit XLA all-reduce), 16 = explicit exact ring
+        # (the bit-parity anchor), 8 = EQuARX int8 ring (~3.97x fewer
+        # dp wire bytes); sharded_weight_update reduce-scatters grads
+        # and shards the optimizer update + opt_state over dp
+        # (PAPERS.md arxiv 2004.13336 — per-replica optimizer memory
+        # ~1/dp).  Consumed by fleet.distributed_runner; refused (never
+        # silently dropped) on meshes the explicit dp path can't honor.
+        # Env overrides: PADDLE_TPU_DP_COMPRESS /
+        # PADDLE_TPU_DP_SHARD_UPDATE.
+        self.quantized_allreduce = 0
+        self.sharded_weight_update = False
         self.localsgd = False
         self.dgc = False
         self.fuse_all_reduce_ops = True

@@ -109,7 +109,11 @@ class Fleet:
         * pipeline accumulate_steps → same when gradient_merge is off,
         * amp → compiled-step auto_cast (O2 when use_pure_fp16, bf16 per
           use_bf16),
-        * recompute → jax.checkpoint around the microbatch loss.
+        * recompute → jax.checkpoint around the microbatch loss,
+        * quantized_allreduce (0|16|8) → explicit dp gradient ring at
+          that wire width (DESIGN-DCN.md),
+        * sharded_weight_update → dp reduce-scatter + 1/dp-sharded
+          optimizer update + param all-gather.
         """
         from ..runner import DistributedRunner
         from .. import collective as coll
@@ -132,7 +136,9 @@ class Fleet:
             model, optimizer, loss_fn, mesh=coll.get_mesh(),
             sharding_stage=stage, accumulate_steps=max(acc, 1),
             input_specs=input_specs, amp_level=amp_level,
-            amp_dtype=amp_dtype, remat=bool(s.recompute))
+            amp_dtype=amp_dtype, remat=bool(s.recompute),
+            dp_compress_bits=getattr(s, "quantized_allreduce", 0),
+            dp_shard_update=getattr(s, "sharded_weight_update", False))
 
     def enable_resilience(self, hang_timeout: Optional[float] = None,
                           on_hang=None, dump_path: Optional[str] = None):

@@ -21,6 +21,7 @@ and bench.py.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -63,6 +64,39 @@ def _observe_mesh_steps(n_steps: int, wall_s: float):
 
 _data_axes = coll.data_axes
 
+#: env overrides for the dp gradient-path knobs (DESIGN-DCN.md): a set
+#: env var WINS over the constructor/strategy value, so a bench or an
+#: operator can flip compression on a job whose profile doesn't carry
+#: the knob.  PADDLE_TPU_DP_COMPRESS ∈ {"", "0", "8", "16"};
+#: PADDLE_TPU_DP_SHARD_UPDATE ∈ {"", "0", "1"}.
+_DP_COMPRESS_ENV = "PADDLE_TPU_DP_COMPRESS"
+_DP_SHARD_ENV = "PADDLE_TPU_DP_SHARD_UPDATE"
+
+
+def _resolve_dp_knobs(dp_compress_bits, dp_shard_update):
+    """(bits, shard_update) after env overrides — bits ∈ {0, 8, 16}."""
+    env_bits = os.environ.get(_DP_COMPRESS_ENV, "").strip().lower()
+    if env_bits:
+        dp_compress_bits = {"0": 0, "off": 0, "none": 0,
+                            "8": 8, "int8": 8,
+                            "16": 16, "exact16": 16}.get(env_bits)
+        if dp_compress_bits is None:
+            raise ValueError(
+                f"{_DP_COMPRESS_ENV}={env_bits!r}: expected 0, 8 or 16")
+    bits = int(dp_compress_bits or 0)
+    if bits not in (0, 8, 16):
+        raise ValueError(
+            f"dp_compress_bits / DistributedStrategy.quantized_allreduce"
+            f" must be 0 (off), 8 (int8 ring) or 16 (exact ring), got "
+            f"{dp_compress_bits!r}")
+    env_sh = os.environ.get(_DP_SHARD_ENV, "").strip().lower()
+    if env_sh:
+        if env_sh not in ("0", "1", "true", "false"):
+            raise ValueError(
+                f"{_DP_SHARD_ENV}={env_sh!r}: expected 0 or 1")
+        dp_shard_update = env_sh in ("1", "true")
+    return bits, bool(dp_shard_update)
+
 
 class DistributedRunner:
     def __init__(self, network, optimizer, loss_fn=None,
@@ -71,13 +105,31 @@ class DistributedRunner:
                  amp_level: Optional[str] = None,
                  amp_dtype: str = "bfloat16",
                  capture_outputs: bool = False,
-                 remat: bool = False):
+                 remat: bool = False,
+                 dp_compress_bits: Optional[int] = None,
+                 dp_shard_update: Optional[bool] = None):
         self.network = network
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh or coll.ensure_mesh()
         self.sharding_stage = sharding_stage
         self.accumulate_steps = accumulate_steps
+        # dp gradient-path knobs (DESIGN-DCN.md; strategy knobs
+        # quantized_allreduce / sharded_weight_update, env override
+        # wins): bits ∈ {0, 8, 16} selects the wire format of the
+        # explicit dp gradient reduction; shard_update reduce-scatters
+        # grads, updates only this replica's 1/dp shard of
+        # params+opt_state and all-gathers params back.  Both route
+        # the shared step body through an explicit shard_map over the
+        # dp axis — see _dp_explicit_step_math.
+        self._dp_compress_bits, self._dp_shard_update = \
+            _resolve_dp_knobs(dp_compress_bits, dp_shard_update)
+        self._dp_world = int(self.mesh.shape.get("dp", 1))
+        self._dp_explicit = bool(
+            (self._dp_compress_bits or self._dp_shard_update)
+            and self._dp_world > 1)
+        self._validate_dp_knobs()
+        self._dp_comm_info = None
         # per-input PartitionSpec overrides (position → PartitionSpec or
         # None to keep the tensor out of the dspec heuristic below)
         self.input_specs = input_specs
@@ -110,6 +162,35 @@ class DistributedRunner:
         self._defer_wrapper_sync = False
         self._wrappers_dirty = False
 
+    def _validate_dp_knobs(self):
+        """Refuse — never silently drop — a dp compression / sharded-
+        update knob the explicit path cannot honor (the strategy
+        contract: every knob is consumed or refused)."""
+        if not (self._dp_compress_bits or self._dp_shard_update):
+            return
+        busy = {ax: int(self.mesh.shape.get(ax, 1))
+                for ax in ("mp", "pp", "sep", "sharding")
+                if int(self.mesh.shape.get(ax, 1)) > 1}
+        if busy:
+            raise ValueError(
+                "quantized_allreduce / sharded_weight_update run the "
+                "step through an explicit shard_map over the dp axis "
+                "and currently require every other mesh axis to be "
+                f"size 1; got {busy}.  Use the implicit path (knobs "
+                "off) for hybrid dp x mp/pp/sep/ZeRO meshes.")
+        if self._dp_shard_update and self._dp_world > 1:
+            clip = getattr(self.optimizer, "_grad_clip", None)
+            if clip is not None and hasattr(clip, "pure_clip"):
+                from ..nn.clip_grad import (ClipGradByGlobalNorm,
+                                            ClipGradByValue)
+                if not isinstance(clip, (ClipGradByGlobalNorm,
+                                         ClipGradByValue)):
+                    raise ValueError(
+                        "sharded_weight_update supports "
+                        "ClipGradByGlobalNorm (cross-shard psum of the "
+                        "norm) and ClipGradByValue (elementwise); got "
+                        f"{type(clip).__name__}")
+
     # -- sharding assignment -------------------------------------------------
     def _param_spec(self, p) -> P:
         if getattr(p, "dist_spec", None) is not None:
@@ -120,10 +201,27 @@ class DistributedRunner:
                 return P(*shard_spec_for(p.shape, size))
         return P()
 
-    def _state_spec(self, pspec: P, leaf) -> P:
+    def _state_spec(self, pspec: P, leaf, name: Optional[str] = None
+                    ) -> P:
         """Optimizer-state leaf sharding: follow the param, except under
-        ZeRO-1/2 where flat state shards on the 'sharding' axis."""
+        ZeRO-1/2 where flat state shards on the 'sharding' axis, and
+        under the dp-sharded weight update where every param-shaped
+        slot shards its update dim on 'dp' (per-replica optimizer
+        memory drops to ~1/dp — PAPERS.md arxiv 2004.13336)."""
         if np.ndim(leaf) == 0:
+            return P()
+        if self._dp_explicit and self._dp_shard_update and \
+                name is not None:
+            d = self._dp_shard_dims.get(name)
+            p = self._name_to_param.get(name)
+            if d is not None and p is not None and \
+                    tuple(np.shape(leaf)) == tuple(p.shape):
+                # no trailing Nones: shard_map canonicalizes its output
+                # NamedSharding to P('dp',) — an equivalent-but-unequal
+                # P('dp', None) on the placed input would miss the jit
+                # cache and retrace the step once after dispatch 1
+                spec = [None] * d + ["dp"]
+                return P(*spec)
             return P()
         if self.sharding_stage >= 1:
             size = int(self.mesh.shape.get("sharding", 1))
@@ -141,6 +239,18 @@ class DistributedRunner:
         self._name_to_buf = dict(self.network.named_buffers())
         self._pspecs = {n: self._param_spec(p)
                         for n, p in name_to_param.items()}
+        # dp-sharded weight update: which dim of each trainable param
+        # the update/opt-state shards on the dp axis (None = nothing
+        # divides — that leaf updates replicated, grads full-reduced)
+        self._dp_shard_dims = {}
+        if self._dp_shard_update and self._dp_world > 1:
+            for n, p in name_to_param.items():
+                if p.stop_gradient:
+                    continue
+                spec = shard_spec_for(p.shape, self._dp_world, "dp")
+                self._dp_shard_dims[n] = next(
+                    (i for i, a in enumerate(spec) if a == "dp"), None)
+        self._compute_dp_comm_info(name_to_param)
         # per-param weight-decay coefficient and LR multiplier
         # (ParamAttr regularizer / learning_rate parity with step())
         (self._decay_coeffs, self._l1_coeffs,
@@ -168,10 +278,44 @@ class DistributedRunner:
         for n, st in self._opt_state.items():
             pspec = self._pspecs.get(n, P())
             placed_state[n] = {
-                k: self._shard(v, self._state_spec(pspec, v))
+                k: self._shard(v, self._state_spec(pspec, v, name=n))
                 for k, v in st.items()}
         self._opt_state = placed_state
         self._placed = True
+
+    def _compute_dp_comm_info(self, name_to_param):
+        """Host-side dp-comm byte model for the observability counters
+        (`dp_allreduce_bytes_total`, `dp_compress_ratio`): modeled
+        per-device bytes per step over the dp axis, cross-checked
+        against compiled-HLO collective sizes by the bench's
+        bytes-moved audit."""
+        W = self._dp_world
+        if W <= 1:
+            self._dp_comm_info = None
+            return
+        from .compressed import dp_comm_bytes_per_step
+        bits = self._dp_compress_bits if self._dp_explicit else 0
+        shard_on = self._dp_shard_update and self._dp_explicit
+        n_elems = 0
+        bytes_step = 0
+        for n, p in name_to_param.items():
+            if p.stop_gradient:
+                continue
+            leaf = int(np.prod(p.shape))
+            n_elems += leaf
+            # a leaf with no dp-divisible dim falls back to a full
+            # all-reduce even under the sharded update — model what
+            # the compiled program actually does, per leaf
+            leaf_sharded = (shard_on and
+                            self._dp_shard_dims.get(n) is not None)
+            bytes_step += dp_comm_bytes_per_step(
+                leaf, W, bits, leaf_sharded)
+        baseline = dp_comm_bytes_per_step(n_elems, W, 0, False)
+        self._dp_comm_info = {
+            "bytes_per_step": bytes_step,
+            "ratio": (baseline / bytes_step) if bytes_step else 1.0,
+            "grad_elems": n_elems,
+        }
 
     # -- the compiled step ---------------------------------------------------
     def _data_pspecs(self, shapes, stacked: bool):
@@ -217,22 +361,37 @@ class DistributedRunner:
         amp/remat, microbatch gradient accumulation, ZeRO grad
         constraints, canonical-sharding pin on the updated params —
         so the legacy per-step program and the folded scan body cannot
-        drift apart (their bit-parity is the engine's contract).
+        drift apart (their bit-parity is the engine's contract).  The
+        dp gradient-path knobs (quantized allreduce, sharded weight
+        update) swap the reduction/update half here, INSIDE the shared
+        body, so both entries get them for free — that sharing is
+        pinned by ``test_dp_compressed.py``.
 
         Returns ``per_step(params, frozen, buffers, opt_state, lr,
         key, md) -> (loss_f32, mstats, out_vals, new_params,
         new_state, new_buf)``; ``mstats`` are the in-step metric stat
         vectors (fold path), empty without ``metric_fns``."""
+        if self._dp_explicit:
+            return self._dp_explicit_step_math(n_in, metric_fns)
+        return self._implicit_step_math(n_in, metric_fns)
+
+    def _grad_math(self, n_in: int, metric_fns=()):
+        """The forward/backward half of the step body — amp/remat,
+        microbatch gradient-accumulation scan, in-step metric stats —
+        shared verbatim by the implicit (XLA-reduced) and the explicit
+        dp (shard_map-reduced) update paths.  Returns
+        ``grad_step(params, frozen, buffers, key, md) -> (loss_f32,
+        mstats, out_vals, grads, new_buf)`` where ``grads`` are the
+        gradients of the loss as seen by this program (global-mean
+        loss under the implicit path; local-mean loss inside the
+        explicit per-replica body)."""
         net = self.network
         loss_layer = self.loss_fn
-        mesh = self.mesh
-        opt = self.optimizer
-        stage = self.sharding_stage
         runner = self
         acc = max(int(self.accumulate_steps), 1)
         capture = bool(self.capture_outputs or metric_fns)
 
-        def per_step(params, frozen, buffers, opt_state, lr, key, md):
+        def grad_step(params, frozen, buffers, key, md):
             def loss_of(p, bufs_in, micro_data, micro_key):
                 import contextlib
                 inputs = [Tensor(v) for v in micro_data[:n_in]]
@@ -298,14 +457,31 @@ class DistributedRunner:
                             for o in out_stack]
                 grads = jax.tree_util.tree_map(lambda g: g / acc, grads)
                 loss_val = loss_sum / acc
-            if stage >= 2:
-                size = int(mesh.shape.get("sharding", 1))
-                if size > 1:
-                    grads = {
-                        n: jax.lax.with_sharding_constraint(
-                            g, NamedSharding(
-                                mesh, P(*shard_spec_for(g.shape, size))))
-                        for n, g in grads.items()}
+            mstats = (tuple(mf(out_vals[0], md[n_in])
+                            for mf in metric_fns)
+                      if metric_fns and len(md) > n_in and out_vals
+                      else ())
+            return loss_val, mstats, out_vals, grads, new_buf
+
+        return grad_step
+
+    def _implicit_step_math(self, n_in: int, metric_fns=()):
+        """The default update half: XLA emits the dp gradient
+        all-reduce (or ZeRO reduce-scatter) implicitly from the
+        shardings; the optimizer update runs replicated (or
+        'sharding'-axis sharded under ZeRO-1/2)."""
+        mesh = self.mesh
+        opt = self.optimizer
+        stage = self.sharding_stage
+        runner = self
+        grad_step = self._grad_math(n_in, metric_fns)
+
+        def per_step(params, frozen, buffers, opt_state, lr, key, md):
+            loss_val, mstats, out_vals, grads, new_buf = grad_step(
+                params, frozen, buffers, key, md)
+            size = int(mesh.shape.get("sharding", 1))
+            if stage >= 1 and size > 1:
+                grads = runner._constrain_zero_grads(grads, stage, size)
             new_params, new_state = opt.apply_gradients_tree(
                 params, grads, opt_state, lr,
                 decay_coeffs=runner._decay_coeffs,
@@ -317,14 +493,281 @@ class DistributedRunner:
                 n: jax.lax.with_sharding_constraint(
                     v, NamedSharding(mesh, runner._pspecs.get(n, P())))
                 for n, v in new_params.items()}
-            mstats = (tuple(mf(out_vals[0], md[n_in])
-                            for mf in metric_fns)
-                      if metric_fns and len(md) > n_in and out_vals
-                      else ())
             return (loss_val, mstats, out_vals, new_params, new_state,
                     new_buf)
 
         return per_step
+
+    # -- explicit dp gradient path (DESIGN-DCN.md) ---------------------------
+    def _dp_data_in_specs(self, shapes):
+        """shard_map in_specs for the per-step data leaves: the same
+        placement `_data_pspecs` pins on the implicit path (batch dim
+        on 'dp'; overrides honored), refused loudly if an override
+        names an axis the explicit path cannot bind."""
+        specs = self._data_pspecs(shapes, stacked=False)
+        if specs is None:
+            return tuple(P() for _ in shapes)
+        out = []
+        for s in specs:
+            if s is None:
+                out.append(P())
+                continue
+            for ax in s:
+                names = [ax] if isinstance(ax, str) else list(ax or [])
+                if any(a != "dp" for a in names):
+                    raise ValueError(
+                        "quantized_allreduce / sharded_weight_update: "
+                        f"input spec {s} names a non-dp mesh axis; the "
+                        "explicit dp path shards data on 'dp' only")
+            out.append(s)
+        return tuple(out)
+
+    def _dp_state_spec_tree(self):
+        """PartitionSpec tree of the (placed) opt_state — the
+        shard_map in/out specs of the sharded weight update; must
+        agree with place()'s device layout (both go through
+        ``_state_spec``)."""
+        return {
+            n: {k: self._state_spec(self._pspecs.get(n, P()), v, name=n)
+                for k, v in st.items()}
+            for n, st in self._opt_state.items()}
+
+    def _dp_sharded_clip_fn(self, clip, shard_dims):
+        """Gradient clipping over the dp-sharded gradient layout.
+        ClipGradByValue is elementwise (shard-safe as-is);
+        ClipGradByGlobalNorm needs the TRUE global norm: sharded
+        leaves contribute their local-shard sum-of-squares psum'd over
+        dp (each element counted once), replicated-fallback leaves
+        contribute locally (identical on every replica).  Anything
+        else was refused at construction."""
+        from ..nn.clip_grad import ClipGradByValue
+
+        if isinstance(clip, ClipGradByValue):
+            return clip.pure_clip
+
+        def global_norm_clip(g_sh):
+            sq_sharded = jnp.asarray(0.0, jnp.float32)
+            sq_repl = jnp.asarray(0.0, jnp.float32)
+            for n, g in g_sh.items():
+                s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                if shard_dims.get(n) is None:
+                    sq_repl = sq_repl + s
+                else:
+                    sq_sharded = sq_sharded + s
+            total = jax.lax.psum(sq_sharded, "dp") + sq_repl
+            norm = jnp.sqrt(total)
+            scale = clip.clip_norm / jnp.maximum(norm, clip.clip_norm)
+            return {n: (g.astype(jnp.float32) * scale).astype(g.dtype)
+                    for n, g in g_sh.items()}
+
+        return global_norm_clip
+
+    def _dp_explicit_step_math(self, n_in: int, metric_fns=()):
+        """The compressed / sharded dp update half: the shared
+        forward/backward (``_grad_math``) runs per-replica inside a
+        ``shard_map`` over the dp axis, then the gradient reduction is
+        an EXPLICIT collective site (DESIGN-DCN.md integration plan):
+
+        * bits=16 — exact ring all-reduce (two 16-bit words per fp32
+          element; the parity anchor: at dp=2 bit-identical to the
+          implicit XLA path end-to-end);
+        * bits=8  — EQuARX int8 ring (~3.97x fewer dp wire bytes,
+          zero-mean stochastic-rounding noise);
+        * sharded_weight_update — grads reduce-scatter (at the mode's
+          wire width), Adam/SGD/... updates only this replica's 1/dp
+          shard of params + opt_state, params all-gather back exactly
+          (weights are state: persistent error is not zero-mean, so
+          the param gather is never quantized).  Opt-state leaves stay
+          full-shape arrays SHARDED on 'dp' via NamedSharding, so
+          checkpoints keep the unsharded layout and per-device memory
+          drops to ~1/dp.
+
+        Model RNG folds the dp rank in (per-replica dropout masks —
+        DataParallel semantics); batch statistics are per-replica with
+        a pmean write-back of float buffers (SyncBN-approximate).
+        """
+        from .shard_map_compat import shard_map
+        from .compressed import quantized_all_reduce, ring_reduce_scatter
+
+        runner = self
+        mesh = self.mesh
+        opt = self.optimizer
+        W = self._dp_world
+        bits = self._dp_compress_bits
+        shard_update = self._dp_shard_update
+        shard_dims = dict(getattr(self, "_dp_shard_dims", {}))
+        grad_step = self._grad_math(n_in, metric_fns)
+        state_specs = self._dp_state_spec_tree()
+        clip = getattr(opt, "_grad_clip", None)
+        clip_fn = None
+        if shard_update and clip is not None and \
+                hasattr(clip, "pure_clip"):
+            clip_fn = self._dp_sharded_clip_fn(clip, shard_dims)
+
+        def reduce_full(g, qkey, i):
+            """Full all-reduce of one grad leaf at the wire mode."""
+            if bits:
+                return quantized_all_reduce(
+                    g, "dp", bits=bits,
+                    key=jax.random.fold_in(qkey, i))
+            return jax.lax.psum(g, "dp")
+
+        def body(params, frozen, buffers, opt_state, lr, key, md):
+            r = jax.lax.axis_index("dp")
+            # per-replica model RNG (dropout decorrelates across dp,
+            # exactly like process-per-rank DataParallel); a no-RNG
+            # model is unaffected, preserving the bits=16 parity pin
+            mkey = jax.random.fold_in(key, r)
+            qkey = jax.random.fold_in(key, jnp.uint32(0x51ED5EED))
+            loss_val, mstats, out_vals, grads, new_buf = grad_step(
+                params, frozen, buffers, mkey, md)
+            # grads are d(local-mean loss); the dp-mean of the
+            # per-replica grads is the global-batch gradient
+            if not shard_update:
+                grads = {n: reduce_full(g, qkey, i) / W
+                         for i, (n, g) in enumerate(grads.items())}
+                new_params, new_state = opt.apply_gradients_tree(
+                    params, grads, opt_state, lr,
+                    decay_coeffs=runner._decay_coeffs,
+                    lr_scales=runner._lr_scales,
+                    l1_coeffs=runner._l1_coeffs)
+            else:
+                g_sh, p_sh = {}, {}
+                for i, (n, g) in enumerate(grads.items()):
+                    d = shard_dims.get(n)
+                    if d is None:
+                        g_sh[n] = reduce_full(g, qkey, i) / W
+                        p_sh[n] = params[n]
+                        continue
+                    if bits:
+                        gs = ring_reduce_scatter(
+                            g, "dp", shard_axis=d, bits=bits,
+                            key=jax.random.fold_in(qkey, i))
+                    else:
+                        gs = jax.lax.psum_scatter(
+                            g, "dp", scatter_dimension=d, tiled=True)
+                    g_sh[n] = gs / W
+                    span_len = params[n].shape[d] // W
+                    p_sh[n] = jax.lax.dynamic_slice_in_dim(
+                        params[n], r * span_len, span_len, axis=d)
+                if clip_fn is not None:
+                    g_sh = clip_fn(g_sh)
+                new_p_sh, new_state = opt.apply_gradients_tree(
+                    p_sh, g_sh, opt_state, lr,
+                    decay_coeffs=runner._decay_coeffs,
+                    lr_scales=runner._lr_scales,
+                    l1_coeffs=runner._l1_coeffs,
+                    apply_clip=clip_fn is None)
+                new_params = {
+                    n: (v if shard_dims.get(n) is None else
+                        jax.lax.all_gather(v, "dp",
+                                           axis=shard_dims[n],
+                                           tiled=True))
+                    for n, v in new_p_sh.items()}
+            loss_val = jax.lax.pmean(loss_val, "dp")
+            mstats = jax.tree_util.tree_map(
+                lambda s: jax.lax.psum(s, "dp"), mstats)
+            new_buf = {
+                n: (jax.lax.pmean(b, "dp")
+                    if jnp.issubdtype(b.dtype, jnp.floating) else b)
+                for n, b in new_buf.items()}
+            return (loss_val, mstats, out_vals, new_params, new_state,
+                    new_buf)
+
+        def per_step(params, frozen, buffers, opt_state, lr, key, md):
+            data_specs = self._dp_data_in_specs(
+                [d.shape for d in md])
+            wrapped = shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), P(), P(), state_specs, P(), P(),
+                          data_specs),
+                out_specs=(P(), P(), P("dp"), P(), state_specs, P()),
+                check_vma=False)
+            return wrapped(params, frozen, buffers, opt_state, lr,
+                           key, md)
+
+        return per_step
+
+    def _observe_dp_comm(self, n_steps: int):
+        """dp-comm observability (host floats only, no device sync):
+        modeled per-device dp wire bytes per dispatch on the registry
+        (`dp_allreduce_bytes_total`) plus the achieved compression
+        ratio gauge; under tracing, instant annotation spans mark the
+        dispatch's reduce-scatter/all-gather (or all-reduce) site with
+        the byte/mode payload so /trace and /fleet/trace see
+        compression working."""
+        info = self._dp_comm_info
+        if not info:
+            return
+        reg = _obs_metrics.registry()
+        reg.counter(
+            "dp_allreduce_bytes_total",
+            "modeled per-device bytes moved over the dp axis by the "
+            "gradient path (reduce-scatter + all-gather wire bytes)"
+            ).inc(info["bytes_per_step"] * n_steps)
+        reg.gauge(
+            "dp_compress_ratio",
+            "uncompressed-allreduce bytes / actual dp gradient-path "
+            "bytes (1.0 = no compression)").set(info["ratio"])
+        if self._dp_explicit and _obs_trace.enabled():
+            now = time.monotonic()
+            if self._dp_shard_update:
+                _obs_trace.add_span(
+                    "mesh.dp.reduce_scatter", now, now,
+                    args={"bytes": info["bytes_per_step"] * n_steps,
+                          "bits": self._dp_compress_bits or 32})
+                _obs_trace.add_span(
+                    "mesh.dp.all_gather", now, now,
+                    args={"bits": 32})
+            else:
+                _obs_trace.add_span(
+                    "mesh.dp.all_reduce", now, now,
+                    args={"bytes": info["bytes_per_step"] * n_steps,
+                          "bits": self._dp_compress_bits or 32})
+
+    def _constrain_zero_grads(self, grads, stage: int, size: int):
+        """Explicit sharding pins on the ZeRO grad boundary.
+
+        Most leaves shard their ROW dim (dim 0) on the 'sharding' axis
+        and XLA lowers the grad psum straight into a reduce-scatter.
+        But a leaf whose dim 0 does not divide the axis shards an
+        *inner* (feature) dim instead — e.g. a ``[2, 64]`` token-type
+        embedding at sharding=4 — and the partitioner then tries to
+        push that feature-dim sharding up into the batch-sharded
+        activation that produces the grad, giving up with an
+        "[SPMD] Involuntary full rematerialization" warning
+        (MULTICHIP_r05).  For exactly those leaves we annotate the
+        boundary explicitly: the grad is pinned fully-reduced and
+        replicated first (cheap by construction — dim 0 indivisible
+        means the leaf is small), and only then resharded onto the
+        state/grad sharding, so every reshard is planned, not a
+        last-resort remat.  ``test_hlo_collective_audit.py`` pins the
+        compile warning-free."""
+        mesh = self.mesh
+        out = {}
+        for n, g in grads.items():
+            spec = shard_spec_for(g.shape, size)
+            if spec == (None,) * len(spec):
+                out[n] = g
+                continue
+            inner_dim = spec[0] is None
+            if inner_dim:
+                g = jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, P()))
+            if stage >= 2:
+                g = jax.lax.with_sharding_constraint(
+                    g, NamedSharding(mesh, P(*spec)))
+            out[n] = g
+        return out
+
+    def _donate_explicit_ok(self) -> bool:
+        """Whether this runner's compiled entries may donate the
+        params/opt_state carry.  Always true on the implicit path;
+        the explicit-dp path donates only under the
+        ``PADDLE_TPU_DP_DONATE=1`` opt-in (see _build)."""
+        if not self._dp_explicit:
+            return True
+        return os.environ.get("PADDLE_TPU_DP_DONATE", "") == "1"
 
     def _build(self):
         runner = self
@@ -346,7 +789,17 @@ class DistributedRunner:
                                    lr, key, data)
             return loss_val, new_params, new_state, new_buf, out_vals
 
-        return jax.jit(step, donate_argnums=(0, 3))
+        # the explicit-dp (shard_map) programs skip buffer donation: this
+        # container's jaxlib CPU client corrupts donated buffers that
+        # alias through shard_map manual collectives (intermittent NaN
+        # end states / segfaults inside XLA execution — reproduced by
+        # tests/test_dp_compressed.py with donation on, 3/3 clean with
+        # it off; the same family the conftest's sync-dispatch note
+        # documents for plain SPMD programs).  PADDLE_TPU_DP_DONATE=1
+        # opts back in for real-TPU memory-bound runs (ROADMAP
+        # re-measure backlog).
+        donate = (0, 3) if self._donate_explicit_ok() else ()
+        return jax.jit(step, donate_argnums=donate)
 
     def train_step(self, inputs, labels) -> float:
         """Run one compiled step; commits params/state/buffers."""
@@ -360,6 +813,7 @@ class DistributedRunner:
             with _obs_trace.span("mesh.dispatch"):
                 out = self._train_step_inner(inputs, labels)
             _observe_mesh_steps(1, time.perf_counter() - t0)
+            self._observe_dp_comm(1)
             return out
         finally:
             coll.set_mesh(prev_mesh)
@@ -537,11 +991,19 @@ class DistributedRunner:
         if (self._placed and restored is not None
                 and restored is not self._opt_state):
             if set(restored) == set(self._pspecs):
+                # re-placement honors the dp-sharded-update layout too:
+                # a promoted spare (or any external restore) hands in
+                # full host arrays and each device re-adopts ONLY its
+                # 1/dp opt-state shard via the NamedSharding put — the
+                # sharded-elastic-restore contract at the reform
+                # barrier (DESIGN-RESILIENCE.md)
                 placed = {}
                 for n, st in restored.items():
                     pspec = self._pspecs.get(n, P())
                     placed[n] = {
-                        k: self._shard(v, self._state_spec(pspec, v))
+                        k: self._shard(v,
+                                       self._state_spec(pspec, v,
+                                                        name=n))
                         for k, v in st.items()}
                 self._opt_state = placed
                 self.optimizer._opt_state_tree = placed
@@ -606,7 +1068,8 @@ class DistributedRunner:
 
         from ..framework.dispatch import build_folded_step
         return build_folded_step(per_step, fold, donate_buffers=False,
-                                 place_data=place_data)
+                                 place_data=place_data,
+                                 donate_carry=self._donate_explicit_ok())
 
     def train_steps_folded(self, groups, metric_fns=(),
                            metric_acc=None):
@@ -632,6 +1095,7 @@ class DistributedRunner:
                     groups, metric_fns, metric_acc)
             _observe_mesh_steps(len(groups),
                                 time.perf_counter() - t0)
+            self._observe_dp_comm(len(groups))
             return out
         finally:
             coll.set_mesh(prev_mesh)

@@ -86,7 +86,8 @@ def _env_int(name: str, default: int) -> int:
 
 def build_folded_step(per_step: Callable, fold: int,
                       donate_buffers: bool = True,
-                      place_data: Optional[Callable] = None):
+                      place_data: Optional[Callable] = None,
+                      donate_carry: bool = True):
     """ONE compiled program running ``fold`` train steps as a rolled
     ``lax.scan`` over batches stacked on a new leading axis.
 
@@ -108,6 +109,10 @@ def build_folded_step(per_step: Callable, fold: int,
     arrays to their data shardings inside the program, before the scan
     slices them.  ``donate_buffers=False`` keeps the buffers dict alive
     for callers whose cached value dicts alias it (DistributedRunner).
+    ``donate_carry=False`` disables carry donation entirely — the
+    explicit-dp (shard_map) mesh programs use it because this
+    container's jaxlib corrupts donated buffers aliased through
+    shard_map manual collectives (see DistributedRunner._build).
     """
     import jax
     import jax.numpy as jnp
@@ -140,7 +145,10 @@ def build_folded_step(per_step: Callable, fold: int,
     # in place across the K steps; buffers join the donation only where
     # the caller does not alias them (hapi TrainState does not, the
     # runner's cached value dicts do)
-    donate = (0, 2, 3, 4) if donate_buffers else (0, 3, 4)
+    if not donate_carry:
+        donate = ()
+    else:
+        donate = (0, 2, 3, 4) if donate_buffers else (0, 3, 4)
     return jax.jit(program, donate_argnums=donate)
 
 

@@ -231,16 +231,21 @@ class Optimizer:
                              state: Dict[str, Any], lr,
                              decay_coeffs: Optional[Dict[str, float]] = None,
                              lr_scales: Optional[Dict[str, float]] = None,
-                             l1_coeffs: Optional[Dict[str, float]] = None
+                             l1_coeffs: Optional[Dict[str, float]] = None,
+                             apply_clip: bool = True
                              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """Pure: (params, grads, state, lr) → (new_params, new_state).
         Used inside jit — one fused XLA update over all tensors.
 
         ``decay_coeffs``/``lr_scales``: per-param weight-decay coefficient
         and LR multiplier (ParamAttr regularizer / learning_rate parity
-        with the eager step())."""
-        if self._grad_clip is not None and hasattr(self._grad_clip,
-                                                   "pure_clip"):
+        with the eager step()).  ``apply_clip=False`` skips the
+        in-tree gradient clip for callers that already clipped with
+        cross-replica awareness (the dp-sharded weight update clips
+        over the sharded layout with a psum'd global norm — a local
+        ``pure_clip`` there would see only 1/dp of every tensor)."""
+        if apply_clip and self._grad_clip is not None and \
+                hasattr(self._grad_clip, "pure_clip"):
             grads = self._grad_clip.pure_clip(grads)
         new_p, new_s = {}, {}
         for n, v in params.items():

@@ -35,6 +35,10 @@ HOT_MODULES = [
     os.path.join("hapi", "callbacks.py"),
     os.path.join("hapi", "train_state.py"),
     os.path.join("distributed", "runner.py"),
+    # the explicit dp gradient path (DESIGN-DCN.md): the compressed
+    # ring collectives and the sharded weight update trace INSIDE the
+    # compiled step — a host sync here would stall every dispatch
+    os.path.join("distributed", "compressed.py"),
     os.path.join("metric", "__init__.py"),
     os.path.join("io", "dataloader.py"),
     os.path.join("io", "staging.py"),

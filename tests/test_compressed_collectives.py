@@ -79,6 +79,24 @@ def test_int8_allreduce_error_is_small_and_zero_mean():
     assert abs(errs.mean()) < 0.05 * step
 
 
+def test_int8_allreduce_is_replica_consistent():
+    """Every rank must reconstruct the IDENTICAL array: the all-gather
+    scatters the owner's DECODED payload, never its exact sum — an
+    owner-exact copy would leave each replica's "replicated" result a
+    slightly different array, silently random-walking dp-replicated
+    params apart step over step (review catch on the explicit dp
+    gradient path, masked there by check_vma=False)."""
+    n = 4
+    _need(n)
+    rng = np.random.RandomState(5)
+    per_rank = rng.randn(n, 2048).astype(np.float32)
+    got = _run_allreduce(
+        lambda x: quantized_all_reduce(x[0], "x", bits=8)[None],
+        per_rank, n).reshape(n, -1)
+    for r in range(1, n):
+        np.testing.assert_array_equal(got[0], got[r], err_msg=str(r))
+
+
 def test_bf16_allreduce_close():
     n = 4
     _need(n)

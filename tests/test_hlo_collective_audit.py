@@ -152,3 +152,140 @@ def test_dp_axis_carries_exactly_the_gradient_allreduce():
     assert 0.85 * expect <= dp_ar_bytes <= 1.5 * expect, \
         (f"dp all-reduce bytes {dp_ar_bytes} vs modeled 4*P_chip "
          f"{expect} ({per_dev_elems} per-device grad elements)")
+
+
+# -- SPMD involuntary-rematerialization pin (ISSUE 11 satellite) ------------
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _cold_compile():
+    """Compile with the persistent compilation cache OFF: the remat
+    warning is emitted by the SPMD partitioner, which never runs on a
+    cache hit — a warm cache would make the warning-free assertion
+    vacuously pass and the negative control spuriously fail.  Flipping
+    the flag alone is not enough: jax memoizes its is-cache-used
+    verdict once per process, so the memo must be reset around the
+    flip (and again after, so later tests get their cache back)."""
+    from jax._src import compilation_cache as _cc
+    prev = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    _cc.reset_cache()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_compilation_cache", prev)
+        _cc.reset_cache()
+
+
+def _remat_trigger_runner(sharding_stage=1):
+    """The minimal MULTICHIP_r05 warning shape: a trainable leaf whose
+    dim 0 does NOT divide the sharding degree (here the [2, 64]
+    embedding table), so its ZeRO opt-state/grad sharding falls on an
+    INNER dim — exactly the boundary the partitioner used to resolve
+    with an involuntary full rematerialization of the batch-sharded
+    activation feeding that grad."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as optim
+    devices = jax.devices()[:8]
+    mesh = collective.build_mesh({"dp": 2, "sharding": 4},
+                                 devices=devices)
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Embedding(2, 64), nn.Linear(64, 64))
+    opt = optim.Adam(1e-3, parameters=net.parameters())
+    runner = DistributedRunner(net, opt, nn.MSELoss(), mesh=mesh,
+                               sharding_stage=sharding_stage)
+    x = np.zeros((8, 16), dtype=np.int64)
+    y = np.random.RandomState(0).rand(8, 16, 64).astype(np.float32)
+    return runner, [x], [y]
+
+
+_REMAT_WARNING = "Involuntary full rematerialization"
+
+
+def test_zero_grad_boundary_compiles_without_spmd_remat_warnings(
+        capfd):
+    """MULTICHIP_r05's '[SPMD] Involuntary full rematerialization'
+    warnings are dead: the explicit replicated pin on inner-dim-
+    sharded grad leaves (runner._constrain_zero_grads) turns the
+    partitioner's last-resort remat into a planned reshard.  capfd
+    captures XLA's C++ stderr, so the assertion is on the COMPILER's
+    own diagnostics."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    for stage in (1, 2):
+        runner, x, y = _remat_trigger_runner(stage)
+        with _cold_compile():
+            runner.lower_step(x, y).compile()
+        err = capfd.readouterr().err
+        assert _REMAT_WARNING not in err, (stage, err[-2000:])
+
+
+def test_spmd_remat_detector_still_detects(capfd, monkeypatch):
+    """Negative control for the pin above: with the replicated-pin
+    boundary annotation disabled (the pre-fix behavior), the SAME
+    compile must surface the warning — proving the capture harness
+    can actually see it (a silent-capture regression would make the
+    warning-free assertion vacuous)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from jax.sharding import NamedSharding
+    from paddle_tpu.distributed.fleet.meta_parallel.sharding_parallel \
+        import shard_spec_for
+
+    def old_constraint(self, grads, stage, size):
+        if stage >= 2:
+            return {n: jax.lax.with_sharding_constraint(
+                        g, NamedSharding(
+                            self.mesh,
+                            P(*shard_spec_for(g.shape, size))))
+                    for n, g in grads.items()}
+        return grads
+
+    from jax.sharding import PartitionSpec as P
+    monkeypatch.setattr(DistributedRunner, "_constrain_zero_grads",
+                        old_constraint)
+    runner, x, y = _remat_trigger_runner(1)
+    with _cold_compile():
+        runner.lower_step(x, y).compile()
+    err = capfd.readouterr().err
+    assert _REMAT_WARNING in err, err[-2000:]
+
+
+# -- compressed-ring bytes audit (ISSUE 11) ---------------------------------
+
+
+def test_compressed_ring_dp_bytes_match_model():
+    """The bytes-moved proxy: on the bits=8 explicit ring, the
+    compiled program's dp-spanning collective bytes (every ring hop's
+    collective-permute payload) match the analytic
+    `dp_comm_bytes_per_step` model within a few percent — int8 wire
+    compression is real in the EXECUTABLE, not just the docstring."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs 2 devices")
+    import bench
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer as optim
+    from paddle_tpu.distributed.compressed import dp_comm_bytes_per_step
+
+    mesh = collective.build_mesh({"dp": 2}, devices=jax.devices()[:2])
+    collective.set_mesh(mesh)
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(256, 512), nn.ReLU(),
+                        nn.Linear(512, 64))
+    opt = optim.Adam(1e-3, parameters=net.parameters())
+    runner = DistributedRunner(net, opt, nn.CrossEntropyLoss(),
+                               mesh=mesh, dp_compress_bits=8)
+    rng = np.random.RandomState(0)
+    x = rng.rand(16, 256).astype(np.float32)
+    y = rng.randint(0, 64, (16,)).astype(np.int64)
+    hlo = runner.lower_step([x], [y]).compile().as_text()
+    audited = bench._hlo_dp_collective_bytes(hlo, mesh)
+    n_elems = sum(int(np.prod(p.shape)) for p in net.parameters()
+                  if not p.stop_gradient)
+    modeled = dp_comm_bytes_per_step(n_elems, 2, 8, False)
+    assert 0.95 * modeled <= audited <= 1.10 * modeled, \
+        (audited, modeled)

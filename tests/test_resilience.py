@@ -1377,6 +1377,20 @@ _ELASTIC_WORKER = textwrap.dedent("""
 
     TOTAL = int(os.environ.get("E2E_TOTAL_STEPS", "5"))
 
+    def make_runner(net, opt):
+        # E2E_DP_SHARDED (ISSUE 11): each rank runs a LOCAL dp=2 CPU
+        # mesh with the compressed (bits=16, the exact parity anchor)
+        # + dp-sharded weight update engine, so the reform contract is
+        # exercised against dp-SHARDED opt_state — the promoted spare
+        # and the survivors re-adopt only their 1/dp shard at restore
+        if os.environ.get("E2E_DP_SHARDED"):
+            mesh = collective.build_mesh({"dp": 2})
+            return DistributedRunner(net, opt, nn.MSELoss(), mesh=mesh,
+                                     dp_compress_bits=16,
+                                     dp_shard_update=True)
+        return DistributedRunner(net, opt, nn.MSELoss(),
+                                 mesh=collective.build_mesh({}))
+
     class Net(nn.Layer):
         def __init__(self):
             super().__init__()
@@ -1413,8 +1427,7 @@ _ELASTIC_WORKER = textwrap.dedent("""
             mgr = CheckpointManager(
                 os.path.join(os.environ["CKPT_ROOT"], f"rank{rank}"),
                 async_save=False)
-            runner = DistributedRunner(net, opt, nn.MSELoss(),
-                                       mesh=collective.build_mesh({}))
+            runner = make_runner(net, opt)
             runner.set_global_step(0)
             final = train_rank(rank, net, runner, mgr, 0)
             mgr.close()
@@ -1457,8 +1470,7 @@ _ELASTIC_WORKER = textwrap.dedent("""
     mgr = CheckpointManager(
         os.path.join(os.environ["CKPT_ROOT"], f"rank{rank}"),
         async_save=False)
-    runner = DistributedRunner(net, opt, nn.MSELoss(),
-                               mesh=collective.build_mesh({}))
+    runner = make_runner(net, opt)
 
     def wait_epoch(min_epoch=0):
         while True:
@@ -1679,6 +1691,62 @@ def test_chaos_e2e_wedged_rank_detected_by_beacon_cross_check(
     assert "beacon stalled" in proc.stderr
     assert "failed: beacon" in proc.stderr
     _assert_promotion_recovery(proc, logs, work, elastic_reference)
+
+
+_SHARDED_ENV = {
+    "E2E_DP_SHARDED": "1",
+    # each rank process needs its own 2 virtual devices for the local
+    # dp=2 mesh (the pod default strips the device-count flag)
+    "XLA_FLAGS": ("--xla_force_host_platform_device_count=2"
+                  " --xla_backend_optimization_level=0"),
+}
+
+
+@pytest.mark.dist
+@pytest.mark.slow
+def test_chaos_e2e_kill_with_dp_sharded_opt_state(tmp_path):
+    """ISSUE 11 sharded elastic restore: the PR-9 kill e2e with every
+    rank running the compressed (bits=16) + dp-SHARDED weight-update
+    engine on a local dp=2 mesh.  Rank 1 dies inside step 3, the
+    spare is promoted, reform rolls back and restores from the
+    (full-layout) checkpoint — `invalidate_cache` re-adopts the
+    optimizer moments dp-SHARDED, so the promoted spare and the
+    survivor each re-place only their 1/dp shard — and the run
+    finishes with final losses bit-identical to an uninterrupted
+    sharded run."""
+    # reference: one process, both ranks sequentially (the PR-9
+    # REFERENCE_MODE argument), under the SAME sharded config
+    ref_work = tmp_path / "ref"
+    ref_work.mkdir()
+    (ref_work / "loss").mkdir()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CKPT_ROOT"] = str(ref_work / "ckpt")
+    env["LOSS_DIR"] = str(ref_work / "loss")
+    env["E2E_REFERENCE_MODE"] = "1"
+    env.update(_SHARDED_ENV)
+    env.pop("PADDLE_FAULT_PLAN", None)
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(_ELASTIC_WORKER)
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          cwd=str(ref_work), capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    ref = _losses(ref_work)
+    assert sorted(ref) == [0, 1], ref
+
+    proc, logs, work = _run_elastic_pod(
+        tmp_path, "kill_sharded",
+        extra_env={
+            **_SHARDED_ENV,
+            "FAULT_RANK": "1",
+            "RANK_FAULT_PLAN": (
+                '[{"site":"train.step","action":"crash",'
+                '"match":{"step":3},"exit_code":143}]'),
+        })
+    assert "injected crash at train.step" in logs["workerlog.1"]
+    _assert_promotion_recovery(proc, logs, work, ref)
 
 
 # ---------------------------------------------------------------------------
