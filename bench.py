@@ -1126,6 +1126,153 @@ def bench_fleet():
     print("RESULT " + json.dumps(out), flush=True)
 
 
+# Self-heal bench worker: beacon-publishing ranks with per-MEMBER
+# pace (the original rank-1 member is the straggler; the spare that
+# replaces it runs at fleet pace), so the bench measures the action
+# loop itself — latency onset → drain verdict → promotion → fleet
+# step-time recovered — with no jax compile noise in the timeline.
+_SELFHEAL_WORKER = '''
+import os, time
+import paddle_tpu  # arms the per-rank /metrics endpoint from env
+from paddle_tpu.distributed.resilience.elastic_rank import (
+    ElasticRankContext)
+
+ctx = ElasticRankContext.from_env()
+assert ctx is not None
+ctx.register()
+if ctx.role == "spare":
+    ticket = ctx.wait_for_promotion()
+    if ticket is None:
+        ctx.exit()
+        raise SystemExit(0)
+slow_member = os.environ["SELFHEAL_SLOW_MEMBER"]
+pace = (float(os.environ["SELFHEAL_SLOW_S"])
+        if ctx.member_id == slow_member
+        else float(os.environ["SELFHEAL_FAST_S"]))
+stop_file = os.environ["SELFHEAL_STOP_FILE"]
+for step in range(1, 100000):
+    time.sleep(pace)
+    ctx.publish_beacon(step=step)
+    if os.path.exists(stop_file):
+        break
+ctx.exit()
+print(f"SELFHEAL-WORKER-DONE member={ctx.member_id}", flush=True)
+'''
+
+
+def bench_selfheal():
+    """The observability→action loop, measured end to end (ISSUE 13):
+    a REAL ``launch --spares 1 --drain_stragglers`` run where the
+    original rank 1 steps 5x slower than the fleet.  The record is
+    the loop's reaction time, scraped from OUTSIDE over the
+    controller plane: ``selfheal_to_drain_s`` (launch → drain
+    decision on /fleet/events) and ``selfheal_drain_to_recovered_s``
+    (drain → the promoted successor's step-time back under the
+    straggler bar on the controller registry)."""
+    import socket
+    import tempfile
+    import urllib.request
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_selfheal_")
+    script = os.path.join(work, "selfheal_worker.py")
+    with open(script, "w") as f:
+        f.write(_SELFHEAL_WORKER)
+    stop_file = os.path.join(work, "stop")
+    base = free_port()
+    factor = 2.0
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SELFHEAL_FAST_S": "0.08",
+        "SELFHEAL_SLOW_S": "0.4",       # 5x the fleet pace
+        "SELFHEAL_SLOW_MEMBER": "rank-1",
+        "SELFHEAL_STOP_FILE": stop_file,
+        "PYTHONPATH": here + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    t0 = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--spares", "1",
+         "--metrics_port", str(base),
+         "--straggler_factor", str(factor),
+         "--drain_stragglers", "8",
+         "--beacon_timeout", "30",     # only the drain may replace
+         "--job_id", "bench-selfheal",
+         "--log_dir", os.path.join(work, "log"), script],
+        env=env, cwd=work, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def get_json(path, timeout=1.0):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{base}{path}",
+                timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    out = {"selfheal_slow_factor": 5.0,
+           "selfheal_drain_windows": 8}
+    t_drain = t_recovered = None
+    deadline = time.time() + 120
+    try:
+        while time.time() < deadline and proc.poll() is None:
+            time.sleep(0.25)
+            try:
+                if t_drain is None:
+                    ev = get_json("/fleet/events")
+                    if any(e.get("kind") == "drain"
+                           for e in ev.get("events", [])):
+                        t_drain = time.perf_counter()
+                    continue
+                # after the drain: recovered when the successor holds
+                # a step-time estimate back under the straggler bar
+                ctl = get_json("/metrics.json")["metrics"]
+                st1 = ctl.get('fleet_rank_step_time_s{rank="1"}',
+                              {}).get("value")
+                st0 = ctl.get('fleet_rank_step_time_s{rank="0"}',
+                              {}).get("value")
+                flag = ctl.get('fleet_straggler{rank="1"}',
+                               {}).get("value")
+                if (st0 and st1 and flag == 0.0
+                        and st1 < factor * st0):
+                    t_recovered = time.perf_counter()
+                    break
+            except (OSError, ValueError):
+                continue
+        if t_drain is not None:
+            out["selfheal_to_drain_s"] = round(t_drain - t0, 2)
+        else:
+            out["selfheal_error"] = "no drain decision in 120s"
+        if t_recovered is not None:
+            out["selfheal_drain_to_recovered_s"] = round(
+                t_recovered - t_drain, 2)
+            out["selfheal_total_s"] = round(t_recovered - t0, 2)
+            try:
+                h = get_json("/fleet/healthz")
+                out["selfheal_quarantined_total"] = \
+                    h["quarantined_total"]
+                out["selfheal_spares_available"] = \
+                    h["spares_available"]
+            except (OSError, ValueError):
+                pass
+        elif t_drain is not None:
+            out["selfheal_error"] = "drained but never recovered"
+    finally:
+        with open(stop_file, "w") as f:
+            f.write("1")
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()          # reap, so returncode is real
+    out["selfheal_launch_rc"] = proc.returncode
+    print("RESULT " + json.dumps(out), flush=True)
+
+
 def bench_flash_micro():
     """Pallas flash kernel vs composed XLA attention, fwd+bwd wall time
     per call at seq 1k/4k/8k (VERDICT r2 item 5 microbench line)."""
@@ -1293,6 +1440,16 @@ def main():
                          else {"error": flerr[-1000:]}), flush=True)
         return
 
+    # `python bench.py --selfheal`: the observability ACTION loop e2e
+    # (ISSUE 13; CPU, cheap) — a real 2-rank + spare launch with
+    # --drain_stragglers armed and rank 1 stepping 5x slow; records
+    # time-from-latency-to-drain and drain-to-recovered-step-time
+    if "--selfheal" in sys.argv:
+        sh, sherr = _run_child("selfheal", 240)
+        print(json.dumps(sh if sh is not None
+                         else {"error": sherr[-1000:]}), flush=True)
+        return
+
     # `python bench.py --mesh-fold [1,8,...]`: run ONLY the mesh fold
     # sweep (CPU dp mesh, cheap) — the multichip counterpart of --fold
     if "--mesh-fold" in sys.argv:
@@ -1336,6 +1493,8 @@ def main():
         return bench_serving()
     if mode == "fleet":
         return bench_fleet()
+    if mode == "selfheal":
+        return bench_selfheal()
 
     t_start = time.time()
 

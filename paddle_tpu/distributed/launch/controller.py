@@ -62,6 +62,35 @@ Spare-pool replenishment (ROADMAP PR-9 follow-up): a successful
 promotion respawns a replacement spare, so the pool no longer drains
 to zero after the first failure; ``resilience_spares_available``
 gauges the live pool on the controller's endpoint.
+
+Straggler auto-drain (DESIGN-OBSERVABILITY.md §Action loop): with
+``--drain_stragglers N`` (off by default — attribution alone must
+never kill a rank) a rank that holds a straggler verdict for N
+*consecutive* judgment windows is **drained**: quarantined through
+the exact failure path a dead rank takes — kill, spare promotion,
+reform barrier, sharded re-adopt — so a persistently slow chip costs
+one checkpoint interval instead of throttling the whole fleet
+forever.  The drain is REFUSED while no live spare is parked
+(``fleet_drains_skipped_total``): trading a slow rank for a missing
+rank is a worse fleet.  Every decision is a ``member.drain`` fault
+site (chaos can fail the decision itself), a ``resilience.drain``
+span, a ``fleet_drains_total`` tick and a ``drain`` entry on the
+decision ring (``/fleet/events``); the drained rank's verdict is
+forgotten with its quarantine so the promoted successor starts
+fresh.
+
+Multi-node fleet scrape: member scrape/trace/events fetches resolve
+each rank's ``host:port`` through the ``obs/<rank>`` records the
+workers publish in the KV registry (``ElasticRankContext.
+publish_obs_endpoint``), falling back to the loopback
+``BASE+1+rank`` layout when a record is absent — so the fleet plane
+keeps working when ranks live on other hosts, with the same
+absent-this-round ``fleet_scrape_errors_total`` semantics.
+
+``/fleet/healthz`` answers the one-glance question (per-member
+alive/finished/quarantined/straggler + lag, spare pool, epoch);
+``/fleet/events`` merges the controller's decision ring with every
+live member's ``/events`` ring, each entry tagged with its source.
 """
 
 from __future__ import annotations
@@ -78,6 +107,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from ...observability import aggregate as _obs_aggregate
+from ...observability import events as _obs_events
 from ...observability import http as _obs_http
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
@@ -117,7 +147,8 @@ class RankController:
                  metrics_port: int = 0,
                  straggler_factor: Optional[float] = None,
                  scrape_interval: float = 1.0,
-                 respawn_spares: bool = True):
+                 respawn_spares: bool = True,
+                 drain_stragglers: int = 0):
         self.args = args
         self.client = client
         self.server_endpoint = server_endpoint
@@ -148,6 +179,22 @@ class RankController:
             window_s=max(10.0, 4 * self.beacon_timeout))
         self._flagged_stragglers: set = set()
         self._straggler_series: set = set()   # ranks with live gauges
+        # auto-drain policy (§Action loop): N consecutive straggler
+        # judgment windows arm a drain; 0 = attribution only (the
+        # default — a control loop that kills ranks is an explicit
+        # ask).  Env mirrors the flag like the straggler factor.
+        if not drain_stragglers:
+            try:
+                drain_stragglers = int(os.environ.get(
+                    "PADDLE_TPU_DRAIN_STRAGGLERS", "0") or 0)
+            except ValueError:
+                drain_stragglers = 0
+        self.drain_windows = max(int(drain_stragglers), 0)
+        self._straggler_streak: Dict[int, int] = {}
+        self._drain_skip_logged: set = set()
+        # multi-node scrape: rank → (host, port) published by the
+        # worker in the KV registry; loopback layout is the fallback
+        self._obs_endpoints: Dict[int, tuple] = {}
         self.respawn_spares = bool(respawn_spares)
         self._spare_seq = int(spares)    # next fresh spare member id
         self._endpoints: Optional[List[str]] = None
@@ -156,6 +203,7 @@ class RankController:
         self._own_http = False
         self._fleet_lock = threading.Lock()
         self._fleet_snapshot: Dict[str, dict] = {}
+        self._member_events: Dict[int, list] = {}
         self._scrape_stop = threading.Event()
         self._scrape_thread: Optional[threading.Thread] = None
         # per-launch nonce: namespaces every mutable protocol key so a
@@ -187,6 +235,14 @@ class RankController:
             "fleet_scrape_errors_total",
             "failed member /metrics.json scrapes (absent rank this "
             "round, not a judgment)")
+        self._drains = self._reg.counter(
+            "fleet_drains_total",
+            "stragglers auto-drained onto a spare by the "
+            "observability action loop")
+        self._drains_skipped = self._reg.counter(
+            "fleet_drains_skipped_total",
+            "armed drains refused for lack of a live spare (a slow "
+            "rank beats a missing rank)")
 
     # -- spawn ---------------------------------------------------------------
     def _kv_key(self, *parts: str) -> str:
@@ -283,6 +339,21 @@ class RankController:
         self.straggler.forget(rank)
         self._flagged_stragglers.discard(rank)
         self._straggler_series.discard(rank)
+        # the drain policy's consecutive-window count dies with the
+        # rank too: a promoted successor must earn its own windows,
+        # never inherit its dead predecessor's arming progress
+        self._straggler_streak.pop(rank, None)
+        self._drain_skip_logged.discard(rank)
+        self._obs_endpoints.pop(rank, None)
+        # and the KV record behind it: without the delete the next
+        # scrape round would re-adopt the DEAD member's host:port and
+        # target it for the rest of the job; the promoted successor
+        # re-publishes under this rank id when it arms (review catch)
+        try:
+            self.client.delete(self._kv_key("obs", str(rank)))
+        except Exception:
+            pass  # registry blip: the successor's re-publish
+            # overwrites the stale record anyway
         for name in ("fleet_straggler", "fleet_rank_step_time_s"):
             self._reg.unregister(name, labels={"rank": str(rank)})
 
@@ -291,7 +362,8 @@ class RankController:
         records `_poll_beacons` already fetched — exported as gauges
         and logged on transition, so "which rank is slow" is
         answerable from the controller's /metrics without touching
-        any worker."""
+        any worker.  Returns the verdicts so the drain policy can act
+        on the same judgment it counts."""
         verdicts = self.straggler.judge()
         # a LIVE rank whose window expired (legitimately parked: long
         # checkpoint, re-form barrier) drops out of the verdict set —
@@ -323,15 +395,137 @@ class RankController:
                       file=sys.stderr, flush=True)
             elif not v["straggler"]:
                 self._flagged_stragglers.discard(rank)
+            # drain-policy hysteresis: count CONSECUTIVE straggler
+            # windows; any healthy window resets to zero (a rank that
+            # is sometimes slow is noise, not a drain candidate)
+            if v["straggler"]:
+                self._straggler_streak[rank] = \
+                    self._straggler_streak.get(rank, 0) + 1
+            else:
+                self._straggler_streak.pop(rank, None)
+                self._drain_skip_logged.discard(rank)
+        # a rank with no estimate this window (expired/parked) has no
+        # verdict either way — absence of evidence resets the streak,
+        # exactly like the gauges go absent-not-stale
+        for rank in list(self._straggler_streak):
+            if rank not in verdicts:
+                self._straggler_streak.pop(rank, None)
+                self._drain_skip_logged.discard(rank)
+        return verdicts
+
+    def _maybe_drain(self, verdicts: Dict):
+        """§Action loop: quarantine a rank whose straggler verdict
+        held for ``drain_windows`` consecutive judgments, through the
+        SAME failure path a dead rank takes (kill → spare promotion →
+        reform) — but only while a live spare is parked: with an
+        empty pool a slow rank still makes progress, a drained one
+        would not.  The decision routes through the ``member.drain``
+        fault site (so chaos can fail the decision itself — it is
+        retried while the verdict persists), lands a
+        ``resilience.drain`` span plus a ``drain`` event, and the
+        quarantine forgets the verdict so the promoted successor
+        starts fresh."""
+        if not self.drain_windows:
+            return
+        # spare BUDGET, not a liveness check: pending failures hold a
+        # claim on the pool already, and two stragglers arming in the
+        # same pass must not double-spend one parked spare — the
+        # second drain would leave a rank with no replacement and
+        # fail the job (review catch)
+        budget = sum(1 for s in self.state.spares
+                     if s.proc.poll() is None and not s.quarantined) \
+            - len(self.state.pending_failures)
+        for rank, streak in list(self._straggler_streak.items()):
+            if streak < self.drain_windows:
+                continue
+            m = self.state.members.get(rank)
+            if m is None or m.finished or m.quarantined:
+                self._straggler_streak.pop(rank, None)
+                continue
+            if budget <= 0:
+                # once per arming (not per 4 Hz tick): the refusal is
+                # ONE decision that stands until the streak breaks or
+                # a spare appears
+                if rank not in self._drain_skip_logged:
+                    self._drains_skipped.inc()
+                    self._drain_skip_logged.add(rank)
+                    _obs_events.record(
+                        "drain_skipped", rank=rank,
+                        member=m.member_id, reason="no spare")
+                    print(f"launch: straggler rank {rank} held for "
+                          f"{streak} windows but no live spare is "
+                          "parked — drain refused (a slow rank "
+                          "beats a missing rank)",
+                          file=sys.stderr, flush=True)
+                continue
+            v = verdicts.get(rank, {})
+            try:
+                with _obs_trace.span(
+                        "resilience.drain",
+                        args=({"rank": rank,
+                               "step_time_s": v.get("step_time_s"),
+                               "windows": streak}
+                              if _obs_trace.enabled() else None)):
+                    _faults.fault_point("member.drain", rank=rank,
+                                        member=m.member_id,
+                                        windows=streak)
+                    self._drains.inc()
+                    _obs_events.record(
+                        "drain", rank=rank, member=m.member_id,
+                        step_time_s=v.get("step_time_s"),
+                        median_s=v.get("median_s"), windows=streak)
+                    print(f"launch: auto-drain: rank {rank} "
+                          f"({m.member_id}) straggled for {streak} "
+                          "consecutive windows "
+                          f"(step-time {v.get('step_time_s')}s vs "
+                          f"median {v.get('median_s')}s) — "
+                          "quarantining onto a spare",
+                          file=sys.stderr, flush=True)
+                    self._queue_failure(rank, "straggler")
+                    budget -= 1
+            except Exception as e:  # noqa: BLE001 — injected: the
+                # decision failed, the rank is untouched; the verdict
+                # persists, so the next window retries
+                print(f"launch: draining rank {rank} failed "
+                      f"({type(e).__name__}: {e}); will retry",
+                      file=sys.stderr, flush=True)
 
     # -- fleet scrape plane --------------------------------------------------
     def _member_metrics_port(self, rank: int) -> int:
         return self.metrics_base + 1 + int(rank)
 
+    def _refresh_obs_endpoints(self):
+        """Pick up the ``obs/<rank>`` scrape-address records the
+        workers publish in the KV registry (multi-node fleet scrape).
+        A registry blip or torn record keeps the last known address —
+        no judgment, exactly like the beacon poll."""
+        for rank in self._live_ranks():
+            try:
+                raw = self.client.get(self._kv_key("obs", str(rank)))
+            except Exception:
+                continue
+            if not raw:
+                continue
+            try:
+                d = json.loads(raw)
+                self._obs_endpoints[int(rank)] = (str(d["host"]),
+                                                  int(d["port"]))
+            except (ValueError, KeyError, TypeError):
+                continue
+
+    def _member_obs_endpoint(self, rank: int) -> tuple:
+        """(host, port) to scrape rank at: the KV-published record
+        when the worker announced one, else the single-host loopback
+        layout (``BASE+1+rank``)."""
+        rec = self._obs_endpoints.get(int(rank))
+        if rec is not None:
+            return rec
+        return ("127.0.0.1", self._member_metrics_port(rank))
+
     def _scrape_member(self, rank: int, path: str,
                        timeout: float = 0.5) -> Optional[dict]:
-        url = (f"http://127.0.0.1:{self._member_metrics_port(rank)}"
-               f"{path}")
+        host, port = self._member_obs_endpoint(rank)
+        url = f"http://{host}:{port}{path}"
         try:
             with urllib.request.urlopen(url, timeout=timeout) as r:
                 return json.loads(r.read().decode("utf-8"))
@@ -354,11 +548,22 @@ class RankController:
         keeps these scrapes out of the retry layer)."""
         if not self.metrics_base:
             return
+        self._refresh_obs_endpoints()
         snaps = {}
+        member_events: Dict[int, list] = {}
         for rank in self._live_ranks():
             payload = self._scrape_member(rank, "/metrics.json")
             if payload and isinstance(payload.get("metrics"), dict):
                 snaps[rank] = payload["metrics"]
+                # member decision rings ride the same cadence (tiny,
+                # host-only — nothing like the MB-sized traces that
+                # keep /fleet/trace on-demand); fetched only from
+                # members whose metrics scrape answered, so a dead
+                # member costs one error count, not two
+                ev = self._scrape_member(rank, "/events")
+                if isinstance(ev, dict) and isinstance(
+                        ev.get("events"), list):
+                    member_events[rank] = ev["events"]
         try:
             merged = _obs_aggregate.merge_snapshots(snaps)
         except (TypeError, ValueError) as e:
@@ -367,6 +572,7 @@ class RankController:
             return
         with self._fleet_lock:
             self._fleet_snapshot = merged
+            self._member_events = member_events
 
     def _fleet_metrics_route(self):
         with self._fleet_lock:
@@ -396,6 +602,74 @@ class RankController:
         return (200, _obs_http.JSON_CONTENT_TYPE,
                 json.dumps(merged).encode("utf-8"))
 
+    def _fleet_health_summary(self) -> dict:
+        """One-glance member health, from state the watch loop already
+        maintains — host-only, so it answers mid-wedge."""
+        now = time.time()
+        members = []
+        degraded = False
+        for rank, m in sorted(list(self.state.members.items())):
+            last = self.detector.last_seen(m.member_id)
+            entry = {
+                "rank": rank, "member": m.member_id,
+                "alive": m.proc.poll() is None,
+                "finished": m.finished,
+                "quarantined": m.quarantined,
+                "straggler": rank in self._flagged_stragglers,
+                "heartbeat_lag_s": (None if last is None
+                                    else round(now - last, 3)),
+            }
+            members.append(entry)
+            if entry["straggler"] or (not entry["alive"]
+                                      and not entry["finished"]):
+                degraded = True
+        spares_live = sum(1 for s in self.state.spares
+                          if s.proc.poll() is None
+                          and not s.quarantined)
+        if self.state.pending_failures:
+            degraded = True
+        return {
+            "status": "degraded" if degraded else "ok",
+            "epoch": self.state.epoch,
+            "members": members,
+            "spares_available": spares_live,
+            "quarantined_total": len(self.state.quarantined),
+            "pending_failures": list(self.state.pending_failures),
+            "drain_windows": self.drain_windows,
+        }
+
+    def _fleet_healthz_route(self):
+        return (200, _obs_http.JSON_CONTENT_TYPE,
+                json.dumps(_obs_http.json_safe(
+                    self._fleet_health_summary()),
+                    allow_nan=False,
+                    default=str).encode("utf-8"))
+
+    def _fleet_events_route(self):
+        """The control loop's audit log: the controller's own decision
+        ring (drain/quarantine/promote/respawn) merged with every live
+        member's ``/events`` ring (router scale/shed decisions live in
+        the serving processes), each entry tagged with its source and
+        the whole merge sorted on wall-clock ts.  Member rings come
+        from the background scrape cache — N serial member fetches on
+        the request path would stack N timeouts onto every poller
+        (review catch); staleness is one ``scrape_interval``, same as
+        /fleet/metrics."""
+        events = [dict(e, source="controller")
+                  for e in _obs_events.snapshot()]
+        with self._fleet_lock:
+            cached = {r: list(evs)
+                      for r, evs in self._member_events.items()}
+        for rank, evs in cached.items():
+            for e in evs:
+                if isinstance(e, dict):
+                    events.append(dict(e, source=f"rank{rank}"))
+        events.sort(key=lambda e: e.get("ts") or 0.0)
+        return (200, _obs_http.JSON_CONTENT_TYPE,
+                json.dumps(_obs_http.json_safe({"events": events}),
+                           allow_nan=False,
+                           default=str).encode("utf-8"))
+
     def _arm_metrics_server(self):
         """Serve the controller's own registry on BASE with the
         /fleet/* routes mounted.  Reuses the env-armed per-process
@@ -408,6 +682,8 @@ class RankController:
             "/fleet/metrics": self._fleet_metrics_route,
             "/fleet/metrics.json": self._fleet_metrics_json_route,
             "/fleet/trace": self._fleet_trace_route,
+            "/fleet/healthz": self._fleet_healthz_route,
+            "/fleet/events": self._fleet_events_route,
         }
         srv = _obs_http.active_server()
         if srv is not None and srv.port != self.metrics_base:
@@ -503,6 +779,8 @@ class RankController:
         self._quarantines.inc()
         if reason == "beacon":
             self._wedged.inc()
+        _obs_events.record("quarantine", rank=m.rank,
+                           member=m.member_id, reason=reason)
 
     def _try_promote(self, rank: int) -> bool:
         """Promote the first live spare into ``rank``.  Returns True
@@ -539,6 +817,8 @@ class RankController:
         self.state.epoch = new_epoch
         self._publish_epoch()
         self._promotions.inc()
+        _obs_events.record("promote", rank=rank,
+                           spare=spare.member_id, epoch=new_epoch)
         print(f"launch: promoted spare {spare.member_id} into rank "
               f"{rank} (epoch {new_epoch}); healthy ranks re-form at "
               "the barrier and resume — no process restart",
@@ -567,6 +847,8 @@ class RankController:
             return
         self._spare_seq += 1
         self.state.spares.append(m)
+        _obs_events.record("spare_respawn", member=member_id,
+                           pool=len(self.state.spares))
         print(f"launch: respawned replacement spare {member_id} "
               f"(pool: {len(self.state.spares)})", flush=True)
 
@@ -624,8 +906,9 @@ class RankController:
             self._poll_beacons()
             # 3b. observability plane: straggler attribution from the
             # beacons just polled + spare-pool gauge (the fleet HTTP
-            # scrape runs on its own thread — see _scrape_loop)
-            self._judge_stragglers()
+            # scrape runs on its own thread — see _scrape_loop); the
+            # drain policy acts on the SAME judgment it counted
+            self._maybe_drain(self._judge_stragglers())
             self._spares_gauge.set(sum(
                 1 for s in self.state.spares
                 if s.proc.poll() is None and not s.quarantined))
@@ -711,7 +994,8 @@ def run_rank_elastic(args) -> int:
         args, client, endpoint, nproc=nproc, spares=args.spares,
         beacon_timeout=args.beacon_timeout,
         metrics_port=getattr(args, "metrics_port", 0),
-        straggler_factor=getattr(args, "straggler_factor", None))
+        straggler_factor=getattr(args, "straggler_factor", None),
+        drain_stragglers=getattr(args, "drain_stragglers", 0))
     try:
         return ctl.run()
     finally:

@@ -56,6 +56,11 @@ def parse_args(argv=None):
     # the rank controller (single-node), like --spares.
     p.add_argument("--metrics_port", type=int, default=0)
     p.add_argument("--straggler_factor", type=float, default=None)
+    # observability action loop (DESIGN-OBSERVABILITY.md §Action
+    # loop): a rank holding a straggler verdict for N consecutive
+    # judgment windows is auto-drained onto a spare.  0 (default)
+    # = attribution only, never a drain.
+    p.add_argument("--drain_stragglers", type=int, default=0)
     p.add_argument("training_script", type=str)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -110,7 +115,8 @@ def main(argv=None):
     # silently dropping --max_restart pod recovery would be a trap.
     # The controller fleet plane (/fleet/*, straggler attribution)
     # is an explicit ask: --metrics_port or --spares.
-    if args.spares > 0 or args.metrics_port > 0:
+    if args.spares > 0 or args.metrics_port > 0 \
+            or args.drain_stragglers > 0:
         # rank-elastic supervision: hot-spare promotion instead of the
         # kill-the-pod watchdog below (controller.py).  --metrics_port
         # routes here too: the fleet observability plane (per-rank
@@ -119,21 +125,24 @@ def main(argv=None):
         # shrinking a multi-node request to one node would run at
         # half the asked-for world size
         if not single_node:
-            print("launch: --spares/--metrics_port support "
-                  f"single-node jobs only (got --nnodes "
-                  f"{args.nnodes}); multi-node spare pools and fleet "
-                  "scrape are a documented follow-up", file=sys.stderr)
+            print("launch: --spares/--metrics_port/--drain_stragglers "
+                  f"support single-node jobs only (got --nnodes "
+                  f"{args.nnodes}); multi-node spare pools are a "
+                  "documented follow-up", file=sys.stderr)
             return 1
         if args.spares <= 0:
             # recovery semantics change and the user should know:
             # rank-elastic supervision recovers by PROMOTION, so with
             # an empty spare pool a rank death fails the job instead
             # of the classic pod restart (--max_restart is not used
-            # on this path)
-            print("launch: --metrics_port routes supervision through "
-                  "the rank controller; without --spares a rank "
-                  "failure fails the job (no --max_restart pod "
-                  "restarts) — add --spares S for single-rank "
+            # on this path) — and the drain policy refuses to fire
+            # at all (it will not trade a slow rank for a missing
+            # one)
+            print("launch: --metrics_port/--drain_stragglers route "
+                  "supervision through the rank controller; without "
+                  "--spares a rank failure fails the job (no "
+                  "--max_restart pod restarts) and auto-drain stays "
+                  "refused — add --spares S for single-rank "
                   "replacement", file=sys.stderr)
         from .controller import run_rank_elastic
         return run_rank_elastic(args)

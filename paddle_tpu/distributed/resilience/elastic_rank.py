@@ -158,9 +158,42 @@ class ElasticRankContext:
 
     # -- control-plane liveness ---------------------------------------------
     def register(self):
-        """Start heartbeating as this member (idempotent)."""
+        """Start heartbeating as this member (idempotent).  An active
+        rank with an armed scrape endpoint also publishes its
+        ``host:port`` so a controller on ANOTHER host can find it (the
+        multi-node fleet scrape — see :meth:`publish_obs_endpoint`)."""
         self.manager.register(payload=self.role)
+        self.publish_obs_endpoint()
         return self
+
+    def publish_obs_endpoint(self) -> bool:
+        """PUT this rank's observability scrape address
+        (``obs/<rank>`` → ``{"host", "port", "member"}``) into the KV
+        registry.  The controller's fleet scrape resolves member
+        endpoints through these records instead of assuming the
+        loopback ``BASE+1+rank`` layout — which only holds when every
+        rank shares the controller's host.  No-op (False) when the
+        process has no rank yet or no endpoint is armed; best-effort —
+        the loopback fallback still works single-node."""
+        if self.rank is None:
+            return False
+        from ...observability import http as _obs_http
+        srv = _obs_http.active_server()
+        if srv is None:
+            return False
+        host = srv.host
+        if host in ("0.0.0.0", "::"):
+            # bound on every interface: publish a routable address
+            from ..fleet.elastic.manager import host_ip
+            host = host_ip()
+        try:
+            self.client.put(
+                self._key("obs", str(self.rank)),
+                json.dumps({"host": host, "port": srv.port,
+                            "member": self.member_id}))
+        except Exception:
+            return False  # registry blip: fallback layout still works
+        return True
 
     def exit(self):
         self.manager.exit()
@@ -252,6 +285,10 @@ class ElasticRankContext:
                     _obs_http.serve_for_rank(ticket.rank)
                 except Exception:
                     pass
+                # re-publish the scrape address under the NEW rank id:
+                # the fleet scrape must find the successor where it
+                # actually listens, not at its dead predecessor's host
+                self.publish_obs_endpoint()
                 return ticket
             if self.shutdown_requested():
                 return None

@@ -56,6 +56,9 @@ KNOWN_SITES = frozenset({
     "member.promote",      # controller promotes a hot spare
     "barrier.reform",      # member enters the membership reform barrier
     "beacon.publish",      # droppable: rank progress beacon (wedged chip)
+    "member.drain",        # controller auto-drains a persistent straggler
+    "router.shed",         # droppable: serving router sheds an admission
+    "replica.spawn",       # serving router spawns a new replica
 })
 
 

@@ -23,6 +23,7 @@ from .decode_model import (ServingModelConfig, decode_forward,
 from .scheduler import QueueFull, Request, RequestStats, Scheduler
 from .engine import DecodeEngine, GenerationResult
 from .api import LLMServer
+from .router import Overloaded, ServingRouter
 
 __all__ = [
     "BlockAllocator", "OutOfBlocks", "PagedKVCache", "SCRATCH_BLOCK",
@@ -32,4 +33,5 @@ __all__ = [
     "prefill_forward", "reference_decode",
     "QueueFull", "Request", "RequestStats", "Scheduler",
     "DecodeEngine", "GenerationResult", "LLMServer",
+    "Overloaded", "ServingRouter",
 ]

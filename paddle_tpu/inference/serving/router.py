@@ -1,0 +1,484 @@
+"""SLO-aware serving router: replica autoscaling + admission shedding
+(DESIGN-OBSERVABILITY.md §Action loop, DESIGN-SERVING.md §Router).
+
+:class:`LLMServer` is one engine on one device pool; production
+traffic is judged on SLOs under load spikes (PAPERS.md arxiv
+2605.25645), which needs the signals the engine already exports —
+queue depth, latency histograms, KV fragmentation, all on the
+process-wide metrics registry — to *drive* capacity and admission,
+not just report them.  :class:`ServingRouter` closes that loop:
+
+- **Routing.**  ``submit`` goes to the least-loaded live replica
+  (queue depth + running batch); a replica answering
+  :class:`~.scheduler.QueueFull` fails over to the next.  Every
+  replica is an ordinary ``LLMServer`` built by the caller's
+  ``replica_factory`` — the router never reaches into engine
+  internals to admit work.
+- **Scaling.**  A background control loop samples the registry
+  signals every ``decision_interval_s`` and applies hysteresis: the
+  overload signal (queue depth per replica above
+  ``scale_up_queue_depth``, or windowed p99 above ``slo_p99_s``)
+  must hold for ``windows_up`` consecutive decisions before a spawn,
+  the underload signal for ``windows_down`` before a retire, and
+  every scale action starts a ``cooldown_s`` lockout — load flapping
+  must not flap capacity.  Retiring drains: the victim stops taking
+  admissions, finishes its running batch, then closes (its registry
+  children are reclaimed — replica churn is by design here).
+- **Shedding.**  When overloaded *and* capacity can't grow (at
+  ``max_replicas`` or mid-cooldown), the router turns admission
+  shedding on: ``submit`` raises :class:`Overloaded` at the door so
+  the upstream load balancer sees backpressure immediately instead
+  of a latency cliff.  Shedding is a *state* toggled by the control
+  loop (events on the transitions), shed volume is a counter, and
+  each shed consults the droppable ``router.shed`` fault site so
+  chaos plans can suppress relief and test the cliff.
+- **p99 over a window.**  Registry histograms are cumulative
+  (process-lifetime); an SLO verdict needs *recent* latency.  The
+  loop diffs consecutive histogram snapshots and estimates the p99
+  of just the completions inside the window — the number
+  ``router_p99_s`` exports and the burst chaos test pins.
+
+Every decision lands on the registry (``serving_replicas``,
+``router_scale_ups_total``/``router_scale_downs_total``,
+``router_shed_total``) and on the decision ring
+(``observability.events`` → ``/events``, merged fleet-wide into the
+launch controller's ``/fleet/events``).
+
+The control loop reads ONLY host state (queue depths, host-float
+histograms) with ``materialize=False`` — it can never add a device
+sync to the decode hot path it supervises.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ...distributed.resilience import faults as _faults
+from ...observability import events as _obs_events
+from ...observability import metrics as _obs_metrics
+from .scheduler import QueueFull
+
+__all__ = ["ServingRouter", "Overloaded"]
+
+
+class Overloaded(QueueFull):
+    """The router is shedding admissions: every replica's queue is
+    full, or the SLO policy turned shedding on.  Subclasses
+    :class:`QueueFull` so existing backpressure handling upstream of
+    ``LLMServer`` covers the router unchanged."""
+
+
+def _window_cum(prev, cur):
+    """Cumulative bucket counts of the observations BETWEEN two
+    cumulative histogram snapshots (``Histogram.collect()`` shape) —
+    a diff of cumulatives is itself cumulative."""
+    cur_cum = [c for _, c in cur.get("buckets", [])]
+    prev_cum = ([c for _, c in prev.get("buckets", [])]
+                if prev else [])
+    if len(prev_cum) != len(cur_cum):
+        prev_cum = [0] * len(cur_cum)
+    return [max(c - p, 0) for p, c in zip(prev_cum, cur_cum)]
+
+
+def _quantile_from_cum(edges: List[float], cum: List[float],
+                       q: float) -> Optional[float]:
+    """q-quantile from cumulative bucket counts with linear
+    interpolation inside the landing bucket, exactly like
+    ``Histogram.quantile`` (the +Inf bucket clamps to the top finite
+    edge).  None when the window saw no observations — absence of
+    traffic is not a latency."""
+    n = cum[-1] if cum else 0
+    if n <= 0:
+        return None
+    rank = q * n
+    prev_c = 0.0
+    for i, c in enumerate(cum):
+        if c >= rank and c > prev_c:
+            lo = 0.0 if i == 0 else float(edges[i - 1])
+            hi = float(edges[i] if i < len(edges) - 1 else edges[-2])
+            frac = (rank - prev_c) / (c - prev_c)
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        prev_c = c
+    return float(edges[-2]) if len(edges) > 1 else None
+
+
+def _delta_quantile(prev, cur, q: float) -> Optional[float]:
+    """q-quantile of one histogram's observations between two
+    snapshots (unit-tested; ``_signals`` runs the same math over the
+    replica-merged window)."""
+    return _quantile_from_cum([e for e, _ in cur.get("buckets", [])],
+                              _window_cum(prev, cur), q)
+
+
+class _Replica:
+    """One managed ``LLMServer`` plus the router's view of it."""
+
+    _seq = 0
+
+    def __init__(self, server):
+        _Replica._seq += 1
+        self.name = f"replica-{_Replica._seq}"
+        self.server = server
+        self.draining = False
+        # last cumulative latency snapshot, for the windowed p99 diff
+        self.last_latency: Optional[Dict[str, Any]] = None
+
+    # -- host-only signal reads (materialize=False everywhere) ------------
+    @property
+    def queue_depth(self) -> int:
+        return self.server.engine.scheduler.queue_depth
+
+    @property
+    def active(self) -> int:
+        return self.server.engine.active_count
+
+    @property
+    def load(self) -> int:
+        return self.queue_depth + self.active
+
+    def latency_snapshot(self) -> Dict[str, Any]:
+        return self.server.engine._h_latency.collect(materialize=False)
+
+
+class ServingRouter:
+    """Admission router + SLO-driven autoscaler over ``LLMServer``
+    replicas.
+
+    ``replica_factory`` is a zero-arg callable returning a RUNNING
+    ``LLMServer`` (pre-warmed factories make spawns cheap — see the
+    README quickstart).  ``decision_interval_s=0`` disables the
+    background loop; tests drive :meth:`control_round` directly.
+    """
+
+    def __init__(self, replica_factory: Callable[[], Any], *,
+                 min_replicas: int = 1, max_replicas: int = 2,
+                 slo_p99_s: Optional[float] = None,
+                 scale_up_queue_depth: float = 4.0,
+                 scale_down_queue_depth: float = 0.5,
+                 windows_up: int = 2, windows_down: int = 8,
+                 cooldown_s: float = 5.0,
+                 decision_interval_s: float = 0.25,
+                 metrics_port: Optional[int] = None):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        self._factory = replica_factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.slo_p99_s = (None if slo_p99_s is None
+                          else float(slo_p99_s))
+        self.scale_up_queue_depth = float(scale_up_queue_depth)
+        self.scale_down_queue_depth = float(scale_down_queue_depth)
+        self.windows_up = max(int(windows_up), 1)
+        self.windows_down = max(int(windows_down), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.decision_interval_s = float(decision_interval_s)
+        self._lock = threading.Lock()
+        self._replicas: List[_Replica] = []
+        self._shedding = False
+        self._sheds_in_window = 0   # queue-full sheds since last round
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_scale_t: float = -float("inf")
+        self._last_p99: Optional[float] = None
+        self._closed = False
+        reg = _obs_metrics.registry()
+        self._g_replicas = reg.gauge(
+            "serving_replicas",
+            "live (non-draining) LLMServer replicas behind the "
+            "router")
+        self._g_p99 = reg.gauge(
+            "router_p99_s",
+            "request p99 latency over the last decision window "
+            "(None scrapes absent when the window saw no "
+            "completions)")
+        self._g_queue = reg.gauge(
+            "router_queue_depth",
+            "waiting requests summed across replicas")
+        self._c_requests = reg.counter(
+            "router_requests_total", "admissions routed to a replica")
+        self._c_shed = reg.counter(
+            "router_shed_total",
+            "admissions shed at the router door (Overloaded)")
+        self._c_up = reg.counter(
+            "router_scale_ups_total", "replicas spawned by the SLO "
+            "control loop")
+        self._c_down = reg.counter(
+            "router_scale_downs_total", "replicas retired by the SLO "
+            "control loop")
+        for _ in range(self.min_replicas):
+            self._spawn_replica(reason="min_replicas")
+        self._g_replicas.set(len(self._replicas))
+        self._metrics_server = None
+        if metrics_port is not None:
+            from ...observability import http as _obs_http
+            self._metrics_server = _obs_http.serve(int(metrics_port))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if self.decision_interval_s > 0:
+            self._thread = threading.Thread(
+                target=self._control_loop,
+                name="paddle-tpu-serving-router", daemon=True)
+            self._thread.start()
+
+    # -- capacity ----------------------------------------------------------
+    def _spawn_replica(self, reason: str) -> _Replica:
+        """Build one replica through the factory; the ``replica.spawn``
+        fault site runs FIRST so chaos can fail the spawn path itself
+        (the control loop survives and retries after cooldown)."""
+        _faults.fault_point("replica.spawn",
+                            n=len(self._replicas) + 1, reason=reason)
+        rep = _Replica(self._factory())
+        with self._lock:
+            self._replicas.append(rep)
+        return rep
+
+    @property
+    def replicas(self) -> List[Any]:
+        """Live (non-draining) replica servers, least-loaded first."""
+        with self._lock:
+            reps = [r for r in self._replicas if not r.draining]
+        return [r.server for r in sorted(reps, key=lambda r: r.load)]
+
+    @property
+    def num_replicas(self) -> int:
+        with self._lock:
+            return sum(1 for r in self._replicas if not r.draining)
+
+    @property
+    def shedding(self) -> bool:
+        return self._shedding
+
+    def windowed_p99_s(self) -> Optional[float]:
+        """p99 over the completions of the last decision window (None
+        when that window saw none)."""
+        return self._last_p99
+
+    # -- front door --------------------------------------------------------
+    def submit(self, prompt_ids, max_tokens: int, stream_cb=None):
+        """Route one request to the least-loaded replica; returns the
+        request future.  Raises :class:`Overloaded` when the router is
+        shedding (SLO policy) or every replica's queue is full."""
+        if self._closed:
+            raise RuntimeError("router closed")
+        with self._lock:
+            reps = sorted((r for r in self._replicas
+                           if not r.draining), key=lambda r: r.load)
+        if not reps:
+            raise RuntimeError("router has no live replicas")
+        if self._shedding and not _faults.should_drop(
+                "router.shed", depth=sum(r.queue_depth for r in reps)):
+            # a POLICY shed is the state doing its job, not fresh
+            # overload evidence — feeding it back into the signal
+            # would latch shedding on for as long as clients retry
+            self._c_shed.inc()
+            raise Overloaded(
+                "router is shedding: SLO policy is on and capacity "
+                "cannot grow — retry with backoff upstream")
+        last_exc: Optional[Exception] = None
+        for rep in reps:
+            try:
+                fut = rep.server.submit(prompt_ids, max_tokens,
+                                        stream_cb=stream_cb)
+            except QueueFull as e:
+                last_exc = e
+                continue
+            self._c_requests.inc()
+            return fut
+        # every queue full: this IS a shed, whatever the policy state
+        self._note_shed()
+        raise Overloaded(
+            f"all {len(reps)} replica queues full "
+            f"({last_exc})") from last_exc
+
+    def _note_shed(self):
+        """Count a QUEUE-FULL shed on the registry AND as overload
+        evidence for the next decision round: queue-depth *samples*
+        miss a burst that fills and drains between two 10 Hz rounds,
+        but the rejections it forced are integral evidence the loop
+        must not lose (verify-drive catch: 76 door-sheds in <0.2 s
+        were invisible to the sampled queue depth — no scale-up,
+        nothing on the ring)."""
+        self._c_shed.inc()
+        with self._lock:
+            self._sheds_in_window += 1
+
+    # -- control loop ------------------------------------------------------
+    def _signals(self) -> Dict[str, Any]:
+        """One host-only sample of the registry-backed signals the
+        policy judges on (no device syncs — materialize=False)."""
+        with self._lock:
+            reps = [r for r in self._replicas if not r.draining]
+            shed_delta, self._sheds_in_window = \
+                self._sheds_in_window, 0
+        queue = sum(r.queue_depth for r in reps)
+        active = sum(r.active for r in reps)
+        # windowed p99: diff every live replica's cumulative latency
+        # histogram against its previous snapshot and merge the
+        # window counts (bucket edges are shared — one registry name,
+        # one fixed grid, so cumulative diffs add elementwise)
+        merged_cum: Optional[List[float]] = None
+        edges: Optional[List[float]] = None
+        for r in reps:
+            cur = r.latency_snapshot()
+            prev, r.last_latency = r.last_latency, cur
+            cum = _window_cum(prev, cur)
+            if merged_cum is None:
+                merged_cum = cum
+                edges = [e for e, _ in cur.get("buckets", [])]
+            elif len(cum) == len(merged_cum):
+                merged_cum = [a + b for a, b in zip(merged_cum, cum)]
+        p99 = (_quantile_from_cum(edges, merged_cum, 0.99)
+               if merged_cum and edges else None)
+        self._last_p99 = p99
+        return {"replicas": len(reps), "queue_depth": queue,
+                "active": active, "p99_s": p99,
+                "shed_delta": shed_delta}
+
+    def control_round(self) -> Dict[str, Any]:
+        """ONE policy decision over one signal sample (the background
+        loop calls this every ``decision_interval_s``; tests call it
+        directly).  Returns the sample it judged, with the decision
+        annotated."""
+        sig = self._signals()
+        n = sig["replicas"]
+        self._g_queue.set(sig["queue_depth"])
+        self._g_p99.set(sig["p99_s"])
+        per_rep = sig["queue_depth"] / max(n, 1)
+        slo_violated = (self.slo_p99_s is not None
+                        and sig["p99_s"] is not None
+                        and sig["p99_s"] > self.slo_p99_s)
+        # sheds since the last round are overload evidence too: a
+        # burst that fills AND drains every queue between two rounds
+        # never shows up in the sampled depth, but the rejections it
+        # forced did happen
+        overloaded = (per_rep > self.scale_up_queue_depth
+                      or slo_violated or sig["shed_delta"] > 0)
+        idle = (per_rep <= self.scale_down_queue_depth
+                and not slo_violated and sig["shed_delta"] == 0)
+        self._up_streak = self._up_streak + 1 if overloaded else 0
+        self._down_streak = self._down_streak + 1 if idle else 0
+        now = time.monotonic()
+        cooled = now - self._last_scale_t >= self.cooldown_s
+        decision = "hold"
+        if (overloaded and self._up_streak >= self.windows_up
+                and n < self.max_replicas and cooled):
+            decision = self._scale_up(sig)
+        elif (idle and self._down_streak >= self.windows_down
+                and n > self.min_replicas and cooled):
+            decision = self._scale_down(sig)
+        # shedding state: overload that capacity can't absorb (maxed
+        # out or mid-cooldown) sheds at the door; any non-overloaded
+        # round turns it back off
+        want_shed = (overloaded and self._up_streak >= self.windows_up
+                     and (n >= self.max_replicas or not cooled))
+        if want_shed and not self._shedding:
+            self._shedding = True
+            _obs_events.record("shed_on", queue_depth=sig["queue_depth"],
+                               p99_s=sig["p99_s"], replicas=n,
+                               shed_recent=sig["shed_delta"])
+        elif self._shedding and not overloaded:
+            self._shedding = False
+            _obs_events.record("shed_off",
+                               queue_depth=sig["queue_depth"],
+                               p99_s=sig["p99_s"], replicas=n)
+        self._reap_draining()
+        self._g_replicas.set(self.num_replicas)
+        sig["decision"] = decision
+        return sig
+
+    def _scale_up(self, sig: Dict[str, Any]) -> str:
+        try:
+            self._spawn_replica(reason="overload")
+        except Exception as e:  # noqa: BLE001 — injected or OOM: the
+            # router survives on current capacity and retries after
+            # cooldown (shedding covers the gap)
+            self._last_scale_t = time.monotonic()
+            _obs_events.record("scale_up_failed",
+                               error=f"{type(e).__name__}: {e}")
+            return "scale_up_failed"
+        self._last_scale_t = time.monotonic()
+        self._up_streak = 0
+        self._c_up.inc()
+        _obs_events.record("scale_up", replicas=self.num_replicas,
+                           queue_depth=sig["queue_depth"],
+                           p99_s=sig["p99_s"])
+        return "scale_up"
+
+    def _scale_down(self, sig: Dict[str, Any]) -> str:
+        with self._lock:
+            live = [r for r in self._replicas if not r.draining]
+            victim = min(live, key=lambda r: r.load)
+            victim.draining = True
+        self._last_scale_t = time.monotonic()
+        self._down_streak = 0
+        self._c_down.inc()
+        _obs_events.record("scale_down", victim=victim.name,
+                           replicas=self.num_replicas,
+                           queue_depth=sig["queue_depth"])
+        return "scale_down"
+
+    def _reap_draining(self):
+        """Close drained victims once their in-flight work finishes
+        (no new admissions reach a draining replica, so load only
+        falls).  Registry children are reclaimed — replica churn is
+        the router's normal operation, and unbounded dead-engine
+        series would bloat every scrape."""
+        with self._lock:
+            done = [r for r in self._replicas
+                    if r.draining and r.load == 0]
+            self._replicas = [r for r in self._replicas
+                              if r not in done]
+        for r in done:
+            try:
+                r.server.close(unregister_metrics=True)
+            except Exception:  # noqa: BLE001 — a wedged close must
+                # not stall the control loop
+                pass
+            _obs_events.record("replica_retired", victim=r.name)
+
+    def _control_loop(self):
+        while not self._stop.wait(self.decision_interval_s):
+            try:
+                self.control_round()
+            except Exception as e:  # noqa: BLE001 — one bad round
+                # (mid-close races included) must not kill the loop
+                _obs_events.record(
+                    "control_round_failed",
+                    error=f"{type(e).__name__}: {e}")
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def metrics_port(self) -> Optional[int]:
+        return (None if self._metrics_server is None
+                else self._metrics_server.port)
+
+    def close(self):
+        """Stop the control loop and close every replica (their
+        pending futures fail per ``LLMServer.close``)."""
+        self._closed = True
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        with self._lock:
+            reps, self._replicas = list(self._replicas), []
+        for r in reps:
+            try:
+                r.server.close(unregister_metrics=True)
+            except Exception:
+                pass
+        self._g_replicas.set(0)
+        if self._metrics_server is not None:
+            self._metrics_server.close()
+            self._metrics_server = None
+
+    def __enter__(self) -> "ServingRouter":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

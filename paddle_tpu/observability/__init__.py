@@ -34,12 +34,13 @@ import os as _os
 from . import trace  # noqa: F401
 from . import metrics  # noqa: F401
 from . import export  # noqa: F401
+from . import events  # noqa: F401
 from . import aggregate  # noqa: F401
 from . import http  # noqa: F401
 from .metrics import registry  # noqa: F401
 
-__all__ = ["trace", "metrics", "export", "aggregate", "http",
-           "registry", "scrape", "scrape_prometheus"]
+__all__ = ["trace", "metrics", "export", "events", "aggregate",
+           "http", "registry", "scrape", "scrape_prometheus"]
 
 
 def scrape(materialize: bool = True):

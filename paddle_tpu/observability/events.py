@@ -1,0 +1,89 @@
+"""Control-loop decision ring (DESIGN-OBSERVABILITY.md §Action loop).
+
+PR 13 turns the observability plane into a control plane: the launch
+controller drains stragglers, the serving router scales replicas and
+sheds admissions.  Counters say *how often* the loop acted; this ring
+says *what it decided and why*, decision by decision, so an operator
+can audit the loop after the fact:
+
+    >>> paddle.observability.events.record("drain", rank=1,
+    ...                                    step_time_s=1.62)
+    >>> paddle.observability.events.snapshot()
+    [{"ts": 1754300000.123, "kind": "drain", "rank": 1,
+      "step_time_s": 1.62}]
+
+Semantics:
+
+- **Bounded.**  A ``deque(maxlen=capacity)`` (default 256, knob
+  ``PADDLE_TPU_EVENTS_CAPACITY``): a chatty loop evicts its own oldest
+  decisions, never grows the process.  Record rate is bounded by
+  decision rate by construction — callers record *decisions*
+  (drain/scale/shed-state transitions), not per-request outcomes
+  (those are counters).
+- **Host-only.**  ``record`` stamps wall-clock ``time.time()`` and
+  stores plain dicts; nothing here can touch the device, so the ring
+  is scrapable mid-wedge exactly like ``/healthz``.
+- **Always on.**  Unlike tracing there is no arming knob: the ring is
+  a tiny fixed cost paid only when a control loop actually decides
+  something, and a self-driving fleet with an un-auditable action log
+  is worse than none.
+
+Exposure: every per-process HTTP endpoint serves the ring at
+``/events``; the launch controller's ``/fleet/events`` merges its own
+ring with every live member's, each entry tagged with its ``source``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["record", "snapshot", "capacity", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 256
+
+
+def _env_capacity() -> int:
+    try:
+        cap = int(os.environ.get("PADDLE_TPU_EVENTS_CAPACITY",
+                                 "0") or 0)
+    except ValueError:  # malformed knob must not kill the import
+        cap = 0
+    return cap if cap > 0 else DEFAULT_CAPACITY
+
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=_env_capacity())
+
+
+def record(kind: str, **detail: Any) -> Dict[str, Any]:
+    """Append one control-loop decision: ``kind`` (``drain``,
+    ``scale_up``, ``shed_on`` …) plus whatever context the decision
+    was made on.  ``detail`` values should be host scalars/strings —
+    they go straight to JSON on ``/events``.  Returns the stored
+    entry (with its timestamp) so callers can log it too."""
+    entry = {"ts": time.time(), "kind": str(kind), **detail}
+    with _lock:
+        _ring.append(entry)
+    return entry
+
+
+def snapshot() -> List[Dict[str, Any]]:
+    """The ring oldest-first (copies — callers can't mutate the
+    ring)."""
+    with _lock:
+        return [dict(e) for e in _ring]
+
+
+def capacity() -> int:
+    return _ring.maxlen or DEFAULT_CAPACITY
+
+
+def _reset_for_tests(capacity: Optional[int] = None):
+    """Clear the ring (and optionally resize it) — test isolation."""
+    global _ring
+    with _lock:
+        _ring = deque(maxlen=capacity or _env_capacity())

@@ -11,6 +11,9 @@ loopback port serving
   snapshot + trace summary) the fleet aggregator consumes;
 - ``/trace``         — Chrome/Perfetto ``trace_event`` JSON of the
   span ring (empty ``traceEvents`` when tracing is disarmed);
+- ``/events``        — the control-loop decision ring
+  (``observability.events``): drain/scale/shed decisions with
+  timestamps, host-state only;
 - ``/healthz``       — liveness probe; answers from already-host
   state only, so it stays responsive even while a ``/metrics`` scrape
   is wedged on a device materialization (each request runs on its own
@@ -47,6 +50,7 @@ import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
+from . import events as _events
 from . import export as _export
 from . import trace as _trace
 from .export import json_safe  # noqa: F401 — re-export: the wire-
@@ -114,6 +118,7 @@ class ObservabilityHTTPServer:
             "/metrics": self._metrics,
             "/metrics.json": self._metrics_json,
             "/trace": self._trace,
+            "/events": self._events,
             "/healthz": self._healthz,
         }
         self._routes.update(extra_routes or {})
@@ -174,6 +179,15 @@ class ObservabilityHTTPServer:
     def _trace(self):
         return (200, JSON_CONTENT_TYPE,
                 json.dumps(_trace.to_chrome_trace()).encode("utf-8"))
+
+    def _events(self):
+        # host-only like /healthz: the decision ring must answer
+        # while a /metrics scrape is wedged on a device sync
+        payload = {"events": _events.snapshot(),
+                   "capacity": _events.capacity()}
+        return (200, JSON_CONTENT_TYPE,
+                json.dumps(json_safe(payload), allow_nan=False,
+                           default=str).encode("utf-8"))
 
     def _healthz(self):
         # host state ONLY — must answer while a /metrics scrape is

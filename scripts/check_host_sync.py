@@ -74,6 +74,13 @@ HOT_MODULES = [
     # neither module may contain a direct jax/numpy sync call at all
     os.path.join("observability", "http.py"),
     os.path.join("observability", "aggregate.py"),
+    # action loop (DESIGN-OBSERVABILITY.md §Action loop): the serving
+    # router's control loop and the decision ring run NEXT TO the
+    # decode hot loop they supervise — both read host state only
+    # (queue depths, host-float histograms via materialize=False), so
+    # neither may contain a direct jax/numpy sync call at all
+    os.path.join("observability", "events.py"),
+    os.path.join("inference", "serving", "router.py"),
 ]
 
 # (module, enclosing function) → why this sync point is legitimate

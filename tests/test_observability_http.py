@@ -172,6 +172,46 @@ def test_endpoint_scrape_e2e_content_types_and_payloads():
         _get(f"http://127.0.0.1:{srv.port}/healthz", timeout=1)
 
 
+def test_events_ring_bounded_ordered_and_copied():
+    """The control-loop decision ring (ISSUE 13 §Action loop): FIFO
+    eviction at capacity, wall-clock timestamps, snapshot returns
+    copies the caller can't mutate through."""
+    from paddle_tpu.observability import events as obs_events
+    obs_events._reset_for_tests(capacity=4)
+    try:
+        for i in range(7):
+            obs_events.record("scale_up", i=i)
+        snap = obs_events.snapshot()
+        assert [e["i"] for e in snap] == [3, 4, 5, 6]
+        assert all(e["kind"] == "scale_up" and isinstance(e["ts"],
+                                                          float)
+                   for e in snap)
+        assert obs_events.capacity() == 4
+        snap[0]["i"] = 999
+        assert obs_events.snapshot()[0]["i"] == 3
+    finally:
+        obs_events._reset_for_tests()
+
+
+def test_events_route_serves_the_decision_ring():
+    """/events on every per-process endpoint: host-state only, the
+    same ring the launch controller merges into /fleet/events."""
+    from paddle_tpu.observability import events as obs_events
+    obs_events._reset_for_tests()
+    try:
+        obs_events.record("drain", rank=1, step_time_s=1.5)
+        obs_events.record("shed_on", queue_depth=12)
+        with obs_http.serve(0) as srv:
+            payload = json.load(
+                _get(f"http://127.0.0.1:{srv.port}/events"))
+        assert payload["capacity"] == obs_events.capacity()
+        kinds = [e["kind"] for e in payload["events"]]
+        assert kinds == ["drain", "shed_on"]
+        assert payload["events"][0]["rank"] == 1
+    finally:
+        obs_events._reset_for_tests()
+
+
 def test_prometheus_label_escaping_over_the_wire():
     """A hostile label value (quotes, backslashes, newlines) must
     arrive escaped — one bad label corrupting the whole payload is
@@ -540,6 +580,20 @@ def test_e2e_two_rank_launch_answers_over_http(tmp_path):
         assert ctl_snap['fleet_rank_step_time_s{rank="1"}'][
             "value"] > 2 * ctl_snap[
                 'fleet_rank_step_time_s{rank="0"}']["value"]
+        # 5. /fleet/healthz (ISSUE 13): one-glance member health on
+        # the live plane — both ranks alive, the straggler flagged,
+        # drain policy off (not asked for here)
+        h = get_json(base, "/fleet/healthz")
+        assert [m["rank"] for m in h["members"]] == [0, 1]
+        assert all(m["alive"] for m in h["members"])
+        assert h["members"][1]["straggler"] is True
+        assert h["status"] == "degraded"        # straggler present
+        assert h["drain_windows"] == 0
+        # 6. /fleet/events answers (no control-loop decisions in this
+        # scenario — drain is off — so the ring may be empty, but the
+        # endpoint and shape must hold)
+        ev = get_json(base, "/fleet/events")
+        assert isinstance(ev["events"], list)
     finally:
         stop_file.write_text("1")
         try:
