@@ -483,6 +483,15 @@ class CheckpointManager:
         vs = self.verified_steps()
         return vs[-1] if vs else None
 
+    def oldest_verified_step(self) -> Optional[int]:
+        """The oldest step retention still holds restorable — the
+        lower edge of this rank's reform-proposal window
+        (``elastic_rank.reform_barrier(..., oldest_step=)``): a fleet
+        resume step below it targets a checkpoint ``max_to_keep``
+        already evicted here."""
+        vs = self.verified_steps()
+        return vs[0] if vs else None
+
     # -- restore ------------------------------------------------------------
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

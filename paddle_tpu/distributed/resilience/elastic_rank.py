@@ -75,6 +75,15 @@ def kv_key(job_id: str, *parts: str, run_id: Optional[str] = None
     return "/k/" + "/".join([ns, *parts])
 
 
+class ReformWindowError(RuntimeError):
+    """The reform barrier's agreed resume step lies outside some
+    member's checkpoint retention window: every member computes the
+    same verdict from the same proposals, so the whole fleet fails
+    identically and loudly instead of one member failing its rollback
+    mid-reform and triggering a promotion cascade (the PR-13 drain
+    e2e's failure mode).  Operator action: raise ``max_to_keep``."""
+
+
 @dataclass
 class PromotionTicket:
     """Controller → spare: become ``rank`` in membership ``epoch``."""
@@ -299,14 +308,26 @@ class ElasticRankContext:
     # -- reform barrier ------------------------------------------------------
     def reform_barrier(self, epoch: int, members: List[int],
                        propose_step: int,
+                       oldest_step: Optional[int] = None,
                        timeout: float = 60.0) -> int:
         """Meet every member of ``epoch`` at the reform barrier and
-        agree on the resume point: each member proposes the newest
-        checkpoint step it can restore bit-exact, and the barrier
-        returns ``min(proposals)`` — computed identically by every
-        member, no coordinator round-trip.  Healthy ranks call this
-        from their *running* process (state rolls back, the process
-        does not)."""
+        agree on the resume point.  Proposals are *range-aware*
+        (DESIGN-RESILIENCE.md §Single-rank replacement): each member
+        publishes the newest checkpoint step it can restore bit-exact
+        AND the oldest step its retention still holds
+        (``CheckpointManager.oldest_verified_step``; 0 = "can restart
+        from scratch", also the legacy default for peers that publish
+        no range).  The barrier returns ``min(newest proposals)`` —
+        computed identically by every member, no coordinator
+        round-trip — after validating it against every member's
+        retention window: a resume step below some member's oldest
+        retained checkpoint means that member's retention already
+        evicted it, and restore would fail *after* the fleet agreed.
+        That raises :class:`ReformWindowError` — loud, deterministic,
+        and identical on every member (the PR-13 drain e2e met the
+        silent version of this: a promotion cascade as each survivor
+        failed its rollback; size ``max_to_keep`` to the largest step
+        spread the fleet can accumulate between failures)."""
         _faults.fault_point("barrier.reform", epoch=int(epoch),
                             rank=self.rank, member=self.member_id)
         self._reform_joined[int(epoch)] = True
@@ -315,17 +336,36 @@ class ElasticRankContext:
             self._pending_reform_epoch = None
         self.client.put(self._key("barrier", str(epoch), str(self.rank)),
                         json.dumps({"propose": int(propose_step),
+                                    "oldest": int(oldest_step or 0),
                                     "member": self.member_id}))
         deadline = time.monotonic() + float(timeout)
         while True:
             proposals: Dict[int, int] = {}
+            oldest: Dict[int, int] = {}
             for r in members:
                 d = self._get_json(
                     self._key("barrier", str(epoch), str(r)))
                 if d is not None:
                     proposals[int(r)] = int(d["propose"])
+                    # legacy peers (pre-range protocol) publish no
+                    # window: treat as unbounded retention below
+                    oldest[int(r)] = int(d.get("oldest", 0))
             if len(proposals) == len(members):
-                return min(proposals.values())
+                resume = min(proposals.values())
+                floor = max(oldest.values())
+                if resume > 0 and resume < floor:
+                    windows = {r: (oldest[r], proposals[r])
+                               for r in sorted(proposals)}
+                    raise ReformWindowError(
+                        f"reform barrier epoch={epoch}: agreed resume "
+                        f"step {resume} is outside a member's "
+                        f"checkpoint retention window (per-rank "
+                        f"[oldest, newest] = {windows}); a member "
+                        "already evicted the step the fleet must roll "
+                        "back to — raise CheckpointManager "
+                        "max_to_keep above the fleet's worst-case "
+                        "step spread between failures")
+                return resume
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"reform barrier epoch={epoch}: only "

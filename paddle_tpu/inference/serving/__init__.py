@@ -14,11 +14,17 @@ what-recompiles/what-never-does contract.
 
 from .kv_cache import (BlockAllocator, OutOfBlocks, PagedKVCache,
                        SCRATCH_BLOCK, gather_pages, paged_append,
-                       write_prompt_pages)
+                       write_prompt_pages, write_prompt_pages_group)
 from .ragged_attention import (causal_prefill_attention,
-                               ragged_decode_attention)
-from .decode_model import (ServingModelConfig, decode_forward,
-                           extract_decode_params, prefill_forward,
+                               chunked_prefill_attention,
+                               paged_decode_attention,
+                               ragged_decode_attention,
+                               resolve_paged_attention_mode)
+from .sampling import sample_tokens
+from .prefix_cache import PrefixCache, PrefixEntry
+from .decode_model import (ServingModelConfig, chunk_prefill_forward,
+                           decode_forward, extract_decode_params,
+                           prefill_forward, prefill_group_forward,
                            reference_decode)
 from .scheduler import QueueFull, Request, RequestStats, Scheduler
 from .engine import DecodeEngine, GenerationResult
@@ -28,9 +34,14 @@ from .router import Overloaded, ServingRouter
 __all__ = [
     "BlockAllocator", "OutOfBlocks", "PagedKVCache", "SCRATCH_BLOCK",
     "gather_pages", "paged_append", "write_prompt_pages",
-    "causal_prefill_attention", "ragged_decode_attention",
-    "ServingModelConfig", "decode_forward", "extract_decode_params",
-    "prefill_forward", "reference_decode",
+    "write_prompt_pages_group",
+    "causal_prefill_attention", "chunked_prefill_attention",
+    "paged_decode_attention", "ragged_decode_attention",
+    "resolve_paged_attention_mode", "sample_tokens",
+    "PrefixCache", "PrefixEntry",
+    "ServingModelConfig", "chunk_prefill_forward", "decode_forward",
+    "extract_decode_params", "prefill_forward",
+    "prefill_group_forward", "reference_decode",
     "QueueFull", "Request", "RequestStats", "Scheduler",
     "DecodeEngine", "GenerationResult", "LLMServer",
     "Overloaded", "ServingRouter",

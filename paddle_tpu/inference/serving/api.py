@@ -139,6 +139,7 @@ class LLMServer:
 
     def _fail_all(self, exc: Exception):
         eng = self.engine
+        eng._prefill_jobs.clear()      # mid-prefill work dies with us
         for s, req in enumerate(eng._slots):
             if req is None:
                 continue
@@ -148,6 +149,9 @@ class LLMServer:
             if req.blocks:
                 eng._kv.allocator.free(req.blocks)
                 req.blocks = []
+            if req.prefix_entries:
+                eng._prefix.release(req.prefix_entries)
+                req.prefix_entries = []
             eng._lengths[s] = 0
             eng._slots[s] = None
             if not req.future.done():
@@ -157,12 +161,20 @@ class LLMServer:
                 req.future.set_exception(exc)
 
     # -- traffic -------------------------------------------------------------
-    def submit(self, prompt_ids, max_tokens: int, stream_cb=None):
+    def submit(self, prompt_ids, max_tokens: int, stream_cb=None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed=None):
         """Enqueue a request; returns its ``concurrent.futures.Future``
-        resolving to a :class:`~.engine.GenerationResult`.  Raises
-        :class:`QueueFull` under backpressure."""
+        resolving to a :class:`~.engine.GenerationResult`.
+        ``temperature``/``top_k``/``top_p``/``seed`` select in-program
+        sampling (temperature 0 = greedy; a fixed seed makes the
+        sampled sequence deterministic — DESIGN-SERVING.md
+        §Long-context tier).  Raises :class:`QueueFull` under
+        backpressure."""
         req = self.engine.submit(prompt_ids, max_tokens,
-                                 stream_cb=stream_cb)
+                                 stream_cb=stream_cb,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, seed=seed)
         with self._cond:
             self._cond.notify_all()
         return req.future

@@ -32,9 +32,13 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from .kv_cache import gather_pages, paged_append, SCRATCH_BLOCK
+from .kv_cache import (gather_pages, paged_append, SCRATCH_BLOCK,
+                       write_prompt_pages)
 from .ragged_attention import (causal_prefill_attention,
+                               chunked_prefill_attention,
+                               paged_decode_attention,
                                ragged_decode_attention)
+from .sampling import sample_tokens
 
 
 @dataclass(frozen=True)
@@ -127,8 +131,47 @@ def _mlp(x, lp, eps):
 # ---------------------------------------------------------------------------
 # prefill
 # ---------------------------------------------------------------------------
+def prefill_group_forward(params, cfg: ServingModelConfig, ids,
+                          lengths, temperature, top_k, top_p, seed):
+    """Batched same-bucket prefill: one dispatch for a whole bucket
+    group (DESIGN-SERVING.md §Long-context tier).
+
+    ``ids`` ``[G, Lb]`` int32 (each prompt right-padded to the shared
+    bucket); ``lengths`` ``[G]`` int32 real prompt lengths; sampling
+    vectors ``[G]`` (see ``sampling.sample_tokens``; the first token's
+    PRNG position is the prompt length).  Returns
+    ``(kv [L, 2, G, Lb, H, Dh], first_tokens [G], last_logits
+    [G, V])``.  Rows are independent under causal attention, so a
+    group member's rows are bit-identical to its solo prefill; padded
+    group rows (length 0) emit garbage the engine ignores.
+    """
+    G, Lb = ids.shape
+    pos = jnp.arange(Lb, dtype=jnp.int32)
+    x = params["wte"][ids] + params["wpe"][pos][None]
+    kvs = []
+    for lp in params["layers"]:
+        h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_epsilon)
+        q, k, v = _split_qkv(h @ lp["wqkv"] + lp["bqkv"],
+                             cfg.num_heads, cfg.head_dim)
+        kvs.append(jnp.stack([k, v]))              # [2, G, Lb, H, Dh]
+        attn = causal_prefill_attention(q, k, v)
+        x = x + attn.reshape(G, Lb, cfg.hidden_size) @ lp["wo"] + lp["bo"]
+        x = x + _mlp(x, lp, cfg.ln_epsilon)
+    x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
+    lengths = lengths.astype(jnp.int32)
+    last_ix = jnp.maximum(lengths - 1, 0)
+    last = jnp.take_along_axis(
+        x, last_ix[:, None, None], axis=1)[:, 0]   # [G, D]
+    logits = last @ params["wte"].T                # [G, V]
+    first_tokens = sample_tokens(logits, temperature, top_k, top_p,
+                                 seed, lengths)
+    return jnp.stack(kvs), first_tokens, logits
+
+
 def prefill_forward(params, cfg: ServingModelConfig, ids, length):
-    """Full-prompt forward at a bucket length.
+    """Full-prompt forward at a bucket length (single request, greedy
+    first token — the historical entry; the engine dispatches
+    :func:`prefill_group_forward`).
 
     ``ids`` ``[1, Lb]`` int32 (prompt right-padded to its bucket);
     ``length`` traced int32 scalar — the real prompt length.  Returns
@@ -142,30 +185,71 @@ def prefill_forward(params, cfg: ServingModelConfig, ids, length):
     real row; its garbage K/V land in pages but are masked by length
     in every later ragged-decode read.
     """
-    B, Lb = ids.shape
-    pos = jnp.arange(Lb, dtype=jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    kv, toks, logits = prefill_group_forward(
+        params, cfg, ids, length[None],
+        jnp.zeros((1,), jnp.float32), jnp.zeros((1,), jnp.int32),
+        jnp.ones((1,), jnp.float32), jnp.zeros((1,), jnp.uint32))
+    return kv[:, :, 0], toks[0], logits[0]
+
+
+def chunk_prefill_forward(params, cfg: ServingModelConfig, pool,
+                          ctx_table, ctx_len, ids, chunk_len,
+                          chunk_blocks, temperature, top_k, top_p,
+                          seed):
+    """One prefill *chunk* against the paged pool: compute the chunk's
+    K/V attending to already-cached context (prefix-cache hits and
+    earlier chunks), write them into the chunk's pages, and emit the
+    next-token logits of the chunk's last real position.
+
+    ``pool`` ``[L, 2, NB, BS, H, Dh]`` (caller's jit donates it);
+    ``ctx_table`` ``[1, NBctx]`` int32 — page-table slice covering the
+    existing context, bucketed so the trace count stays logarithmic;
+    ``ctx_len`` int32 scalar — real cached tokens; ``ids`` ``[1, Cb]``
+    int32 chunk tokens right-padded to the chunk bucket; ``chunk_len``
+    int32 scalar real chunk tokens; ``chunk_blocks`` ``[Cb // BS]``
+    int32 destination pages (tail entries SCRATCH_BLOCK); sampling
+    scalars as in :func:`prefill_group_forward` (only meaningful on a
+    prompt's final chunk, whose last position emits the first
+    generated token at PRNG position ``ctx_len + chunk_len``).
+    Returns ``(pool, first_token, last_logits [V])``.
+    """
+    B, Cb = ids.shape
+    ctx_len = jnp.asarray(ctx_len, jnp.int32)
+    chunk_len = jnp.asarray(chunk_len, jnp.int32)
+    pos = jnp.minimum(ctx_len + jnp.arange(Cb, dtype=jnp.int32),
+                      cfg.max_position - 1)
     x = params["wte"][ids] + params["wpe"][pos][None]
     kvs = []
-    for lp in params["layers"]:
+    for li, lp in enumerate(params["layers"]):
         h = _ln(x, lp["ln1_w"], lp["ln1_b"], cfg.ln_epsilon)
         q, k, v = _split_qkv(h @ lp["wqkv"] + lp["bqkv"],
                              cfg.num_heads, cfg.head_dim)
-        kvs.append(jnp.stack([k[0], v[0]]))        # [2, Lb, H, Dh]
-        attn = causal_prefill_attention(q, k, v)
-        x = x + attn.reshape(B, Lb, cfg.hidden_size) @ lp["wo"] + lp["bo"]
+        kvs.append(jnp.stack([k[0], v[0]]))        # [2, Cb, H, Dh]
+        k_ctx, v_ctx = gather_pages(pool, li, ctx_table)
+        attn = chunked_prefill_attention(q, k_ctx, v_ctx, ctx_len,
+                                         k, v)
+        x = x + attn.reshape(B, Cb, cfg.hidden_size) @ lp["wo"] + lp["bo"]
         x = x + _mlp(x, lp, cfg.ln_epsilon)
+    pool = write_prompt_pages(pool, jnp.stack(kvs), chunk_blocks)
     x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
-    last = x[0, length - 1]                        # [D]
+    last = x[0, jnp.maximum(chunk_len - 1, 0)]     # [D]
     logits = last @ params["wte"].T                # [V]
-    first_token = jnp.argmax(logits).astype(jnp.int32)
-    return jnp.stack(kvs), first_token, logits
+    tok = sample_tokens(
+        logits[None],
+        jnp.asarray(temperature, jnp.float32)[None],
+        jnp.asarray(top_k, jnp.int32)[None],
+        jnp.asarray(top_p, jnp.float32)[None],
+        jnp.asarray(seed, jnp.uint32)[None],
+        (ctx_len + chunk_len)[None])[0]
+    return pool, tok, logits
 
 
 # ---------------------------------------------------------------------------
 # decode
 # ---------------------------------------------------------------------------
 def decode_forward(params, cfg: ServingModelConfig, pool, page_table,
-                   lengths, tokens, write_ok):
+                   lengths, tokens, write_ok, attention="gather"):
     """ONE decode token per request over the paged pool.
 
     ``pool`` ``[L, 2, NB, BS, H, Dh]`` (caller's jit donates it);
@@ -174,7 +258,11 @@ def decode_forward(params, cfg: ServingModelConfig, pool, page_table,
     ``tokens`` ``[B]`` int32 — the input token per request;
     ``write_ok`` ``[B]`` bool — rows with ``False`` (empty slot, done
     request) write to the scratch block and their output is garbage
-    the engine masks.  Returns ``(pool, logits [B, V])``.
+    the engine masks.  ``attention`` is the *resolved* implementation
+    behind the ``ragged_attention.paged_decode_attention`` seam
+    ("gather" reference or the fused "pallas" kernel) — a static
+    trace-time choice baked into the engine's one decode program.
+    Returns ``(pool, logits [B, V])``.
     """
     L, _, NB, BS, H, Dh = pool.shape
     B, MAXNB = page_table.shape
@@ -194,9 +282,9 @@ def decode_forward(params, cfg: ServingModelConfig, pool, page_table,
         q, k, v = _split_qkv(h @ lp["wqkv"] + lp["bqkv"],
                              cfg.num_heads, cfg.head_dim)
         pool = paged_append(pool, li, k, v, block_ids, offsets)
-        kp, vp = gather_pages(pool, li, page_table)
         # context includes the token just appended
-        attn = ragged_decode_attention(q, kp, vp, lengths + 1)
+        attn = paged_decode_attention(pool, li, page_table,
+                                      lengths + 1, q, mode=attention)
         x = x + attn.reshape(B, cfg.hidden_size) @ lp["wo"] + lp["bo"]
         x = x + _mlp(x, lp, cfg.ln_epsilon)
     x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
@@ -208,19 +296,35 @@ def decode_forward(params, cfg: ServingModelConfig, pool, page_table,
 # sequential oracle (tests only)
 # ---------------------------------------------------------------------------
 def reference_decode(params, cfg: ServingModelConfig, prompt_ids,
-                     num_tokens):
-    """Per-request sequential greedy decode with a dense cache.
+                     num_tokens, temperature=0.0, top_k=0,
+                     top_p=1.0, seed=0):
+    """Per-request sequential decode with a dense cache (greedy by
+    default; sampled when ``temperature > 0``).
 
     ``prompt_ids``: 1-D int sequence.  Returns ``(tokens [num_tokens],
     logits [num_tokens, V])`` as jax arrays.  Unbatched, unpaged,
     unjitted — the exactness oracle the ragged batched path is tested
     against, sharing the same primitive helpers so the only deltas are
-    batching, paging, and padded-axis reduction order.
+    batching, paging, and padded-axis reduction order.  Sampling
+    derives the identical in-program keys as the serving engine
+    (``fold_in(PRNGKey(seed), token_index)``), so a seeded sampled
+    request must reproduce this oracle token for token.
     """
+
+    def _pick(lg, position):
+        return sample_tokens(
+            lg[None],
+            jnp.asarray(float(temperature), jnp.float32)[None],
+            jnp.asarray(int(top_k), jnp.int32)[None],
+            jnp.asarray(float(top_p), jnp.float32)[None],
+            jnp.asarray(int(seed), jnp.uint32)[None],
+            jnp.asarray(int(position), jnp.int32)[None])[0]
+
     ids = jnp.asarray(prompt_ids, dtype=jnp.int32)[None]    # [1, Lp]
     Lp = ids.shape[1]
     kv, tok, logits = prefill_forward(params, cfg, ids,
                                       jnp.int32(Lp))
+    tok = _pick(logits, Lp)
     caches = [(kv[li, 0], kv[li, 1]) for li in
               range(cfg.num_layers)]                        # [T, H, Dh]
     out_toks = [tok]
@@ -247,7 +351,7 @@ def reference_decode(params, cfg: ServingModelConfig, prompt_ids,
         caches = new_caches
         x = _ln(x, params["lnf_w"], params["lnf_b"], cfg.ln_epsilon)
         lg = (x @ params["wte"].T)[0]
-        tok = jnp.argmax(lg).astype(jnp.int32)
+        tok = _pick(lg, Lp + step)
         out_toks.append(tok)
         out_logits.append(lg)
     return jnp.stack(out_toks), jnp.stack(out_logits)

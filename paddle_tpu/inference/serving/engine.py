@@ -45,8 +45,10 @@ explicit ``done_poll_interval=`` stays fixed.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 import weakref
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -57,9 +59,13 @@ from ...framework.lazy import LazyScalar, LazyStack
 from ...io.bucketing import shape_bucket
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
-from .decode_model import (ServingModelConfig, decode_forward,
-                           extract_decode_params, prefill_forward)
+from .decode_model import (ServingModelConfig, chunk_prefill_forward,
+                           decode_forward, extract_decode_params,
+                           prefill_group_forward)
 from .kv_cache import SCRATCH_BLOCK, PagedKVCache
+from .prefix_cache import PrefixCache
+from .ragged_attention import resolve_paged_attention_mode
+from .sampling import sample_tokens
 from .scheduler import Request, Scheduler
 
 # synthetic Chrome-trace track ids for request lifecycle spans: one
@@ -82,6 +88,36 @@ class GenerationResult:
     def __repr__(self):
         return (f"GenerationResult(id={self.request_id}, "
                 f"tokens={self.tokens})")
+
+
+def _pow2_buckets(max_n: int) -> List[int]:
+    """1, 2, 4, … capped-at-``max_n`` buckets (group sizes, context
+    block counts) — logarithmic trace sets for dimensions whose real
+    extent varies per dispatch."""
+    out, b = [], 1
+    while b < max_n:
+        out.append(b)
+        b *= 2
+    out.append(max_n)
+    return sorted(set(out))
+
+
+class _PrefillJob:
+    """Host bookkeeping for one chunk-prefilling request: how much of
+    the prompt is in cache (prefix hits + completed chunks), the chain
+    hash where prefix-cache insertion resumes, and the pool blocks
+    this request computed itself (candidate cache entries)."""
+
+    __slots__ = ("req", "slot", "chain", "done_tokens", "insert_from",
+                 "computed_blocks")
+
+    def __init__(self, req, slot, chain, done_tokens, insert_from):
+        self.req = req
+        self.slot = slot
+        self.chain = chain
+        self.done_tokens = int(done_tokens)
+        self.insert_from = int(insert_from)
+        self.computed_blocks: List[int] = []
 
 
 def _default_buckets(block_size: int, max_context: int) -> List[int]:
@@ -116,7 +152,10 @@ class DecodeEngine:
                  prefill_buckets: Optional[Sequence[int]] = None,
                  eos_id: Optional[int] = None, pad_id: int = 0,
                  done_poll_interval: Optional[int] = None,
-                 max_queue: int = 64):
+                 max_queue: int = 64,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: Optional[bool] = None,
+                 attention: Optional[str] = None):
         if network is not None:
             params = extract_decode_params(network)
             gpt_config = network.config
@@ -168,11 +207,53 @@ class DecodeEngine:
                     f"prefill bucket {b} is not a multiple of "
                     f"block_size {block_size}")
         self._buckets = sorted(int(b) for b in prefill_buckets)
+        # -- long-context tier knobs (DESIGN-SERVING.md §Long-context
+        # tier): chunked prefill, shared-prefix KV reuse, and the
+        # decode-attention implementation behind the kernel seam --
+        if prefill_chunk is None:
+            env_chunk = os.environ.get("PADDLE_TPU_PREFILL_CHUNK", "")
+            prefill_chunk = int(env_chunk) if env_chunk.strip() else None
+        if prefill_chunk is not None and prefill_chunk <= 0:
+            prefill_chunk = None
+        if prefill_chunk is not None:
+            if prefill_chunk % block_size:
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} is not a multiple "
+                    f"of block_size {block_size}")
+            prefill_chunk = min(int(prefill_chunk), self._buckets[-1])
+        self.prefill_chunk = prefill_chunk
+        # chunk buckets: the final (or only) chunk of a prompt can be
+        # any residue length, bucketed like legacy prefill; the
+        # prefix-hit suffix path uses these even with chunking off
+        self._chunk_buckets = _default_buckets(
+            block_size, self.prefill_chunk or self._buckets[-1])
+        # context-extent buckets for the chunk program's pool gather:
+        # pow2 block counts keep its trace set logarithmic in context
+        self._ctx_buckets = _pow2_buckets(self.max_blocks_per_seq)
+        self._group_buckets = _pow2_buckets(self.max_batch)
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "PADDLE_TPU_PREFIX_CACHE", "0").strip() not in (
+                "", "0", "off", "false")
+        self.attention_mode = resolve_paged_attention_mode(attention)
         # host-side batch state (authoritative; staged per dispatch)
         self._slots: List[Optional[Request]] = [None] * self.max_batch
         self._tables = np.full((self.max_batch, self.max_blocks_per_seq),
                                SCRATCH_BLOCK, dtype=np.int32)
         self._lengths = np.zeros(self.max_batch, dtype=np.int32)
+        # per-slot sampling vectors — decode-step DATA like the page
+        # tables, so greedy and sampled requests share one program.
+        # Unlike tables/lengths they mutate only at seat/finalize, so
+        # the staged device copies are cached and re-staged only on
+        # change (4 fewer H2D transfers on every steady-state dispatch)
+        self._temps = np.zeros(self.max_batch, dtype=np.float32)
+        self._topks = np.zeros(self.max_batch, dtype=np.int32)
+        self._topps = np.ones(self.max_batch, dtype=np.float32)
+        self._seeds = np.zeros(self.max_batch, dtype=np.uint32)
+        self._samp_dev = None          # invalidated by _mark_sampling
+        self._prefix = (PrefixCache(self._kv.allocator, block_size)
+                        if prefix_cache else None)
+        self._prefill_jobs: deque = deque()
         # device-resident loop state
         self._tokens = jnp.zeros(self.max_batch, dtype=jnp.int32)
         self._done = jnp.zeros(self.max_batch, dtype=bool)
@@ -180,6 +261,7 @@ class DecodeEngine:
         # decode must stay at exactly one trace, tests pin it)
         self._decode = self._build_decode_step()
         self._prefill = jax.jit(self._run_prefill)
+        self._chunk = jax.jit(self._run_chunk, donate_argnums=(1,))
         self._write = jax.jit(
             lambda pool, kv, blocks: self._write_pages(pool, kv, blocks),
             donate_argnums=(0,))
@@ -230,6 +312,25 @@ class DecodeEngine:
         self._h_queue_time = reg.histogram(
             "serving_queue_time_s", "request submit→admission wait",
             labels=labels)
+        # long-context tier instruments (DESIGN-SERVING.md
+        # §Long-context tier): prefix-cache traffic counters tick at
+        # match/insert sites, chunk latency is the host wall around
+        # each chunk dispatch (async-dispatch caveat documented there)
+        self._c_prefix_hits = reg.counter(
+            "serving_prefix_cache_hits_total",
+            "prompt blocks reused from the shared-prefix cache",
+            labels=labels)
+        self._c_prefix_misses = reg.counter(
+            "serving_prefix_cache_misses_total",
+            "share-eligible prompt blocks prefilled fresh",
+            labels=labels)
+        self._c_prefix_evictions = reg.counter(
+            "serving_prefix_cache_evictions_total",
+            "idle prefix entries reclaimed under pool pressure",
+            labels=labels)
+        self._h_chunk = reg.histogram(
+            "serving_prefill_chunk_s",
+            "per-chunk prefill dispatch wall time", labels=labels)
         wr = weakref.ref(self)
 
         def _gauge_fn(getter):
@@ -253,12 +354,29 @@ class DecodeEngine:
                   "dispatches between EOS polls (auto-tuned)",
                   labels=labels).set_function(
             _gauge_fn(lambda e: e.done_poll_interval))
+        # absent (None) while the prefix cache is disabled — a dead
+        # series would read as "cache on, empty"
+        reg.gauge("serving_prefix_blocks",
+                  "pool blocks owned by the shared-prefix cache",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: None if e._prefix is None
+                      else e._prefix.cached_blocks))
+        reg.gauge("serving_prefix_refs",
+                  "live request references onto shared prefix blocks",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: None if e._prefix is None
+                      else e._prefix.live_refs))
         self._obs_metric_names = (
             "serving_dispatches_total", "serving_tokens_total",
             "serving_requests_completed_total", "serving_latency_s",
             "serving_ttft_s", "serving_queue_time_s",
+            "serving_prefix_cache_hits_total",
+            "serving_prefix_cache_misses_total",
+            "serving_prefix_cache_evictions_total",
+            "serving_prefill_chunk_s",
             "serving_queue_depth", "serving_active",
-            "serving_kv_fragmentation", "serving_done_poll_interval")
+            "serving_kv_fragmentation", "serving_done_poll_interval",
+            "serving_prefix_blocks", "serving_prefix_refs")
 
     def unregister_metrics(self):
         """Reclaim this engine's labeled children from the process-wide
@@ -271,22 +389,42 @@ class DecodeEngine:
             reg.unregister(name, labels=self._obs_labels)
 
     # -- compiled steps ------------------------------------------------------
-    def _run_prefill(self, params, ids, length):
-        return prefill_forward(params, self._cfg, ids, length)
+    def _run_prefill(self, params, ids, lengths, temps, topks, topps,
+                     seeds):
+        """Batched same-bucket prefill: ONE dispatch per bucket group
+        (trace cache keyed by the (group, bucket) shape pair)."""
+        return prefill_group_forward(params, self._cfg, ids, lengths,
+                                     temps, topks, topps, seeds)
+
+    def _run_chunk(self, params, pool, ctx_table, ctx_len, ids,
+                   chunk_len, chunk_blocks, temp, topk, topp, seed):
+        """One prefill chunk against cached context (pool donated);
+        trace cache keyed by (chunk bucket, context-extent bucket)."""
+        return chunk_prefill_forward(params, self._cfg, pool,
+                                     ctx_table, ctx_len, ids,
+                                     chunk_len, chunk_blocks, temp,
+                                     topk, topp, seed)
 
     @staticmethod
     def _write_pages(pool, kv, blocks):
-        from .kv_cache import write_prompt_pages
-        return write_prompt_pages(pool, kv, blocks)
+        from .kv_cache import write_prompt_pages_group
+        return write_prompt_pages_group(pool, kv, blocks)
 
     def _build_decode_step(self):
         cfg, eos, pad = self._cfg, self.eos_id, self.pad_id
+        attn_mode = self.attention_mode
 
-        def step(params, pool, table, lengths, tokens, done):
+        def step(params, pool, table, lengths, tokens, done, temps,
+                 topks, topps, seeds):
             active = (lengths > 0) & jnp.logical_not(done)
             pool, logits = decode_forward(params, cfg, pool, table,
-                                          lengths, tokens, active)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                                          lengths, tokens, active,
+                                          attention=attn_mode)
+            # the sampled token's sequence index is lengths + 1 — the
+            # PRNG counter, a pure function of the request (seed,
+            # position), never of slot or batch composition
+            nxt = sample_tokens(logits, temps, topks, topps, seeds,
+                                lengths + 1)
             emit = jnp.where(active, nxt, jnp.int32(pad))
             if eos is not None:
                 done = done | (active & (nxt == jnp.int32(eos)))
@@ -295,28 +433,44 @@ class DecodeEngine:
         return jax.jit(step, donate_argnums=(1,))
 
     # -- front door ----------------------------------------------------------
-    def submit(self, prompt_ids, max_tokens: int,
-               stream_cb=None) -> Request:
+    def submit(self, prompt_ids, max_tokens: int, stream_cb=None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0,
+               seed: Optional[int] = None) -> Request:
         """Enqueue a generation request (thread-safe).  Returns the
         :class:`Request`; its ``future`` resolves to a
-        :class:`GenerationResult`.  Raises
-        :class:`~.scheduler.QueueFull` at queue capacity and
-        ``ValueError`` for requests the pool geometry can never run."""
-        req = Request(prompt_ids, max_tokens, stream_cb=stream_cb)
-        if len(req.prompt) > self._buckets[-1]:
+        :class:`GenerationResult`.  ``temperature``/``top_k``/
+        ``top_p``/``seed`` select in-program sampling (0 temperature =
+        greedy; see ``sampling.py`` for semantics and the determinism
+        contract).  Raises :class:`~.scheduler.QueueFull` at queue
+        capacity and ``ValueError`` for requests the pool geometry can
+        never run."""
+        req = Request(prompt_ids, max_tokens, stream_cb=stream_cb,
+                      temperature=temperature, top_k=top_k,
+                      top_p=top_p, seed=seed)
+        if self.prefill_chunk is None and \
+                len(req.prompt) > self._buckets[-1]:
             raise ValueError(
                 f"prompt length {len(req.prompt)} exceeds the largest "
-                f"prefill bucket {self._buckets[-1]}")
+                f"prefill bucket {self._buckets[-1]}; enable chunked "
+                "prefill (prefill_chunk=) for longer prompts")
         return self.scheduler.submit(req)
 
     # -- engine loop ---------------------------------------------------------
     def step(self) -> bool:
-        """Admit waiting requests, then run ONE batched decode
-        dispatch.  Returns True while there is (or may be) work."""
+        """Admit waiting requests, advance at most ONE prefill chunk,
+        then run ONE batched decode dispatch — chunked prefill
+        interleaves with the running decode batch instead of stalling
+        it behind a whole-prompt dispatch.  Returns True while there
+        is (or may be) work."""
         self._admit()
-        active = [s for s, r in enumerate(self._slots) if r is not None]
+        self._advance_prefill()
+        active = [s for s, r in enumerate(self._slots)
+                  if r is not None and not getattr(r, "prefilling",
+                                                   False)]
         if not active:
-            return self.scheduler.queue_depth > 0
+            return (self.scheduler.queue_depth > 0
+                    or bool(self._prefill_jobs))
         self._grow_pages(active)
         with _obs_trace.span(
                 "serving.dispatch",
@@ -326,9 +480,10 @@ class DecodeEngine:
             # layout; the decode dispatch itself never syncs
             table = jax.device_put(self._tables)
             lengths = jax.device_put(self._lengths)
-            pool, emit, done = self._decode(self._params, self._kv.pool,
-                                            table, lengths, self._tokens,
-                                            self._done)
+            temps, topks, topps, seeds = self._staged_sampling()
+            pool, emit, done = self._decode(
+                self._params, self._kv.pool, table, lengths,
+                self._tokens, self._done, temps, topks, topps, seeds)
         self._kv.swap_pool(pool)
         self._tokens = emit            # feeds back next dispatch (D2D)
         self._done = done
@@ -362,44 +517,236 @@ class DecodeEngine:
         return n
 
     # -- admission / prefill -------------------------------------------------
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pool draw with prefix-cache pressure relief: idle cached
+        entries are the only occupancy beyond the reservation
+        envelope, so evicting them (LRU, leaf-first) restores the
+        no-OOM guarantee of reservation-gated admission."""
+        if self._prefix is not None:
+            ev0 = self._prefix.evictions
+            self._prefix.ensure_free(n)
+            d = self._prefix.evictions - ev0
+            if d:
+                self._c_prefix_evictions.inc(d)
+        return self._kv.allocator.allocate(n)
+
+    def _set_sampling(self, slot: int, req: Request):
+        self._temps[slot] = req.temperature
+        self._topks[slot] = req.top_k
+        self._topps[slot] = req.top_p
+        self._seeds[slot] = np.uint32(req.seed & 0xFFFFFFFF)
+        self._samp_dev = None
+
+    def _staged_sampling(self):
+        """Device copies of the per-slot sampling vectors, re-staged
+        only when a seat/finalize mutated them."""
+        if self._samp_dev is None:
+            self._samp_dev = (jax.device_put(self._temps),
+                              jax.device_put(self._topks),
+                              jax.device_put(self._topps),
+                              jax.device_put(self._seeds))
+        return self._samp_dev
+
+    def _cache_insert(self, req: Request, start: int, chain: bytes,
+                      blocks: List[int]):
+        """Register a prefilled prompt's share-eligible blocks with
+        the prefix cache (ownership transfer; the request keeps a
+        reference on each new entry and keeps hash-collision
+        duplicates as its own)."""
+        n_share = self._prefix.shareable_blocks(req.prompt)
+        n_insert = max(0, n_share - start)
+        if not n_insert:
+            return
+        entries, _ = self._prefix.insert(req.prompt, start, chain,
+                                         blocks[:n_insert])
+        req.prefix_entries = req.prefix_entries + entries
+        inserted = {e.block for e in entries}
+        req.blocks = [b for b in req.blocks if b not in inserted]
+
     def _admit(self):
+        """Admit waiting requests: prefix-cache lookup decides the
+        prefill path per request — requests continuing from cached
+        context (or longer than ``prefill_chunk``) go through the
+        chunk machinery; the rest batch into one dispatch per bucket
+        group."""
         free = [s for s, r in enumerate(self._slots) if r is None]
         if not free:
             return
+        grouped: List = []
         for req in self.scheduler.pop_admissible(len(free)):
-            self._start_request(free.pop(0), req)
+            slot = free.pop(0)
+            req.slot = slot
+            self._slots[slot] = req
+            entries, chain = ([], b"")
+            if self._prefix is not None:
+                entries, chain = self._prefix.match(req.prompt)
+                n_share = self._prefix.shareable_blocks(req.prompt)
+                if len(entries):
+                    self._c_prefix_hits.inc(len(entries))
+                if n_share - len(entries):
+                    self._c_prefix_misses.inc(n_share - len(entries))
+            long_prompt = (self.prefill_chunk is not None
+                           and len(req.prompt) > self.prefill_chunk)
+            if entries or long_prompt:
+                self._start_chunked(slot, req, entries, chain)
+            else:
+                grouped.append((slot, req))
+        self._prefill_grouped(grouped)
 
-    def _start_request(self, slot: int, req: Request):
-        """Prefill the prompt at its bucket, write its pages, and seat
-        it in the batch.  The first generated token comes out of the
-        prefill program itself (greedy over the last real position)."""
+    def _prefill_grouped(self, seated: List):
+        """Batched same-bucket prefill: ONE dispatch per bucket group
+        (group size padded to a pow2 bucket so the trace set stays
+        ``len(buckets) * log2(max_batch)``), one grouped page-write
+        dispatch, then per-request seating."""
+        if not seated:
+            return
+        by_bucket: Dict[int, List] = {}
+        for slot, req in seated:
+            b = shape_bucket(len(req.prompt), self._buckets)
+            by_bucket.setdefault(b, []).append((slot, req))
+        for bucket, members in sorted(by_bucket.items()):
+            G = len(members)
+            Gb = shape_bucket(G, self._group_buckets)
+            ids = np.zeros((Gb, bucket), dtype=np.int32)
+            lengths = np.zeros(Gb, dtype=np.int32)
+            temps = np.zeros(Gb, dtype=np.float32)
+            topks = np.zeros(Gb, dtype=np.int32)
+            topps = np.ones(Gb, dtype=np.float32)
+            seeds = np.zeros(Gb, dtype=np.uint32)
+            for g, (slot, req) in enumerate(members):
+                Lp = len(req.prompt)
+                ids[g, :Lp] = req.prompt
+                lengths[g] = Lp
+                temps[g] = req.temperature
+                topks[g] = req.top_k
+                topps[g] = req.top_p
+                seeds[g] = np.uint32(req.seed & 0xFFFFFFFF)
+            with _obs_trace.span(
+                    "serving.prefill",
+                    args=({"bucket": bucket, "group": G}
+                          if _obs_trace.enabled() else None)):
+                kv, toks, _ = self._prefill(
+                    self._params, jax.device_put(ids),
+                    jax.device_put(lengths), jax.device_put(temps),
+                    jax.device_put(topks), jax.device_put(topps),
+                    jax.device_put(seeds))
+            nb_bucket = bucket // self.block_size
+            blocks_arr = np.full((Gb, nb_bucket), SCRATCH_BLOCK,
+                                 dtype=np.int32)
+            per_req_blocks = []
+            for g, (slot, req) in enumerate(members):
+                nb_needed = self._kv.blocks_for_tokens(len(req.prompt))
+                blocks = self._alloc_blocks(nb_needed)
+                blocks_arr[g, :nb_needed] = blocks
+                per_req_blocks.append(blocks)
+            self._kv.swap_pool(self._write(self._kv.pool, kv,
+                                           jax.device_put(blocks_arr)))
+            stack = LazyStack(toks)
+            now = time.monotonic()
+            for g, (slot, req) in enumerate(members):
+                self._seat(slot, req, per_req_blocks[g], toks[g],
+                           LazyScalar(stack, post=(lambda a, i=g: a[i])),
+                           now)
+
+    def _seat(self, slot: int, req: Request, blocks: List[int],
+              tok_dev, first_tok, now: float):
+        """Seat a fully prefilled request in the decode batch: page
+        table, sampling vectors, prefix-cache insertion of its full
+        prompt blocks, and the prefill-emitted first token."""
         Lp = len(req.prompt)
-        bucket = shape_bucket(Lp, self._buckets)
-        ids = np.zeros((1, bucket), dtype=np.int32)
-        ids[0, :Lp] = req.prompt
-        with _obs_trace.span(
-                "serving.prefill",
-                args=({"bucket": bucket, "prompt_len": Lp}
-                      if _obs_trace.enabled() else None)):
-            kv, first_tok, _ = self._prefill(self._params,
-                                             jax.device_put(ids),
-                                             np.int32(Lp))
-        nb_needed = self._kv.blocks_for_tokens(Lp)
-        blocks = self._kv.allocator.allocate(nb_needed)
-        blocks_arr = np.full(bucket // self.block_size, SCRATCH_BLOCK,
-                             dtype=np.int32)
-        blocks_arr[:nb_needed] = blocks
-        self._kv.swap_pool(self._write(self._kv.pool, kv,
-                                       jax.device_put(blocks_arr)))
-        req.slot = slot
-        req.blocks = blocks
-        self._slots[slot] = req
-        self._tables[slot, :] = SCRATCH_BLOCK
-        self._tables[slot, :nb_needed] = blocks
+        nb = len(blocks)
+        req.blocks = list(blocks)
+        start = req.n_prefix_blocks
+        self._tables[slot, start + nb:] = SCRATCH_BLOCK
+        self._tables[slot, start:start + nb] = blocks
         self._lengths[slot] = Lp
+        self._set_sampling(slot, req)
+        if self._prefix is not None:
+            self._cache_insert(req, start,
+                               getattr(req, "_prefix_chain", b""),
+                               list(req.blocks))
+        req.prefilling = False
         self._tokens, self._done = self._join(self._tokens, self._done,
-                                              np.int32(slot), first_tok)
-        req.push_token(LazyScalar(first_tok), time.monotonic())
+                                              np.int32(slot), tok_dev)
+        req.push_token(first_tok, now)
+        if req.max_tokens == 1:
+            self._finalize(slot)
+
+    def _start_chunked(self, slot: int, req: Request, entries, chain):
+        """Enter the chunk-prefill path: seat the prefix-cache hits in
+        the page table now (their K/V are already in the pool) and
+        queue the remainder of the prompt for chunkwise admission
+        interleaved with the decode loop."""
+        req.prefilling = True
+        req.prefix_entries = list(entries)
+        req._prefix_chain = chain
+        ctx_len = len(entries) * self.block_size
+        self._tables[slot, :] = SCRATCH_BLOCK
+        self._tables[slot, :len(entries)] = [e.block for e in entries]
+        self._lengths[slot] = 0            # joins decode at completion
+        self._prefill_jobs.append(
+            _PrefillJob(req, slot, chain, ctx_len, len(entries)))
+
+    def _advance_prefill(self):
+        """Run at most ONE chunk of the head prefill job — the fixed
+        unit of prefill work an engine step may spend, so a 32k prompt
+        admits over many steps while the decode batch keeps
+        dispatching between chunks."""
+        if not self._prefill_jobs:
+            return
+        job = self._prefill_jobs[0]
+        req, slot = job.req, job.slot
+        Lp = len(req.prompt)
+        remaining = Lp - job.done_tokens
+        take = min(remaining, self.prefill_chunk or remaining)
+        bs = self.block_size
+        Cb = shape_bucket(take, self._chunk_buckets)
+        # chunk starts are block-aligned (prefix hits and full chunks
+        # are block multiples), so the new-block count is exact
+        nb_new = -(-take // bs)
+        new_blocks = self._alloc_blocks(nb_new)
+        job.computed_blocks.extend(new_blocks)
+        req.blocks.extend(new_blocks)
+        have = req.n_prefix_blocks + len(req.blocks)
+        self._tables[slot, have - nb_new:have] = new_blocks
+        chunk_blocks = np.full(Cb // bs, SCRATCH_BLOCK, dtype=np.int32)
+        chunk_blocks[:nb_new] = new_blocks
+        nb_ctx = shape_bucket(max(1, job.done_tokens // bs),
+                              self._ctx_buckets)
+        ctx_table = np.ascontiguousarray(
+            self._tables[slot:slot + 1, :nb_ctx])
+        ids = np.zeros((1, Cb), dtype=np.int32)
+        ids[0, :take] = req.prompt[job.done_tokens:job.done_tokens + take]
+        t0 = time.monotonic()
+        with _obs_trace.span(
+                "serving.prefill_chunk",
+                args=({"chunk": take, "ctx": job.done_tokens,
+                       "bucket": Cb} if _obs_trace.enabled()
+                      else None)):
+            pool, tok, _ = self._chunk(
+                self._params, self._kv.pool, jax.device_put(ctx_table),
+                np.int32(job.done_tokens), jax.device_put(ids),
+                np.int32(take), jax.device_put(chunk_blocks),
+                np.float32(req.temperature), np.int32(req.top_k),
+                np.float32(req.top_p),
+                np.uint32(req.seed & 0xFFFFFFFF))
+        self._kv.swap_pool(pool)
+        self._h_chunk.observe(time.monotonic() - t0)
+        job.done_tokens += take
+        if job.done_tokens < Lp:
+            return
+        # prompt complete: cache-insert its freshly computed full
+        # blocks, seat the slot in the decode batch, emit token 0
+        self._prefill_jobs.popleft()
+        if self._prefix is not None:
+            self._cache_insert(req, job.insert_from, job.chain,
+                               job.computed_blocks)
+        self._lengths[slot] = Lp
+        self._set_sampling(slot, req)
+        req.prefilling = False
+        self._tokens, self._done = self._join(self._tokens, self._done,
+                                              np.int32(slot), tok)
+        req.push_token(LazyScalar(tok), time.monotonic())
         if req.max_tokens == 1:
             self._finalize(slot)
 
@@ -414,14 +761,14 @@ class DecodeEngine:
             req = self._slots[s]
             if req.capped:
                 continue
-            have = len(req.blocks)
+            have = req.n_prefix_blocks + len(req.blocks)
             if int(self._lengths[s]) < have * self.block_size:
                 continue
             if have >= req.reserved_blocks or \
                     have >= self.max_blocks_per_seq:
                 req.capped = True
                 continue
-            blk = self._kv.allocator.allocate(1)[0]
+            blk = self._alloc_blocks(1)[0]
             req.blocks.append(blk)
             self._tables[s, have] = blk
 
@@ -477,7 +824,11 @@ class DecodeEngine:
         with _obs_trace.span("serving.poll"):
             done = np.asarray(jax.device_get(self._done))
         for s, req in enumerate(self._slots):
-            if req is not None and bool(done[s]):
+            # a chunk-prefilling slot has not joined the device loop
+            # yet: its done flag is its dead predecessor's leftover
+            # (reset by _join at seating), never this request's state
+            if req is not None and bool(done[s]) and \
+                    not getattr(req, "prefilling", False):
                 self._finalize(s)
 
     def _finalize(self, slot: int):
@@ -494,9 +845,19 @@ class DecodeEngine:
         if req.blocks:
             self._kv.allocator.free(req.blocks)
             req.blocks = []
+        if req.prefix_entries:
+            # shared blocks stay cached (idle, warm for the next hit);
+            # only the live reference drops
+            self._prefix.release(req.prefix_entries)
+            req.prefix_entries = []
         self._slots[slot] = None
         self._lengths[slot] = 0
         self._tables[slot, :] = SCRATCH_BLOCK
+        self._temps[slot] = 0.0
+        self._topks[slot] = 0
+        self._topps[slot] = 1.0
+        self._seeds[slot] = 0
+        self._samp_dev = None
         self._observe_finalize(slot, req, len(toks))
         req.future.set_result(
             GenerationResult(req.id, toks, req.stats))
@@ -550,13 +911,20 @@ class DecodeEngine:
                            for n in prompt_lengths})
                    if prompt_lengths else list(self._buckets))
         per_bucket = {}
+        one_f = np.zeros(1, dtype=np.float32)
+        one_i = np.zeros(1, dtype=np.int32)
+        one_u = np.zeros(1, dtype=np.uint32)
+        one_p = np.ones(1, dtype=np.float32)
         for b in buckets:
             tb = time.monotonic()
             ids = np.zeros((1, b), dtype=np.int32)
-            kv, tok, _ = self._prefill(self._params,
-                                       jax.device_put(ids), np.int32(1))
-            blocks_arr = np.full(b // self.block_size, SCRATCH_BLOCK,
-                                 dtype=np.int32)
+            kv, tok, _ = self._prefill(
+                self._params, jax.device_put(ids),
+                jax.device_put(np.ones(1, dtype=np.int32)),
+                jax.device_put(one_f), jax.device_put(one_i),
+                jax.device_put(one_p), jax.device_put(one_u))
+            blocks_arr = np.full((1, b // self.block_size),
+                                 SCRATCH_BLOCK, dtype=np.int32)
             self._kv.swap_pool(self._write(self._kv.pool, kv,
                                            jax.device_put(blocks_arr)))
             jax.block_until_ready(tok)
@@ -564,9 +932,11 @@ class DecodeEngine:
         self._tokens, self._done = self._join(
             self._tokens, self._done, np.int32(0), jnp.int32(0))
         td = time.monotonic()
+        w_temps, w_topks, w_topps, w_seeds = self._staged_sampling()
         pool, emit, done = self._decode(
             self._params, self._kv.pool, jax.device_put(self._tables),
-            jax.device_put(self._lengths), self._tokens, self._done)
+            jax.device_put(self._lengths), self._tokens, self._done,
+            w_temps, w_topks, w_topps, w_seeds)
         self._kv.swap_pool(pool)
         self._tokens, self._done = emit, done
         jax.block_until_ready(emit)
@@ -598,6 +968,7 @@ class DecodeEngine:
                 return -1
         return {"decode_traces": _size(self._decode),
                 "prefill_traces": _size(self._prefill),
+                "chunk_traces": _size(self._chunk),
                 "write_traces": _size(self._write),
                 "join_traces": _size(self._join)}
 
@@ -608,7 +979,11 @@ class DecodeEngine:
               "total_tokens": int(
                   self._c_tokens.collect(materialize=False)),
               "done_poll_interval": self.done_poll_interval,
+              "attention": self.attention_mode,
+              "prefill_chunk": self.prefill_chunk,
               "kv": self._kv.allocator.stats()}
+        if self._prefix is not None:
+            st["prefix_cache"] = self._prefix.stats()
         if self._poll_decision is not None:
             st["done_poll_decision"] = dict(self._poll_decision)
         st.update(self.compile_stats())

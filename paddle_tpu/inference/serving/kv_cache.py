@@ -221,6 +221,23 @@ def write_prompt_pages(pool, kv, block_ids):
     return pool.at[:, :, block_ids].set(kvp)
 
 
+def write_prompt_pages_group(pool, kv, block_ids):
+    """Grouped variant of :func:`write_prompt_pages`: one scatter for
+    a whole same-bucket prefill group (DESIGN-SERVING.md
+    §Long-context tier — batched same-bucket prefill).
+
+    ``kv``: ``[L, 2, G, Lb, H, Dh]``; ``block_ids`` ``[G, nb]`` int32
+    (dummy group rows and bucket-padding tails point at
+    SCRATCH_BLOCK).  Scatter collisions exist only inside scratch,
+    which is never read.
+    """
+    L, two, G, Lb, H, Dh = kv.shape
+    nb = block_ids.shape[1]
+    bs = Lb // nb
+    kvp = kv.reshape(L, two, G, nb, bs, H, Dh)
+    return pool.at[:, :, block_ids].set(kvp)
+
+
 def paged_append(pool, layer, k_new, v_new, block_ids, offsets):
     """Write one decode token's K/V per request into its current page.
 

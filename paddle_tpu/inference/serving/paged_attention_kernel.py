@@ -1,0 +1,139 @@
+"""Fused ragged paged-attention Pallas kernel (arxiv 2604.15464).
+
+The gather+mask decode composition (``kv_cache.gather_pages`` →
+``ragged_attention.ragged_decode_attention``) materializes
+``[B, MAXNB*BS, H, Dh]`` K and V per layer — the page-table gather
+padded to the table's maximum extent.  At 1k context that is noise;
+at 32k context it is the whole memory story: every decode dispatch
+writes two full-context-sized intermediates per layer that the
+attention reduction immediately consumes.
+
+This kernel is the long-context answer: ONE ``pallas_call`` walks
+each request's page table block by block and accumulates the
+attention output with an online (flash-style) softmax.  The working
+set per request is a single ``[BS, H, Dh]`` KV block plus ``[H]``-row
+running statistics — independent of context length — and the walk's
+trip count is the request's REAL block count (``ceil(len/BS)``), so
+a short request in a long-context batch does proportional work: the
+ragged part of Ragged Paged Attention.
+
+Structure: requests unroll statically over the (small) ``max_batch``
+axis; each request runs a ``fori_loop`` over its blocks whose body
+dynamically indexes the layer's K/V pools (``ref[pl.ds(block_id,
+1)]``) — the page table is *data* read inside the kernel, exactly the
+zero-recompile contract the engine pins.  The last block's tail and
+empty rows mask with the serving stack's usual exact-zero arithmetic
+(``MASK_VALUE`` / ``DENOM_TINY`` — an empty slot returns exact 0.0,
+never NaN).
+
+On this CPU container the kernel runs in **interpret mode**
+(``pl.pallas_call(interpret=True)``): Pallas lowers the same body
+through the interpreter into the XLA program, so the fused structure
+(no full-extent gather) is exercised end to end without TPU hardware.
+Two real-TPU evolutions are deliberately left to the live-TPU
+backlog (ROADMAP): lane-aligning ``[BS, H*Dh]`` tiles to the 128-lane
+grid, and moving the block walk onto a
+``PrefetchScalarGridSpec`` grid whose index_map reads the page table
+(the canonical Mosaic pipelining shape — this jaxlib's *interpreter*
+cannot run grid machinery under the repo's global ``jax_enable_x64``,
+which is why the in-body walk is the portable form here).
+
+Numerics: statistics in f32 like the reference; the online softmax
+re-associates the reduction, so outputs match the gather composition
+to reduction-order tolerance (the kernel-vs-reference pin in
+``tests/test_serving_longcontext.py`` holds 2e-6, the same bound the
+gather path documents vs the sequential oracle).  Selection lives
+behind ``ragged_attention.paged_decode_attention``
+(DESIGN-SERVING.md §Long-context tier).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .ragged_attention import DENOM_TINY, MASK_VALUE
+
+
+def _paged_attn_kernel(block_size: int, scale: float,
+                       table_ref, len_ref, q_ref,   # inputs
+                       k_ref, v_ref,                # per-layer pools
+                       o_ref):                      # [B, H, Dh] out
+    B = q_ref.shape[0]
+    bs = jnp.int32(block_size)
+    for b in range(B):                 # static unroll: B = max_batch
+        length = len_ref[b]
+        nb = jax.lax.div(length + bs - jnp.int32(1), bs)
+        qf = q_ref[b].astype(jnp.float32)            # [H, Dh]
+
+        def body(j, carry, b=b, qf=qf, length=length):
+            m, l, acc = carry
+            blk = table_ref[b, j]
+            k = k_ref[pl.ds(blk, 1)][0].astype(jnp.float32)
+            v = v_ref[pl.ds(blk, 1)][0].astype(jnp.float32)
+            logits = jnp.einsum(
+                "hd,thd->ht", qf, k,
+                preferred_element_type=jnp.float32) * scale
+            pos = j * bs + jax.lax.broadcasted_iota(
+                jnp.int32, (1, block_size), 1)[0]
+            valid = pos < length                     # [BS]
+            logits = jnp.where(valid[None, :], logits, MASK_VALUE)
+            m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(logits - m_new[:, None])
+            p = jnp.where(valid[None, :], p, 0.0)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[:, None] + jnp.einsum(
+                "ht,thd->hd", p, v,
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        H, Dh = qf.shape
+        init = (jnp.full((H,), MASK_VALUE, jnp.float32),
+                jnp.zeros((H,), jnp.float32),
+                jnp.zeros((H, Dh), jnp.float32))
+        m, l, acc = jax.lax.fori_loop(jnp.int32(0), nb, body, init)
+        denom = jnp.maximum(l, DENOM_TINY)[:, None]
+        o_ref[b] = (acc / denom).astype(o_ref.dtype)
+
+
+def paged_ragged_attention(pool_k, pool_v, page_table, lengths, q,
+                           *, interpret: bool, scale=None):
+    """Fused paged decode attention — no full-extent gather.
+
+    ``pool_k``/``pool_v`` ``[NB, BS, H, Dh]`` (one layer's K/V pool);
+    ``page_table`` ``[B, MAXNB]`` int32; ``lengths`` ``[B]`` int32;
+    ``q`` ``[B, H, Dh]``.  Returns ``[B, H, Dh]`` in ``q``'s dtype.
+    Call through :func:`ragged_attention.paged_decode_attention` —
+    that seam owns backend/env selection.
+    """
+    NB, BS, H, Dh = pool_k.shape
+    B, MAXNB = page_table.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(Dh)
+    kernel = functools.partial(_paged_attn_kernel, BS, float(scale))
+    fn = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, H, Dh), q.dtype),
+        interpret=bool(interpret))
+    return fn(page_table.astype(jnp.int32), lengths.astype(jnp.int32),
+              q, pool_k, pool_v)
+
+
+def attention_working_set_bytes(num_batch: int, max_blocks: int,
+                                block_size: int, num_heads: int,
+                                head_dim: int, itemsize: int = 4
+                                ) -> dict:
+    """Analytic per-layer attention working set: the gather
+    composition's ``[B, MAXNB*BS, H, Dh]`` K+V intermediates vs the
+    kernel's one-block-per-request residency.  Recorded by
+    ``bench.py --longcontext`` as the memory story of the tier."""
+    per_tok = num_heads * head_dim * itemsize
+    gather = 2 * num_batch * max_blocks * block_size * per_tok
+    kernel = 2 * num_batch * block_size * per_tok
+    return {"gather_bytes": int(gather), "kernel_bytes": int(kernel),
+            "ratio": round(gather / max(kernel, 1), 1)}

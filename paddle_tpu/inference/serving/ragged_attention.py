@@ -27,13 +27,71 @@ DESIGN-SERVING.md §Exactness).
 from __future__ import annotations
 
 import math
+import os
 
+import jax
 import jax.numpy as jnp
 
 #: large-finite mask value (``-inf`` breeds NaN under 0*inf folding)
 MASK_VALUE = -1e30
 #: denominator guard — bit-inert for any row with >= 1 valid position
 DENOM_TINY = 1e-30
+
+#: env knob for the decode-attention implementation behind
+#: :func:`paged_decode_attention` (DESIGN-SERVING.md §Long-context
+#: tier): "gather" = the reference gather+mask composition, "pallas" =
+#: the fused paged kernel (interpret mode off-TPU), "auto" = pallas on
+#: a TPU backend, gather elsewhere.
+PAGED_ATTENTION_ENV = "PADDLE_TPU_PAGED_ATTENTION"
+
+
+def resolve_paged_attention_mode(mode=None) -> str:
+    """Resolve the decode-attention implementation once, at engine
+    build time (the decision is baked into the compiled decode step,
+    never re-read per dispatch).  Explicit ``mode`` wins, then the
+    ``PADDLE_TPU_PAGED_ATTENTION`` env knob, then capability: the
+    fused kernel compiles through Mosaic on TPU and through the
+    Pallas interpreter elsewhere — interpretation is correct but
+    host-paced, so off-TPU the gather composition stays the default
+    and the kernel is an opt-in (tests/bench pin it)."""
+    m = (mode if mode is not None
+         else os.environ.get(PAGED_ATTENTION_ENV, "auto")).strip().lower()
+    if m in ("", "auto"):
+        return "pallas" if jax.default_backend() == "tpu" else "gather"
+    if m in ("0", "ref", "reference", "gather"):
+        return "gather"
+    if m in ("1", "pallas", "kernel"):
+        return "pallas"
+    raise ValueError(
+        f"unknown paged-attention mode {mode!r} (expected auto | "
+        "gather | pallas)")
+
+
+def paged_decode_attention(pool, layer, page_table, lengths, q,
+                           mode: str = "gather"):
+    """THE decode-attention seam: per-request single-token queries
+    against the paged KV pool, page layout as data.
+
+    ``pool`` ``[L, 2, NB, BS, H, Dh]``; ``page_table`` ``[B, MAXNB]``
+    int32; ``lengths`` ``[B]`` int32 (positions ``t < lengths[b]``
+    attend); ``q`` ``[B, H, Dh]``.  ``mode`` is a *resolved* mode
+    string (see :func:`resolve_paged_attention_mode`) — a static
+    trace-time choice:
+
+    - ``"gather"``: the CPU/parity reference — materialize the padded
+      ``[B, MAXNB*BS, H, Dh]`` gather, mask by length;
+    - ``"pallas"``: the fused kernel walks pages block-by-block with
+      an online softmax, working set one block per request
+      (``paged_attention_kernel.py``).
+    """
+    if mode == "pallas":
+        from .paged_attention_kernel import paged_ragged_attention
+        return paged_ragged_attention(
+            pool[layer, 0], pool[layer, 1], page_table, lengths, q,
+            interpret=jax.default_backend() != "tpu")
+    from .kv_cache import gather_pages
+    kp, vp = gather_pages(pool, layer, page_table)
+    return ragged_decode_attention(q, kp, vp, lengths)
 
 
 def ragged_decode_attention(q, k, v, lengths, scale=None):
@@ -61,6 +119,53 @@ def ragged_decode_attention(q, k, v, lengths, scale=None):
     denom = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), DENOM_TINY)
     probs = w / denom
     out = jnp.einsum("bht,bthd->bhd", probs, vf)
+    return out.astype(orig)
+
+
+def chunked_prefill_attention(q, k_ctx, v_ctx, ctx_len, k_chunk,
+                              v_chunk, scale=None):
+    """Attention for one prefill *chunk* against cached context plus
+    itself (DESIGN-SERVING.md §Long-context tier: chunk admission).
+
+    ``q``/``k_chunk``/``v_chunk`` ``[B, C, H, Dh]`` — the chunk's
+    projections; ``k_ctx``/``v_ctx`` ``[B, T, H, Dh]`` — the page
+    gather of everything already in cache (prefix-cache hits and
+    earlier chunks), padded to ``T``; ``ctx_len`` int32 scalar — the
+    real context extent (positions ``t < ctx_len`` attend).  Chunk row
+    ``i`` (global position ``ctx_len + i``) attends the full valid
+    context plus chunk positions ``j <= i`` — exactly the rows a
+    whole-prompt causal prefill computes for those positions, so chunk
+    boundaries change only reduction order (same masked-softmax
+    arithmetic, exact zeros, f32 statistics).  Returns
+    ``[B, C, H, Dh]`` in ``q``'s dtype.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    orig = q.dtype
+    qf = q.astype(jnp.float32)
+    C = q.shape[1]
+    T = k_ctx.shape[1]
+    lg_ctx = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                        k_ctx.astype(jnp.float32)) * scale
+    lg_chk = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                        k_chunk.astype(jnp.float32)) * scale
+    ctx_valid = jnp.arange(T, dtype=jnp.int32)[None, None, None, :] < \
+        jnp.asarray(ctx_len, jnp.int32)                  # [1,1,1,T]
+    causal = jnp.tril(jnp.ones((C, C), dtype=bool))[None, None]
+    lg_ctx = jnp.where(ctx_valid, lg_ctx, MASK_VALUE)
+    lg_chk = jnp.where(causal, lg_chk, MASK_VALUE)
+    logits = jnp.concatenate([lg_ctx, lg_chk], axis=-1)  # [B,H,C,T+C]
+    valid = jnp.concatenate(
+        [jnp.broadcast_to(ctx_valid, lg_ctx.shape),
+         jnp.broadcast_to(causal, lg_chk.shape)], axis=-1)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    w = jnp.exp(logits - m)
+    w = jnp.where(valid, w, 0.0)
+    denom = jnp.maximum(jnp.sum(w, axis=-1, keepdims=True), DENOM_TINY)
+    probs = w / denom
+    vall = jnp.concatenate([v_ctx.astype(jnp.float32),
+                            v_chunk.astype(jnp.float32)], axis=1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vall)
     return out.astype(orig)
 
 

@@ -258,10 +258,16 @@ class ServingRouter:
         return self._last_p99
 
     # -- front door --------------------------------------------------------
-    def submit(self, prompt_ids, max_tokens: int, stream_cb=None):
+    def submit(self, prompt_ids, max_tokens: int, stream_cb=None,
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, seed=None):
         """Route one request to the least-loaded replica; returns the
-        request future.  Raises :class:`Overloaded` when the router is
-        shedding (SLO policy) or every replica's queue is full."""
+        request future.  Sampling kwargs forward to
+        ``LLMServer.submit`` (seeded sampling is replica-independent
+        by construction — keys are (seed, position) functions, so
+        routing does not affect output).  Raises :class:`Overloaded`
+        when the router is shedding (SLO policy) or every replica's
+        queue is full."""
         if self._closed:
             raise RuntimeError("router closed")
         with self._lock:
@@ -282,7 +288,10 @@ class ServingRouter:
         for rep in reps:
             try:
                 fut = rep.server.submit(prompt_ids, max_tokens,
-                                        stream_cb=stream_cb)
+                                        stream_cb=stream_cb,
+                                        temperature=temperature,
+                                        top_k=top_k, top_p=top_p,
+                                        seed=seed)
             except QueueFull as e:
                 last_exc = e
                 continue

@@ -1,0 +1,98 @@
+"""In-program token sampling: temperature / top-k / top-p INSIDE the
+compiled decode step (DESIGN-SERVING.md §Long-context tier).
+
+The zero-recompile contract is the design driver: per-request sampling
+parameters ride the decode signature as ``[B]`` *data* vectors
+(``temperature``, ``top_k``, ``top_p``, ``seed``) exactly like page
+tables and lengths, so a greedy request and a nucleus-sampling request
+share one compiled program and membership churn still costs no
+retraces.  Randomness uses the PR-5 in-program PRNG pattern
+(DESIGN-PERF.md §Step folding): the per-row key derives *inside* the
+program as ``fold_in(PRNGKey(seed_b), position_b)`` where ``position``
+is the sampled token's sequence index — a pure function of the
+request, never of its batch slot, its neighbors, or the dispatch
+count.  Consequences, all test-pinned:
+
+- same ``seed`` ⇒ same token sequence, run to run and machine-state
+  free;
+- join/leave invariance: a request samples the identical sequence
+  alone or inside a churning batch (its logits are exact across
+  batching already — §Exactness — and its keys never see the batch);
+- the sequential oracle (``decode_model.reference_decode``) derives
+  the same keys and therefore reproduces sampled output exactly.
+
+``temperature == 0`` rows take the greedy argmax — greedy is the
+``temperature=0`` point of the same program, not a separate path.
+Sampling itself is Gumbel-max over the filtered, scaled logits:
+``argmax(logits/T + G)`` is a categorical draw from
+``softmax(logits/T)`` restricted to the kept support, so no
+normalization or CDF inversion runs on device.  Top-k keeps the k
+largest logits (``k <= 0`` keeps all); top-p keeps the smallest
+prefix of the probability-sorted distribution whose cumulative mass
+reaches ``p`` (the standard nucleus rule: a token is kept when the
+mass *before* it is ``< p``, so the top token always survives and the
+boundary token that crosses ``p`` is included).  Both filters mask
+with the serving stack's large-finite ``MASK_VALUE`` — never ``-inf``
+— for the same NaN-hygiene reasons as the attention masks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .ragged_attention import MASK_VALUE
+
+#: floor for temperature / top-p so the temperature==0 greedy select
+#: never divides by zero and top_p==0 degenerates to the top token
+_EPS = 1e-6
+
+
+def sample_tokens(logits, temperature, top_k, top_p, seed, position):
+    """``[B, V]`` logits → ``[B]`` int32 token ids, fully in-program.
+
+    ``temperature`` ``[B]`` f32 (0 = greedy); ``top_k`` ``[B]`` int32
+    (<= 0 = off); ``top_p`` ``[B]`` f32 (>= 1 = off); ``seed`` ``[B]``
+    uint32; ``position`` ``[B]`` int32 — the sequence index of the
+    token being sampled (the PRNG counter).
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _sampled(_):
+        lf = logits.astype(jnp.float32)
+        scaled = lf / jnp.maximum(temperature, _EPS)[:, None]
+
+        # top-k: kth-largest threshold per row; k<=0 disables
+        sorted_desc = jnp.flip(jnp.sort(scaled, axis=-1), axis=-1)
+        k = jnp.clip(top_k.astype(jnp.int32), 1, V)
+        kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None],
+                                  axis=1)
+        keep = (top_k <= 0)[:, None] | (scaled >= kth)
+        filtered = jnp.where(keep, scaled, MASK_VALUE)
+
+        # top-p over the post-top-k distribution
+        probs = jax.nn.softmax(filtered, axis=-1)
+        p_desc = jnp.flip(jnp.sort(probs, axis=-1), axis=-1)
+        csum = jnp.cumsum(p_desc, axis=-1)
+        p = jnp.clip(top_p, _EPS, 1.0)[:, None]
+        in_nucleus = (csum - p_desc) < p       # mass BEFORE token < p
+        cutoff = jnp.min(jnp.where(in_nucleus, p_desc, jnp.inf),
+                         axis=-1, keepdims=True)
+        keep_p = (top_p >= 1.0)[:, None] | (probs >= cutoff)
+        filtered = jnp.where(keep_p, filtered, MASK_VALUE)
+
+        def _row_gumbel(s, pos):
+            key = jax.random.fold_in(jax.random.PRNGKey(s), pos)
+            return jax.random.gumbel(key, (V,), dtype=jnp.float32)
+
+        g = jax.vmap(_row_gumbel)(seed.astype(jnp.uint32),
+                                  position.astype(jnp.int32))
+        sampled = jnp.argmax(filtered + g, axis=-1).astype(jnp.int32)
+        return jnp.where(temperature > 0.0, sampled, greedy)
+
+    # all-greedy batches (the common serving default) skip the sort /
+    # cumsum / Gumbel work at RUNTIME — lax.cond is data-dependent,
+    # so the one compiled program still serves any greedy/sampled mix
+    return jax.lax.cond(jnp.any(temperature > 0.0), _sampled,
+                        lambda _: greedy, None)

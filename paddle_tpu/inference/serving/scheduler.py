@@ -88,7 +88,9 @@ class Request:
     _ids = itertools.count()
 
     def __init__(self, prompt_ids, max_tokens: int,
-                 stream_cb: Optional[Callable] = None):
+                 stream_cb: Optional[Callable] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, seed: Optional[int] = None):
         self.id = next(Request._ids)
         self.prompt = [int(t) for t in prompt_ids]
         if not self.prompt:
@@ -96,6 +98,19 @@ class Request:
         self.max_tokens = int(max_tokens)
         if self.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        # sampling semantics (DESIGN-SERVING.md §Long-context tier):
+        # temperature 0 = greedy; top_k <= 0 / top_p >= 1 disable the
+        # respective filter; seed None derives a per-request default
+        # (request id) so unseeded sampled requests differ.  All four
+        # ride the compiled decode step as [B] data vectors.
+        self.temperature = float(temperature)
+        if self.temperature < 0.0:
+            raise ValueError("temperature must be >= 0")
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError("top_p must be in (0, 1]")
+        self.seed = int(seed) if seed is not None else self.id
         self.stream_cb = stream_cb
         self.future: Future = Future()
         self.stats = RequestStats()
@@ -103,10 +118,17 @@ class Request:
         self.stats.submitted = time.monotonic()
         # engine-side state
         self.slot: Optional[int] = None
-        self.blocks: List[int] = []
+        self.blocks: List[int] = []     # exclusively-owned pool blocks
+        self.prefix_entries: list = []  # PrefixCache refs (shared)
         self.reserved_blocks = 0
         self.lazy_tokens: list = []     # per-step lazy device views
         self.capped = False             # page growth stopped (done-lag)
+
+    @property
+    def n_prefix_blocks(self) -> int:
+        """Table entries borrowed from the prefix cache (shared,
+        cache-owned; the request holds one reference each)."""
+        return len(self.prefix_entries)
 
     def worst_case_blocks(self, block_size: int) -> int:
         # prompt positions + one cache write per decode dispatch

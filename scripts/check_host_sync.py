@@ -55,6 +55,14 @@ HOT_MODULES = [
     os.path.join("inference", "serving", "kv_cache.py"),
     os.path.join("inference", "serving", "decode_model.py"),
     os.path.join("inference", "serving", "scheduler.py"),
+    # long-context tier (DESIGN-SERVING.md §Long-context tier): the
+    # fused paged-attention kernel and the sampling math trace INSIDE
+    # the compiled decode step; the prefix cache is host bookkeeping
+    # living on the pump thread between dispatches — none of the
+    # three may ever sync host with device
+    os.path.join("inference", "serving", "paged_attention_kernel.py"),
+    os.path.join("inference", "serving", "sampling.py"),
+    os.path.join("inference", "serving", "prefix_cache.py"),
     # observability subsystem (DESIGN-OBSERVABILITY.md): it lives
     # INSIDE every hot loop above, so it is held to the same contract
     # — instruments hold lazy device values and defer the sync to

@@ -948,6 +948,95 @@ def test_reform_barrier_agrees_on_min_and_is_injectable(server):
     clear()
 
 
+def test_reform_barrier_range_aware_clamps_to_retention(server):
+    """Range-aware proposals (ISSUE 14 satellite): the barrier
+    validates min(newest) against every member's retention window —
+    a feasible window returns min(newest) exactly as before; an empty
+    window (a fast rank's retention already evicted the agreed step)
+    raises ReformWindowError identically on every member instead of
+    letting a rollback fail mid-reform (the PR-13 drain-e2e cascade)."""
+    import threading
+    from paddle_tpu.distributed.resilience.elastic_rank import (
+        ElasticRankContext, ReformWindowError)
+    a = ElasticRankContext(server.endpoint, "rbw", "rank-0", rank=0,
+                           poll_interval=0.02)
+    b = ElasticRankContext(server.endpoint, "rbw", "rank-1", rank=1,
+                           poll_interval=0.02)
+    out, errs = {}, {}
+
+    def run(ctx, name, epoch, propose, oldest):
+        try:
+            out[name] = ctx.reform_barrier(epoch, [0, 1], propose,
+                                           oldest_step=oldest,
+                                           timeout=10)
+        except Exception as e:                      # noqa: BLE001
+            errs[name] = e
+
+    # feasible: windows [2, 9] and [3, 5] → resume min(9, 5) = 5 >= 3
+    t = threading.Thread(target=run, args=(b, "b", 1, 5, 3))
+    t.start()
+    run(a, "a", 1, 9, 2)
+    t.join(timeout=10)
+    assert out == {"a": 5, "b": 5} and not errs
+    # empty: slow member's newest (5) is below the fast member's
+    # oldest (36) → BOTH members fail with the same loud verdict
+    out.clear()
+    t = threading.Thread(target=run, args=(b, "b", 2, 5, 1))
+    t.start()
+    run(a, "a", 2, 40, 36)
+    t.join(timeout=10)
+    assert not out
+    assert isinstance(errs["a"], ReformWindowError)
+    assert isinstance(errs["b"], ReformWindowError)
+    assert "retention window" in str(errs["a"])
+    # resume == 0 (a member proposes a fresh start) stays feasible
+    # regardless of windows: step 0 is re-initialization, not a
+    # checkpoint read
+    out.clear()
+    errs.clear()
+    t = threading.Thread(target=run, args=(b, "b", 3, 0, 0))
+    t.start()
+    run(a, "a", 3, 40, 36)
+    t.join(timeout=10)
+    assert out == {"a": 0, "b": 0} and not errs
+
+
+def test_reform_barrier_legacy_peer_has_unbounded_window(server):
+    """A pre-range peer (no "oldest" in its barrier record) must be
+    treated as unbounded-below — mixed fleets keep re-forming."""
+    import json as _json
+    import threading
+    from paddle_tpu.distributed.resilience.elastic_rank import (
+        ElasticRankContext)
+    a = ElasticRankContext(server.endpoint, "rbl", "rank-0", rank=0,
+                           poll_interval=0.02)
+    # hand-write rank 1's arrival in the legacy (rangeless) format
+    a.client.put("/k/rbl/barrier/1/1",
+                 _json.dumps({"propose": 4, "member": "rank-1"}))
+    assert a.reform_barrier(1, [0, 1], 7, oldest_step=2,
+                            timeout=10) == 4
+
+
+def test_oldest_verified_step_tracks_retention(tmp_path):
+    """CheckpointManager.oldest_verified_step — the lower edge of the
+    reform-proposal window — follows max_to_keep eviction."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    paddle.seed(0)
+    net = nn.Linear(4, 4)
+    opt = optimizer.Adam(learning_rate=1e-3,
+                         parameters=net.parameters())
+    mgr = CheckpointManager(str(tmp_path / "ck"), async_save=False,
+                            max_to_keep=2)
+    assert mgr.oldest_verified_step() is None
+    for s in (1, 2, 3):
+        assert mgr.save(s, net, opt, force=True)
+    assert mgr.oldest_verified_step() == 2      # step 1 evicted
+    assert mgr.latest_verified_step() == 3
+    mgr.close()
+
+
 def test_step_barrier_detects_epoch_bump(server):
     """A member parked in the data-plane lockstep barrier must notice
     a membership epoch bump and hand control to the reform path
@@ -1627,12 +1716,13 @@ _ELASTIC_WORKER = textwrap.dedent("""
     from paddle_tpu.distributed.runner import DistributedRunner
 
     TOTAL = int(os.environ.get("E2E_TOTAL_STEPS", "5"))
-    # retention horizon: the reform barrier's min-over-proposals can
-    # legitimately land MANY steps behind a fast rank (straggler
-    # drain: the slow rank's newest checkpoint is old), and a member
-    # whose retention already dropped the resume step cannot re-form.
-    # Long e2es size retention to the run (DESIGN-RESILIENCE.md
-    # §Known limits).
+    # retention horizon: reform proposals are range-aware — the
+    # barrier validates min(newest) against every member's oldest
+    # retained step and fails loudly (ReformWindowError) when the
+    # windows don't intersect, instead of letting a member fail its
+    # rollback mid-reform.  E2es whose proposal spread can exceed
+    # max_to_keep (straggler drain) size retention to the run so the
+    # window stays non-empty.
     KEEP = int(os.environ.get("E2E_CKPT_KEEP", "5"))
 
     def make_runner(net, opt):
@@ -1740,8 +1830,9 @@ _ELASTIC_WORKER = textwrap.dedent("""
     def do_reform(rec):
         members = sorted(int(r) for r in rec["members"])
         propose = mgr.latest_verified_step() or 0
+        oldest = mgr.oldest_verified_step() or 0
         resume = ctx.reform_barrier(int(rec["epoch"]), members,
-                                    propose)
+                                    propose, oldest_step=oldest)
         mgr.rollback_to(resume)
         if resume > 0:
             mgr.restore(net, opt, step=resume)

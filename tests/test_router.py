@@ -74,7 +74,7 @@ class _StubServer:
         for v in vals:
             self.engine._h_latency.observe(v)
 
-    def submit(self, prompt_ids, max_tokens, stream_cb=None):
+    def submit(self, prompt_ids, max_tokens, stream_cb=None, **kw):
         if self.queue_full:
             raise QueueFull("stub queue full")
         self.submitted.append(list(prompt_ids))
