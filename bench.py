@@ -683,6 +683,166 @@ def bench_mesh_fold():
     _emit_result("mesh_fold", out)
 
 
+def bench_pp_fold():
+    """Pipeline-engine fold sweep on a CPU pp=2 mesh (ISSUE 15): the
+    pipeline half of the unified dispatch engine, measured like
+    --mesh-fold measures the dp half.  CPU by DESIGN — what folding
+    removes is HOST work per train batch, which this measures
+    directly.
+
+    ``legacy`` is the pre-unification per-batch entry (host-drawn key,
+    per-batch stacked-leaf wrapper commit); fold=1 dispatches the
+    whole stages×microbatches schedule as scan-of-1 through the
+    unified engine; fold=K covers K whole batches per dispatch with
+    the wrapper sync deferred to the epoch boundary.  Host-dispatch
+    accounting per batch rides the engine's own registry counters
+    (``pp_dispatches_total`` = compiled dispatches,
+    ``pp_commit_ops_total`` = stacked-leaf wrapper slice ops): the
+    ISSUE 15 acceptance — O(1) compiled dispatches per batch at
+    fold=1, O(1/K) at fold K — is read straight off the record."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed import collective
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
+    from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
+        import PipelineParallel
+    from paddle_tpu.framework.dispatch import (AutoFoldTuner,
+                                               GroupDispatcher)
+    from paddle_tpu.observability import metrics as obs_metrics
+
+    print("devices-ok", jax.devices(), flush=True)
+    folds = [int(f) for f in os.environ.get(
+        "GRAFT_BENCH_PP_FOLDS", "1,8").split(",")]
+    reps = int(os.environ.get("GRAFT_BENCH_PP_REPS", "3"))
+    micro = int(os.environ.get("GRAFT_BENCH_PP_MICRO", "4"))
+
+    class Block(nn.Layer):
+        def __init__(self, d):
+            super().__init__()
+            self.fc = nn.Linear(d, d)
+
+        def forward(self, x):
+            return nn.functional.relu(self.fc(x))
+
+    paddle.seed(0)
+    net = PipelineLayer(
+        [nn.Linear(16, 32)] + [Block(32) for _ in range(4)] +
+        [nn.Linear(32, 10)],
+        num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    opt = optimizer.Adam(1e-3, parameters=net.parameters())
+    mesh = collective.build_mesh({"pp": 2},
+                                 devices=jax.devices()[:2])
+    collective.set_mesh(mesh)
+
+    class _Strat:
+        pipeline_configs = {"accumulate_steps": micro}
+
+    eng = PipelineParallel(net, None, _Strat(), optimizer=opt)
+    rng = np.random.RandomState(0)
+    batches = [([rng.rand(16, 16).astype(np.float32)],
+                [rng.randint(0, 10, (16,)).astype(np.int64)])
+               for _ in range(48)]
+    steps, rounds = len(batches), 4
+    reg = obs_metrics.registry()
+
+    def counters():
+        return {name: reg.counter(name).collect()
+                for name in ("pp_dispatches_total",
+                             "pp_commit_ops_total")}
+
+    def run_epoch(f):
+        if f == 0:                       # legacy per-batch entry
+            eng.dispatch_mode = "legacy"
+            try:
+                for ins, lbs in batches:
+                    eng.train_batch((ins[0], lbs[0]), opt)
+            finally:
+                eng.dispatch_mode = "unified"
+            return
+        # unified fold path, wrapper sync deferred to the epoch
+        # boundary exactly like Model.fit defers it
+        eng._defer_wrapper_sync = True
+        try:
+            for i in range(0, steps, f):
+                eng.train_steps_folded(batches[i:i + f])
+        finally:
+            eng._defer_wrapper_sync = False
+            eng.sync_to_layers()
+
+    variants = [0] + folds               # 0 = legacy baseline
+    t_compile0 = time.perf_counter()
+    for f in variants:                   # compile + warmup epoch each
+        run_epoch(f)
+    pp_compile_warmup_s = round(time.perf_counter() - t_compile0, 2)
+    samples = {f: [] for f in variants}
+    dispatch_rec = {}
+    for r in range(reps):
+        for f in variants:               # interleaved medians
+            c0 = counters()
+            t0 = time.perf_counter()
+            for _ in range(rounds):
+                run_epoch(f)
+            jax.block_until_ready(eng._opt_tree)
+            dt = time.perf_counter() - t0
+            samples[f].append(steps * rounds / dt)
+            if r == 0:
+                c1 = counters()
+                n = steps * rounds
+                dispatch_rec[f] = {
+                    "dispatches_per_batch": round(
+                        (c1["pp_dispatches_total"]
+                         - c0["pp_dispatches_total"]) / n, 4),
+                    "commit_ops_per_batch": round(
+                        (c1["pp_commit_ops_total"]
+                         - c0["pp_commit_ops_total"]) / n, 4),
+                }
+    out = {"pp_degree": 2, "pp_microbatches": micro,
+           "pp_compile_warmup_s": pp_compile_warmup_s}
+    for f in variants:
+        med = sorted(samples[f])[len(samples[f]) // 2]
+        key = ("pp_fit_steps_per_sec_legacy" if f == 0 else
+               "pp_fit_steps_per_sec" if f == 1 else
+               f"pp_fit_steps_per_sec_fold{f}")
+        out[key] = round(med, 1)
+        tag = ("legacy" if f == 0 else
+               "fold1" if f == 1 else f"fold{f}")
+        for k, v in dispatch_rec.get(f, {}).items():
+            out[f"pp_{k}_{tag}"] = v
+    base = out.get("pp_fit_steps_per_sec")
+    for f in folds:
+        if f != 1 and base:
+            out[f"pp_fold{f}_speedup"] = round(
+                out[f"pp_fit_steps_per_sec_fold{f}"] / base, 3)
+    # auto-K through the SAME GroupDispatcher/AutoFoldTuner machinery
+    # Model.fit drives: the tuner watches the first dispatches and
+    # freezes K from the measured host/device ratio
+    tuner = AutoFoldTuner()
+    eng._defer_wrapper_sync = True
+    try:
+        disp = GroupDispatcher(
+            lambda groups: (eng.train_steps_folded(groups)[0], []),
+            lambda *a: None, fold=1, tuner=tuner)
+        for i, (ins, lbs) in enumerate(batches * 2):
+            disp.feed(i, ins, lbs)
+        disp.flush()
+    finally:
+        eng._defer_wrapper_sync = False
+        eng.sync_to_layers()
+    if tuner.decided:
+        out["pp_auto_fold"] = tuner.fold
+        out["pp_auto_host_ms_per_step"] = \
+            tuner.decision["host_ms_per_step"]
+        out["pp_auto_device_ms_per_step"] = \
+            tuner.decision["device_ms_per_step"]
+    _emit_result("pp_fold", out)
+
+
 def _hlo_dp_collective_bytes(hlo_text, mesh):
     """Bytes-moved proxy from the COMPILED program: per-device WIRE
     bytes of every collective whose replica group spans the dp axis.
@@ -1612,6 +1772,19 @@ def main():
                          else {"error": merr[-1000:]}), flush=True)
         return
 
+    # `python bench.py --pp-fold [1,8,...]`: run ONLY the pipeline
+    # fold sweep (CPU pp=2 mesh, cheap) — the pipeline-schedule
+    # counterpart of --mesh-fold (ISSUE 15): legacy vs unified fold
+    # curve with host-dispatch counts per batch on the record
+    if "--pp-fold" in sys.argv:
+        i = sys.argv.index("--pp-fold")
+        if i + 1 < len(sys.argv):
+            os.environ["GRAFT_BENCH_PP_FOLDS"] = sys.argv[i + 1]
+        pf, perr = _run_child("pp_fold", 420)
+        print(json.dumps(pf if pf is not None
+                         else {"error": perr[-1000:]}), flush=True)
+        return
+
     # `python bench.py --dp-compressed`: run ONLY the compressed +
     # sharded dp sweep (CPU dp mesh, cheap) — the dp gradient-path
     # counterpart of --mesh-fold (ISSUE 11)
@@ -1638,6 +1811,8 @@ def main():
         return bench_hapi()
     if mode == "mesh_fold":
         return bench_mesh_fold()
+    if mode == "pp_fold":
+        return bench_pp_fold()
     if mode == "dp_compressed":
         return bench_dp_compressed()
     if mode == "serving":
@@ -1704,6 +1879,18 @@ def main():
             out["mesh_fold_error"] = mferr[-500:]
     elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
         out["mesh_fold_error"] = "skipped: out of budget"
+
+    # pipeline fold sweep (CPU pp=2 mesh, cheap): legacy vs unified
+    # fold curve + host-dispatch counts per batch — the pipeline
+    # engine's trend line records every round (ISSUE 15)
+    if remaining() > 60 and not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        pf, pferr = _run_child("pp_fold", min(240, remaining()))
+        if pf is not None:
+            out.update(pf)
+        else:
+            out["pp_fold_error"] = pferr[-500:]
+    elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        out["pp_fold_error"] = "skipped: out of budget"
 
     # compressed + sharded dp sweep (CPU dp mesh, cheap): wire-format
     # x update-sharding matrix with bytes proxy + opt-state memory —

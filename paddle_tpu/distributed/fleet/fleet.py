@@ -113,9 +113,11 @@ class Fleet:
         * quantized_allreduce (0|16|8) → explicit dp gradient ring at
           that wire width (DESIGN-DCN.md),
         * sharded_weight_update → dp reduce-scatter + 1/dp-sharded
-          optimizer update + param all-gather.
+          optimizer update + param all-gather,
+        * pp mesh + PipelineLayer model → the pipeline-schedule engine
+          on the unified dispatcher (``PipelinedRunner``, ISSUE 15).
         """
-        from ..runner import DistributedRunner
+        from ..runner import DistributedRunner, PipelinedRunner
         from .. import collective as coll
         s = self._strategy or DistributedStrategy()
         stage = int(s.sharding_configs.get("stage", 1)) if s.sharding \
@@ -132,6 +134,36 @@ class Fleet:
             amp_level = "O2" if cfg.get("use_pure_fp16") else "O1"
             amp_dtype = "bfloat16" if cfg.get("use_bf16", True) \
                 else "float16"
+        mesh = coll.get_mesh()
+        from .meta_parallel.pp_layers import PipelineLayer
+        if mesh is not None and int(mesh.shape.get("pp", 1)) > 1 and \
+                isinstance(model, PipelineLayer):
+            # refuse — never silently drop — strategy knobs the
+            # pipeline-schedule engine cannot honor yet (the PR-11
+            # strategy contract: every knob is consumed or refused)
+            unsupported = {}
+            if stage:
+                unsupported["sharding stage"] = stage
+            if getattr(s, "quantized_allreduce", 0):
+                unsupported["quantized_allreduce"] = \
+                    s.quantized_allreduce
+            if getattr(s, "sharded_weight_update", False):
+                unsupported["sharded_weight_update"] = True
+            if input_specs:
+                unsupported["input_specs"] = input_specs
+            if unsupported:
+                raise ValueError(
+                    "pipeline meshes run the pipeline-schedule engine, "
+                    "which does not support these strategy knobs yet: "
+                    f"{unsupported}.  Drop them or use a pp=1 mesh "
+                    "(DESIGN-PERF.md §Pipeline schedule).")
+            return PipelinedRunner(
+                model, optimizer, loss_fn, mesh=mesh,
+                accumulate_steps=max(acc, 1), amp_level=amp_level,
+                amp_dtype=amp_dtype,
+                pipeline_configs=s.pipeline_configs if s.pipeline
+                else None,
+                remat=True if s.recompute else None)
         return DistributedRunner(
             model, optimizer, loss_fn, mesh=coll.get_mesh(),
             sharding_stage=stage, accumulate_steps=max(acc, 1),

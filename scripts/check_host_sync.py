@@ -89,6 +89,14 @@ HOT_MODULES = [
     # neither may contain a direct jax/numpy sync call at all
     os.path.join("observability", "events.py"),
     os.path.join("inference", "serving", "router.py"),
+    # pipeline-schedule engine on the unified dispatcher (ISSUE 15,
+    # DESIGN-PERF.md §Unified dispatch engine): train_batch /
+    # train_steps_folded sit directly on the hot loop for pp and
+    # hybrid dp x mp x pp meshes — staging rides io/staging, wrapper
+    # write-back is reference-only, and nothing may sync host with
+    # device between dispatches
+    os.path.join("distributed", "fleet", "meta_parallel",
+                 "pipeline_parallel.py"),
 ]
 
 # (module, enclosing function) → why this sync point is legitimate

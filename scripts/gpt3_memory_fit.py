@@ -84,7 +84,9 @@ def fit(pp, mp, dp, seq=2048, micro_bs=2, acc=4, seed_params=True):
         step = eng._build_step()
 
         B = micro_bs * acc * dp
-        xs = np.zeros((acc, B // acc, seq), np.int64)
+        # the shared schedule body takes the FULL train batch and
+        # reshapes into `acc` microbatches in-program (ISSUE 15)
+        xs = np.zeros((B, seq), np.int64)
         lr = jnp.asarray(1e-4, jnp.float32)
         key = _random.default_generator().draw_key()
         t1 = time.time()

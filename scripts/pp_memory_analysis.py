@@ -71,7 +71,9 @@ def analyze(pp, remat, hidden, layers, seq, micro_bs, acc):
         step = eng._build_step()
 
         B = micro_bs * acc * dp
-        xs = np.zeros((acc, B // acc, seq), np.int64)
+        # the shared schedule body takes the FULL train batch and
+        # reshapes into `acc` microbatches in-program (ISSUE 15)
+        xs = np.zeros((B, seq), np.int64)
         lr = jnp.asarray(1e-3, jnp.float32)
         key = _random.default_generator().draw_key()
         lowered = step.lower(eng._params, eng._frozen, eng._buffers,
