@@ -27,9 +27,12 @@ from .decode_model import (ServingModelConfig, chunk_prefill_forward,
                            prefill_forward, prefill_group_forward,
                            reference_decode)
 from .scheduler import QueueFull, Request, RequestStats, Scheduler
-from .engine import DecodeEngine, GenerationResult
+from .migration import (MigrationError, PageMigration,
+                        gather_request_pages, scatter_request_pages)
+from .engine import DecodeEngine, ENGINE_ROLES, GenerationResult
 from .api import LLMServer
-from .router import Overloaded, ServingRouter
+from .router import Overloaded, ROUTER_PHASES, ServingRouter
+from .disagg import DisaggRouter
 
 __all__ = [
     "BlockAllocator", "OutOfBlocks", "PagedKVCache", "SCRATCH_BLOCK",
@@ -43,6 +46,8 @@ __all__ = [
     "extract_decode_params", "prefill_forward",
     "prefill_group_forward", "reference_decode",
     "QueueFull", "Request", "RequestStats", "Scheduler",
-    "DecodeEngine", "GenerationResult", "LLMServer",
-    "Overloaded", "ServingRouter",
+    "MigrationError", "PageMigration", "gather_request_pages",
+    "scatter_request_pages",
+    "DecodeEngine", "ENGINE_ROLES", "GenerationResult", "LLMServer",
+    "Overloaded", "ROUTER_PHASES", "ServingRouter", "DisaggRouter",
 ]

@@ -45,11 +45,20 @@ class LLMServer:
 
     def __init__(self, network=None, *, auto_start: bool = True,
                  idle_wait_s: float = 0.005,
-                 metrics_port: Optional[int] = None, **engine_kwargs):
+                 metrics_port: Optional[int] = None,
+                 on_handoff=None, **engine_kwargs):
         # persistent XLA compilation cache (opt-in via env): restarts
         # of this server skip recompiling the decode/prefill programs
         compile_cache.enable_from_env()
         self.engine = DecodeEngine(network, **engine_kwargs)
+        # prefill→decode handoff plane (DESIGN-SERVING.md
+        # §Disaggregated tier): a prefill-role engine stages finished
+        # prompts out as PageMigration tickets; the pump hands each to
+        # ``on_handoff(mig)`` (the router's transition hook) or parks
+        # it for :meth:`pop_handoffs`.  A handler that raises fails
+        # THAT request's future — never the pump.
+        self._on_handoff = on_handoff
+        self._handoffs: list = []
         self._idle_wait_s = float(idle_wait_s)
         self._cond = threading.Condition()
         self._closed = False
@@ -72,6 +81,14 @@ class LLMServer:
         """The bound scrape port (None when not armed)."""
         return (None if self._metrics_server is None
                 else self._metrics_server.port)
+
+    def set_handoff_handler(self, fn) -> "LLMServer":
+        """Install/replace the prefill→decode transition hook (the
+        DisaggRouter wires itself in here after the factory builds the
+        server).  The pump reads it per round, so installing on a
+        running server is safe."""
+        self._on_handoff = fn
+        return self
 
     # -- lifecycle -----------------------------------------------------------
     @property
@@ -126,6 +143,7 @@ class LLMServer:
                     return
             try:
                 busy = self.engine.step()
+                busy = self._dispatch_handoffs() or busy
             except Exception as e:   # noqa: BLE001 — a dead pump must
                 # not strand callers on futures that never resolve
                 self._fail_all(RuntimeError(
@@ -137,9 +155,40 @@ class LLMServer:
                         return
                     self._cond.wait(self._idle_wait_s)
 
+    def _dispatch_handoffs(self) -> bool:
+        """Hand staged migrations to the transition hook (pump
+        thread).  Without a hook they park for :meth:`pop_handoffs` —
+        a direct-drive caller's polling surface."""
+        migs = self.engine.pop_ready_migrations()
+        if not migs:
+            return False
+        for mig in migs:
+            if self._on_handoff is None:
+                self._handoffs.append(mig)
+                continue
+            try:
+                self._on_handoff(mig)
+            except Exception as e:  # noqa: BLE001 — one bad handoff
+                # must not take the pump (and every other request) down
+                if not mig.request.future.done():
+                    mig.request.future.set_exception(RuntimeError(
+                        f"prefill→decode handoff failed: "
+                        f"{type(e).__name__}: {e}"))
+        return True
+
+    def pop_handoffs(self) -> list:
+        """Drain migrations parked because no ``on_handoff`` hook was
+        installed (thread-safe enough: the pump only appends; callers
+        poll)."""
+        out, self._handoffs = self._handoffs, []
+        return out
+
     def _fail_all(self, exc: Exception):
         eng = self.engine
         eng._prefill_jobs.clear()      # mid-prefill work dies with us
+        for mig in eng.drain_all_migrations() + self.pop_handoffs():
+            if not mig.request.future.done():
+                mig.request.future.set_exception(exc)
         for s, req in enumerate(eng._slots):
             if req is None:
                 continue
@@ -178,6 +227,21 @@ class LLMServer:
         with self._cond:
             self._cond.notify_all()
         return req.future
+
+    @property
+    def role(self) -> str:
+        """This server's phase role ("both"/"prefill"/"decode") —
+        the router's spawn-time contract check reads it."""
+        return self.engine.role
+
+    def submit_migration(self, mig) -> None:
+        """Admit a migrated request into this (decode-phase) server's
+        engine and wake the pump.  Propagates the engine's refusals
+        (:class:`QueueFull` → failover, ``MigrationError``/
+        ``ValueError`` → misrouted)."""
+        self.engine.submit_migration(mig)
+        with self._cond:
+            self._cond.notify_all()
 
     def warmup(self, prompt_lengths: Optional[Sequence[int]] = None):
         """AOT-compile the serving programs BEFORE traffic (must be

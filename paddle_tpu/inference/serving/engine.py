@@ -44,8 +44,10 @@ explicit ``done_poll_interval=`` stays fixed.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import os
+import threading
 import time
 import weakref
 from collections import deque
@@ -63,10 +65,19 @@ from .decode_model import (ServingModelConfig, chunk_prefill_forward,
                            decode_forward, extract_decode_params,
                            prefill_group_forward)
 from .kv_cache import SCRATCH_BLOCK, PagedKVCache
+from .migration import (MigrationError, PageMigration,
+                        gather_request_pages, scatter_request_pages)
 from .prefix_cache import PrefixCache
 from .ragged_attention import resolve_paged_attention_mode
 from .sampling import sample_tokens
-from .scheduler import Request, Scheduler
+from .scheduler import QueueFull, Request, Scheduler
+
+#: phase roles an engine can run as (DESIGN-SERVING.md §Disaggregated
+#: tier): "both" is the classic single-engine pipeline; "prefill"
+#: runs admission + (chunked) prefill only and ships every finished
+#: prompt out as a PageMigration; "decode" admits ONLY migrations —
+#: no prefill dispatch ever enters its program
+ENGINE_ROLES = ("both", "prefill", "decode")
 
 # synthetic Chrome-trace track ids for request lifecycle spans: one
 # lane per (engine, batch slot), so concurrent requests render as
@@ -155,7 +166,20 @@ class DecodeEngine:
                  max_queue: int = 64,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: Optional[bool] = None,
-                 attention: Optional[str] = None):
+                 attention: Optional[str] = None,
+                 role: str = "both",
+                 prefix_reserve_discount: bool = False,
+                 device=None):
+        if role not in ENGINE_ROLES:
+            raise ValueError(
+                f"role {role!r} is not one of {ENGINE_ROLES}")
+        self.role = role
+        # placement pin (disaggregated tier): every allocation and
+        # dispatch this engine makes lands on `device`, so two
+        # phase-pinned replicas in one process stop sharing a device
+        # execution queue — the in-process analogue of phases owning
+        # separate chips.  None = process default device, as before.
+        self._device = device
         if network is not None:
             params = extract_decode_params(network)
             gpt_config = network.config
@@ -164,7 +188,10 @@ class DecodeEngine:
         self._cfg = (gpt_config
                      if isinstance(gpt_config, ServingModelConfig)
                      else ServingModelConfig.from_gpt_config(gpt_config))
-        self._params = params
+        # replicas can share one network object: each engine stages
+        # its own committed copy of the params on its pinned device
+        self._params = (params if device is None
+                        else jax.device_put(params, device))
         cfg = self._cfg
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
@@ -193,11 +220,20 @@ class DecodeEngine:
         self.max_context = min(cfg.max_position,
                                self.max_blocks_per_seq * block_size)
         dtype = params["wte"].dtype
-        self._kv = PagedKVCache(cfg.num_layers, num_blocks, block_size,
-                                cfg.num_heads, cfg.head_dim, dtype=dtype)
+        with self._on_device():
+            self._kv = PagedKVCache(cfg.num_layers, num_blocks,
+                                    block_size, cfg.num_heads,
+                                    cfg.head_dim, dtype=dtype)
+        # prefill-role door: this engine only ever holds PROMPT
+        # blocks (decode growth happens in the importing replica's
+        # pool after handoff), so its capacity sanity check must not
+        # refuse a long-max_tokens stream the deployment serves fine
+        door_need = ((lambda req: -(-len(req.prompt) // block_size))
+                     if self.role == "prefill" else None)
         self.scheduler = Scheduler(self._kv.allocator, block_size,
                                    max_queue=max_queue,
-                                   max_context=self.max_context)
+                                   max_context=self.max_context,
+                                   door_need_fn=door_need)
         if prefill_buckets is None:
             prefill_buckets = _default_buckets(block_size,
                                                self.max_context)
@@ -251,12 +287,35 @@ class DecodeEngine:
         self._topps = np.ones(self.max_batch, dtype=np.float32)
         self._seeds = np.zeros(self.max_batch, dtype=np.uint32)
         self._samp_dev = None          # invalidated by _mark_sampling
-        self._prefix = (PrefixCache(self._kv.allocator, block_size)
-                        if prefix_cache else None)
+        # reservation-discount knob (opt-in): admission reserves
+        # worst-case MINUS live prefix-cache hits; the pinned-block
+        # envelope on the allocator keeps the no-OOM invariant — a
+        # knob that cannot act must refuse, not no-op
+        if prefix_reserve_discount and not prefix_cache:
+            raise ValueError(
+                "prefix_reserve_discount=True requires "
+                "prefix_cache=True: there is nothing to discount "
+                "against without the shared-prefix cache")
+        self._reserve_discount = bool(prefix_reserve_discount)
+        self._prefix = (PrefixCache(
+            self._kv.allocator, block_size,
+            pin_referenced=self._reserve_discount)
+            if prefix_cache else None)
         self._prefill_jobs: deque = deque()
+        # disaggregated-tier state: migrations staged OUT (prefill
+        # role; the server pump hands them to the router) and the
+        # thread-safe inbox of migrations waiting to be imported
+        # (decode role; drained on the pump thread as slots and
+        # reservations free up)
+        self._ready_migrations: deque = deque()
+        self._migration_inbox: deque = deque()
+        self._mig_lock = threading.Lock()
+        self._inbox_need = 0           # reservation estimate of inbox
+        self._last_dispatch_t: Optional[float] = None
         # device-resident loop state
-        self._tokens = jnp.zeros(self.max_batch, dtype=jnp.int32)
-        self._done = jnp.zeros(self.max_batch, dtype=bool)
+        with self._on_device():
+            self._tokens = jnp.zeros(self.max_batch, dtype=jnp.int32)
+            self._done = jnp.zeros(self.max_batch, dtype=bool)
         # compiled steps (ONE jit each; trace cache keyed by shape —
         # decode must stay at exactly one trace, tests pin it)
         self._decode = self._build_decode_step()
@@ -271,7 +330,24 @@ class DecodeEngine:
         self._join = jax.jit(
             lambda tok, done, i, v: (tok.at[i].set(v),
                                      done.at[i].set(False)))
+        # page-migration D2D copy ops (DESIGN-SERVING.md
+        # §Disaggregated tier): the exporter's pool is NOT donated
+        # (other slots still live in it); the importer's is — the
+        # scatter output replaces the handle exactly like a decode
+        # dispatch.  Trace cache keyed by the pow2 block-count bucket.
+        self._export_kv = jax.jit(gather_request_pages)
+        self._import_kv = jax.jit(scatter_request_pages,
+                                  donate_argnums=(0,))
         self._init_observability()
+
+    def _on_device(self):
+        """Placement scope for this engine's device work: under a
+        pinned ``device=`` every un-committed ``device_put``/array
+        creation in the block lands there (committed inputs already
+        carry their placement).  No-op without a pin."""
+        return (jax.default_device(self._device)
+                if self._device is not None
+                else contextlib.nullcontext())
 
     def _init_observability(self):
         """Per-engine children on the process-wide metrics registry
@@ -291,7 +367,10 @@ class DecodeEngine:
         # keys the lane range, so two live engines can never interleave
         # request spans on one track
         self._obs_lane_base = _REQ_LANE_BASE + (ordinal << 16)
-        self._obs_labels = {"engine": self._obs_id}
+        # the phase label keys per-role dashboards: a disaggregated
+        # deployment sums decode-phase children for steady-state SLOs
+        # and prefill-phase children for admission capacity
+        self._obs_labels = {"engine": self._obs_id, "phase": self.role}
         labels = self._obs_labels
         reg = _obs_metrics.registry()
         self._c_dispatches = reg.counter(
@@ -331,6 +410,30 @@ class DecodeEngine:
         self._h_chunk = reg.histogram(
             "serving_prefill_chunk_s",
             "per-chunk prefill dispatch wall time", labels=labels)
+        # disaggregated tier (DESIGN-SERVING.md §Disaggregated tier):
+        # migration instruments tick on the IMPORTING engine — a
+        # migration counts when it is seated into a decode batch, not
+        # when it is cut (a parked or failed handoff is not traffic).
+        # The inter-token histogram is the decode pool's scaling
+        # signal: host wall between consecutive decode dispatches
+        # while the batch is non-empty (same async-dispatch caveat as
+        # the chunk histogram).
+        self._c_migrations = reg.counter(
+            "serving_page_migrations_total",
+            "migrated requests imported into this engine's batch",
+            labels=labels)
+        self._c_migrated_blocks = reg.counter(
+            "serving_migrated_blocks_total",
+            "KV pool blocks imported via page migration",
+            labels=labels)
+        self._h_migration = reg.histogram(
+            "serving_migration_s",
+            "prefill-complete to decode-seated handoff wall time",
+            labels=labels)
+        self._h_intertoken = reg.histogram(
+            "serving_intertoken_s",
+            "gap between consecutive decode dispatches of a non-empty "
+            "batch", labels=labels)
         wr = weakref.ref(self)
 
         def _gauge_fn(getter):
@@ -374,6 +477,9 @@ class DecodeEngine:
             "serving_prefix_cache_misses_total",
             "serving_prefix_cache_evictions_total",
             "serving_prefill_chunk_s",
+            "serving_page_migrations_total",
+            "serving_migrated_blocks_total",
+            "serving_migration_s", "serving_intertoken_s",
             "serving_queue_depth", "serving_active",
             "serving_kv_fragmentation", "serving_done_poll_interval",
             "serving_prefix_blocks", "serving_prefix_refs")
@@ -445,6 +551,11 @@ class DecodeEngine:
         contract).  Raises :class:`~.scheduler.QueueFull` at queue
         capacity and ``ValueError`` for requests the pool geometry can
         never run."""
+        if self.role == "decode":
+            raise ValueError(
+                "decode-role engine admits only migrated requests "
+                "(submit_migration); route prompts to a prefill "
+                "replica — DESIGN-SERVING.md §Disaggregated tier")
         req = Request(prompt_ids, max_tokens, stream_cb=stream_cb,
                       temperature=temperature, top_k=top_k,
                       top_p=top_p, seed=seed)
@@ -456,21 +567,111 @@ class DecodeEngine:
                 "prefill (prefill_chunk=) for longer prompts")
         return self.scheduler.submit(req)
 
+    def submit_migration(self, mig: PageMigration) -> None:
+        """Enqueue a migrated request for import (thread-safe; decode
+        and classic roles only).  Refuses what this engine can never
+        or currently cannot honor: :class:`MigrationError` for a
+        consumed ticket or a pool-geometry mismatch, ``ValueError``
+        for a request whose worst case exceeds this pool outright,
+        :class:`QueueFull` when batch slots / reservations are
+        exhausted — the router's failover signal (next-least-loaded
+        decode replica).  The admission-estimate reads here are racy
+        against the pump by design: an optimistic accept only parks
+        the migration in the inbox until the pump can actually reserve
+        — it can never OOM the pool."""
+        if self.role == "prefill":
+            raise MigrationError(
+                "prefill-role engine cannot import migrations: its "
+                "program never decodes")
+        if mig.consumed:
+            raise MigrationError(
+                f"migration of request {mig.request.id} already "
+                "imported — tickets are single-use")
+        mig.check_geometry(self)
+        req = mig.request
+        need = req.worst_case_blocks(self.block_size)
+        if need > self._kv.allocator.capacity:
+            raise ValueError(
+                f"migrated request needs {need} blocks worst-case but "
+                f"this pool only has {self._kv.allocator.capacity}")
+        if len(req.prompt) + req.max_tokens - 1 > self.max_context:
+            raise ValueError(
+                f"migrated prompt+max_tokens exceeds max context "
+                f"{self.max_context}")
+        with self._mig_lock:
+            if (self.active_count + len(self._migration_inbox)
+                    >= self.max_batch):
+                raise QueueFull(
+                    "decode replica batch full (active + pending "
+                    "migrations at max_batch)")
+            if not self._kv.allocator.can_reserve(
+                    need + self._inbox_need):
+                raise QueueFull(
+                    "decode replica cannot reserve the migrated "
+                    "request's worst-case blocks")
+            self._migration_inbox.append(mig)
+            self._inbox_need += need
+
+    @property
+    def pending_migrations(self) -> int:
+        """Migrations accepted but not yet imported (decode-side
+        queue depth; the router's load/scaling signal includes it)."""
+        with self._mig_lock:
+            return len(self._migration_inbox)
+
+    def pop_ready_migrations(self) -> List[PageMigration]:
+        """Drain the staged-out migrations a prefill-role step
+        produced (pump-thread only; the server hands them to the
+        router's prefill→decode transition)."""
+        out = list(self._ready_migrations)
+        self._ready_migrations.clear()
+        return out
+
+    def drain_all_migrations(self) -> List[PageMigration]:
+        """Remove every in-flight migration, inbound and staged-out
+        (server teardown/failure path — their futures must not be
+        stranded)."""
+        with self._mig_lock:
+            out = list(self._migration_inbox)
+            self._migration_inbox.clear()
+            self._inbox_need = 0
+        out.extend(self._ready_migrations)
+        self._ready_migrations.clear()
+        return out
+
     # -- engine loop ---------------------------------------------------------
     def step(self) -> bool:
-        """Admit waiting requests, advance at most ONE prefill chunk,
-        then run ONE batched decode dispatch — chunked prefill
-        interleaves with the running decode batch instead of stalling
-        it behind a whole-prompt dispatch.  Returns True while there
-        is (or may be) work."""
-        self._admit()
-        self._advance_prefill()
+        """One engine step, by role.  Classic ("both"): admit waiting
+        requests, advance at most ONE prefill chunk, then run ONE
+        batched decode dispatch — chunked prefill interleaves with the
+        running decode batch instead of stalling it behind a
+        whole-prompt dispatch.  "prefill": admission + prefill only;
+        finished prompts stage out as migrations and the decode
+        dispatch never runs.  "decode": import pending migrations,
+        then the decode dispatch — no prefill work can ever reach its
+        program.  Returns True while there is (or may be) work."""
+        with self._on_device():
+            return self._step()
+
+    def _step(self) -> bool:
+        if self.role == "decode":
+            self._drain_migration_inbox()
+        else:
+            self._admit()
+            self._advance_prefill()
+            if self.role == "both":
+                self._drain_migration_inbox()
+        if self.role == "prefill":
+            return (self.scheduler.queue_depth > 0
+                    or bool(self._prefill_jobs))
         active = [s for s, r in enumerate(self._slots)
                   if r is not None and not getattr(r, "prefilling",
                                                    False)]
         if not active:
+            self._last_dispatch_t = None
             return (self.scheduler.queue_depth > 0
-                    or bool(self._prefill_jobs))
+                    or bool(self._prefill_jobs)
+                    or self.pending_migrations > 0)
         self._grow_pages(active)
         with _obs_trace.span(
                 "serving.dispatch",
@@ -490,6 +691,9 @@ class DecodeEngine:
         self._c_dispatches.inc()
         stack = LazyStack(emit)        # ONE shared fetch, if read
         now = time.monotonic()
+        if self._last_dispatch_t is not None:
+            self._h_intertoken.observe(now - self._last_dispatch_t)
+        self._last_dispatch_t = now
         to_finish = []
         for s in active:
             req = self._slots[s]
@@ -562,6 +766,38 @@ class DecodeEngine:
         req.prefix_entries = req.prefix_entries + entries
         inserted = {e.block for e in entries}
         req.blocks = [b for b in req.blocks if b not in inserted]
+        if self._reserve_discount and entries:
+            # the freshly inserted blocks are now cache-owned and
+            # pinned (this request references them) — keeping them
+            # reserved too would double-count them in the envelope
+            self.scheduler.release_partial(req, len(entries))
+
+    def _admission_need(self, req: Request) -> int:
+        """Blocks to RESERVE for one admission, by role and knob: a
+        prefill-role engine reserves prompt blocks only (the decode
+        growth is the importing replica's worst case to reserve), and
+        reservation-discounted admission subtracts the live
+        prefix-cache hit depth — the match is taken HERE (references
+        and pins included) so the discount is computed against entries
+        that can no longer be evicted, never a stale peek."""
+        base = (-(-len(req.prompt) // self.block_size)
+                if self.role == "prefill"
+                else req.worst_case_blocks(self.block_size))
+        if not self._reserve_discount or self._prefix is None:
+            return base
+        entries, chain = self._prefix.match(req.prompt, count=False)
+        req._pre_matched = (entries, chain)
+        return max(0, base - len(entries))
+
+    def _admission_cancel(self, req: Request):
+        """Reservation refused after a discounted-admission match:
+        the request stays queued, so the speculative references (and
+        their pins) must drop — they are retaken on the next
+        attempt."""
+        pre = getattr(req, "_pre_matched", None)
+        if pre is not None:
+            self._prefix.release(pre[0])
+            req._pre_matched = None
 
     def _admit(self):
         """Admit waiting requests: prefix-cache lookup decides the
@@ -573,14 +809,24 @@ class DecodeEngine:
         if not free:
             return
         grouped: List = []
-        for req in self.scheduler.pop_admissible(len(free)):
+        for req in self.scheduler.pop_admissible(
+                len(free), need_fn=self._admission_need,
+                cancel_fn=self._admission_cancel):
             slot = free.pop(0)
             req.slot = slot
             self._slots[slot] = req
             entries, chain = ([], b"")
             if self._prefix is not None:
-                entries, chain = self._prefix.match(req.prompt)
-                n_share = self._prefix.shareable_blocks(req.prompt)
+                pre = getattr(req, "_pre_matched", None)
+                if pre is not None:
+                    entries, chain = pre
+                    req._pre_matched = None
+                    n_share = self._prefix.shareable_blocks(req.prompt)
+                    self._prefix.count_match(len(entries),
+                                             n_share - len(entries))
+                else:
+                    entries, chain = self._prefix.match(req.prompt)
+                    n_share = self._prefix.shareable_blocks(req.prompt)
                 if len(entries):
                     self._c_prefix_hits.inc(len(entries))
                 if n_share - len(entries):
@@ -660,12 +906,22 @@ class DecodeEngine:
         self._tables[slot, start + nb:] = SCRATCH_BLOCK
         self._tables[slot, start:start + nb] = blocks
         self._lengths[slot] = Lp
-        self._set_sampling(slot, req)
         if self._prefix is not None:
             self._cache_insert(req, start,
                                getattr(req, "_prefix_chain", b""),
                                list(req.blocks))
         req.prefilling = False
+        if self.role == "prefill":
+            # phase boundary: token 0 streams from HERE (TTFT is the
+            # prefill replica's); the rest of the request leaves as a
+            # migration — this engine's decode program never runs
+            req.push_token(first_tok, now)
+            if req.max_tokens == 1:
+                self._finalize(slot)
+            else:
+                self._stage_handoff(slot, req, tok_dev, now)
+            return
+        self._set_sampling(slot, req)
         self._tokens, self._done = self._join(self._tokens, self._done,
                                               np.int32(slot), tok_dev)
         req.push_token(first_tok, now)
@@ -742,11 +998,19 @@ class DecodeEngine:
             self._cache_insert(req, job.insert_from, job.chain,
                                job.computed_blocks)
         self._lengths[slot] = Lp
-        self._set_sampling(slot, req)
         req.prefilling = False
+        now = time.monotonic()
+        if self.role == "prefill":
+            req.push_token(LazyScalar(tok), now)
+            if req.max_tokens == 1:
+                self._finalize(slot)
+            else:
+                self._stage_handoff(slot, req, tok, now)
+            return
+        self._set_sampling(slot, req)
         self._tokens, self._done = self._join(self._tokens, self._done,
                                               np.int32(slot), tok)
-        req.push_token(LazyScalar(tok), time.monotonic())
+        req.push_token(LazyScalar(tok), now)
         if req.max_tokens == 1:
             self._finalize(slot)
 
@@ -764,13 +1028,145 @@ class DecodeEngine:
             have = req.n_prefix_blocks + len(req.blocks)
             if int(self._lengths[s]) < have * self.block_size:
                 continue
-            if have >= req.reserved_blocks or \
+            if have >= req.block_budget or \
                     have >= self.max_blocks_per_seq:
                 req.capped = True
                 continue
             blk = self._alloc_blocks(1)[0]
             req.blocks.append(blk)
             self._tables[s, have] = blk
+
+    # -- page migration (disaggregated tier) ---------------------------------
+    def _stage_handoff(self, slot: int, req: Request, tok_dev,
+                       now: float):
+        """Cut the migration ticket for a prompt this prefill-role
+        engine just finished: gather the request's pages in TABLE
+        order (prefix-cache hits first, then its own blocks — the
+        order the decode attention reads them), free the source copy,
+        and stage the ticket for the router's prefill→decode
+        transition.  Token 0 already streamed from here; the device
+        token rides the ticket as the decode replica's next-dispatch
+        input, never re-pushed."""
+        nb_total = req.n_prefix_blocks + len(req.blocks)
+        nbb = shape_bucket(nb_total, self._ctx_buckets)
+        ids = np.full(nbb, SCRATCH_BLOCK, dtype=np.int32)
+        ids[:nb_total] = self._tables[slot, :nb_total]
+        with _obs_trace.span(
+                "serving.export",
+                args=({"blocks": nb_total}
+                      if _obs_trace.enabled() else None)):
+            kv = self._export_kv(self._kv.pool, jax.device_put(ids))
+        kvc = self._kv
+        mig = PageMigration(
+            req, kv, nb_total, tok_dev, now,
+            geometry={"num_layers": kvc.num_layers,
+                      "block_size": kvc.block_size,
+                      "num_heads": kvc.num_heads,
+                      "head_dim": kvc.head_dim,
+                      "dtype": str(kvc.pool.dtype)},
+            source=self._obs_id)
+        # the ticket owns the K/V now: free the source copy.  Shared
+        # prefix blocks stay cached HERE, warm for the next prompt —
+        # only this request's reference drops; its exclusive blocks
+        # export (free + lifetime counter) back to this pool.
+        self.scheduler.finish(req)
+        if req.blocks:
+            self._kv.allocator.export_blocks(req.blocks)
+            req.blocks = []
+        if req.prefix_entries:
+            self._prefix.release(req.prefix_entries)
+            req.prefix_entries = []
+        self._slots[slot] = None
+        self._lengths[slot] = 0
+        self._tables[slot, :] = SCRATCH_BLOCK
+        self._ready_migrations.append(mig)
+
+    def _drain_migration_inbox(self):
+        """Seat accepted migrations as slots and reservations free up
+        (pump thread only).  Strict FIFO with head blocking, mirroring
+        the scheduler's admission policy: a large migrated request at
+        the head waits for capacity, it is never overtaken."""
+        while True:
+            with self._mig_lock:
+                if not self._migration_inbox:
+                    return
+                mig = self._migration_inbox[0]
+            slot = next((s for s, r in enumerate(self._slots)
+                         if r is None), None)
+            if slot is None:
+                return
+            need = mig.request.worst_case_blocks(self.block_size)
+            if not self._kv.allocator.reserve(need):
+                return
+            with self._mig_lock:
+                self._migration_inbox.popleft()
+                self._inbox_need -= need
+            try:
+                self._import_migration(slot, mig, need)
+            except MigrationError:
+                # consumed ticket (double submit): whoever imported it
+                # first owns the request; drop ours and keep draining
+                self._kv.allocator.release(need)
+
+    def _import_migration(self, slot: int, mig: PageMigration,
+                          need: int):
+        """Land one migrated request in this engine's batch: fresh
+        pool blocks, one D2D scatter of the ticket's K/V, page-table
+        remap to THIS pool's ids, sampling state, and the device
+        token joined as the next dispatch's input.  Token-exact
+        continuation: length, resolved seed, and token all came over,
+        so the first decode dispatch here samples exactly the position
+        the single-engine oracle would."""
+        req = mig.consume()
+        nb = mig.nb
+        # the ticket's arrays are committed on the EXPORTER's device;
+        # under a placement pin they must cross explicitly before the
+        # scatter/join (the in-process analogue of the multi-host
+        # fleet-KV fetch — DESIGN-SERVING.md §Multi-host sketch)
+        mig_kv, mig_tok = mig.kv, mig.token
+        if self._device is not None:
+            mig_kv = jax.device_put(mig_kv, self._device)
+            mig_tok = jax.device_put(mig_tok, self._device)
+        if self._prefix is not None:
+            ev0 = self._prefix.evictions
+            self._prefix.ensure_free(nb)
+            d = self._prefix.evictions - ev0
+            if d:
+                self._c_prefix_evictions.inc(d)
+        blocks = self._kv.allocator.import_blocks(nb)
+        # scatter ids pad to the TICKET's bucket (the gathered kv's
+        # block extent), not this engine's — the tail lands in scratch
+        nbb = int(mig.kv.shape[2])
+        ids = np.full(nbb, SCRATCH_BLOCK, dtype=np.int32)
+        ids[:nb] = blocks
+        with _obs_trace.span(
+                "serving.import",
+                args=({"blocks": nb}
+                      if _obs_trace.enabled() else None)):
+            self._kv.swap_pool(self._import_kv(
+                self._kv.pool, mig_kv, jax.device_put(ids)))
+        req.slot = slot
+        self._slots[slot] = req
+        req.blocks = list(blocks)
+        req.prefix_entries = []
+        req.reserved_blocks = need
+        req.block_budget = req.worst_case_blocks(self.block_size)
+        Lp = len(req.prompt)
+        self._tables[slot, :] = SCRATCH_BLOCK
+        self._tables[slot, :nb] = blocks
+        self._lengths[slot] = Lp
+        self._set_sampling(slot, req)
+        if self._prefix is not None:
+            # the imported prompt blocks are ordinary full-prompt
+            # blocks in THIS pool now — register them so later local
+            # prompts (and the discount envelope) can share them
+            self._cache_insert(req, 0, b"", list(req.blocks))
+        req.prefilling = False
+        self._tokens, self._done = self._join(self._tokens, self._done,
+                                              np.int32(slot), mig_tok)
+        self._c_migrations.inc()
+        self._c_migrated_blocks.inc(nb)
+        self._h_migration.observe(time.monotonic() - mig.t_start)
 
     # -- completion ----------------------------------------------------------
     def _timed_poll(self):
@@ -906,6 +1302,10 @@ class DecodeEngine:
         writer, the join op, and THE decode step.  Returns wall-times;
         call before traffic cuts over — this is the one engine method
         allowed to block on device completion."""
+        with self._on_device():
+            return self._warmup(prompt_lengths)
+
+    def _warmup(self, prompt_lengths=None) -> Dict[str, float]:
         t0 = time.monotonic()
         buckets = (sorted({shape_bucket(int(n), self._buckets)
                            for n in prompt_lengths})
@@ -970,11 +1370,15 @@ class DecodeEngine:
                 "prefill_traces": _size(self._prefill),
                 "chunk_traces": _size(self._chunk),
                 "write_traces": _size(self._write),
-                "join_traces": _size(self._join)}
+                "join_traces": _size(self._join),
+                "export_traces": _size(self._export_kv),
+                "import_traces": _size(self._import_kv)}
 
     def stats(self) -> Dict[str, object]:
         st = {"active": self.active_count,
+              "role": self.role,
               "queue_depth": self.scheduler.queue_depth,
+              "pending_migrations": self.pending_migrations,
               "dispatches": self._dispatch_count,
               "total_tokens": int(
                   self._c_tokens.collect(materialize=False)),

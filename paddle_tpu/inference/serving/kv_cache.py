@@ -62,10 +62,25 @@ class BlockAllocator:
 
     Reservations: ``reserve(n)`` / ``release(n)`` track the worst-case
     block need of every admitted request WITHOUT allocating.  Admission
-    control only admits while ``reserved + need <= capacity``; actual
-    ``allocate`` calls then draw lazily (prompt blocks at prefill, one
-    block at a time as decode crosses block boundaries) and can never
-    fail for an admitted request.
+    control only admits while ``reserved + pinned + need <= capacity``;
+    actual ``allocate`` calls then draw lazily (prompt blocks at
+    prefill, one block at a time as decode crosses block boundaries)
+    and can never fail for an admitted request.
+
+    Pins: ``pin(n)`` / ``unpin(n)`` count blocks that are occupied,
+    un-evictable, and NOT covered by any reservation — prefix-cache
+    blocks referenced by live requests under reservation-discounted
+    admission (DESIGN-SERVING.md §Disaggregated tier).  The classic
+    admission path never pins; the envelope then degenerates to the
+    original ``reserved <= capacity``.
+
+    Page migration: ``export_blocks`` / ``import_blocks`` are the
+    allocator half of the disaggregated tier's page-migration API —
+    an export returns a request's pages to this pool (the K/V has
+    been copied out), an import draws fresh pages for K/V copied in.
+    Accounting-wise they are free/allocate with intent and lifetime
+    counters; the device copy itself is the engine's jitted
+    gather/scatter (``migration.py``).
     """
 
     def __init__(self, num_blocks: int):
@@ -75,14 +90,21 @@ class BlockAllocator:
         self._allocated: set = set()
         self.capacity = num_blocks - 1
         self._reserved = 0
+        self._pinned = 0
+        self.exported_blocks = 0       # lifetime migration counters
+        self.imported_blocks = 0
 
     # -- reservations (admission control) -----------------------------------
     @property
     def reserved(self) -> int:
         return self._reserved
 
+    @property
+    def pinned(self) -> int:
+        return self._pinned
+
     def can_reserve(self, n: int) -> bool:
-        return self._reserved + int(n) <= self.capacity
+        return self._reserved + self._pinned + int(n) <= self.capacity
 
     def reserve(self, n: int) -> bool:
         if not self.can_reserve(n):
@@ -93,6 +115,39 @@ class BlockAllocator:
     def release(self, n: int):
         self._reserved -= int(n)
         assert self._reserved >= 0, "release() without matching reserve()"
+
+    def pin(self, n: int = 1):
+        """Count ``n`` occupied blocks into the admission envelope that
+        no reservation covers (live-referenced prefix-cache blocks
+        under discounted admission).  Without the pin, two requests
+        whose reservations were discounted against DIFFERENT cached
+        prefixes could jointly out-demand the pool mid-decode."""
+        self._pinned += int(n)
+
+    def unpin(self, n: int = 1):
+        self._pinned -= int(n)
+        assert self._pinned >= 0, "unpin() without matching pin()"
+
+    # -- page migration (disaggregated serving) ------------------------------
+    def export_blocks(self, blocks: Sequence[int]) -> int:
+        """Give a migrating request's pages back to this pool: the K/V
+        they held has been copied into another engine's pool, so an
+        export IS a free — validated against double-export exactly
+        like ``free`` — plus the lifetime counter ``stats()`` surfaces.
+        Returns the number of blocks exported."""
+        blocks = [int(b) for b in blocks]
+        self.free(blocks)
+        self.exported_blocks += len(blocks)
+        return len(blocks)
+
+    def import_blocks(self, n: int) -> List[int]:
+        """Draw ``n`` fresh pages for K/V migrating INTO this pool.
+        Same contract as ``allocate`` (the importer must hold a
+        reservation); the page-table remap is the caller's: migrated
+        block ids are this pool's, never the source's."""
+        got = self.allocate(n)
+        self.imported_blocks += len(got)
+        return got
 
     # -- allocate / free -----------------------------------------------------
     @property
@@ -155,6 +210,9 @@ class BlockAllocator:
             "free": free,
             "allocated": len(self._allocated),
             "reserved": self._reserved,
+            "pinned": self._pinned,
+            "exported_blocks": self.exported_blocks,
+            "imported_blocks": self.imported_blocks,
             "free_runs": len(runs),
             "largest_run": largest,
             # 0.0 = one contiguous run (or empty), → 1.0 = maximally

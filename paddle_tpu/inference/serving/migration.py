@@ -1,0 +1,140 @@
+"""KV page migration: the transfer ticket between serving phases
+(DESIGN-SERVING.md §Disaggregated tier).
+
+Disaggregated serving runs prefill and decode on SEPARATE engines so
+long-prompt admission never perturbs steady-state decode (PAPERS.md
+arxiv 2605.25645).  The seam between them is this module: when a
+prefill replica finishes a prompt, the request's finished pages plus
+its sampling state leave that engine as a :class:`PageMigration` and
+enter the decode replica's pool under NEW block ids — a page-table
+remap, not a pointer handoff.
+
+What must transfer for the handoff to be token-exact (test-pinned
+against the single-engine oracle):
+
+- the K/V pages of the full prompt, in table order (prefix-cache hit
+  blocks first, then the request's own) — gathered from the source
+  pool, scattered into freshly imported destination blocks;
+- the prompt length (the sampling PRNG position counter continues
+  from it) and the first generated token, still ON DEVICE (the decode
+  replica's join consumes it as the next dispatch's input token);
+- the resolved sampling state: ``temperature``/``top_k``/``top_p``
+  and the request's RESOLVED seed.  Seeds default per-request
+  (``Request.seed = id``), so the ticket carries the request object
+  itself — re-deriving the seed on the decode side would change the
+  sampled sequence.  Sampling keys are pure ``(seed, position)``
+  functions, never slot/batch/engine functions, which is the whole
+  reason a migrated request samples identically.
+
+The device copy is two shape-stable jitted ops the engines own
+(:func:`gather_request_pages` on the exporter — the pool is NOT
+donated, other slots still live in it — and
+:func:`scatter_request_pages` on the importer, destination pool
+donated).  Block counts pad to the exporter's pow2 context buckets,
+padding slots target ``SCRATCH_BLOCK`` on both sides: scatter
+collisions land only in scratch, which nothing reads.  Neither side
+syncs host with device — in process, a migration is one D2D copy
+riding the dispatch queue (``check_host_sync.py`` holds this module
+to the hot-loop contract).
+
+A ticket is SINGLE-USE: :meth:`PageMigration.consume` refuses a
+second import — the pages were freed on the source when the ticket
+was cut, so a double import would seat two live requests on one
+future and one stats record.
+
+Multi-host: in-process the ticket holds device arrays; across hosts
+the same ticket rides the fleet KV registry as the transfer
+coordination plane — see the design doc for the protocol sketch
+(gather → publish under the request's chain hash → importer fetch →
+scatter), which reuses this exact export/import seam.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+__all__ = ["MigrationError", "PageMigration",
+           "gather_request_pages", "scatter_request_pages"]
+
+
+class MigrationError(RuntimeError):
+    """A page migration cannot be honored: geometry mismatch between
+    pools, a consumed (already-imported) ticket, or an import on a
+    role that never admits one."""
+
+
+# ---------------------------------------------------------------------------
+# pure pool ops (jitted by the engines; shape-stable per pow2 bucket)
+# ---------------------------------------------------------------------------
+def gather_request_pages(pool, block_ids):
+    """Copy one request's pages out of a pool: ``pool``
+    ``[L, 2, NB, BS, H, Dh]``, ``block_ids`` ``[nbb]`` int32 (padded
+    to a pow2 bucket with SCRATCH_BLOCK) → ``[L, 2, nbb, BS, H, Dh]``.
+    Whatever the padding gathers from scratch is never scattered onto
+    a real destination block."""
+    return pool[:, :, block_ids]
+
+
+def scatter_request_pages(pool, kv, block_ids):
+    """Land migrated pages in the destination pool under its OWN block
+    ids: ``kv`` ``[L, 2, nbb, BS, H, Dh]`` from
+    :func:`gather_request_pages`, ``block_ids`` ``[nbb]`` int32 with
+    the padding tail at SCRATCH_BLOCK — duplicate scratch indices make
+    the scatter order-dependent only inside scratch, which is never
+    read."""
+    return pool.at[:, :, block_ids].set(kv)
+
+
+class PageMigration:
+    """One request's pages + sampling state in flight between engines.
+
+    Cut by the exporting (prefill) engine at prompt completion;
+    consumed exactly once by the importing (decode) engine.  The
+    source engine has already freed its copy of the pages when the
+    ticket exists — the ticket OWNS the K/V until import.
+    """
+
+    __slots__ = ("request", "kv", "nb", "token", "t_start",
+                 "geometry", "consumed", "source")
+
+    def __init__(self, request, kv, nb: int, token, t_start: float,
+                 geometry: Dict[str, Any], source: str = ""):
+        self.request = request          # carries future/stats/seed
+        self.kv = kv                    # [L, 2, nbb, BS, H, Dh] device
+        self.nb = int(nb)               # real block count (<= nbb)
+        self.token = token              # first generated token, device
+        self.t_start = float(t_start)   # export wall clock (monotonic)
+        self.geometry = dict(geometry)
+        self.source = source            # exporting engine id (obs)
+        self.consumed = False
+
+    def check_geometry(self, engine) -> None:
+        """Refuse an import the destination pool can never hold
+        bit-exactly: pages are raw ``[BS, H, Dh]`` K/V slabs, so every
+        shape component and the dtype must agree."""
+        kvc = engine._kv
+        want = {"num_layers": kvc.num_layers,
+                "block_size": kvc.block_size,
+                "num_heads": kvc.num_heads,
+                "head_dim": kvc.head_dim,
+                "dtype": str(kvc.pool.dtype)}
+        if self.geometry != want:
+            raise MigrationError(
+                f"pool geometry mismatch: ticket {self.geometry} vs "
+                f"destination {want} — migration requires identical "
+                "block shape and dtype")
+
+    def consume(self):
+        """Single-use gate: returns the request, or refuses a second
+        import (the source pages are gone; a double import would seat
+        one future twice)."""
+        if self.consumed:
+            raise MigrationError(
+                f"migration of request {self.request.id} already "
+                "imported — tickets are single-use")
+        self.consumed = True
+        return self.request
+
+    def __repr__(self):
+        return (f"PageMigration(request={self.request.id}, "
+                f"nb={self.nb}, consumed={self.consumed})")

@@ -70,17 +70,33 @@ def _chain_hash(prev: bytes, tokens: Sequence[int]) -> bytes:
 
 
 class PrefixCache:
-    """Prefix → pool-block map with refcounts and LRU eviction."""
+    """Prefix → pool-block map with refcounts and LRU eviction.
 
-    def __init__(self, allocator: BlockAllocator, block_size: int):
+    ``pin_referenced=True`` arms the reservation-discount admission
+    mode (DESIGN-SERVING.md §Disaggregated tier): every entry whose
+    refcount rises 0→1 pins one block on the allocator (falls 1→0
+    unpins), so live-referenced cache blocks — occupied, un-evictable,
+    and NOT covered by any discounted reservation — still count in
+    the admission envelope.  Off (the default), admission reserves
+    the full worst case and the envelope never needs the pin.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int,
+                 pin_referenced: bool = False):
         self._alloc = allocator
         self.block_size = int(block_size)
+        self.pin_referenced = bool(pin_referenced)
         self._entries: Dict[bytes, PrefixEntry] = {}
         self._tick = itertools.count(1)
         # lifetime stats (the engine mirrors them onto the registry)
         self.hits = 0            # blocks reused from cache
         self.misses = 0          # shareable blocks computed fresh
         self.evictions = 0       # idle entries reclaimed
+
+    def _ref(self, e: PrefixEntry):
+        e.refs += 1
+        if e.refs == 1 and self.pin_referenced:
+            self._alloc.pin(1)
 
     # -- introspection -------------------------------------------------------
     @property
@@ -107,14 +123,18 @@ class PrefixCache:
         generated token has logits to come from."""
         return max(0, (len(prompt) - 1) // self.block_size)
 
-    def match(self, prompt: Sequence[int]
+    def match(self, prompt: Sequence[int], count: bool = True
               ) -> Tuple[List[PrefixEntry], bytes]:
         """Longest cached prefix of ``prompt``: returns the matched
         entries (a reference is taken on each — pair with
         :meth:`release`) and the chain hash at the match depth, which
         :meth:`insert` extends for the blocks this request computes
         itself.  Counts hits (matched) and misses (share-eligible but
-        absent) on the lifetime stats."""
+        absent) on the lifetime stats; ``count=False`` defers that to
+        an explicit :meth:`count_match` — the discounted-admission
+        path matches speculatively at every reservation attempt and
+        must not inflate the rate while a request waits at the
+        door."""
         bs = self.block_size
         n = self.shareable_blocks(prompt)
         got: List[PrefixEntry] = []
@@ -128,11 +148,17 @@ class PrefixCache:
             got.append(e)
         tick = next(self._tick)
         for e in got:
-            e.refs += 1
+            self._ref(e)
             e.last_used = tick
-        self.hits += len(got)
-        self.misses += n - len(got)
+        if count:
+            self.count_match(len(got), n - len(got))
         return got, h
+
+    def count_match(self, hits: int, misses: int):
+        """Fold one ADMITTED request's match outcome into the lifetime
+        hit/miss stats (see ``match(count=False)``)."""
+        self.hits += int(hits)
+        self.misses += int(misses)
 
     # -- insert / release ----------------------------------------------------
     def insert(self, prompt: Sequence[int], start_block: int,
@@ -169,7 +195,7 @@ class PrefixCache:
                 broken = True
                 continue
             e = PrefixEntry(nxt, h if h else None, block)
-            e.refs = 1
+            self._ref(e)
             e.last_used = tick
             self._entries[nxt] = e
             parent = self._entries.get(h) if h else None
@@ -186,6 +212,8 @@ class PrefixCache:
         for e in entries:
             assert e.refs > 0, "release() without matching reference"
             e.refs -= 1
+            if e.refs == 0 and self.pin_referenced:
+                self._alloc.unpin(1)
 
     # -- eviction ------------------------------------------------------------
     def _evictable(self) -> Optional[PrefixEntry]:

@@ -60,7 +60,15 @@ from ...observability import events as _obs_events
 from ...observability import metrics as _obs_metrics
 from .scheduler import QueueFull
 
-__all__ = ["ServingRouter", "Overloaded"]
+__all__ = ["ServingRouter", "Overloaded", "ROUTER_PHASES"]
+
+#: phase a router's pool can be pinned to (DESIGN-SERVING.md
+#: §Disaggregated tier).  None = classic phase-agnostic pool of
+#: "both"-role replicas; "prefill"/"decode" pools refuse replicas of
+#: any other role at spawn and judge their own scaling signal —
+#: prefill on admission queue depth, decode on windowed inter-token
+#: p99 (``serving_intertoken_s``) instead of request latency.
+ROUTER_PHASES = (None, "prefill", "decode")
 
 
 class Overloaded(QueueFull):
@@ -127,8 +135,19 @@ class _Replica:
 
     # -- host-only signal reads (materialize=False everywhere) ------------
     @property
+    def alive(self) -> bool:
+        """Pump thread still running (a crashed replica must stop
+        receiving admissions and be reaped; stub servers without the
+        property count as alive)."""
+        return bool(getattr(self.server, "running", True))
+
+    @property
     def queue_depth(self) -> int:
-        return self.server.engine.scheduler.queue_depth
+        # accepted-but-unseated migrations ARE queue depth on a
+        # decode replica: same admission backlog, different door
+        eng = self.server.engine
+        return (eng.scheduler.queue_depth
+                + int(getattr(eng, "pending_migrations", 0)))
 
     @property
     def active(self) -> int:
@@ -138,8 +157,12 @@ class _Replica:
     def load(self) -> int:
         return self.queue_depth + self.active
 
-    def latency_snapshot(self) -> Dict[str, Any]:
-        return self.server.engine._h_latency.collect(materialize=False)
+    def signal_snapshot(self, hist_attr: str) -> Dict[str, Any]:
+        """Cumulative snapshot of this replica's SLO histogram —
+        ``_h_latency`` (classic/prefill pools) or ``_h_intertoken``
+        (decode pools)."""
+        return getattr(self.server.engine, hist_attr).collect(
+            materialize=False)
 
 
 class ServingRouter:
@@ -152,7 +175,17 @@ class ServingRouter:
     background loop; tests drive :meth:`control_round` directly.
     """
 
+    #: knob surface of :meth:`to_config` / :meth:`from_config` — the
+    #: exported-profile round-trip (every knob consumed or refused,
+    #: same contract as the fleet DistributedStrategy)
+    CONFIG_KNOBS = ("phase", "min_replicas", "max_replicas",
+                    "slo_p99_s", "scale_up_queue_depth",
+                    "scale_down_queue_depth", "windows_up",
+                    "windows_down", "cooldown_s",
+                    "decision_interval_s")
+
     def __init__(self, replica_factory: Callable[[], Any], *,
+                 phase: Optional[str] = None,
                  min_replicas: int = 1, max_replicas: int = 2,
                  slo_p99_s: Optional[float] = None,
                  scale_up_queue_depth: float = 4.0,
@@ -165,6 +198,15 @@ class ServingRouter:
             raise ValueError("min_replicas must be >= 1")
         if max_replicas < min_replicas:
             raise ValueError("max_replicas must be >= min_replicas")
+        if phase not in ROUTER_PHASES:
+            raise ValueError(
+                f"phase {phase!r} is not one of {ROUTER_PHASES}")
+        self.phase = phase
+        # decode pools judge the SLO on the inter-token gap (the
+        # steady-state jitter disaggregation exists to protect);
+        # everything else judges request latency
+        self._hist_attr = ("_h_intertoken" if phase == "decode"
+                           else "_h_latency")
         self._factory = replica_factory
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
@@ -186,29 +228,38 @@ class ServingRouter:
         self._last_p99: Optional[float] = None
         self._closed = False
         reg = _obs_metrics.registry()
+        # phase-pinned pools label their children so two routers (a
+        # disaggregated deployment runs one per phase) never write
+        # one unlabeled child; a classic router keeps the unlabeled
+        # names for backwards-compatible dashboards
+        labels = {"phase": phase} if phase is not None else None
+        self._obs_labels = labels
         self._g_replicas = reg.gauge(
             "serving_replicas",
             "live (non-draining) LLMServer replicas behind the "
-            "router")
+            "router", labels=labels)
         self._g_p99 = reg.gauge(
             "router_p99_s",
-            "request p99 latency over the last decision window "
-            "(None scrapes absent when the window saw no "
-            "completions)")
+            "windowed p99 of the pool's SLO signal (request latency; "
+            "inter-token gap for decode pools); absent when the "
+            "window saw no observations", labels=labels)
         self._g_queue = reg.gauge(
             "router_queue_depth",
-            "waiting requests summed across replicas")
+            "waiting requests summed across replicas "
+            "(pending migrations included)", labels=labels)
         self._c_requests = reg.counter(
-            "router_requests_total", "admissions routed to a replica")
+            "router_requests_total", "admissions routed to a replica",
+            labels=labels)
         self._c_shed = reg.counter(
             "router_shed_total",
-            "admissions shed at the router door (Overloaded)")
+            "admissions shed at the router door (Overloaded)",
+            labels=labels)
         self._c_up = reg.counter(
             "router_scale_ups_total", "replicas spawned by the SLO "
-            "control loop")
+            "control loop", labels=labels)
         self._c_down = reg.counter(
             "router_scale_downs_total", "replicas retired by the SLO "
-            "control loop")
+            "control loop", labels=labels)
         for _ in range(self.min_replicas):
             self._spawn_replica(reason="min_replicas")
         self._g_replicas.set(len(self._replicas))
@@ -231,22 +282,46 @@ class ServingRouter:
         (the control loop survives and retries after cooldown)."""
         _faults.fault_point("replica.spawn",
                             n=len(self._replicas) + 1, reason=reason)
-        rep = _Replica(self._factory())
+        server = self._factory()
+        if self.phase is not None:
+            # exported-knob contract (DistributedStrategy class): a
+            # phase the replica can't honor is REFUSED loudly — a
+            # "decode pool" quietly running both-role replicas would
+            # re-admit prefill into the program this tier exists to
+            # protect
+            role = getattr(server, "role", "both")
+            if role != self.phase:
+                try:
+                    server.close(unregister_metrics=True)
+                except Exception:  # noqa: BLE001
+                    pass
+                raise ValueError(
+                    f"router phase {self.phase!r} refused: "
+                    f"replica_factory built a {role!r}-role server "
+                    f"(pass role={self.phase!r} to the LLMServer)")
+        rep = _Replica(server)
         with self._lock:
             self._replicas.append(rep)
         return rep
 
+    def _live(self) -> List["_Replica"]:
+        """Routable replicas (lock held by caller NOT required —
+        takes it): not draining, pump alive, least-loaded first."""
+        with self._lock:
+            reps = [r for r in self._replicas
+                    if not r.draining and r.alive]
+        return sorted(reps, key=lambda r: r.load)
+
     @property
     def replicas(self) -> List[Any]:
         """Live (non-draining) replica servers, least-loaded first."""
-        with self._lock:
-            reps = [r for r in self._replicas if not r.draining]
-        return [r.server for r in sorted(reps, key=lambda r: r.load)]
+        return [r.server for r in self._live()]
 
     @property
     def num_replicas(self) -> int:
         with self._lock:
-            return sum(1 for r in self._replicas if not r.draining)
+            return sum(1 for r in self._replicas
+                       if not r.draining and r.alive)
 
     @property
     def shedding(self) -> bool:
@@ -270,9 +345,12 @@ class ServingRouter:
         queue is full."""
         if self._closed:
             raise RuntimeError("router closed")
-        with self._lock:
-            reps = sorted((r for r in self._replicas
-                           if not r.draining), key=lambda r: r.load)
+        if self.phase == "decode":
+            raise ValueError(
+                "decode-phase router admits only migrations "
+                "(submit_migration); route prompts to the prefill "
+                "pool — DESIGN-SERVING.md §Disaggregated tier")
+        reps = self._live()
         if not reps:
             raise RuntimeError("router has no live replicas")
         if self._shedding and not _faults.should_drop(
@@ -303,6 +381,35 @@ class ServingRouter:
             f"all {len(reps)} replica queues full "
             f"({last_exc})") from last_exc
 
+    def submit_migration(self, mig) -> Any:
+        """Route one prefill→decode migration to the least-loaded
+        replica that will take it (ISSUE-16 failover contract: target
+        full → next-least-loaded; every target full →
+        :class:`Overloaded`, counted as a shed — the caller parks and
+        retries).  Returns the replica server that accepted."""
+        if self._closed:
+            raise RuntimeError("router closed")
+        if self.phase == "prefill":
+            raise ValueError(
+                "prefill-phase router cannot accept migrations: its "
+                "replicas never decode")
+        reps = self._live()
+        if not reps:
+            raise RuntimeError("router has no live replicas")
+        last_exc: Optional[Exception] = None
+        for rep in reps:
+            try:
+                rep.server.submit_migration(mig)
+            except QueueFull as e:
+                last_exc = e
+                continue
+            self._c_requests.inc()
+            return rep.server
+        self._note_shed()
+        raise Overloaded(
+            f"all {len(reps)} decode replicas full "
+            f"({last_exc})") from last_exc
+
     def _note_shed(self):
         """Count a QUEUE-FULL shed on the registry AND as overload
         evidence for the next decision round: queue-depth *samples*
@@ -320,19 +427,21 @@ class ServingRouter:
         """One host-only sample of the registry-backed signals the
         policy judges on (no device syncs — materialize=False)."""
         with self._lock:
-            reps = [r for r in self._replicas if not r.draining]
+            reps = [r for r in self._replicas
+                    if not r.draining and r.alive]
             shed_delta, self._sheds_in_window = \
                 self._sheds_in_window, 0
         queue = sum(r.queue_depth for r in reps)
         active = sum(r.active for r in reps)
-        # windowed p99: diff every live replica's cumulative latency
-        # histogram against its previous snapshot and merge the
-        # window counts (bucket edges are shared — one registry name,
-        # one fixed grid, so cumulative diffs add elementwise)
+        # windowed p99: diff every live replica's cumulative SLO
+        # histogram (latency; inter-token for decode pools) against
+        # its previous snapshot and merge the window counts (bucket
+        # edges are shared — one registry name, one fixed grid, so
+        # cumulative diffs add elementwise)
         merged_cum: Optional[List[float]] = None
         edges: Optional[List[float]] = None
         for r in reps:
-            cur = r.latency_snapshot()
+            cur = r.signal_snapshot(self._hist_attr)
             prev, r.last_latency = r.last_latency, cur
             cum = _window_cum(prev, cur)
             if merged_cum is None:
@@ -395,6 +504,7 @@ class ServingRouter:
                                queue_depth=sig["queue_depth"],
                                p99_s=sig["p99_s"], replicas=n)
         self._reap_draining()
+        self._reap_dead()
         self._g_replicas.set(self.num_replicas)
         sig["decision"] = decision
         return sig
@@ -449,6 +559,32 @@ class ServingRouter:
                 pass
             _obs_events.record("replica_retired", victim=r.name)
 
+    def _reap_dead(self):
+        """Remove replicas whose pump crashed (their in-flight futures
+        already failed via ``LLMServer._fail_all``) and respawn back to
+        ``min_replicas`` — a died-mid-prompt prefill replica must not
+        leave the pool permanently short (the disaggregated failover
+        path re-admits its lost prompts through the NEW capacity)."""
+        with self._lock:
+            dead = [r for r in self._replicas
+                    if not r.draining and not r.alive]
+            self._replicas = [r for r in self._replicas
+                              if r not in dead]
+        for r in dead:
+            try:
+                r.server.close(unregister_metrics=True)
+            except Exception:  # noqa: BLE001
+                pass
+            _obs_events.record("replica_died", victim=r.name)
+        while dead and self.num_replicas < self.min_replicas:
+            try:
+                self._spawn_replica(reason="replace_dead")
+            except Exception as e:  # noqa: BLE001 — chaos-injected
+                # spawn failure: stay short, retry next round
+                _obs_events.record("respawn_failed",
+                                   error=f"{type(e).__name__}: {e}")
+                break
+
     def _control_loop(self):
         while not self._stop.wait(self.decision_interval_s):
             try:
@@ -458,6 +594,31 @@ class ServingRouter:
                 _obs_events.record(
                     "control_round_failed",
                     error=f"{type(e).__name__}: {e}")
+
+    # -- profile round-trip ------------------------------------------------
+    def to_config(self) -> Dict[str, Any]:
+        """Export this router's policy knobs as a plain dict — the
+        profile surface a deployment config serializes.  Round-trips
+        through :meth:`from_config` bit-for-bit."""
+        return {k: getattr(self, k) for k in self.CONFIG_KNOBS}
+
+    @classmethod
+    def from_config(cls, config: Dict[str, Any],
+                    replica_factory: Callable[[], Any],
+                    **kwargs) -> "ServingRouter":
+        """Build a router from an exported profile.  Every knob is
+        consumed or REFUSED: an unknown key raises instead of
+        silently no-opping (the DistributedStrategy knob contract —
+        a typo'd SLO in a profile must fail deploy, not ship a router
+        that never scales)."""
+        unknown = sorted(set(config) - set(cls.CONFIG_KNOBS))
+        if unknown:
+            raise ValueError(
+                f"unknown router knob(s) {unknown} refused; known "
+                f"knobs: {sorted(cls.CONFIG_KNOBS)}")
+        merged = dict(config)
+        merged.update(kwargs)
+        return cls(replica_factory, **merged)
 
     # -- lifecycle ---------------------------------------------------------
     @property

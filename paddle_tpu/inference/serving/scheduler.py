@@ -120,7 +120,12 @@ class Request:
         self.slot: Optional[int] = None
         self.blocks: List[int] = []     # exclusively-owned pool blocks
         self.prefix_entries: list = []  # PrefixCache refs (shared)
+        # reserved_blocks is admission ACCOUNTING (released at finish,
+        # shrunk by discounted-mode cache inserts); block_budget is the
+        # page-table growth CAP (always the worst case) — the two
+        # coincide only under undiscounted admission
         self.reserved_blocks = 0
+        self.block_budget = 0
         self.lazy_tokens: list = []     # per-step lazy device views
         self.capped = False             # page growth stopped (done-lag)
 
@@ -151,17 +156,27 @@ class Scheduler:
     """FCFS waiting queue with block-budget admission control."""
 
     def __init__(self, allocator, block_size: int, max_queue: int = 64,
-                 max_context: Optional[int] = None):
+                 max_context: Optional[int] = None,
+                 door_need_fn: Optional[Callable] = None):
         self.allocator = allocator
         self.block_size = int(block_size)
         self.max_queue = int(max_queue)
         self.max_context = max_context
+        # the submit-door capacity sanity check: how many blocks this
+        # ENGINE will ever hold for the request.  Default worst case;
+        # a prefill-role engine overrides with prompt-blocks-only —
+        # the decode blocks belong to the importing replica's pool,
+        # so gating its door on max_tokens would refuse long streams
+        # a disaggregated deployment serves fine.
+        self._door_need_fn = door_need_fn
         self._waiting: deque = deque()
         self._lock = threading.Lock()
 
     # -- front door ----------------------------------------------------------
     def submit(self, req: Request) -> Request:
-        need = req.worst_case_blocks(self.block_size)
+        need = (self._door_need_fn(req)
+                if self._door_need_fn is not None
+                else req.worst_case_blocks(self.block_size))
         if need > self.allocator.capacity:
             raise ValueError(
                 f"request needs {need} blocks worst-case but the pool "
@@ -186,23 +201,51 @@ class Scheduler:
             return len(self._waiting)
 
     # -- engine side ---------------------------------------------------------
-    def pop_admissible(self, free_slots: int) -> List[Request]:
+    def pop_admissible(self, free_slots: int,
+                       need_fn: Optional[Callable] = None,
+                       cancel_fn: Optional[Callable] = None
+                       ) -> List[Request]:
         """Admit FCFS-head requests while slots and block reservations
-        allow; reservations are taken here, released at finish."""
+        allow; reservations are taken here, released at finish.
+
+        ``need_fn(req) -> int`` overrides the worst-case reservation —
+        the engine supplies it for phase-specialized replicas (a
+        prefill-role engine reserves prompt blocks only; the decode
+        blocks are the importing replica's to reserve) and for
+        reservation-discounted admission (need minus live prefix-cache
+        hits).  A need_fn may acquire side state (prefix references);
+        ``cancel_fn(req)`` releases it when the reservation is refused
+        and the request stays queued.  ``block_budget`` is always the
+        undiscounted worst case: the growth cap is about table extent,
+        not about who accounts for the blocks."""
         admitted: List[Request] = []
         now = time.monotonic()
         with self._lock:
             while free_slots > 0 and self._waiting:
                 req = self._waiting[0]
-                need = req.worst_case_blocks(self.block_size)
+                need = (need_fn(req) if need_fn is not None
+                        else req.worst_case_blocks(self.block_size))
                 if not self.allocator.reserve(need):
+                    if cancel_fn is not None:
+                        cancel_fn(req)
                     break           # strict FCFS: no head-of-line skip
                 self._waiting.popleft()
                 req.reserved_blocks = need
+                req.block_budget = req.worst_case_blocks(self.block_size)
                 req.stats.admitted = now
                 admitted.append(req)
                 free_slots -= 1
         return admitted
+
+    def release_partial(self, req: Request, n: int):
+        """Shrink a live request's reservation by ``n`` blocks
+        (discounted-admission mode: blocks whose ownership moved to
+        the prefix cache are accounted by the allocator pin from that
+        moment, so keeping them reserved would double-count)."""
+        n = min(int(n), req.reserved_blocks)
+        if n > 0:
+            self.allocator.release(n)
+            req.reserved_blocks -= n
 
     def drain_waiting(self) -> List[Request]:
         """Remove and return EVERY waiting request unconditionally

@@ -63,6 +63,14 @@ HOT_MODULES = [
     os.path.join("inference", "serving", "paged_attention_kernel.py"),
     os.path.join("inference", "serving", "sampling.py"),
     os.path.join("inference", "serving", "prefix_cache.py"),
+    # disaggregated tier (DESIGN-SERVING.md §Disaggregated tier):
+    # page migration is a jitted device-to-device gather/scatter cut
+    # and imported ON the pump threads — the ticket itself is host
+    # bookkeeping and must stay that way (reading migrated K/V on the
+    # host would stall both replicas' dispatch queues at once); the
+    # disagg router runs its transition hook on prefill pump threads
+    os.path.join("inference", "serving", "migration.py"),
+    os.path.join("inference", "serving", "disagg.py"),
     # observability subsystem (DESIGN-OBSERVABILITY.md): it lives
     # INSIDE every hot loop above, so it is held to the same contract
     # — instruments hold lazy device values and defer the sync to
@@ -151,9 +159,10 @@ ALLOWED_SYNC = {
         "THE group-boundary sync of the decode loop: one [B] bool "
         "done-mask fetch every done_poll_interval dispatches, never "
         "inside one (DESIGN-SERVING.md §EOS)",
-    ("inference", "serving", "engine.py", "warmup"):
+    ("inference", "serving", "engine.py", "_warmup"):
         "AOT compile timing before traffic cuts over — blocking on "
-        "device completion is the point (cold-start metric)",
+        "device completion is the point (cold-start metric; `warmup` "
+        "wraps this body in the engine's device-placement scope)",
 }
 
 

@@ -1,0 +1,562 @@
+"""Disaggregated prefill/decode serving (DESIGN-SERVING.md
+§Disaggregated tier).
+
+The acceptance pins of ISSUE 16:
+
+- page migration is a faithful transfer: export/import/remap preserve
+  refcounts and prefix chains, tickets are single-use, pools don't
+  leak;
+- a disaggregated deployment's output is TOKEN-IDENTICAL to the
+  single-engine oracle (greedy and seeded sampling) — sampling keys
+  are pure (seed, position) functions, so the handoff must carry
+  pages + length + token + resolved seed and nothing else;
+- the decode replica's zero-recompile contract survives migration
+  admission (decode_traces == 1) and the prefill replica never traces
+  decode at all;
+- the router transitions are first-class: prefill death re-admits
+  from the prompt, a full decode target fails over to the
+  next-least-loaded, phase knobs round-trip and refuse what a replica
+  can't honor.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.inference.serving import (
+    BlockAllocator, DecodeEngine, DisaggRouter, LLMServer,
+    MigrationError, Overloaded, PageMigration, PrefixCache, QueueFull,
+    ServingModelConfig, ServingRouter, extract_decode_params,
+    reference_decode)
+
+
+@pytest.fixture(scope="module")
+def tiny_net():
+    paddle.seed(0)
+    cfg = gpt_tiny(use_flash_attention=False)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    return net, cfg
+
+
+@pytest.fixture(scope="module")
+def oracle(tiny_net):
+    net, cfg = tiny_net
+    params = extract_decode_params(net)
+    scfg = ServingModelConfig.from_gpt_config(cfg)
+
+    def ref(prompt, n, **kw):
+        toks, _ = reference_decode(params, scfg, prompt, n, **kw)
+        return [int(t) for t in toks]
+    return ref
+
+
+def _drain(eng, max_steps=500):
+    for _ in range(max_steps):
+        busy = eng.step()
+        if not busy and eng.active_count == 0 \
+                and eng.pending_migrations == 0:
+            return
+    raise AssertionError("engine did not drain")
+
+
+def _handoff_all(pre, dec, max_steps=500):
+    """Direct-drive a prefill engine until every staged ticket has
+    been delivered to the decode engine."""
+    for _ in range(max_steps):
+        busy = pre.step()
+        for mig in pre.pop_ready_migrations():
+            dec.submit_migration(mig)
+        if not busy:
+            return
+    raise AssertionError("prefill engine did not drain")
+
+
+# ---------------------------------------------------------------------------
+# migration unit lifecycle
+# ---------------------------------------------------------------------------
+def test_allocator_export_import_accounting():
+    a = BlockAllocator(9)              # capacity 8 (block 0 scratch)
+    got = a.allocate(3)
+    assert a.export_blocks(got) == 3
+    assert a.exported_blocks == 3 and a.num_free == 8
+    with pytest.raises(ValueError):
+        a.export_blocks(got)           # double export = double free
+    imp = a.import_blocks(2)
+    assert len(imp) == 2 and a.imported_blocks == 2
+    st = a.stats()
+    assert st["exported_blocks"] == 3 and st["imported_blocks"] == 2
+
+
+def test_pinned_blocks_tighten_the_admission_envelope():
+    """The reservation-discount envelope: pinned (live-referenced
+    cache) blocks count against reservations even though no
+    reservation covers them — without this, two discounted admissions
+    can jointly out-demand the pool mid-decode (the eviction-failure
+    story in DESIGN-SERVING.md)."""
+    a = BlockAllocator(11)             # capacity 10
+    assert a.reserve(6)
+    a.pin(3)
+    assert not a.can_reserve(2)        # 6 + 3 + 2 > 10
+    assert a.can_reserve(1)
+    a.unpin(3)
+    assert a.can_reserve(4)
+    with pytest.raises(AssertionError):
+        a.unpin(1)
+
+
+def test_prefix_cache_pin_referenced_mode():
+    a = BlockAllocator(17)
+    pc = PrefixCache(a, block_size=4, pin_referenced=True)
+    prompt = list(range(13))           # 3 shareable blocks
+    blocks = a.allocate(3)
+    entries, _ = pc.insert(prompt, 0, b"", blocks)
+    assert a.pinned == 3               # refs 0→1 pinned each
+    got, _ = pc.match(prompt)
+    assert len(got) == 3 and a.pinned == 3   # refs 1→2: no re-pin
+    pc.release(got)
+    assert a.pinned == 3
+    pc.release(entries)
+    assert a.pinned == 0               # refs 1→0 unpins
+
+
+def test_migration_ticket_single_use_and_geometry(tiny_net):
+    net, _ = tiny_net
+    eng = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=32)
+    kvc = eng._kv
+    good = {"num_layers": kvc.num_layers, "block_size": kvc.block_size,
+            "num_heads": kvc.num_heads, "head_dim": kvc.head_dim,
+            "dtype": str(kvc.pool.dtype)}
+
+    class _Req:
+        id, prompt, max_tokens = 1, [1, 2, 3], 4
+    mig = PageMigration(_Req(), kv=None, nb=1, token=None, t_start=0.0,
+                       geometry=dict(good, block_size=16))
+    with pytest.raises(MigrationError):
+        mig.check_geometry(eng)
+    mig2 = PageMigration(_Req(), kv=None, nb=1, token=None,
+                         t_start=0.0, geometry=good)
+    mig2.check_geometry(eng)           # identical geometry passes
+    mig2.consume()
+    with pytest.raises(MigrationError):
+        mig2.consume()                 # single-use
+
+
+def test_role_contract(tiny_net):
+    net, _ = tiny_net
+    with pytest.raises(ValueError):
+        DecodeEngine(net, role="training")
+    with pytest.raises(ValueError):
+        # discount knob with nothing to discount against must refuse
+        DecodeEngine(net, prefix_reserve_discount=True,
+                     prefix_cache=False)
+    dec = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=32,
+                       role="decode")
+    with pytest.raises(ValueError):
+        dec.submit([1, 2, 3], max_tokens=4)
+    pre = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=32,
+                       role="prefill")
+    with pytest.raises(MigrationError):
+        pre.submit_migration(PageMigration(
+            object(), None, 0, None, 0.0, {}))
+
+
+# ---------------------------------------------------------------------------
+# handoff token-exactness vs the single-engine oracle
+# ---------------------------------------------------------------------------
+def test_handoff_token_exact_and_no_leaks(tiny_net, oracle):
+    net, cfg = tiny_net
+    pre = DecodeEngine(net, max_batch=4, block_size=8, num_blocks=64,
+                       role="prefill", prefix_cache=False)
+    dec = DecodeEngine(net, max_batch=4, block_size=8, num_blocks=64,
+                       role="decode", prefix_cache=False)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 11, 3)]
+    reqs = [pre.submit(p, max_tokens=10) for p in prompts]
+    sreq = pre.submit(prompts[1], max_tokens=10, temperature=0.8,
+                      top_k=7, seed=99)
+    _handoff_all(pre, dec)
+    _drain(dec)
+    for p, r in zip(prompts, reqs):
+        assert r.future.result(timeout=5).tokens == oracle(p, 10)
+    assert sreq.future.result(timeout=5).tokens == oracle(
+        prompts[1], 10, temperature=0.8, top_k=7, seed=99)
+    # phase contract: the decode program compiled exactly once on the
+    # decode replica and NEVER on the prefill replica
+    assert dec.compile_stats()["decode_traces"] == 1
+    assert pre.compile_stats()["decode_traces"] == 0
+    assert pre.compile_stats()["prefill_traces"] > 0
+    # faithful transfer: both pools drain back to empty — no leaked
+    # blocks, reservations, or pins on either side
+    for eng in (pre, dec):
+        st = eng._kv.allocator.stats()
+        assert st["free"] == st["capacity"]
+        assert st["reserved"] == 0 and st["pinned"] == 0
+    assert pre._kv.allocator.exported_blocks == \
+        dec._kv.allocator.imported_blocks > 0
+    # migration instruments tick on the IMPORTING engine only
+    assert int(dec._c_migrations.collect(materialize=False)) == 4
+    assert int(pre._c_migrations.collect(materialize=False)) == 0
+    assert dec._h_migration.collect()["count"] == 4
+
+
+def test_handoff_across_pinned_host_devices(tiny_net, oracle):
+    """The disaggregated deployment story: each phase replica pinned
+    to its OWN device (conftest fakes 8 host devices), so the two
+    engines stop sharing a device execution queue.  The migration
+    ticket's arrays are committed on the exporter's device and must
+    cross explicitly at import — the handoff stays token-exact."""
+    import jax
+    devs = jax.devices()
+    if len(devs) < 3:
+        pytest.skip("needs xla_force_host_platform_device_count >= 3")
+    net, cfg = tiny_net
+    pre = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="prefill", prefix_cache=False,
+                       device=devs[1])
+    dec = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="decode", prefix_cache=False,
+                       device=devs[2])
+    assert pre._kv.pool.devices() == {devs[1]}
+    assert dec._kv.pool.devices() == {devs[2]}
+    rng = np.random.RandomState(11)
+    p = rng.randint(0, cfg.vocab_size, (9,)).tolist()
+    r1 = pre.submit(p, max_tokens=8)
+    r2 = pre.submit(p, max_tokens=8, temperature=0.7, top_k=5,
+                    seed=123)
+    _handoff_all(pre, dec)
+    _drain(dec)
+    assert r1.future.result(timeout=5).tokens == oracle(p, 8)
+    assert r2.future.result(timeout=5).tokens == oracle(
+        p, 8, temperature=0.7, top_k=5, seed=123)
+    # the pool never left its pinned device across import + decode
+    assert dec._kv.pool.devices() == {devs[2]}
+    assert dec.compile_stats()["decode_traces"] == 1
+
+
+def test_prefix_chains_preserved_across_migration(tiny_net, oracle):
+    """Shared-prefix blocks survive on the EXPORTING engine (cached,
+    idle, warm — the next same-prefix prompt still hits) and the
+    imported copy re-registers on the importing engine's cache."""
+    net, cfg = tiny_net
+    pre = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="prefill", prefix_cache=True,
+                       prefill_chunk=8)
+    dec = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="decode", prefix_cache=True)
+    rng = np.random.RandomState(3)
+    shared = rng.randint(0, cfg.vocab_size, (16,)).tolist()
+    p1 = shared + rng.randint(0, cfg.vocab_size, (5,)).tolist()
+    p2 = shared + rng.randint(0, cfg.vocab_size, (3,)).tolist()
+    r1 = pre.submit(p1, max_tokens=6)
+    _handoff_all(pre, dec)
+    hits0 = pre._prefix.hits
+    r2 = pre.submit(p2, max_tokens=6)
+    _handoff_all(pre, dec)
+    _drain(dec)
+    assert r1.future.result(timeout=5).tokens == oracle(p1, 6)
+    assert r2.future.result(timeout=5).tokens == oracle(p2, 6)
+    # the second prompt hit the chain the first one left behind
+    assert pre._prefix.hits > hits0
+    # exporting released the refs without evicting the chain
+    assert pre._prefix.cached_blocks > 0
+    assert pre._prefix.live_refs == 0
+    # the importer registered the migrated full-prompt blocks
+    assert dec._prefix.cached_blocks > 0
+    assert dec._prefix.live_refs == 0
+
+
+def test_double_import_refused_at_the_engine_door(tiny_net):
+    net, cfg = tiny_net
+    pre = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=32,
+                       role="prefill")
+    dec = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=32,
+                       role="decode")
+    req = pre.submit([1, 2, 3, 4, 5], max_tokens=4)
+    migs = []
+    for _ in range(50):
+        pre.step()
+        migs += pre.pop_ready_migrations()
+        if migs:
+            break
+    assert len(migs) == 1
+    mig = migs[0]
+    dec.submit_migration(mig)
+    _drain(dec)
+    assert req.future.result(timeout=5) is not None
+    with pytest.raises(MigrationError):
+        dec.submit_migration(mig)      # consumed ticket refused
+
+
+# ---------------------------------------------------------------------------
+# reservation discount (opt-in knob)
+# ---------------------------------------------------------------------------
+def test_reserve_discount_admits_shared_prompts_exactly(tiny_net,
+                                                        oracle):
+    """Discounted admission: a request whose prefix is live in cache
+    reserves worst-case MINUS the hit depth, the pinned envelope
+    keeps the no-OOM invariant, and output stays oracle-exact."""
+    net, cfg = tiny_net
+    eng = DecodeEngine(net, max_batch=4, block_size=8, num_blocks=64,
+                       prefix_cache=True, prefill_chunk=8,
+                       prefix_reserve_discount=True)
+    rng = np.random.RandomState(5)
+    shared = rng.randint(0, cfg.vocab_size, (24,)).tolist()
+    p1 = shared + [7]
+    p2 = shared + [11]
+    r1 = eng.submit(p1, max_tokens=6)
+    eng.run_until_idle()               # p1 populates the chain
+    r2 = eng.submit(p2, max_tokens=6)
+    # drive admission, then inspect the live reservation
+    for _ in range(5):
+        eng.step()
+        if r2.reserved_blocks:
+            break
+    worst = r2.worst_case_blocks(eng.block_size)
+    assert r2.reserved_blocks < worst          # discounted
+    assert r2.block_budget == worst            # growth cap undimmed
+    assert eng._kv.allocator.pinned > 0        # hits pinned
+    eng.run_until_idle()
+    assert r1.future.result(timeout=5).tokens == oracle(p1, 6)
+    assert r2.future.result(timeout=5).tokens == oracle(p2, 6)
+    st = eng._kv.allocator.stats()
+    assert st["reserved"] == 0 and st["pinned"] == 0
+
+
+def test_reserve_discount_envelope_refuses_overdemand():
+    """The eviction-failure story, distilled: naive discounting
+    (reserved <= capacity, hits uncounted) would admit a combination
+    whose occupancy exceeds the pool mid-decode; the pinned envelope
+    refuses it at the door.  Capacity 10: A holds 4 pinned cache
+    blocks and a discounted reservation of 2; C wants 5 un-discounted
+    — naive math says 2+5 <= 10 fits, the envelope (2+4+5 > 10) says
+    no, because A's pinned blocks are occupied and un-evictable."""
+    a = BlockAllocator(11)             # capacity 10
+    assert a.reserve(2)                # A: worst 6, hits 4 → 2
+    a.pin(4)                           # A's live-referenced hits
+    assert a.reserved + 5 <= a.capacity          # naive check passes
+    assert not a.reserve(5)            # envelope refuses C
+    assert a.reserve(4)                # right-sized C admits
+
+
+# ---------------------------------------------------------------------------
+# router: phase knobs, failover, round-trip
+# ---------------------------------------------------------------------------
+class _StubEngine:
+    def __init__(self):
+        from paddle_tpu.observability import metrics as m
+        self.scheduler = type("S", (), {"queue_depth": 0})()
+        self.active_count = 0
+        self.pending_migrations = 0
+        self._h_latency = m.registry().histogram(
+            "serving_latency_s", labels={"engine": "stub"})
+        self._h_intertoken = m.registry().histogram(
+            "serving_intertoken_s", labels={"engine": "stub"})
+
+
+class _StubServer:
+    def __init__(self, role="both"):
+        self.role = role
+        self.running = True
+        self.engine = _StubEngine()
+        self.closed = False
+
+    def close(self, unregister_metrics=False):
+        self.closed = True
+        self.running = False
+
+
+def test_router_phase_refuses_wrong_role_replicas():
+    built = []
+
+    def factory():
+        s = _StubServer(role="both")
+        built.append(s)
+        return s
+    with pytest.raises(ValueError, match="refused"):
+        ServingRouter(factory, phase="decode", decision_interval_s=0)
+    assert built and built[0].closed   # refused replica reclaimed
+    with pytest.raises(ValueError):
+        ServingRouter(factory, phase="training",
+                      decision_interval_s=0)
+    r = ServingRouter(lambda: _StubServer("prefill"), phase="prefill",
+                      decision_interval_s=0)
+    assert r.num_replicas == 1
+    r.close()
+
+
+def test_router_config_round_trip_refuses_unknown_knobs():
+    r = ServingRouter(lambda: _StubServer("decode"), phase="decode",
+                      min_replicas=1, max_replicas=3, slo_p99_s=0.25,
+                      decision_interval_s=0)
+    cfg = r.to_config()
+    assert cfg["phase"] == "decode" and cfg["slo_p99_s"] == 0.25
+    r.close()
+    r2 = ServingRouter.from_config(
+        cfg, lambda: _StubServer("decode"), decision_interval_s=0)
+    assert r2.to_config()["slo_p99_s"] == 0.25
+    assert r2.to_config()["phase"] == "decode"
+    r2.close()
+    with pytest.raises(ValueError, match="refused"):
+        ServingRouter.from_config(
+            dict(cfg, slo_p99=0.25),   # typo'd knob must fail loudly
+            lambda: _StubServer("decode"))
+
+
+def test_decode_phase_router_refuses_prompts():
+    r = ServingRouter(lambda: _StubServer("decode"), phase="decode",
+                      decision_interval_s=0)
+    with pytest.raises(ValueError, match="submit_migration"):
+        r.submit([1, 2], max_tokens=2)
+    r.close()
+
+
+def test_decode_full_fails_over_to_next_replica(tiny_net, oracle):
+    """ISSUE-16 failover: decode target full → next-least-loaded.
+    Two single-slot decode replicas; two concurrent migrations must
+    land one on each (the first replica's batch+inbox is full when
+    the second ticket arrives)."""
+    net, cfg = tiny_net
+    pre = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="prefill")
+    decs = [DecodeEngine(net, max_batch=1, block_size=8,
+                         num_blocks=32, role="decode")
+            for _ in range(2)]
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, cfg.vocab_size, (6,)).tolist()
+               for _ in range(2)]
+    reqs = [pre.submit(p, max_tokens=8) for p in prompts]
+    for _ in range(100):
+        busy = pre.step()
+        for mig in pre.pop_ready_migrations():
+            try:
+                decs[0].submit_migration(mig)
+            except QueueFull:
+                decs[1].submit_migration(mig)      # failover
+        if not busy:
+            break
+    for d in decs:
+        _drain(d)
+    for p, r in zip(prompts, reqs):
+        assert r.future.result(timeout=5).tokens == oracle(p, 8)
+    assert all(d._kv.allocator.imported_blocks > 0 for d in decs)
+
+
+def test_disagg_prefill_death_readmits_from_prompt(tiny_net, oracle):
+    """ISSUE-16 failover: a prefill replica dying mid-prompt fails
+    its engine futures; the DisaggRouter re-admits every lost prompt
+    on surviving prefill capacity and the client future still
+    resolves with oracle-exact tokens."""
+    net, cfg = tiny_net
+
+    def pre_factory():
+        return LLMServer(net, max_batch=2, block_size=8,
+                         num_blocks=64, role="prefill",
+                         prefill_chunk=8)
+
+    def dec_factory():
+        return LLMServer(net, max_batch=4, block_size=8,
+                         num_blocks=64, role="decode")
+    router = DisaggRouter(
+        pre_factory, dec_factory,
+        prefill_pool={"min_replicas": 2, "max_replicas": 2,
+                      "decision_interval_s": 0},
+        decode_pool={"decision_interval_s": 0})
+    try:
+        rng = np.random.RandomState(11)
+        prompts = [rng.randint(0, cfg.vocab_size, (40,)).tolist()
+                   for _ in range(4)]
+        futs = [router.submit(p, max_tokens=6) for p in prompts]
+        sfut = router.submit(prompts[0], max_tokens=6,
+                             temperature=0.7, top_k=5)
+        # kill one prefill replica out from under the router: its
+        # queued/mid-prefill requests fail → tracker re-admits them
+        victim = router.prefill.replicas[0]
+        victim.close()
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=60).tokens == oracle(p, 6)
+        # the auto-seeded sampled request survives the failover too
+        # (its seed was resolved at the disagg door, so re-admission
+        # cannot silently change the sampled sequence)
+        assert len(sfut.result(timeout=60).tokens) == 6
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# server-level handoff plumbing
+# ---------------------------------------------------------------------------
+def test_server_parks_handoffs_without_hook(tiny_net, oracle):
+    net, cfg = tiny_net
+    pre = LLMServer(net, max_batch=2, block_size=8, num_blocks=32,
+                    role="prefill", auto_start=True)
+    dec = LLMServer(net, max_batch=2, block_size=8, num_blocks=32,
+                    role="decode", auto_start=True)
+    try:
+        p = [3, 1, 4, 1, 5]
+        fut = pre.submit(p, max_tokens=5)
+        deadline = time.monotonic() + 30
+        migs = []
+        while not migs and time.monotonic() < deadline:
+            migs = pre.pop_handoffs()
+            time.sleep(0.01)
+        assert len(migs) == 1
+        dec.submit_migration(migs[0])
+        assert fut.result(timeout=30).tokens == oracle(p, 5)
+    finally:
+        pre.close()
+        dec.close()
+
+
+# ---------------------------------------------------------------------------
+# mixed-load e2e (slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+def test_disagg_mixed_load_e2e(tiny_net, oracle):
+    """Mixed long/short traffic through the full disaggregated
+    pipeline: every output oracle-exact, decode program compiled
+    once, both pools drained clean."""
+    net, cfg = tiny_net
+
+    def pre_factory():
+        return LLMServer(net, max_batch=4, block_size=8,
+                         num_blocks=128, role="prefill",
+                         prefill_chunk=16, prefix_cache=True)
+
+    def dec_factory():
+        return LLMServer(net, max_batch=4, block_size=8,
+                         num_blocks=128, role="decode",
+                         prefix_cache=True)
+    router = DisaggRouter(
+        pre_factory, dec_factory,
+        prefill_pool={"decision_interval_s": 0},
+        decode_pool={"decision_interval_s": 0})
+    try:
+        rng = np.random.RandomState(13)
+        lengths = [5, 48, 9, 120, 17, 64, 3, 33, 80, 12]
+        prompts = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+                   for n in lengths]
+        futs, want = [], []
+        for i, p in enumerate(prompts):
+            if i % 3 == 2:
+                futs.append(router.submit(
+                    p, max_tokens=8, temperature=0.9, top_k=9,
+                    seed=1000 + i))
+                want.append(oracle(p, 8, temperature=0.9, top_k=9,
+                                   seed=1000 + i))
+            else:
+                futs.append(router.submit(p, max_tokens=8))
+                want.append(oracle(p, 8))
+        for f, w in zip(futs, want):
+            assert f.result(timeout=120).tokens == w
+        dec_server = router.decode.replicas[0]
+        assert dec_server.engine.compile_stats()["decode_traces"] == 1
+        st = dec_server.engine._kv.allocator.stats()
+        assert st["reserved"] == 0 and st["pinned"] == 0
+        assert router.pending_handoffs == 0
+    finally:
+        router.close()
