@@ -189,7 +189,8 @@ def bench_gpt():
         # O2: bf16 params + fp32 master weights in the optimizer
         amp.decorate(net, opt, level="O2", dtype="bfloat16")
         crit = GPTPretrainingCriterion()
-        if os.environ.get("PADDLE_TPU_FUSED_LMCE"):
+        from paddle_tpu.framework import env_knobs
+        if env_knobs.get_raw("PADDLE_TPU_FUSED_LMCE"):
             # A/B knob: fold the lm-head matmul into the Pallas
             # streaming-CE kernel (logits never hit HBM); enable by
             # default once hardware numbers confirm the win

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 import jax
 
+from ...framework import env_knobs
 from ...tensor import Tensor
 from ...observability import metrics as _obs_metrics
 from ...observability import trace as _obs_trace
@@ -57,8 +58,8 @@ def _digest_policy():
     """(chunk_bytes | None, sample_chunks): ``None`` chunk size means
     chunking is disabled (every file takes the legacy whole-file
     digest) — both env knobs treat 0/negative as "off"."""
-    chunk_mb = float(os.environ.get(_DIGEST_CHUNK_ENV, "64") or 64)
-    sample = int(os.environ.get(_DIGEST_SAMPLE_ENV, "0") or 0)
+    chunk_mb = env_knobs.get_float(_DIGEST_CHUNK_ENV, 64.0)
+    sample = env_knobs.get_int(_DIGEST_SAMPLE_ENV, 0)
     chunk_bytes = max(1, int(chunk_mb * (1 << 20))) if chunk_mb > 0 \
         else None
     return chunk_bytes, max(0, sample)

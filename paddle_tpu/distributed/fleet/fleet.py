@@ -12,6 +12,46 @@ from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
                             _get_hybrid_parallel_group)
 from ..parallel import ParallelEnv, init_parallel_env
 
+# Strategy knobs this port REFUSES rather than consumes (the PR-11
+# contract: every DistributedStrategy knob is consumed or refused,
+# never silently dropped).  ``distributed_runner`` raises when any of
+# these differs from its default; the reasons double as the error
+# message and as the knob-consumption lint's refusal ledger.
+_REFUSED_STRATEGY_KNOBS = {
+    "a_sync": "PS-era async SGD; parameter server is a documented "
+              "non-goal (SURVEY.md §2.1)",
+    "a_sync_configs": "PS-era async SGD tuning; see a_sync",
+    "dgc": "deep gradient compression targets NCCL rings; the dp "
+           "compressor here is quantized_allreduce (DESIGN-DCN.md)",
+    "find_unused_parameters": "DDP dynamic-graph pruning; jit "
+                              "whole-program autodiff has no unused-"
+                              "parameter hazard",
+    "fuse_all_reduce_ops": "XLA fuses and schedules collectives "
+                           "itself; manual fusion knobs do not apply",
+    "fuse_grad_merge": "gradient-merge accumulation is already fused "
+                       "inside the compiled step",
+    "fuse_grad_size_in_MB": "XLA collective fusion is not "
+                            "size-threshold driven",
+    "heter_ccl_mode": "heterogeneous PS communication; PS is a "
+                      "non-goal",
+    "lamb": "optimizer selection lives on the optimizer object passed "
+            "to distributed_runner, not on the strategy",
+    "lamb_configs": "see lamb",
+    "localsgd": "periodic local-SGD sync is not implemented; dp "
+                "gradients sync every step",
+    "nccl_comm_num": "NCCL channel tuning; XLA manages its own "
+                     "collective channels",
+    "recompute_configs": "checkpoint selection is not honored — "
+                         "s.recompute rematerializes the whole "
+                         "microbatch loss via jax.checkpoint",
+    "tensor_parallel": "mp parallelism is selected by "
+                       "hybrid_configs[mp_degree] / the mesh axes, "
+                       "not this flag",
+    "without_graph_optimization": "XLA always optimizes the program; "
+                                  "there is no pass-through graph "
+                                  "mode",
+}
+
 
 class Fleet:
     def __init__(self):
@@ -120,6 +160,22 @@ class Fleet:
         from ..runner import DistributedRunner, PipelinedRunner
         from .. import collective as coll
         s = self._strategy or DistributedStrategy()
+        # refuse — never silently drop — knobs with no XLA analog.
+        # Deliberately compared through to_dict() (plain dict access),
+        # not getattr chains: the defaults object is the single source
+        # of truth for "unchanged", including the *_configs dict-merge
+        # semantics of DistributedStrategy.__setattr__.
+        current = s.to_dict()
+        defaults = DistributedStrategy().to_dict()
+        refused = {k: current.get(k) for k in _REFUSED_STRATEGY_KNOBS
+                   if current.get(k) != defaults.get(k)}
+        if refused:
+            reasons = "; ".join(
+                f"{k}={refused[k]!r} ({_REFUSED_STRATEGY_KNOBS[k]})"
+                for k in sorted(refused))
+            raise ValueError(
+                "DistributedStrategy knobs this port refuses (set "
+                "only defaults for them): " + reasons)
         stage = int(s.sharding_configs.get("stage", 1)) if s.sharding \
             else 0
         acc = 1

@@ -57,6 +57,7 @@ from jax import lax
 
 from jax.sharding import PartitionSpec as P
 
+from ....framework import env_knobs
 from ....tensor import Tensor
 from ....nn import functional_call as F
 from ....io.staging import to_device_value, stack_to_device
@@ -77,7 +78,8 @@ _PP_UNROLL_ENV = "PADDLE_TPU_PP_UNROLL_TICKS"
 
 
 def _resolve_dispatch_mode(cfg_value) -> str:
-    env = os.environ.get(_PP_DISPATCH_ENV, "").strip().lower()
+    env = (env_knobs.get_raw(_PP_DISPATCH_ENV, "")
+           or "").strip().lower()
     mode = env or (cfg_value or "auto")
     mode = str(mode).strip().lower()
     if mode == "auto":
@@ -401,7 +403,8 @@ class PipelineParallel:
         scheduler the whole schedule to overlap; numerics are the same
         ops in the same order.  Env knob wins for debugging.
         """
-        env = os.environ.get(_PP_UNROLL_ENV, "").strip().lower()
+        env = (env_knobs.get_raw(_PP_UNROLL_ENV, "")
+               or "").strip().lower()
         cfg = self._unroll_cfg
         if env in ("1", "true", "yes"):
             return True
@@ -839,10 +842,16 @@ class PipelineParallel:
         return per_step
 
     # -- compiled entries ----------------------------------------------------
-    def _build_step(self, capture: bool = False):
+    def _build_step(self, capture: bool = False,
+                    donate_carry: bool = True):
         """The legacy per-batch entry — the parity reference: one jit
         per train batch, PRNG key drawn host-side, numerically the
-        pre-unification program."""
+        pre-unification program.  ``donate_carry`` is the one opt-out
+        switch for (params, opt_state) donation: the pp schedule's
+        collectives are jit-level (psum through the partitioner, not
+        shard_map manual collectives), so donation is safe here, but
+        the decision stays on a knob like every shard_map-adjacent
+        engine (DESIGN-DCN.md donation caveat)."""
         per_step = self._step_math(capture=capture)
 
         def step(params, frozen, buffers, opt_state, lr, key, x, y):
@@ -852,7 +861,8 @@ class PipelineParallel:
                 return loss, out_vals, new_p, new_s, new_bufs
             return loss, new_p, new_s, new_bufs
 
-        return jax.jit(step, donate_argnums=(0, 3))
+        return jax.jit(step,
+                       donate_argnums=(0, 3) if donate_carry else ())
 
     def _build_fold(self, fold: int, metric_fns):
         """The unified entry: the SAME schedule body wrapped by the
@@ -874,7 +884,12 @@ class PipelineParallel:
             return loss, mstats, new_p, new_st, new_buf
 
         from ....framework.dispatch import build_folded_step
-        return build_folded_step(per_step, fold, donate_buffers=False)
+        # explicit donate_carry: the fold scan's carry donation is
+        # safe on pp meshes (jit-level collectives, no shard_map
+        # manual aliases), but the opt-in is spelled out so the
+        # DESIGN-DCN.md caveat has one visible switch per engine
+        return build_folded_step(per_step, fold, donate_buffers=False,
+                                 donate_carry=True)
 
     # -- commit / wrapper sync -----------------------------------------------
     def _commit_dicts(self, new_p, new_s, new_bufs, steps: int,

@@ -106,6 +106,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ...framework import env_knobs
 from ...observability import aggregate as _obs_aggregate
 from ...observability import events as _obs_events
 from ...observability import http as _obs_http
@@ -161,19 +162,13 @@ class RankController:
         # distributed observability plane: BASE for the controller,
         # BASE+1+r per rank (see module docstring).  0 = disarmed.
         if not metrics_port:
-            try:
-                metrics_port = int(os.environ.get(
-                    "PADDLE_TPU_METRICS_PORT", "0") or 0)
-            except ValueError:
-                metrics_port = 0
+            metrics_port = env_knobs.get_int(
+                "PADDLE_TPU_METRICS_PORT", 0)
         self.metrics_base = max(int(metrics_port), 0)
         self.scrape_interval = float(scrape_interval)
         if straggler_factor is None:
-            try:
-                straggler_factor = float(os.environ.get(
-                    "PADDLE_TPU_STRAGGLER_FACTOR", "2.0") or 2.0)
-            except ValueError:
-                straggler_factor = 2.0
+            straggler_factor = env_knobs.get_float(
+                "PADDLE_TPU_STRAGGLER_FACTOR", 2.0)
         self.straggler = _obs_aggregate.StragglerDetector(
             factor=straggler_factor,
             window_s=max(10.0, 4 * self.beacon_timeout))
@@ -184,11 +179,8 @@ class RankController:
         # default — a control loop that kills ranks is an explicit
         # ask).  Env mirrors the flag like the straggler factor.
         if not drain_stragglers:
-            try:
-                drain_stragglers = int(os.environ.get(
-                    "PADDLE_TPU_DRAIN_STRAGGLERS", "0") or 0)
-            except ValueError:
-                drain_stragglers = 0
+            drain_stragglers = env_knobs.get_int(
+                "PADDLE_TPU_DRAIN_STRAGGLERS", 0)
         self.drain_windows = max(int(drain_stragglers), 0)
         self._straggler_streak: Dict[int, int] = {}
         self._drain_skip_logged: set = set()

@@ -39,6 +39,7 @@ from .fleet.meta_parallel.sharding_parallel import shard_spec_for
 from .resilience import elastic_rank as _elastic
 from .resilience import faults as _faults
 from .resilience import watchdog as _watchdog
+from ..framework import env_knobs
 from ..observability import metrics as _obs_metrics
 from ..observability import trace as _obs_trace
 
@@ -75,7 +76,8 @@ _DP_SHARD_ENV = "PADDLE_TPU_DP_SHARD_UPDATE"
 
 def _resolve_dp_knobs(dp_compress_bits, dp_shard_update):
     """(bits, shard_update) after env overrides — bits ∈ {0, 8, 16}."""
-    env_bits = os.environ.get(_DP_COMPRESS_ENV, "").strip().lower()
+    env_bits = (env_knobs.get_raw(_DP_COMPRESS_ENV, "")
+                or "").strip().lower()
     if env_bits:
         dp_compress_bits = {"0": 0, "off": 0, "none": 0,
                             "8": 8, "int8": 8,
@@ -89,7 +91,8 @@ def _resolve_dp_knobs(dp_compress_bits, dp_shard_update):
             f"dp_compress_bits / DistributedStrategy.quantized_allreduce"
             f" must be 0 (off), 8 (int8 ring) or 16 (exact ring), got "
             f"{dp_compress_bits!r}")
-    env_sh = os.environ.get(_DP_SHARD_ENV, "").strip().lower()
+    env_sh = (env_knobs.get_raw(_DP_SHARD_ENV, "")
+              or "").strip().lower()
     if env_sh:
         if env_sh not in ("0", "1", "true", "false"):
             raise ValueError(
@@ -767,7 +770,7 @@ class DistributedRunner:
         ``PADDLE_TPU_DP_DONATE=1`` opt-in (see _build)."""
         if not self._dp_explicit:
             return True
-        return os.environ.get("PADDLE_TPU_DP_DONATE", "") == "1"
+        return env_knobs.get_raw("PADDLE_TPU_DP_DONATE", "") == "1"
 
     def _build(self):
         runner = self
@@ -1212,7 +1215,7 @@ class DistributedRunner:
                         payload = out._value
             return payload, holder.get("buffers", {})
 
-        return jax.jit(run, donate_argnums=(2,))
+        return jax.jit(run, donate_argnums=(2,))  # lint: allow(donation-safety): eval forward never enters the explicit-dp shard_map collectives — the donated buffers alias a plain SPMD program only, outside the DESIGN-DCN.md corruption mode
 
     def _eval_values(self):
         if not self._placed:

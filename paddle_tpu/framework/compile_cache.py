@@ -72,7 +72,8 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
 def enable_from_env() -> Optional[str]:
     """Honor ``PADDLE_TPU_COMPILE_CACHE`` if set (see module doc).
     Returns the active dir, or None when the knob is off."""
-    val = os.environ.get(ENV_VAR, "").strip()
+    from . import env_knobs
+    val = (env_knobs.get_raw(ENV_VAR, "") or "").strip()
     if not val or val == "0":
         return _active_dir
     return enable_compilation_cache(None if val == "1" else val)

@@ -36,12 +36,14 @@ Knobs (all optional):
 
 from __future__ import annotations
 
+import functools
 import logging
 import math
 import os
 import time
 from typing import Any, Callable, List, Optional, Tuple
 
+from . import env_knobs
 from .lazy import LazyStack
 from ..observability import metrics as _obs_metrics
 from ..observability import trace as _obs_trace
@@ -67,18 +69,139 @@ def _observe_dispatch(n_steps: int, wall_s: float):
                   ).observe(wall_s)
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+# -- retrace sentinel ------------------------------------------------------
+#
+# The dispatch-count discipline every headline win rides on (fold-K,
+# the unified pp schedule, EQuARX dp) holds only if compiled entries
+# are PROGRAM-STABLE: traced once, dispatched forever.  The silent
+# failure mode is an equivalent-but-unequal input — a PartitionSpec
+# with trailing Nones, a size-1 mesh axis normalized away by GSPMD, an
+# uncommitted default-device array — that misses the jit cache and
+# quietly retraces the whole program after dispatch 1 (the PR-11/PR-15
+# recompile-pin bug class).  The sentinel turns the hand-written
+# ``entries == 1, traces == 1`` pins into an ambient property: every
+# program built through :func:`guarded_jit` counts its traces and
+# dispatches, exports ``dispatch_retraces_total``, and — when strict
+# mode is armed (``PADDLE_TPU_RETRACE_STRICT=1`` or the tests'
+# ``retrace_strict`` fixture) — raises :class:`RetraceError` on any
+# trace after the entry's first dispatch.
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+class RetraceError(RuntimeError):
+    """A single-trace compiled entry re-traced after it had already
+    dispatched — an equivalent-but-unequal input missed the jit cache
+    (see DESIGN-ANALYSIS.md §Retrace sentinel)."""
+
+
+class _GuardEntry:
+    __slots__ = ("label", "single_trace", "traces", "dispatches")
+
+    def __init__(self, label: str, single_trace: bool):
+        self.label = label
+        self.single_trace = single_trace
+        self.traces = 0
+        self.dispatches = 0
+
+
+_guard_entries: List[_GuardEntry] = []
+#: tri-state strict override: None = follow the env knob
+_strict_override: Optional[bool] = None
+
+
+def set_retrace_strict(flag: Optional[bool]) -> None:
+    """Arm/disarm strict mode programmatically (tests); ``None``
+    restores the ``PADDLE_TPU_RETRACE_STRICT`` env-knob default."""
+    global _strict_override
+    _strict_override = flag
+
+
+def retrace_strict_enabled() -> bool:
+    if _strict_override is not None:
+        return _strict_override
+    return env_knobs.get_bool("PADDLE_TPU_RETRACE_STRICT")
+
+
+def retrace_report() -> List[dict]:
+    """Per-entry (label, traces, dispatches) for introspection."""
+    return [{"label": e.label, "single_trace": e.single_trace,
+             "traces": e.traces, "dispatches": e.dispatches}
+            for e in _guard_entries]
+
+
+def _note_trace(entry: _GuardEntry) -> None:
+    entry.traces += 1
+    if entry.single_trace and entry.dispatches > 0:
+        reg = _obs_metrics.registry()
+        reg.counter("dispatch_retraces_total",
+                    "traces of single-trace compiled entries after "
+                    "their first dispatch (each one recompiles the "
+                    "whole program mid-run)").inc()
+        logger.warning(
+            "retrace sentinel: %r traced again (trace %d) after %d "
+            "dispatches — an equivalent-but-unequal input missed the "
+            "jit cache", entry.label, entry.traces, entry.dispatches)
+        if retrace_strict_enabled():
+            raise RetraceError(
+                f"compiled entry {entry.label!r} re-traced (trace "
+                f"{entry.traces}) after {entry.dispatches} "
+                f"dispatch(es).  Some input is equivalent-but-unequal "
+                f"to the first dispatch's — a non-canonical "
+                f"PartitionSpec, an uncommitted / differently-placed "
+                f"array, or a weak-type flip (the PR-11/PR-15 "
+                f"recompile-pin bug class).  Canonicalize the input "
+                f"at the placement seam, or build the entry with "
+                f"single_trace=False if its trace set is genuinely "
+                f"open-ended.")
+
+
+class GuardedProgram:
+    """A jitted program wrapped with trace/dispatch accounting.  All
+    jit attributes (``_cache_size`` et al.) delegate to the wrapped
+    callable, so ``compile_stats()`` introspection is unchanged."""
+
+    __slots__ = ("_fn", "entry")
+
+    def __init__(self, fn, entry: _GuardEntry):
+        self._fn = fn
+        self.entry = entry
+
+    def __call__(self, *args, **kwargs):
+        out = self._fn(*args, **kwargs)
+        self.entry.dispatches += 1
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def guarded_jit(fun: Callable, label: str, single_trace: bool = True,
+                **jit_kwargs) -> GuardedProgram:
+    """``jax.jit`` with the retrace sentinel attached.
+
+    ``single_trace=True`` declares the entry program-stable: its one
+    legitimate trace happens on the first dispatch, and any later
+    trace ticks ``dispatch_retraces_total`` (and raises under strict
+    mode).  Entries whose trace set is legitimately open-ended
+    (bucketed serving prefill, shape-polymorphic eval) pass
+    ``single_trace=False`` to keep the accounting without the
+    contract."""
+    import jax
+
+    entry = _GuardEntry(label, single_trace)
+    _guard_entries.append(entry)
+    # the counter exists (at 0) from the moment a guarded program is
+    # built, so the retrace lane is scrape-visible before any trouble
+    _obs_metrics.registry().counter(
+        "dispatch_retraces_total",
+        "traces of single-trace compiled entries after their first "
+        "dispatch (each one recompiles the whole program mid-run)")
+
+    @functools.wraps(fun)
+    def traced(*args, **kwargs):
+        _note_trace(entry)
+        return fun(*args, **kwargs)
+
+    return GuardedProgram(jax.jit(traced, **jit_kwargs), entry)
 
 
 # -- the shared compiled program ------------------------------------------
@@ -149,7 +272,11 @@ def build_folded_step(per_step: Callable, fold: int,
         donate = ()
     else:
         donate = (0, 2, 3, 4) if donate_buffers else (0, 3, 4)
-    return jax.jit(program, donate_argnums=donate)
+    # every folded program is single-trace by contract: callers cache
+    # one entry per (fold, batch signature), so a second trace of THIS
+    # entry is always the silent-retrace bug class
+    return guarded_jit(program, label=f"folded_step[fold={fold}]",
+                       single_trace=True, donate_argnums=donate)
 
 
 # -- auto-K ---------------------------------------------------------------
@@ -182,14 +309,15 @@ class AutoFoldTuner:
                  max_fold: Optional[int] = None,
                  calib_groups: Optional[int] = None):
         self.target = (target if target is not None else
-                       _env_float("PADDLE_TPU_FOLD_OVERHEAD_TARGET",
-                                  0.05))
+                       env_knobs.get_float(
+                           "PADDLE_TPU_FOLD_OVERHEAD_TARGET", 0.05))
         self.max_fold = max(1, max_fold if max_fold is not None else
-                            _env_int("PADDLE_TPU_FOLD_MAX", 32))
+                            env_knobs.get_int("PADDLE_TPU_FOLD_MAX",
+                                              32))
         self.calib_groups = max(1, calib_groups if calib_groups
                                 is not None else
-                                _env_int("PADDLE_TPU_FOLD_CALIB_GROUPS",
-                                         3))
+                                env_knobs.get_int(
+                                    "PADDLE_TPU_FOLD_CALIB_GROUPS", 3))
         self.fold = 1
         self.decided = False
         self.decision: Optional[dict] = None

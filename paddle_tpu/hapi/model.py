@@ -47,6 +47,7 @@ from ..tensor import Tensor
 from ..nn.layer import Layer
 from ..nn import functional_call as F
 from ..metric import Metric
+from ..framework import env_knobs
 from ..framework import random as _random
 from ..framework.io import save as _save, load as _load
 from ..framework.lazy import LazyStack
@@ -847,14 +848,14 @@ class Model:
         step count advanced by K on both the single-chip and mesh
         paths (``_tick_resilience`` /
         ``DistributedRunner.train_steps_folded``)."""
-        if os.environ.get("PADDLE_TPU_FIT_WATCHDOG", "1").lower() in (
-                "0", "false", "no"):
+        if env_knobs.get_raw("PADDLE_TPU_FIT_WATCHDOG",
+                             "1").lower() in ("0", "false", "no"):
             return None
         watchdog, _, _elastic = _resilience()
         if watchdog.current_watchdog() is not None:
             return None
-        timeout = float(os.environ.get(
-            "PADDLE_TPU_FIT_WATCHDOG_TIMEOUT_S", "1800"))
+        timeout = env_knobs.get_float(
+            "PADDLE_TPU_FIT_WATCHDOG_TIMEOUT_S", 1800.0)
         wd = watchdog.HangWatchdog(timeout=timeout, exit_code=None)
         watchdog.install_watchdog(wd.start())
         return wd

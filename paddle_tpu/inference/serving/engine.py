@@ -57,6 +57,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ...framework import env_knobs
 from ...framework.lazy import LazyScalar, LazyStack
 from ...io.bucketing import shape_bucket
 from ...observability import metrics as _obs_metrics
@@ -201,15 +202,17 @@ class DecodeEngine:
         # economics — the SAME calibrate/median/clamp policy as the
         # training engine's fold factor (AutoFoldTuner): start at 8,
         # calibrate over the first few polls, freeze
-        from ...framework.dispatch import (AutoFoldTuner, _env_float,
-                                           _env_int)
+        from ...framework.dispatch import AutoFoldTuner
         self._poll_auto = done_poll_interval is None
         self.done_poll_interval = (8 if self._poll_auto
                                    else max(1, int(done_poll_interval)))
         self._poll_tuner = (AutoFoldTuner(
-            target=_env_float("PADDLE_TPU_SERVING_POLL_TARGET", 0.05),
-            max_fold=_env_int("PADDLE_TPU_SERVING_POLL_MAX", 64),
-            calib_groups=_env_int("PADDLE_TPU_SERVING_POLL_CALIB", 3))
+            target=env_knobs.get_float(
+                "PADDLE_TPU_SERVING_POLL_TARGET", 0.05),
+            max_fold=env_knobs.get_int(
+                "PADDLE_TPU_SERVING_POLL_MAX", 64),
+            calib_groups=env_knobs.get_int(
+                "PADDLE_TPU_SERVING_POLL_CALIB", 3))
             if self._poll_auto else None)
         self._poll_decision: Optional[Dict] = None
         self._last_poll_end: Optional[float] = None
@@ -247,7 +250,8 @@ class DecodeEngine:
         # tier): chunked prefill, shared-prefix KV reuse, and the
         # decode-attention implementation behind the kernel seam --
         if prefill_chunk is None:
-            env_chunk = os.environ.get("PADDLE_TPU_PREFILL_CHUNK", "")
+            env_chunk = env_knobs.get_raw("PADDLE_TPU_PREFILL_CHUNK",
+                                          "")
             prefill_chunk = int(env_chunk) if env_chunk.strip() else None
         if prefill_chunk is not None and prefill_chunk <= 0:
             prefill_chunk = None
@@ -268,7 +272,7 @@ class DecodeEngine:
         self._ctx_buckets = _pow2_buckets(self.max_blocks_per_seq)
         self._group_buckets = _pow2_buckets(self.max_batch)
         if prefix_cache is None:
-            prefix_cache = os.environ.get(
+            prefix_cache = env_knobs.get_raw(
                 "PADDLE_TPU_PREFIX_CACHE", "0").strip() not in (
                 "", "0", "off", "false")
         self.attention_mode = resolve_paged_attention_mode(attention)
@@ -536,7 +540,13 @@ class DecodeEngine:
                 done = done | (active & (nxt == jnp.int32(eos)))
             return pool, emit, done
 
-        return jax.jit(step, donate_argnums=(1,))
+        # the decode program is single-trace by contract (fixed
+        # [max_batch] geometry; composition changes are DATA): a
+        # second trace after dispatch 1 is the silent-retrace class
+        # the sentinel exists for
+        from ...framework.dispatch import guarded_jit
+        return guarded_jit(step, label="serving.decode",
+                           single_trace=True, donate_argnums=(1,))
 
     # -- front door ----------------------------------------------------------
     def submit(self, prompt_ids, max_tokens: int, stream_cb=None,

@@ -32,6 +32,8 @@ import os
 import jax
 import jax.numpy as jnp
 
+from ...framework import env_knobs
+
 #: large-finite mask value (``-inf`` breeds NaN under 0*inf folding)
 MASK_VALUE = -1e30
 #: denominator guard — bit-inert for any row with >= 1 valid position
@@ -55,7 +57,8 @@ def resolve_paged_attention_mode(mode=None) -> str:
     host-paced, so off-TPU the gather composition stays the default
     and the kernel is an opt-in (tests/bench pin it)."""
     m = (mode if mode is not None
-         else os.environ.get(PAGED_ATTENTION_ENV, "auto")).strip().lower()
+         else env_knobs.get_raw(PAGED_ATTENTION_ENV,
+                                "auto")).strip().lower()
     if m in ("", "auto"):
         return "pallas" if jax.default_backend() == "tpu" else "gather"
     if m in ("0", "ref", "reference", "gather"):

@@ -1030,8 +1030,8 @@ def convert_function(fn: Callable) -> Tuple[Callable, Optional[str]]:
 
 
 def _convert_raw(fn):
-    import os
-    if os.environ.get("PADDLE_TPU_NO_DY2STATIC"):
+    from ..framework import env_knobs
+    if env_knobs.get_raw("PADDLE_TPU_NO_DY2STATIC"):
         return fn, None
     try:
         src = textwrap.dedent(inspect.getsource(fn))

@@ -58,7 +58,8 @@ def _build() -> Optional[str]:
 
 
 def _load_impl() -> Optional[ctypes.CDLL]:
-    if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+    from ..framework import env_knobs
+    if env_knobs.get_raw("PADDLE_TPU_DISABLE_NATIVE"):
         return None
     so = _build()
     if so is None:

@@ -29,8 +29,7 @@ and counters are ALWAYS on (host floats, no device syncs).
 
 from __future__ import annotations
 
-import os as _os
-
+from ..framework import env_knobs as _env_knobs
 from . import trace  # noqa: F401
 from . import metrics  # noqa: F401
 from . import export  # noqa: F401
@@ -59,13 +58,9 @@ def scrape_prometheus() -> str:
 # PADDLE_TPU_TRACE=1 arms the span recorder at import — i.e. before
 # any instrumented module dispatches — so "trace this run" is an env
 # var, not a code change.  Capacity knob: PADDLE_TPU_TRACE_CAPACITY.
-if _os.environ.get("PADDLE_TPU_TRACE", "").lower() in ("1", "true",
-                                                       "yes", "on"):
-    try:
-        _cap = int(_os.environ.get(
-            "PADDLE_TPU_TRACE_CAPACITY", "0") or 0)
-    except ValueError:        # malformed knob must not kill the import
-        _cap = 0
+if _env_knobs.get_bool("PADDLE_TPU_TRACE"):
+    # malformed capacity must not kill the import (get_int -> default)
+    _cap = _env_knobs.get_int("PADDLE_TPU_TRACE_CAPACITY", 0)
     # nonpositive values (unset, 0, or e.g. -1) keep the default ring
     trace.enable(capacity=_cap if _cap > 0 else None)
     del _cap

@@ -41,17 +41,16 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ..framework import env_knobs
+
 __all__ = ["record", "snapshot", "capacity", "DEFAULT_CAPACITY"]
 
 DEFAULT_CAPACITY = 256
 
 
 def _env_capacity() -> int:
-    try:
-        cap = int(os.environ.get("PADDLE_TPU_EVENTS_CAPACITY",
-                                 "0") or 0)
-    except ValueError:  # malformed knob must not kill the import
-        cap = 0
+    # malformed knob must not kill the import (get_int -> default)
+    cap = env_knobs.get_int("PADDLE_TPU_EVENTS_CAPACITY", 0)
     return cap if cap > 0 else DEFAULT_CAPACITY
 
 

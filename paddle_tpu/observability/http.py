@@ -50,6 +50,7 @@ import warnings
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
+from ..framework import env_knobs
 from . import events as _events
 from . import export as _export
 from . import trace as _trace
@@ -86,7 +87,8 @@ def resolve_port(env=None) -> Optional[int]:
     rank ``r``.  A parked spare resolves to None (no rank yet — see
     :func:`serve_for_rank`)."""
     env = env or os.environ
-    raw = (env.get("PADDLE_TPU_METRICS_PORT") or "").strip()
+    raw = (env_knobs.get_raw("PADDLE_TPU_METRICS_PORT", env=env)
+           or "").strip()
     if not raw:
         return None
     try:
@@ -291,7 +293,8 @@ def serve_for_rank(rank: int, env=None
     env is disarmed or an endpoint is already up."""
     global _active
     env = env or os.environ
-    raw = (env.get("PADDLE_TPU_METRICS_PORT") or "").strip()
+    raw = (env_knobs.get_raw("PADDLE_TPU_METRICS_PORT", env=env)
+           or "").strip()
     try:
         base = int(raw) if raw else 0
     except ValueError:

@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 
 from ._primitive import primitive
+from ..framework import env_knobs
 from ..framework import random as _random
 
 logger = logging.getLogger("paddle_tpu")
@@ -71,7 +72,7 @@ def _on_tpu() -> bool:
 
 def _block_default(name: str, fallback: int) -> int:
     try:
-        return int(os.environ.get(name, fallback))
+        return int(env_knobs.get_raw(name, fallback))  # lint: allow(env-knobs): literal-name pass-through — every call site passes a registered literal (the wiring census sees them) and get_raw still KeyErrors on typos at runtime
     except ValueError:
         return fallback
 
@@ -80,7 +81,7 @@ def _interpret() -> bool:
     """PADDLE_TPU_PALLAS_INTERPRET=1 runs the Pallas kernels in
     interpreter mode — lets CPU tests exercise the ACTUAL kernel code
     (not just the composed fallback)."""
-    return bool(os.environ.get("PADDLE_TPU_PALLAS_INTERPRET"))
+    return bool(env_knobs.get_raw("PADDLE_TPU_PALLAS_INTERPRET"))
 
 
 def _fit_block(seq: int, requested: int) -> int:
@@ -260,7 +261,7 @@ def _flash_kernel_hpack(*refs, scale: float, causal: bool, hp: int,
 
 def _headpack() -> int:
     try:
-        return int(os.environ.get("PADDLE_TPU_FLASH_HEADPACK", "1"))
+        return int(env_knobs.get_raw("PADDLE_TPU_FLASH_HEADPACK", "1"))
     except ValueError:
         return 1
 
@@ -662,7 +663,7 @@ def _pallas_flash_bwd(q, k, v, out, lse, do, q_seg=None, k_seg=None, *,
     # for re-evaluation on other TPU generations.
     fused_scratch = sq * (d + _LANES) * 4
     if (fused_scratch <= _FUSED_BWD_MAX_SCRATCH_BYTES
-            and os.environ.get("PADDLE_TPU_FLASH_FUSED_BWD")):
+            and env_knobs.get_raw("PADDLE_TPU_FLASH_FUSED_BWD")):
         # single-sweep fused backward; grid (bh, kv, q) with q minor
         qspec = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, b * 0))
         kspec = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, b * 0))
@@ -1263,8 +1264,8 @@ def _packed_healthy() -> bool:
 
 
 def _packed_eligible(h: int, d: int, sq: int, sk: int) -> bool:
-    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS") or \
-            os.environ.get("PADDLE_TPU_FLASH_NO_PACKED"):
+    if env_knobs.get_raw("PADDLE_TPU_DISABLE_PALLAS") or \
+            env_knobs.get_raw("PADDLE_TPU_FLASH_NO_PACKED"):
         return False
     if not _on_tpu() and not _interpret():
         return False
@@ -1342,7 +1343,7 @@ def _pallas_healthy() -> bool:
 
 
 def _pallas_eligible(q, k):
-    if os.environ.get("PADDLE_TPU_DISABLE_PALLAS"):
+    if env_knobs.get_raw("PADDLE_TPU_DISABLE_PALLAS"):
         return False
     if not _on_tpu() and not _interpret():
         return False

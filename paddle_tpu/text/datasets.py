@@ -13,12 +13,13 @@ from typing import Optional
 import numpy as np
 import jax.numpy as jnp
 
+from ..framework import env_knobs
 from ..io.dataset import Dataset
 from ..tensor import Tensor
 
 
 def _n(default=512):
-    return int(os.environ.get("PADDLE_TPU_SYNTH_N", default))
+    return int(env_knobs.get_raw("PADDLE_TPU_SYNTH_N", default))
 
 
 class Imdb(Dataset):

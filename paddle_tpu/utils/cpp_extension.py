@@ -56,7 +56,8 @@ _lock = threading.Lock()
 
 
 def _default_build_dir(name: str) -> str:
-    root = os.environ.get(
+    from ..framework import env_knobs
+    root = env_knobs.get_raw(
         "PADDLE_TPU_EXTENSION_DIR",
         os.path.join(os.path.expanduser("~"), ".cache",
                      "paddle_tpu_extensions"))

@@ -10,6 +10,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...framework import env_knobs
 from ...io.dataset import Dataset
 
 
@@ -34,7 +35,7 @@ class Cifar10(Dataset):
             self._load_archive(data_file)
         else:
             n = 50000 if mode == "train" else 10000
-            n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))
+            n = int(env_knobs.get_raw("PADDLE_TPU_SYNTH_N", n))
             self.images, self.labels = _synthetic_cifar(
                 n, self.NUM_CLASSES, seed=0 if mode == "train" else 1)
 

@@ -10,6 +10,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...framework import env_knobs
 from ...io.dataset import Dataset
 
 
@@ -65,7 +66,7 @@ class MNIST(Dataset):
             self.labels = _load_idx_labels(lbl).astype(np.int64)
         else:
             n = 60000 if mode == "train" else 10000
-            n = int(os.environ.get("PADDLE_TPU_SYNTH_N", n))
+            n = int(env_knobs.get_raw("PADDLE_TPU_SYNTH_N", n))
             self.images, self.labels = _synthetic_mnist(
                 n, seed=0 if mode == "train" else 1)
 

@@ -1,0 +1,24 @@
+"""Program-stability analysis suite (DESIGN-ANALYSIS.md).
+
+Eight passes over one shared :class:`core.Codebase`; run them all via
+``python scripts/lint.py`` or individually through the thin
+``scripts/check_*.py`` wrappers (kept for their historic CLIs).
+"""
+
+from . import core  # noqa: F401
+from . import (donation_safety, env_knobs_pass, fault_sites,  # noqa: F401
+               host_sync, knob_consumption, metric_names,
+               retrace_hazards, retry_coverage)
+
+# registration order is report order: the four ported checks first,
+# then the program-stability passes this suite added
+PASSES = {m.NAME: m for m in (
+    host_sync,
+    metric_names,
+    fault_sites,
+    retry_coverage,
+    retrace_hazards,
+    donation_safety,
+    knob_consumption,
+    env_knobs_pass,
+)}

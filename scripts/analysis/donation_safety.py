@@ -1,0 +1,237 @@
+"""donation-safety pass: donated buffers are never reused, and
+donation in the shard_map hazard modules stays behind the
+``donate_carry=`` knob (DESIGN-ANALYSIS.md §donation-safety).
+
+``donate_argnums`` hands the *buffer* to XLA: after the dispatch the
+Python name still points at a deleted array, and the next touch
+raises (best case) or reads garbage through an alias (worst case —
+this container's jaxlib corrupts buffers donated through shard_map
+manual collectives, the DESIGN-DCN.md caveat).  Two rules:
+
+1. **Use-after-donation.**  Where a module binds a name to a
+   jit-with-donation (``X = jax.jit(f, donate_argnums=(...))`` /
+   ``guarded_jit(...)``), every call ``X(a, b, ...)`` donates the
+   arguments at those positions; a plain-name argument at a donated
+   position that is *read again* before being rebound in the same
+   function is a use-after-donation.
+2. **Knob-routed donation in hazard modules.**  Modules that use
+   ``shard_map`` may not hard-code ``donate_argnums`` literals: the
+   donation decision must flow through a ``donate_carry`` parameter
+   (or a name computed from one), so the shard_map donation caveat
+   has one opt-in switch instead of scattered literals.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Tuple
+
+from . import core
+from .core import Codebase, Violation
+
+NAME = "donation-safety"
+OK_MESSAGE = ("donation-safety OK: no donated-arg reuse; hazard-"
+              "module donation routes through donate_carry=")
+REPORT_HEADER = "donation-safety violations:"
+
+_JIT_NAMES = {"jit", "guarded_jit"}
+
+
+def _donation_kwarg(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            return kw
+    return None
+
+
+def _literal_positions(node: ast.AST):
+    """donate_argnums literal -> tuple of ints, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, ast.Tuple) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _bind_target(stmt: ast.stmt):
+    """``X = jit(...)`` / ``self._x = jit(...)`` -> ('X',) key."""
+    if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+        return None
+    t = stmt.targets[0]
+    if isinstance(t, ast.Name):
+        return ("name", t.id)
+    if isinstance(t, ast.Attribute) and \
+            isinstance(t.value, ast.Name) and t.value.id == "self":
+        return ("self", t.attr)
+    return None
+
+
+def _donating_bindings(tree: ast.Module) -> Dict[Tuple[str, str],
+                                                 Tuple[int, ...]]:
+    """Names/self-attrs bound to a jit with a literal donate_argnums
+    in this module."""
+    out: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+    for stmt in ast.walk(tree):
+        key = _bind_target(stmt) if isinstance(stmt, ast.Assign) \
+            else None
+        if key is None or not isinstance(stmt.value, ast.Call):
+            continue
+        call = stmt.value
+        if core.call_name(call) not in _JIT_NAMES:
+            continue
+        kw = _donation_kwarg(call)
+        if kw is None:
+            continue
+        pos = _literal_positions(kw.value)
+        if pos:
+            out[key] = pos
+    return out
+
+
+def _call_key(call: ast.Call):
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("name", f.id)
+    if isinstance(f, ast.Attribute) and \
+            isinstance(f.value, ast.Name) and f.value.id == "self":
+        return ("self", f.attr)
+    return None
+
+
+def _rebinds(stmt: ast.stmt, name: str) -> bool:
+    """Does this statement bind ``name`` (assignment target, for-loop
+    target, with-as, aug-assign)?"""
+    targets = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign, ast.For)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [i.optional_vars for i in stmt.items
+                   if i.optional_vars is not None]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+    return False
+
+
+def _reads(stmt: ast.stmt, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name
+               and isinstance(n.ctx, ast.Load)
+               for n in ast.walk(stmt))
+
+
+def _check_use_after(fn, call: ast.Call, donated: List[str],
+                     rel: str, out: List[Violation]) -> None:
+    """Scan the statements of ``fn`` after the one containing ``call``
+    for a read-before-rebind of each donated name."""
+    # statement list in source order: enough for the linear
+    # post-call scan (nested scopes that rebind break the scan)
+    stmts = [n for n in ast.walk(fn) if isinstance(n, ast.stmt)]
+    stmts.sort(key=lambda s: (s.lineno, s.col_offset))
+    containing = None
+    for s in stmts:
+        if any(n is call for n in ast.walk(s)):
+            containing = s       # innermost statement wins (last hit)
+    if containing is None:
+        return
+    for name in donated:
+        # the containing statement itself may rebind (the canonical
+        # ``state = step(state, ...)`` carry idiom)
+        if _rebinds(containing, name):
+            continue
+        for s in stmts:
+            if s.lineno <= containing.lineno or s is containing:
+                continue
+            if _rebinds(s, name) and not _reads(s, name):
+                break
+            if _reads(s, name):
+                out.append(Violation(
+                    rel, s.lineno,
+                    f"{name!r} was donated to the compiled entry at "
+                    f"line {call.lineno} and is read again here "
+                    "before rebinding — the donated buffer is dead "
+                    "after dispatch (rebind from the entry's return "
+                    "value)"))
+                break
+            if _rebinds(s, name):
+                break
+
+
+def _hazard_modules(cb: Codebase) -> List[str]:
+    """Modules whose source mentions shard_map — the donation-caveat
+    surface (DESIGN-DCN.md)."""
+    out = []
+    for mod in cb.iter_modules():
+        if "shard_map(" in mod.source or \
+                "from jax.experimental.shard_map" in mod.source or \
+                "shard_map_compat" in mod.source:
+            out.append(mod.rel)
+    return out
+
+
+def run(cb: Codebase) -> List[Violation]:
+    violations: List[Violation] = []
+    hazard = set(_hazard_modules(cb))
+    for mod in cb.iter_modules():
+        bindings = _donating_bindings(mod.tree)
+        funcs, chains = core.enclosing_chains(mod.tree)
+        # rule 1: use-after-donation at call sites of donating entries
+        if bindings:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                key = _call_key(node)
+                if key is None or key not in bindings:
+                    continue
+                donated = [node.args[i].id for i in bindings[key]
+                           if i < len(node.args)
+                           and isinstance(node.args[i], ast.Name)]
+                chain = chains.get(id(node), [])
+                if donated and chain:
+                    _check_use_after(chain[-1], node, donated,
+                                     mod.rel, violations)
+        # rule 2: knob-routed donation in shard_map hazard modules
+        if mod.rel not in hazard:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cname = core.call_name(node)
+            if cname == "build_folded_step":
+                # the shared engine donates the carry by default: in
+                # a hazard module the opt-in must be spelled out
+                if not any(k.arg == "donate_carry"
+                           for k in node.keywords):
+                    violations.append(Violation(
+                        mod.rel, node.lineno,
+                        "build_folded_step call relies on the "
+                        "implicit donate_carry=True default in a "
+                        "shard_map module — spell the opt-in out "
+                        "(donate_carry=...) so the DESIGN-DCN.md "
+                        "donation caveat has a visible switch"))
+                continue
+            if cname not in _JIT_NAMES:
+                continue
+            kw = _donation_kwarg(node)
+            if kw is None:
+                continue
+            if _literal_positions(kw.value) is None:
+                continue    # computed from a gate — the knob in action
+            chain = chains.get(id(node), [])
+            if not any("donate_carry" in [a.arg for a in
+                                          fn.args.args + fn.args.kwonlyargs]
+                       for fn in chain):
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    "literal donate_argnums in a shard_map module — "
+                    "this container's jaxlib corrupts buffers donated "
+                    "through shard_map manual collectives "
+                    "(DESIGN-DCN.md); route the decision through a "
+                    "donate_carry= parameter so the caveat has one "
+                    "opt-in switch"))
+    return violations

@@ -1,0 +1,91 @@
+"""fault-sites pass: FaultPlan site-name registry coverage
+(DESIGN-RESILIENCE.md; ported verdict-unchanged from
+scripts/check_fault_sites.py).
+
+Chaos rules target injection sites by *string name*; a typo on either
+side produces an injection point that silently never fires — the
+recovery path looks chaos-tested while nothing is being injected.
+
+1. every string-literal site passed to ``fault_point(...)`` /
+   ``should_drop(...)`` in production code must appear in the central
+   registry (``resilience.faults.KNOWN_SITES``);
+2. every registry name must be wired into at least one production
+   call site (a registry entry with zero call sites is a recovery
+   path whose chaos coverage silently evaporated);
+3. call sites must use a string literal — a computed site name can't
+   be audited and defeats the registry.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Set
+
+from . import core
+from .core import Codebase, Violation
+
+NAME = "fault-sites"
+OK_MESSAGE = ("fault-site coverage OK: every injection site is "
+              "registered and every registered site is wired")
+REPORT_HEADER = "fault-site violations:"
+
+_INJECT_FNS = {"fault_point", "should_drop"}
+
+REGISTRY_MOD = os.path.join(core.PKG_REL, "distributed", "resilience",
+                            "faults.py")
+
+
+def _known_sites() -> Set[str]:
+    sys.path.insert(0, core.REPO)
+    try:
+        from paddle_tpu.distributed.resilience.faults import KNOWN_SITES
+    finally:
+        sys.path.pop(0)
+    return set(KNOWN_SITES)
+
+
+def _iter_sites(cb: Codebase):
+    """Yield (repo_rel, lineno, site|None) for every injection call in
+    the package; site is None when the first arg is not a literal."""
+    for mod in cb.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if core.call_name(node) not in _INJECT_FNS:
+                continue
+            if not node.args:
+                continue
+            site = core.const_str(node.args[0])
+            yield mod.rel, node.lineno, site
+
+
+def run(cb: Codebase, known_sites: Set[str] = None) -> List[Violation]:
+    """``known_sites`` overrides the runtime registry import so the
+    negative-control tests don't need a fake package on sys.path."""
+    if known_sites is None:
+        known_sites = _known_sites()
+    violations: List[Violation] = []
+    used: Set[str] = set()
+    for rel, line, site in _iter_sites(cb):
+        # the registry's own module defines the names, it doesn't
+        # call them
+        if rel == REGISTRY_MOD:
+            continue
+        if site is None:
+            violations.append(Violation(
+                rel, line, "injection site is not a string literal "
+                "(unauditable; name sites statically)"))
+        elif site not in known_sites:
+            violations.append(Violation(
+                rel, line, f"unknown fault site {site!r} — add it to "
+                "resilience.faults.KNOWN_SITES or fix the typo"))
+        else:
+            used.add(site)
+    for site in sorted(known_sites - used):
+        violations.append(Violation(
+            REGISTRY_MOD, 0,
+            f"registered fault site {site!r} has no production call "
+            "site — dead registry entry or a typo'd call"))
+    return violations

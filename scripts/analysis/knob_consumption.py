@@ -1,0 +1,162 @@
+"""knob-consumption pass: every DistributedStrategy knob is consumed
+or explicitly refused, never silently dropped (the PR-11 strategy
+contract; DESIGN-ANALYSIS.md §knob-consumption).
+
+``DistributedStrategy.to_dict()`` exports exactly the ``self.X``
+attributes ``__init__`` assigns; a knob a user sets that nothing
+reads is the worst failure mode a config object has — training runs,
+silently, without the feature.  Rules:
+
+1. every exported knob is either *consumed* (an attribute read
+   ``<obj>.<knob>`` / literal ``getattr(s, "<knob>")`` / literal
+   ``d["<knob>"]`` somewhere in the package outside the strategy
+   module) or *refused* (listed in ``fleet.py``'s
+   ``_REFUSED_STRATEGY_KNOBS`` ledger, whose runtime gate raises when
+   a refused knob is changed from its default);
+2. the refusal ledger stays consistent: every refused name is a real
+   knob, carries a reason, and is not also consumed;
+3. computed knob names are rejected — ``getattr(strategy, var)`` on a
+   strategy receiver defeats the census this pass performs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Set
+
+from . import core
+from .core import Codebase, Violation
+
+NAME = "knob-consumption"
+OK_MESSAGE = ("strategy-knob coverage OK: every DistributedStrategy "
+              "knob is consumed or refused on record")
+REPORT_HEADER = "knob-consumption violations:"
+
+STRATEGY_MOD = os.path.join(core.PKG_REL, "distributed", "fleet",
+                            "base", "distributed_strategy.py")
+FLEET_MOD = os.path.join(core.PKG_REL, "distributed", "fleet",
+                         "fleet.py")
+
+# receiver names that read as "a strategy object" for the
+# computed-name rule
+_STRATEGY_RECEIVERS = {"s", "strategy", "_strategy", "strat"}
+
+
+def exported_knobs(cb: Codebase) -> Dict[str, int]:
+    """knob name -> defining line, from ``self.X = ...`` assignments
+    in DistributedStrategy.__init__ (== the to_dict key set)."""
+    mod = cb.get(STRATEGY_MOD)
+    if mod is None:
+        return {}
+    out: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.ClassDef)
+                and node.name == "DistributedStrategy"):
+            continue
+        for item in node.body:
+            if not (isinstance(item, ast.FunctionDef)
+                    and item.name == "__init__"):
+                continue
+            for stmt in ast.walk(item):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id == "self":
+                        out.setdefault(t.attr, stmt.lineno)
+    return out
+
+
+def refusal_ledger(cb: Codebase) -> Dict[str, int]:
+    """Keys of the ``_REFUSED_STRATEGY_KNOBS`` dict literal in
+    fleet.py -> line (values are the reasons, checked non-empty)."""
+    mod = cb.get(FLEET_MOD)
+    if mod is None:
+        return {}
+    out: Dict[str, int] = {}
+    for node in mod.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "_REFUSED_STRATEGY_KNOBS"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k in node.value.keys:
+            name = core.const_str(k)
+            if name is not None:
+                out[name] = k.lineno
+    return out
+
+
+def run(cb: Codebase) -> List[Violation]:
+    violations: List[Violation] = []
+    knobs = exported_knobs(cb)
+    if not knobs:
+        violations.append(Violation(
+            STRATEGY_MOD, 0,
+            "could not locate DistributedStrategy.__init__ self.X "
+            "assignments — the knob census has nothing to check"))
+        return violations
+    refused = refusal_ledger(cb)
+    consumed: Set[str] = set()
+    for mod in cb.iter_modules():
+        if mod.rel == STRATEGY_MOD:
+            continue
+        for node in ast.walk(mod.tree):
+            # <obj>.<knob> attribute read
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    node.attr in knobs:
+                consumed.add(node.attr)
+            elif isinstance(node, ast.Call):
+                fname = core.call_name(node)
+                # getattr(s, "knob"[, default]) — literal consumption;
+                # computed name on a strategy receiver — violation
+                if fname == "getattr" and len(node.args) >= 2:
+                    key = core.const_str(node.args[1])
+                    if key is not None:
+                        if key in knobs:
+                            consumed.add(key)
+                    elif isinstance(node.args[0], ast.Name) and \
+                            node.args[0].id in _STRATEGY_RECEIVERS:
+                        violations.append(Violation(
+                            mod.rel, node.lineno,
+                            "computed strategy-knob name "
+                            "(getattr with a non-literal key on a "
+                            "strategy receiver) — knob reads must be "
+                            "statically auditable"))
+                # d.get("knob") / d["knob"] on exported config dicts
+                elif fname == "get" and node.args:
+                    key = core.const_str(node.args[0])
+                    if key in knobs:
+                        consumed.add(key)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, ast.Load):
+                key = core.const_str(node.slice)
+                if key in knobs:
+                    consumed.add(key)
+    # rule 2: ledger consistency
+    for name, line in sorted(refused.items()):
+        if name not in knobs:
+            violations.append(Violation(
+                FLEET_MOD, line,
+                f"refusal ledger names {name!r}, which is not a "
+                "DistributedStrategy knob — stale entry or typo"))
+        elif name in consumed:
+            violations.append(Violation(
+                FLEET_MOD, line,
+                f"{name!r} is in the refusal ledger but also "
+                "consumed — drop the refusal (the knob works) or "
+                "the consumer (it doesn't)"))
+    # rule 1: every knob consumed or refused
+    for name, line in sorted(knobs.items()):
+        if name not in consumed and name not in refused:
+            violations.append(Violation(
+                STRATEGY_MOD, line,
+                f"strategy knob {name!r} is neither consumed nor "
+                "refused — a user setting it trains silently without "
+                "the feature (wire it, or add it to fleet.py's "
+                "_REFUSED_STRATEGY_KNOBS with the reason)"))
+    return violations

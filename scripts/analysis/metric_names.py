@@ -1,0 +1,123 @@
+"""metric-names pass: instrument-name convention on the process-wide
+registry (DESIGN-OBSERVABILITY.md §Metric naming convention; ported
+verdict-unchanged from scripts/check_metric_names.py).
+
+Enforced at the AST level over every production module:
+
+- **Literal names only.**  A computed name (f-string, concat,
+  variable) cannot be grepped from a dashboard back to its call site
+  and silently mints unbounded families (``labels`` carry the dynamic
+  dimension instead).
+- **Shape:** snake_case, ``^[a-z][a-z0-9_]*[a-z0-9]$``, no ``__``.
+- **Counters end in ``_total``**; **histograms end in a unit suffix**
+  (``_s``, ``_ms``, ``_bytes``, ``_pct``, ``_ratio``); **gauges never
+  end in ``_total``**.
+
+Receiver heuristic (syntactic): ``registry().counter(...)``,
+``reg.counter(...)`` or ``self._reg.counter(...)``.  The check fails
+closed on its own coverage: implausibly few matched call sites means
+the heuristic broke, and that is itself a violation.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Tuple
+
+from .core import Codebase, Violation
+
+NAME = "metric-names"
+OK_MESSAGE = "metric-name convention OK"
+REPORT_HEADER = "metric-name violations:"
+
+KINDS = ("counter", "gauge", "histogram")
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[a-z0-9]$")
+UNIT_SUFFIXES = ("_s", "_ms", "_bytes", "_pct", "_ratio")
+
+# fewer literal call sites than this means the receiver heuristic
+# stopped matching the codebase idiom — fail loudly, not silently
+# (52 sites as of PR 13's control-loop instruments; the floor trails
+# the census so genuine removals don't trip it)
+MIN_EXPECTED_SITES = 40
+
+
+def _is_registry_receiver(node: ast.expr) -> bool:
+    """registry() / *.registry() / reg / self._reg / *_reg"""
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        return name == "registry"
+    if isinstance(node, ast.Name):
+        return node.id == "reg" or node.id.endswith("_reg")
+    if isinstance(node, ast.Attribute):
+        return node.attr == "_reg" or node.attr.endswith("_reg")
+    return False
+
+
+def _check_name(kind: str, name: str) -> List[str]:
+    problems = []
+    if not NAME_RE.match(name) or "__" in name:
+        problems.append(f"{name!r} is not snake_case "
+                        "([a-z][a-z0-9_]*, no '__')")
+        return problems
+    if kind == "counter" and not name.endswith("_total"):
+        problems.append(f"counter {name!r} must end in _total")
+    if kind == "histogram" and not name.endswith(UNIT_SUFFIXES):
+        problems.append(
+            f"histogram {name!r} must end in a unit suffix "
+            f"{UNIT_SUFFIXES}")
+    if kind != "counter" and name.endswith("_total"):
+        problems.append(
+            f"{kind} {name!r} must not end in _total (that suffix "
+            "promises a monotone counter)")
+    return problems
+
+
+def scan(cb: Codebase) -> Tuple[List[Violation], int]:
+    """(violations, matched call sites) — the wrapper CLI reports the
+    site count; ``run`` folds the coverage self-check in."""
+    violations: List[Violation] = []
+    sites = 0
+    for rel, (lineno, msg) in sorted(cb.broken.items()):
+        if rel.startswith("paddle_tpu"):
+            violations.append(Violation(rel, lineno,
+                                        f"unparseable: {msg}"))
+    for mod in cb.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in KINDS
+                    and _is_registry_receiver(node.func.value)):
+                continue
+            sites += 1
+            if not node.args:
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    f".{node.func.attr}() with no name argument"))
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant)
+                    and isinstance(arg.value, str)):
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    f".{node.func.attr}() name is computed "
+                    f"({ast.dump(arg)[:60]}...): instrument "
+                    "names must be string literals — put the "
+                    "dynamic dimension in labels"))
+                continue
+            for p in _check_name(node.func.attr, arg.value):
+                violations.append(Violation(mod.rel, node.lineno, p))
+    if sites < MIN_EXPECTED_SITES:
+        violations.append(Violation(
+            "scripts/analysis/metric_names.py", 0,
+            f"coverage self-check: only {sites} registry call sites "
+            f"matched (expected >= {MIN_EXPECTED_SITES}) — the "
+            "receiver heuristic no longer matches the codebase "
+            "idiom"))
+    return violations, sites
+
+
+def run(cb: Codebase) -> List[Violation]:
+    return scan(cb)[0]

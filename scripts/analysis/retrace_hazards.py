@@ -1,0 +1,166 @@
+"""retrace-hazards pass: statically catch the silent-retrace bug
+class the runtime sentinel (``framework.dispatch.guarded_jit``)
+catches dynamically (DESIGN-ANALYSIS.md §retrace-hazards).
+
+A jit program retraces when dispatch N+1's arguments are
+*equivalent but unequal* to dispatch N's — the program runs the same
+math twice as fast as it recompiles.  Two statically visible sources:
+
+1. **Non-canonical PartitionSpec literals.**  jit canonicalizes its
+   output NamedShardings (trailing ``None`` entries dropped, size-1
+   mesh axes normalized away); a hand-built ``P('dp', None)`` on the
+   *input* side compares unequal to the canonical ``P('dp')`` the
+   previous dispatch produced, misses the cache, and retraces once
+   after dispatch 1 (the PR-11/PR-15 recompile-pin bug class).
+   Flagged: ``P(...)`` / ``PartitionSpec(...)`` literals with a
+   trailing ``None`` positional, and ``Mesh(...)`` built from a
+   ``reshape`` with a literal size-1 axis.
+2. **Fresh-tree ``device_put`` outside the placement seams.**  In the
+   training-engine modules every value entering a compiled entry must
+   flow through the engine's canonicalizing seam (``_shard`` /
+   ``_place``) so its sharding/commitment matches what dispatch 1
+   compiled against; an ad-hoc ``jax.device_put`` elsewhere builds a
+   fresh tree whose placement the cache has never seen.  Serving
+   modules are exempt: their per-dispatch ``device_put`` calls stage
+   fresh host data under the engine's pinned default device, which is
+   the sanctioned pattern there (engine.py's placement-scope note).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from . import core
+from .core import Codebase, Violation
+
+NAME = "retrace-hazards"
+OK_MESSAGE = ("retrace-hazard check OK: no non-canonical spec "
+              "literals; engine device_puts stay in their seams")
+REPORT_HEADER = "retrace-hazard violations:"
+
+_SPEC_NAMES = {"P", "PartitionSpec"}
+
+# training-engine modules under rule 2, and the placement-seam
+# functions (enclosing chain) where device_put is the point
+ENGINE_MODULES = [
+    os.path.join("framework", "dispatch.py"),
+    os.path.join("distributed", "runner.py"),
+    os.path.join("distributed", "fleet", "meta_parallel",
+                 "pipeline_parallel.py"),
+    os.path.join("hapi", "model.py"),
+]
+
+# (module parts..., enclosing function) → why placement is legitimate
+ALLOWED_PLACEMENT = {
+    ("distributed", "runner.py", "_shard"):
+        "THE explicit-dp placement seam: every engine value is "
+        "device_put here with its canonical (trailing-None-free) "
+        "spec, once, at place() time",
+    ("distributed", "fleet", "meta_parallel", "pipeline_parallel.py",
+     "_place"):
+        "the pipeline engine's placement seam: specs are "
+        "canonicalized by strip() the way jit canonicalizes output "
+        "NamedShardings before the one-time device_put",
+    ("hapi", "model.py", "_train_batch_folded_mesh"):
+        "one-time replicated init of the device metric accumulators, "
+        "pinned to P() up front precisely so dispatch 2's sharding "
+        "matches dispatch 1's compiled layout",
+}
+
+
+def _is_spec_call(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _SPEC_NAMES
+    if isinstance(f, ast.Attribute):
+        return f.attr == "PartitionSpec"
+    return False
+
+
+def _trailing_none(call: ast.Call) -> bool:
+    if not call.args or call.keywords:
+        return False
+    last = call.args[-1]
+    return isinstance(last, ast.Constant) and last.value is None
+
+
+def _is_device_put(call: ast.Call) -> bool:
+    return core.call_name(call) == "device_put"
+
+
+def _mesh_size1_axis(call: ast.Call) -> bool:
+    """Mesh(x.reshape(..., 1, ...), ...) — a literal size-1 mesh axis:
+    specs naming that axis compare unequal to the canonical form that
+    drops it, the same cache-miss mode as a trailing None."""
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        getattr(f, "id", "")
+    if name != "Mesh" or not call.args:
+        return False
+    shape_arg = call.args[0]
+    if isinstance(shape_arg, ast.Call) and \
+            isinstance(shape_arg.func, ast.Attribute) and \
+            shape_arg.func.attr == "reshape":
+        return any(isinstance(a, ast.Constant) and a.value == 1
+                   for a in shape_arg.args)
+    return False
+
+
+def run(cb: Codebase) -> List[Violation]:
+    violations: List[Violation] = []
+    # rule 1: everywhere in the package
+    for mod in cb.iter_modules():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_spec_call(node) and _trailing_none(node):
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    "PartitionSpec literal with a trailing None — "
+                    "equivalent but UNEQUAL to the canonical spec jit "
+                    "produces, so a placed value built from it misses "
+                    "the jit cache and silently retraces (drop the "
+                    "trailing None)"))
+            elif _mesh_size1_axis(node):
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    "Mesh built with a literal size-1 axis — specs "
+                    "naming it normalize away in jit output "
+                    "shardings and stop matching the input specs "
+                    "(drop the axis or size it from the device "
+                    "count)"))
+    # rule 2: engine modules only
+    seen_funcs = set()
+    for rel in ENGINE_MODULES:
+        repo_rel = os.path.join(core.PKG_REL, rel)
+        mod = cb.get(repo_rel)
+        if mod is None:
+            continue
+        parts = tuple(rel.split(os.sep))
+        funcs, chains = core.enclosing_chains(mod.tree)
+        for fn in funcs:
+            seen_funcs.add(parts + (fn.name,))
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and _is_device_put(node)):
+                continue
+            chain = chains.get(id(node), [])
+            if not any(parts + (fn.name,) in ALLOWED_PLACEMENT
+                       for fn in chain):
+                where = f"in {chain[-1].name}()" if chain \
+                    else "at module level"
+                violations.append(Violation(
+                    repo_rel, node.lineno,
+                    f"device_put {where} outside the engine's "
+                    "placement seams — an ad-hoc placement builds a "
+                    "tree whose sharding/commitment the compiled "
+                    "entry has never seen (route through "
+                    "_shard/_place, or stage via io/staging)"))
+    for entry, reason in ALLOWED_PLACEMENT.items():
+        if entry not in seen_funcs:
+            violations.append(Violation(
+                os.path.join(core.PKG_REL, *entry[:-1]), 0,
+                f"stale placement-seam entry: no function named "
+                f"{entry[-1]!r} ({reason[:40]}...)"))
+    return violations

@@ -1,0 +1,126 @@
+"""retry-coverage pass: network / checkpoint IO routes through the
+resilience retry layer (DESIGN-RESILIENCE.md; ported
+verdict-unchanged from scripts/check_retry_coverage.py).
+
+A bare ``urlopen`` or orbax save/restore call is a latent pod-killer
+on real infrastructure, where transient 5xx / NFS stalls are routine:
+
+1. ``urllib.request.urlopen`` (or bare ``urlopen``) may only be called
+   inside a function that routes through ``retry_call(...)`` /
+   ``@retryable`` — or in an allowlisted module that documents why it
+   is exempt.
+2. Orbax manager IO (``self._mgr.save/restore``) in the checkpoint
+   manager must likewise sit in retry-routed functions.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List
+
+from . import core
+from .core import Codebase, Violation
+
+NAME = "retry-coverage"
+OK_MESSAGE = ("retry coverage OK: all urlopen/checkpoint-IO sites "
+              "route through resilience.retry")
+REPORT_HEADER = "retry coverage violations:"
+
+# modules where a bare urlopen is acceptable, with the reason on record
+URLOPEN_ALLOWLIST = {
+    # the retry layer itself obviously sits below retry_call
+    os.path.join(core.PKG_REL, "distributed", "resilience", "retry.py"),
+    # the controller's fleet metrics scrape is best-effort BY DESIGN:
+    # a failed member scrape means "absent this round" (counted on
+    # fleet_scrape_errors_total), never a judgment, and the next
+    # scrape interval retries naturally — blocking the 4 Hz watch
+    # loop on urlopen retries would delay the failure detection the
+    # loop exists for (DESIGN-OBSERVABILITY.md §Distributed plane)
+    os.path.join(core.PKG_REL, "distributed", "launch", "controller.py"),
+}
+
+CHECKPOINT_MANAGER = os.path.join(core.PKG_REL, "distributed",
+                                  "checkpoint", "manager.py")
+
+
+def _is_urlopen(call: ast.Call) -> bool:
+    return core.call_name(call) == "urlopen"
+
+
+def _is_ckpt_io(call: ast.Call) -> bool:
+    """self._mgr.save(...) / self._mgr.restore(...) — the raw orbax
+    manager IO inside the checkpoint manager."""
+    f = call.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in ("save", "restore")
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "_mgr")
+
+
+def _routes_through_retry(func: ast.AST) -> bool:
+    """The function either calls retry_call / retry.retry_call or is
+    wrapped by @retryable."""
+    for deco in getattr(func, "decorator_list", []):
+        base = deco.func if isinstance(deco, ast.Call) else deco
+        name = base.attr if isinstance(base, ast.Attribute) else \
+            getattr(base, "id", "")
+        if name == "retryable":
+            return True
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call) and \
+                core.call_name(node) == "retry_call":
+            return True
+    return False
+
+
+def _retry_wrapped_names(tree: ast.Module) -> set:
+    """Names of functions handed to ``retry_call`` as the callable —
+    ``retry_call(self._send, ...)`` / ``retry_call(_write, ...)``:
+    their bodies hold the raw IO by design."""
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if core.call_name(node) != "retry_call":
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute):
+            names.add(arg.attr)
+        elif isinstance(arg, ast.Name):
+            names.add(arg.id)
+    return names
+
+
+def run(cb: Codebase) -> List[Violation]:
+    violations: List[Violation] = []
+    for rel, (lineno, msg) in sorted(cb.broken.items()):
+        if rel.startswith(core.PKG_REL):
+            violations.append(Violation(rel, lineno,
+                                        f"syntax error: {msg}"))
+    for mod in cb.iter_modules():
+        _, chains = core.enclosing_chains(mod.tree)
+        wrapped = _retry_wrapped_names(mod.tree)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = None
+            if _is_urlopen(node) and mod.rel not in URLOPEN_ALLOWLIST:
+                kind = "urlopen"
+            elif mod.rel == CHECKPOINT_MANAGER and _is_ckpt_io(node):
+                kind = "checkpoint-IO"
+            if kind is None:
+                continue
+            chain = chains.get(id(node), [])
+            if not chain:
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    f"module-level {kind} call (unretried)"))
+            elif not any(_routes_through_retry(fn)
+                         or fn.name in wrapped for fn in chain):
+                violations.append(Violation(
+                    mod.rel, node.lineno,
+                    f"{kind} call in {chain[-1].name}() does not "
+                    "route through resilience.retry "
+                    "(retry_call/@retryable)"))
+    return violations

@@ -1,112 +1,38 @@
 #!/usr/bin/env python
 """Static FaultPlan site-name check (DESIGN-RESILIENCE.md).
 
-Chaos rules target injection sites by *string name*; a typo on either
-side produces an injection point that silently never fires — the
-recovery path looks chaos-tested while nothing is being injected.
-Enforced structurally like ``check_retry_coverage.py`` (run as a
-plain test in ``tests/test_resilience.py``, no CI needed):
-
-1. every string-literal site passed to ``fault_point(...)`` /
-   ``should_drop(...)`` in production code (``paddle_tpu/``) must
-   appear in the central registry
-   (``resilience.faults.KNOWN_SITES``);
-2. every registry name must be wired into at least one production
-   call site (a registry entry with zero call sites is a recovery
-   path whose chaos coverage silently evaporated);
-3. call sites must use a string literal — a computed site name can't
-   be audited and defeats the registry.
-
-Exit 0 clean; exit 1 with a violation report otherwise.
+Thin wrapper: the check lives in
+``scripts/analysis/fault_sites.py`` on the shared pass framework
+(DESIGN-ANALYSIS.md); this CLI and its ``check()`` API are kept for
+the historic call sites.  Exit 0 clean; exit 1 with a report.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
-from typing import List, Set, Tuple
+from typing import List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "paddle_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-_INJECT_FNS = {"fault_point", "should_drop"}
-
-
-def _call_name(call: ast.Call) -> str:
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        return f.attr
-    return getattr(f, "id", "")
-
-
-def _iter_sites():
-    """Yield (relpath, lineno, site|None) for every injection call in
-    the package; site is None when the first arg is not a literal."""
-    for dirpath, _, files in os.walk(PKG):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, PKG)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=path)
-                except SyntaxError:
-                    continue  # check_retry_coverage reports these
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                if _call_name(node) not in _INJECT_FNS:
-                    continue
-                if not node.args:
-                    continue
-                arg = node.args[0]
-                if isinstance(arg, ast.Constant) and \
-                        isinstance(arg.value, str):
-                    yield rel, node.lineno, arg.value
-                else:
-                    yield rel, node.lineno, None
+from analysis import core, fault_sites  # noqa: E402
 
 
 def check() -> List[Tuple[str, int, str]]:
-    sys.path.insert(0, REPO)
-    try:
-        from paddle_tpu.distributed.resilience.faults import KNOWN_SITES
-    finally:
-        sys.path.pop(0)
-    violations: List[Tuple[str, int, str]] = []
-    used: Set[str] = set()
-    # the registry's own module defines the names, it doesn't call them
-    registry_mod = os.path.join("distributed", "resilience", "faults.py")
-    for rel, line, site in _iter_sites():
-        if rel == registry_mod:
-            continue
-        if site is None:
-            violations.append(
-                (rel, line, "injection site is not a string literal "
-                 "(unauditable; name sites statically)"))
-        elif site not in KNOWN_SITES:
-            violations.append(
-                (rel, line, f"unknown fault site {site!r} — add it to "
-                 "resilience.faults.KNOWN_SITES or fix the typo"))
-        else:
-            used.add(site)
-    for site in sorted(KNOWN_SITES - used):
-        violations.append(
-            (registry_mod, 0,
-             f"registered fault site {site!r} has no production call "
-             "site — dead registry entry or a typo'd call"))
-    return violations
+    """Violations as (path-relative-to-paddle_tpu, line, message)."""
+    cb = core.Codebase.load()
+    prefix = core.PKG_REL + os.sep
+    return [(v.rel[len(prefix):] if v.rel.startswith(prefix) else v.rel,
+             v.line, v.message)
+            for v in core.run_pass(cb, fault_sites)]
 
 
 def main() -> int:
     violations = check()
     if not violations:
-        print("fault-site coverage OK: every injection site is "
-              "registered and every registered site is wired")
+        print(fault_sites.OK_MESSAGE)
         return 0
-    print("fault-site violations:")
+    print(fault_sites.REPORT_HEADER)
     for rel, line, msg in violations:
         print(f"  paddle_tpu/{rel}:{line}: {msg}")
     return 1

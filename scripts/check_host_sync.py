@@ -1,242 +1,39 @@
 #!/usr/bin/env python
 """Static host-sync check for the training hot path (DESIGN-PERF.md).
 
-The async-dispatch contract says the ``Model.fit`` /
-``DistributedRunner`` hot loop may NOT synchronize host and device:
-every ``jax.device_get`` / ``.numpy()`` / ``np.asarray`` /
-``jax.block_until_ready`` on a device value stalls the dispatch queue
-and serializes host with device — exactly the overlap TPUs live on.
-Syncs are allowed only at explicitly whitelisted points (boundary
-materialization, host→device staging of fresh numpy input, public
-APIs that return numpy by contract).
-
-Mirrors ``scripts/check_retry_coverage.py``: enforced structurally as
-a plain test (``tests/test_hapi_hot_path.py``), no CI required.  The
-check is syntactic — it cannot tell a device value from a host value —
-so every allowlisted (module, function) carries its justification here,
-on record.
-
-Exit 0 clean; exit 1 with a violation report otherwise.
+Thin wrapper: the check itself lives in
+``scripts/analysis/host_sync.py`` on the shared pass framework
+(DESIGN-ANALYSIS.md); this CLI and its ``check()`` API are kept for
+the historic call sites.  Exit 0 clean; exit 1 with a report.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "paddle_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# the hot-loop modules under the contract
-HOT_MODULES = [
-    os.path.join("hapi", "model.py"),
-    os.path.join("hapi", "callbacks.py"),
-    os.path.join("hapi", "train_state.py"),
-    os.path.join("distributed", "runner.py"),
-    # the explicit dp gradient path (DESIGN-DCN.md): the compressed
-    # ring collectives and the sharded weight update trace INSIDE the
-    # compiled step — a host sync here would stall every dispatch
-    os.path.join("distributed", "compressed.py"),
-    os.path.join("metric", "__init__.py"),
-    os.path.join("io", "dataloader.py"),
-    os.path.join("io", "staging.py"),
-    os.path.join("framework", "lazy.py"),
-    # the unified dispatch engine (DESIGN-PERF.md §Unified dispatch
-    # engine): grouping + auto-K sit directly on the hot loop for
-    # both the single-chip and mesh paths
-    os.path.join("framework", "dispatch.py"),
-    # serving decode hot path (DESIGN-SERVING.md): the persistent
-    # dispatch loop must never stall host↔device — same contract,
-    # same guard, as the training loop
-    os.path.join("inference", "serving", "engine.py"),
-    os.path.join("inference", "serving", "ragged_attention.py"),
-    os.path.join("inference", "serving", "kv_cache.py"),
-    os.path.join("inference", "serving", "decode_model.py"),
-    os.path.join("inference", "serving", "scheduler.py"),
-    # long-context tier (DESIGN-SERVING.md §Long-context tier): the
-    # fused paged-attention kernel and the sampling math trace INSIDE
-    # the compiled decode step; the prefix cache is host bookkeeping
-    # living on the pump thread between dispatches — none of the
-    # three may ever sync host with device
-    os.path.join("inference", "serving", "paged_attention_kernel.py"),
-    os.path.join("inference", "serving", "sampling.py"),
-    os.path.join("inference", "serving", "prefix_cache.py"),
-    # disaggregated tier (DESIGN-SERVING.md §Disaggregated tier):
-    # page migration is a jitted device-to-device gather/scatter cut
-    # and imported ON the pump threads — the ticket itself is host
-    # bookkeeping and must stay that way (reading migrated K/V on the
-    # host would stall both replicas' dispatch queues at once); the
-    # disagg router runs its transition hook on prefill pump threads
-    os.path.join("inference", "serving", "migration.py"),
-    os.path.join("inference", "serving", "disagg.py"),
-    # observability subsystem (DESIGN-OBSERVABILITY.md): it lives
-    # INSIDE every hot loop above, so it is held to the same contract
-    # — instruments hold lazy device values and defer the sync to
-    # scrape (metrics._materialize is a float() call, deliberately
-    # not a whitelisted jax sync: a device value pays its sync via
-    # the LazyScalar.__float__ sanctioned path)
-    os.path.join("observability", "__init__.py"),
-    os.path.join("observability", "trace.py"),
-    os.path.join("observability", "metrics.py"),
-    os.path.join("observability", "export.py"),
-    # distributed observability plane (DESIGN-OBSERVABILITY.md
-    # §Distributed plane): the HTTP handlers and the fleet merge run
-    # next to live training/serving processes — materialization is
-    # allowed ONLY inside a scrape request (which rides the same
-    # metrics._materialize float() path as in-process scrape), and
-    # the aggregator works on already-materialized snapshot dicts, so
-    # neither module may contain a direct jax/numpy sync call at all
-    os.path.join("observability", "http.py"),
-    os.path.join("observability", "aggregate.py"),
-    # action loop (DESIGN-OBSERVABILITY.md §Action loop): the serving
-    # router's control loop and the decision ring run NEXT TO the
-    # decode hot loop they supervise — both read host state only
-    # (queue depths, host-float histograms via materialize=False), so
-    # neither may contain a direct jax/numpy sync call at all
-    os.path.join("observability", "events.py"),
-    os.path.join("inference", "serving", "router.py"),
-    # pipeline-schedule engine on the unified dispatcher (ISSUE 15,
-    # DESIGN-PERF.md §Unified dispatch engine): train_batch /
-    # train_steps_folded sit directly on the hot loop for pp and
-    # hybrid dp x mp x pp meshes — staging rides io/staging, wrapper
-    # write-back is reference-only, and nothing may sync host with
-    # device between dispatches
-    os.path.join("distributed", "fleet", "meta_parallel",
-                 "pipeline_parallel.py"),
-]
-
-# (module, enclosing function) → why this sync point is legitimate
-ALLOWED_SYNC = {
-    ("framework", "lazy.py", "_materialize"):
-        "THE deferred sync point: LazyScalar materializes on first "
-        "host use (callback formatting), not per step",
-    ("framework", "lazy.py", "block"):
-        "auto-K calibration probe ONLY: waits on the device value "
-        "without fetching it, during the first calib_groups "
-        "dispatches of a fit — never steady state",
-    ("framework", "dispatch.py", "_calibration_block"):
-        "auto-K calibration ONLY: splits host dispatch overhead from "
-        "device step time over the first calib_groups dispatches; "
-        "the steady-state hot loop never enters it",
-    ("hapi", "model.py", "predict_batch"):
-        "public API returns numpy by contract",
-    ("hapi", "model.py", "_cat"):
-        "host-side concat of host loader batches (grad-accum "
-        "grouping happens before staging)",
-    ("hapi", "callbacks.py", "_fmt"):
-        "verbose-interval log formatting (ProgBarLogger) — the "
-        "sanctioned materialization cadence",
-    ("hapi", "callbacks.py", "on_eval_end"):
-        "EarlyStopping decision at the epoch boundary",
-    ("metric", "__init__.py", "_np"):
-        "host-path Metric API: used for direct user calls, never by "
-        "the fit hot loop (which uses device_batch_stats)",
-    ("metric", "__init__.py", "update"):
-        "host-path Metric.update (outside the fit hot loop)",
-    ("metric", "__init__.py", "compute"):
-        "host-path Metric.compute (outside the fit hot loop)",
-    ("metric", "__init__.py", "accumulate"):
-        "epoch-boundary materialization of device accumulators",
-    ("metric", "__init__.py", "_device_stat_sum"):
-        "accumulate()'s helper: one materialization of the pending "
-        "stats + folded-carry accumulator at the epoch boundary",
-    ("metric", "__init__.py", "accuracy"):
-        "functional host metric (one-shot, not a loop)",
-    ("io", "staging.py", "to_device_value"):
-        "host→device staging (np.asarray views host data, never a "
-        "device value)",
-    ("io", "staging.py", "to_device_values"):
-        "host→device staging (batched device_put of host leaves)",
-    ("io", "staging.py", "stack_to_device"):
-        "step-folding staging: np.asarray views HOST batch leaves "
-        "before the K-group's single batched device_put; device "
-        "leaves take jnp.stack (no D2H)",
-    ("io", "dataloader.py", "default_collate_fn"):
-        "collates host sample arrays produced by the dataset",
-    ("inference", "serving", "engine.py", "_poll_done"):
-        "THE group-boundary sync of the decode loop: one [B] bool "
-        "done-mask fetch every done_poll_interval dispatches, never "
-        "inside one (DESIGN-SERVING.md §EOS)",
-    ("inference", "serving", "engine.py", "_warmup"):
-        "AOT compile timing before traffic cuts over — blocking on "
-        "device completion is the point (cold-start metric; `warmup` "
-        "wraps this body in the engine's device-placement scope)",
-}
-
-
-def _sync_kind(call: ast.Call):
-    f = call.func
-    if isinstance(f, ast.Attribute):
-        if f.attr == "device_get":
-            return "jax.device_get"
-        if f.attr == "block_until_ready":
-            return "jax.block_until_ready"
-        if f.attr == "numpy" and not call.args and not call.keywords:
-            return ".numpy()"
-        if f.attr == "asarray" and isinstance(f.value, ast.Name) \
-                and f.value.id in ("np", "numpy"):
-            return "np.asarray"
-    elif isinstance(f, ast.Name) and f.id == "device_get":
-        return "jax.device_get"
-    return None
+from analysis import core, host_sync  # noqa: E402
+from analysis.host_sync import ALLOWED_SYNC, HOT_MODULES  # noqa: F401,E402
 
 
 def check() -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
-    seen_funcs = set()
-    for rel in HOT_MODULES:
-        path = os.path.join(PKG, rel)
-        with open(path) as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        parts = tuple(rel.split(os.sep))
-        # enclosing-function chains (innermost last)
-        funcs = [n for n in ast.walk(tree)
-                 if isinstance(n, (ast.FunctionDef,
-                                   ast.AsyncFunctionDef))]
-        chains = {}
-        for fn in funcs:
-            seen_funcs.add(parts + (fn.name,))
-            for n in ast.walk(fn):
-                chains.setdefault(id(n), []).append(fn)
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.Call):
-                continue
-            kind = _sync_kind(node)
-            if kind is None:
-                continue
-            chain = chains.get(id(node), [])
-            if not chain:
-                violations.append(
-                    (rel, node.lineno,
-                     f"module-level {kind} (host sync outside any "
-                     "whitelisted function)"))
-            elif not any(parts + (fn.name,) in ALLOWED_SYNC
-                         for fn in chain):
-                violations.append(
-                    (rel, node.lineno,
-                     f"{kind} in {chain[-1].name}() is not a "
-                     "whitelisted sync point (DESIGN-PERF.md: the hot "
-                     "loop must not stall the dispatch queue)"))
-    # a stale allowlist hides future violations: every entry must
-    # still name a real function
-    for entry, reason in ALLOWED_SYNC.items():
-        if entry not in seen_funcs:
-            violations.append(
-            (os.path.join(*entry[:-1]), 0,
-             f"stale allowlist entry: no function named "
-             f"{entry[-1]!r} ({reason[:40]}...)"))
-    return violations
+    """Violations as (path-relative-to-paddle_tpu, line, message)."""
+    cb = core.Codebase.load()
+    prefix = core.PKG_REL + os.sep
+    return [(v.rel[len(prefix):] if v.rel.startswith(prefix) else v.rel,
+             v.line, v.message)
+            for v in core.run_pass(cb, host_sync)]
 
 
 def main() -> int:
     violations = check()
     if not violations:
-        print("host-sync coverage OK: hot-loop modules sync only at "
-              "whitelisted points")
+        print(host_sync.OK_MESSAGE)
         return 0
-    print("host-sync violations:")
+    print(host_sync.REPORT_HEADER)
     for rel, line, msg in violations:
         print(f"  paddle_tpu/{rel}:{line}: {msg}")
     return 1

@@ -2,140 +2,35 @@
 """Static metric-name convention check (DESIGN-OBSERVABILITY.md
 §Metric naming convention).
 
-Every instrument on the process-wide registry is created through
-``registry().counter/gauge/histogram("name", ...)`` — this check
-walks every production module under ``paddle_tpu/`` and enforces, at
-the AST level:
-
-- **Literal names only.**  A computed name (f-string, concat,
-  variable) cannot be grepped from a dashboard back to its call site
-  and silently mints unbounded families; the registry's
-  one-name-one-meaning contract needs names that exist in the source
-  text.  (``labels`` carry the dynamic dimension instead.)
-- **Shape:** snake_case, ``^[a-z][a-z0-9_]*[a-z0-9]$``, no ``__``.
-- **Counters end in ``_total``** (Prometheus counter convention).
-- **Histograms end in a unit suffix** (``_s``, ``_ms``, ``_bytes``,
-  ``_pct``, ``_ratio``) — every histogram in the process is a
-  distribution *of* something measurable on a shared grid.
-- **Gauges never end in ``_total``** (that suffix promises
-  monotonicity) and carry a unit suffix when they measure a unit
-  (level quantities like ``serving_queue_depth`` stay bare).
-
-Receiver heuristic (syntactic, like check_host_sync.py): a call is a
-registry call when it reads ``registry().counter(...)``,
-``reg.counter(...)`` or ``self._reg.counter(...)`` — the three idioms
-the codebase uses (``jnp.histogram`` and friends don't match).  The
-check fails closed on its own coverage: finding implausibly few call
-sites means the heuristic broke, and that is itself a violation.
-
-Mirrors check_retry_coverage/check_fault_sites/check_host_sync:
-enforced as a plain test, exit 0 clean / 1 with a report.
+Thin wrapper: the check lives in
+``scripts/analysis/metric_names.py`` on the shared pass framework
+(DESIGN-ANALYSIS.md); this CLI and its ``check()`` API are kept for
+the historic call sites.  Exit 0 clean; exit 1 with a report.
 """
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
 from typing import List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "paddle_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-KINDS = ("counter", "gauge", "histogram")
-NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[a-z0-9]$")
-UNIT_SUFFIXES = ("_s", "_ms", "_bytes", "_pct", "_ratio")
-
-# fewer literal call sites than this means the receiver heuristic
-# stopped matching the codebase idiom — fail loudly, not silently
-# (52 sites as of PR 13's control-loop instruments; the floor trails
-# the census so genuine removals don't trip it)
-MIN_EXPECTED_SITES = 40
-
-
-def _is_registry_receiver(node: ast.expr) -> bool:
-    """registry() / *.registry() / reg / self._reg / *_reg"""
-    if isinstance(node, ast.Call):
-        f = node.func
-        name = f.id if isinstance(f, ast.Name) else (
-            f.attr if isinstance(f, ast.Attribute) else "")
-        return name == "registry"
-    if isinstance(node, ast.Name):
-        return node.id == "reg" or node.id.endswith("_reg")
-    if isinstance(node, ast.Attribute):
-        return node.attr == "_reg" or node.attr.endswith("_reg")
-    return False
-
-
-def _check_name(kind: str, name: str) -> List[str]:
-    problems = []
-    if not NAME_RE.match(name) or "__" in name:
-        problems.append(f"{name!r} is not snake_case "
-                        "([a-z][a-z0-9_]*, no '__')")
-        return problems
-    if kind == "counter" and not name.endswith("_total"):
-        problems.append(f"counter {name!r} must end in _total")
-    if kind == "histogram" and not name.endswith(UNIT_SUFFIXES):
-        problems.append(
-            f"histogram {name!r} must end in a unit suffix "
-            f"{UNIT_SUFFIXES}")
-    if kind != "counter" and name.endswith("_total"):
-        problems.append(
-            f"{kind} {name!r} must not end in _total (that suffix "
-            "promises a monotone counter)")
-    return problems
+from analysis import core, metric_names  # noqa: E402
+from analysis.metric_names import (MIN_EXPECTED_SITES,  # noqa: F401,E402
+                                   _check_name)
 
 
 def check() -> Tuple[List[Tuple[str, int, str]], int]:
-    violations: List[Tuple[str, int, str]] = []
-    sites = 0
-    for dirpath, dirnames, filenames in os.walk(PKG):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, REPO)
-            with open(path) as fh:
-                try:
-                    tree = ast.parse(fh.read(), filename=path)
-                except SyntaxError as e:
-                    violations.append((rel, e.lineno or 0,
-                                       f"unparseable: {e.msg}"))
-                    continue
-            for node in ast.walk(tree):
-                if not (isinstance(node, ast.Call)
-                        and isinstance(node.func, ast.Attribute)
-                        and node.func.attr in KINDS
-                        and _is_registry_receiver(node.func.value)):
-                    continue
-                sites += 1
-                if not node.args:
-                    violations.append(
-                        (rel, node.lineno,
-                         f".{node.func.attr}() with no name argument"))
-                    continue
-                arg = node.args[0]
-                if not (isinstance(arg, ast.Constant)
-                        and isinstance(arg.value, str)):
-                    violations.append(
-                        (rel, node.lineno,
-                         f".{node.func.attr}() name is computed "
-                         f"({ast.dump(arg)[:60]}...): instrument "
-                         "names must be string literals — put the "
-                         "dynamic dimension in labels"))
-                    continue
-                for p in _check_name(node.func.attr, arg.value):
-                    violations.append((rel, node.lineno, p))
-    if sites < MIN_EXPECTED_SITES:
-        violations.append(
-            ("scripts/check_metric_names.py", 0,
-             f"coverage self-check: only {sites} registry call sites "
-             f"matched (expected >= {MIN_EXPECTED_SITES}) — the "
-             "receiver heuristic no longer matches the codebase "
-             "idiom"))
-    return violations, sites
+    """(violations as (repo-relative path, line, message), sites)."""
+    cb = core.Codebase.load()
+    violations, sites = metric_names.scan(cb)
+    kept = []
+    for v in violations:
+        sups = cb.suppressions_at(v.rel, v.line, metric_names.NAME)
+        if not sups:
+            kept.append((v.rel, v.line, v.message))
+    return kept, sites
 
 
 def main() -> int:
@@ -144,7 +39,7 @@ def main() -> int:
         print(f"metric-name convention OK over {sites} registry "
               "call sites")
         return 0
-    print("metric-name violations:")
+    print(metric_names.REPORT_HEADER)
     for rel, line, msg in violations:
         print(f"  {rel}:{line}: {msg}")
     return 1

@@ -1,174 +1,38 @@
 #!/usr/bin/env python
 """Static retry-coverage check (DESIGN-RESILIENCE.md).
 
-Every network and checkpoint-IO call site in ``paddle_tpu/`` must route
-through the resilience retry layer — a bare ``urlopen`` or orbax
-save/restore call is a latent pod-killer on real infrastructure, where
-transient 5xx / NFS stalls are routine.  The rule is enforced
-structurally, no CI required: ``tests/test_resilience.py`` runs this
-script as a plain test.
-
-Checked invariants:
-
-1. ``urllib.request.urlopen`` (or bare ``urlopen``) may only be called
-   inside a function whose enclosing module imports the resilience
-   retry layer AND whose function body routes through it
-   (``retry_call(...)`` / ``@retryable``) — or in an allowlisted
-   module that documents why it is exempt.
-2. Orbax manager IO (``self._mgr.save/restore``) in the checkpoint
-   manager must likewise sit in retry-routed functions.
-
-Exit 0 clean; exit 1 with a violation report otherwise.
+Thin wrapper: the check lives in
+``scripts/analysis/retry_coverage.py`` on the shared pass framework
+(DESIGN-ANALYSIS.md); this CLI and its ``check()`` API are kept for
+the historic call sites.  Exit 0 clean; exit 1 with a report.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "paddle_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# modules where a bare urlopen is acceptable, with the reason on record
-URLOPEN_ALLOWLIST = {
-    # the retry layer itself obviously sits below retry_call
-    os.path.join("distributed", "resilience", "retry.py"),
-    # the controller's fleet metrics scrape is best-effort BY DESIGN:
-    # a failed member scrape means "absent this round" (counted on
-    # fleet_scrape_errors_total), never a judgment, and the next
-    # scrape interval retries naturally — blocking the 4 Hz watch
-    # loop on urlopen retries would delay the failure detection the
-    # loop exists for (DESIGN-OBSERVABILITY.md §Distributed plane)
-    os.path.join("distributed", "launch", "controller.py"),
-}
-
-CHECKPOINT_MANAGER = os.path.join("distributed", "checkpoint",
-                                  "manager.py")
-
-
-def _is_urlopen(call: ast.Call) -> bool:
-    f = call.func
-    if isinstance(f, ast.Name):
-        return f.id == "urlopen"
-    if isinstance(f, ast.Attribute):
-        return f.attr == "urlopen"
-    return False
-
-
-def _is_ckpt_io(call: ast.Call) -> bool:
-    """self._mgr.save(...) / self._mgr.restore(...) — the raw orbax
-    manager IO inside the checkpoint manager."""
-    f = call.func
-    return (isinstance(f, ast.Attribute)
-            and f.attr in ("save", "restore")
-            and isinstance(f.value, ast.Attribute)
-            and f.value.attr == "_mgr")
-
-
-def _routes_through_retry(func: ast.AST) -> bool:
-    """The function either calls retry_call / retry.retry_call or is
-    wrapped by @retryable."""
-    for deco in getattr(func, "decorator_list", []):
-        base = deco.func if isinstance(deco, ast.Call) else deco
-        name = base.attr if isinstance(base, ast.Attribute) else \
-            getattr(base, "id", "")
-        if name == "retryable":
-            return True
-    for node in ast.walk(func):
-        if isinstance(node, ast.Call):
-            f = node.func
-            name = f.attr if isinstance(f, ast.Attribute) else \
-                getattr(f, "id", "")
-            if name == "retry_call":
-                return True
-    return False
-
-
-def _retry_wrapped_names(tree: ast.Module) -> set:
-    """Names of functions handed to ``retry_call`` as the callable —
-    ``retry_call(self._send, ...)`` / ``retry_call(_write, ...)``:
-    their bodies hold the raw IO by design."""
-    names = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        f = node.func
-        fname = f.attr if isinstance(f, ast.Attribute) else \
-            getattr(f, "id", "")
-        if fname != "retry_call":
-            continue
-        arg = node.args[0]
-        if isinstance(arg, ast.Attribute):
-            names.add(arg.attr)
-        elif isinstance(arg, ast.Name):
-            names.add(arg.id)
-    return names
-
-
-def _enclosing_functions(tree: ast.Module):
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node
+from analysis import core, retry_coverage  # noqa: E402
 
 
 def check() -> List[Tuple[str, int, str]]:
-    violations: List[Tuple[str, int, str]] = []
-    for dirpath, _, files in os.walk(PKG):
-        for name in files:
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, name)
-            rel = os.path.relpath(path, PKG)
-            with open(path) as f:
-                try:
-                    tree = ast.parse(f.read(), filename=path)
-                except SyntaxError as e:
-                    violations.append((rel, e.lineno or 0,
-                                       f"syntax error: {e.msg}"))
-                    continue
-            # every enclosing function of each interesting call
-            # (innermost last), plus the module-wide set of functions
-            # that are themselves handed to retry_call
-            funcs = list(_enclosing_functions(tree))
-            chains = {}
-            for fn in funcs:
-                for n in ast.walk(fn):
-                    chains.setdefault(id(n), []).append(fn)
-            wrapped = _retry_wrapped_names(tree)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                kind = None
-                if _is_urlopen(node) and rel not in URLOPEN_ALLOWLIST:
-                    kind = "urlopen"
-                elif rel == CHECKPOINT_MANAGER and _is_ckpt_io(node):
-                    kind = "checkpoint-IO"
-                if kind is None:
-                    continue
-                chain = chains.get(id(node), [])
-                if not chain:
-                    violations.append(
-                        (rel, node.lineno,
-                         f"module-level {kind} call (unretried)"))
-                elif not any(_routes_through_retry(fn)
-                             or fn.name in wrapped for fn in chain):
-                    violations.append(
-                        (rel, node.lineno,
-                         f"{kind} call in {chain[-1].name}() does not "
-                         "route through resilience.retry "
-                         "(retry_call/@retryable)"))
-    return violations
+    """Violations as (path-relative-to-paddle_tpu, line, message)."""
+    cb = core.Codebase.load()
+    prefix = core.PKG_REL + os.sep
+    return [(v.rel[len(prefix):] if v.rel.startswith(prefix) else v.rel,
+             v.line, v.message)
+            for v in core.run_pass(cb, retry_coverage)]
 
 
 def main() -> int:
     violations = check()
     if not violations:
-        print("retry coverage OK: all urlopen/checkpoint-IO sites "
-              "route through resilience.retry")
+        print(retry_coverage.OK_MESSAGE)
         return 0
-    print("retry coverage violations:")
+    print(retry_coverage.REPORT_HEADER)
     for rel, line, msg in violations:
         print(f"  paddle_tpu/{rel}:{line}: {msg}")
     return 1

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Single entry point for the program-stability analysis suite
+(DESIGN-ANALYSIS.md).
+
+One file walk, one ``ast.parse`` per module, eight passes::
+
+    python scripts/lint.py                  # run everything
+    python scripts/lint.py host-sync env-knobs   # a subset
+    python scripts/lint.py --list           # pass catalog
+    python scripts/lint.py --write-env-table     # refresh README
+
+Exit 0 clean; exit 1 with a uniform violation report otherwise.
+Suppress a finding in place with ``# lint: allow(<pass>): <reason>``
+on the flagged line — the reason is mandatory, the pass name must
+exist, and a suppression that no longer silences anything is itself
+a violation (the full run enforces all three).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from analysis import PASSES, core  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="program-stability analysis suite")
+    ap.add_argument("passes", nargs="*",
+                    help="pass names to run (default: all)")
+    ap.add_argument("--list", action="store_true",
+                    help="list the pass catalog and exit")
+    ap.add_argument("--write-env-table", action="store_true",
+                    help="regenerate the README env-knob table from "
+                         "the registry and exit")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for name, mod in PASSES.items():
+            doc = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"  {name:18s} {doc}")
+        return 0
+
+    if args.write_env_table:
+        from analysis.env_knobs_pass import write_env_table
+        changed = write_env_table()
+        print("README env-knob table "
+              + ("rewritten" if changed else "already fresh"))
+        return 0
+
+    selected = args.passes or list(PASSES)
+    unknown = [p for p in selected if p not in PASSES]
+    if unknown:
+        print(f"unknown pass(es): {', '.join(unknown)} "
+              f"(known: {', '.join(PASSES)})")
+        return 2
+
+    cb = core.Codebase.load()
+    violations = []
+    for name in selected:
+        violations.extend(core.run_pass(cb, PASSES[name]))
+    # suppression hygiene rides the full run only: a subset run can't
+    # judge suppressions for passes it didn't execute
+    violations.extend(core.suppression_violations(
+        cb, known_passes=set(PASSES), ran_passes=selected))
+
+    if not violations:
+        print(f"lint OK: {len(selected)} pass(es) clean over "
+              f"{len(cb.modules)} modules")
+        return 0
+    print(f"lint: {len(violations)} violation(s):")
+    print(core.format_report(violations))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -148,6 +148,19 @@ def require_cpu_multiprocess():
                     "capability probe; ROADMAP container drift)")
 
 
+@pytest.fixture
+def retrace_strict():
+    """Arm the runtime retrace sentinel for a test module
+    (``pytestmark = pytest.mark.usefixtures("retrace_strict")``): any
+    trace of a single-trace compiled entry after its first dispatch
+    raises RetraceError instead of silently recompiling — the ambient
+    form of the hand-written ``entries == 1, traces == 1`` pins."""
+    from paddle_tpu.framework import dispatch as _dispatch
+    _dispatch.set_retrace_strict(True)
+    yield
+    _dispatch.set_retrace_strict(None)
+
+
 @pytest.fixture(autouse=True)
 def _reset_state():
     """Isolate tests: fresh tape, fresh RNG, no leaked mesh."""

@@ -1,0 +1,376 @@
+"""Program-stability analysis suite (ISSUE 17, DESIGN-ANALYSIS.md):
+the shared pass framework, all eight passes green over the live tree,
+a negative control per pass, suppression-ledger hygiene, the thin
+wrapper CLIs, and the runtime retrace sentinel's contract.
+
+This module replaces the per-script test shims that used to live in
+test_observability / test_observability_http / test_resilience /
+test_hapi_hot_path: one Codebase load + one run of every pass serves
+every green assertion here (budget: the whole module adds a few
+seconds to tier-1, not a reparse per test)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+from analysis import PASSES, core  # noqa: E402
+from analysis import (donation_safety, env_knobs_pass, fault_sites,  # noqa: E402
+                      host_sync, knob_consumption, metric_names,
+                      retrace_hazards, retry_coverage)
+
+PKG = core.PKG_REL
+
+
+def _mod(rel, src):
+    """from_sources key helper: a synthetic package module."""
+    return {os.path.join(PKG, rel): src}
+
+
+@pytest.fixture(scope="module")
+def cb():
+    """ONE file walk + parse of the live tree for the whole module."""
+    return core.Codebase.load()
+
+
+@pytest.fixture(scope="module")
+def lint_results(cb):
+    """Every pass run once over the shared Codebase (order-independent:
+    green tests and the hygiene test read this cache instead of
+    re-running passes per test)."""
+    return {name: core.run_pass(cb, mod) for name, mod in PASSES.items()}
+
+
+# ---------------------------------------------------------------------------
+# green: the live tree passes all eight checks
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", sorted(PASSES))
+def test_pass_green(lint_results, name):
+    violations = lint_results[name]
+    assert not violations, "\n" + core.format_report(violations)
+
+
+def test_suppression_ledger_hygiene(cb, lint_results):
+    """Every in-tree ``# lint: allow(...)`` names a real pass, carries
+    a reason, and still silences a live finding."""
+    violations = core.suppression_violations(
+        cb, known_passes=set(PASSES), ran_passes=set(PASSES))
+    assert not violations, "\n" + core.format_report(violations)
+    # and the ledger is non-empty by design: the suite documents its
+    # own exemptions in place rather than in out-of-band allowlists
+    assert any(cb.all_suppressions())
+
+
+# ---------------------------------------------------------------------------
+# suppression machinery (synthetic sources)
+# ---------------------------------------------------------------------------
+
+def test_suppression_hygiene_rules():
+    src = ("x = 1  # lint: allow(no-such-pass): whatever\n"
+           "y = 2  # lint: allow(env-knobs)\n")
+    syn = core.Codebase.from_sources(_mod("m.py", src))
+    vs = core.suppression_violations(syn, set(PASSES), ran_passes=set())
+    assert any("unknown pass" in v.message and v.line == 1 for v in vs)
+    assert any("no reason" in v.message and v.line == 2 for v in vs)
+
+
+def test_suppression_silences_and_unused_fires():
+    src = ("from jax.sharding import PartitionSpec as P\n"
+           "a = P('dp', None)  # lint: allow(retrace-hazards): control\n"
+           "b = 1  # lint: allow(retrace-hazards): silences nothing\n")
+    syn = core.Codebase.from_sources(_mod("m.py", src))
+    vs = core.run_pass(syn, retrace_hazards)
+    # line 2's finding is suppressed...
+    assert not [v for v in vs if v.line == 2]
+    # ...and the dangling allow on line 3 is itself a violation
+    hv = core.suppression_violations(syn, set(PASSES),
+                                     ran_passes={"retrace-hazards"})
+    assert any("unused suppression" in v.message and v.line == 3
+               for v in hv)
+
+
+# ---------------------------------------------------------------------------
+# negative controls: each pass still catches what it exists to catch
+# ---------------------------------------------------------------------------
+
+def test_host_sync_negative_control():
+    rel = os.path.join("framework", "dispatch.py")  # a HOT module
+    src = ("import jax\n"
+           "def hot_loop(x):\n"
+           "    jax.block_until_ready(x)\n")
+    vs = host_sync.run(core.Codebase.from_sources(_mod(rel, src)))
+    assert any(v.rel == os.path.join(PKG, rel)
+               and "jax.block_until_ready" in v.message
+               and "not a whitelisted sync point" in v.message
+               for v in vs)
+    # wrapper-era coverage assertions ride along: the instrumented
+    # observability hot loops stay under the contract
+    for hot in ("trace.py", "http.py", "aggregate.py"):
+        assert os.path.join("observability", hot) in host_sync.HOT_MODULES
+
+
+def test_metric_names_negative_control():
+    src = "def f(reg):\n    reg.counter('fit_steps', 'doc')\n"
+    vs = metric_names.run(core.Codebase.from_sources(_mod("m.py", src)))
+    assert any("must end in _total" in v.message for v in vs)
+    # the name rules themselves (ported verdict-unchanged)
+    assert metric_names._check_name("counter", "fit_steps")
+    assert metric_names._check_name("histogram", "dispatch_wall")
+    assert metric_names._check_name("gauge", "queue_total")
+    assert metric_names._check_name("counter", "Bad-Name_total")
+    assert not metric_names._check_name("counter", "fit_steps_total")
+    assert not metric_names._check_name("histogram", "dispatch_wall_s")
+    assert not metric_names._check_name("gauge", "serving_queue_depth")
+    assert metric_names.MIN_EXPECTED_SITES >= 40
+
+
+def test_fault_sites_negative_control():
+    src = ("def f():\n"
+           "    fault_point('typo_site')\n"
+           "    should_drop(name)\n")
+    vs = fault_sites.run(core.Codebase.from_sources(_mod("m.py", src)),
+                         known_sites={"registered_site"})
+    assert any("unknown fault site 'typo_site'" in v.message for v in vs)
+    assert any("not a string literal" in v.message for v in vs)
+    assert any("'registered_site' has no production call site"
+               in v.message for v in vs)
+
+
+def test_retry_coverage_negative_control():
+    src = ("from urllib.request import urlopen\n"
+           "def fetch(u):\n"
+           "    return urlopen(u)\n")
+    vs = retry_coverage.run(core.Codebase.from_sources(_mod("m.py", src)))
+    assert any("urlopen call in fetch()" in v.message for v in vs)
+    # and the retry-routed form is clean
+    ok = ("from urllib.request import urlopen\n"
+          "from .retry import retry_call\n"
+          "def fetch(u):\n"
+          "    return retry_call(lambda: urlopen(u))\n")
+    vs = retry_coverage.run(core.Codebase.from_sources(_mod("ok.py", ok)))
+    assert not vs
+
+
+def test_retrace_hazards_negative_control():
+    src = ("import jax\n"
+           "import numpy as np\n"
+           "from jax.sharding import Mesh, PartitionSpec as P\n"
+           "spec = P('dp', None)\n"
+           "def build(devs):\n"
+           "    return Mesh(np.array(devs).reshape(4, 1), ('dp', 'mp'))\n")
+    vs = retrace_hazards.run(core.Codebase.from_sources(_mod("m.py", src)))
+    assert any("trailing None" in v.message and v.line == 4 for v in vs)
+    assert any("size-1 axis" in v.message and v.line == 6 for v in vs)
+    # rule 2: device_put outside a placement seam in an engine module
+    eng = os.path.join("distributed", "runner.py")
+    src2 = ("import jax\n"
+            "def _shard(v):\n"
+            "    return jax.device_put(v)\n"       # the seam: allowed
+            "def ad_hoc(v):\n"
+            "    return jax.device_put(v)\n")      # outside: flagged
+    vs = retrace_hazards.run(core.Codebase.from_sources(_mod(eng, src2)))
+    assert any("device_put in ad_hoc()" in v.message for v in vs)
+    assert not any("in _shard()" in v.message for v in vs)
+
+
+def test_donation_safety_negative_control():
+    # rule 1: read of a donated name before rebinding
+    src = ("import jax\n"
+           "def run(step_fn, state, batch):\n"
+           "    step = jax.jit(step_fn, donate_argnums=(0,))\n"
+           "    out = step(state, batch)\n"
+           "    return state.sum() + out\n")
+    vs = donation_safety.run(core.Codebase.from_sources(_mod("m.py", src)))
+    assert any("'state' was donated" in v.message and v.line == 5
+               for v in vs)
+    # the canonical carry idiom (rebind in the calling statement) is ok
+    ok = ("import jax\n"
+          "def run(step_fn, state, batch):\n"
+          "    step = jax.jit(step_fn, donate_argnums=(0,))\n"
+          "    state = step(state, batch)\n"
+          "    return state\n")
+    assert not donation_safety.run(
+        core.Codebase.from_sources(_mod("ok.py", ok)))
+    # rule 2: literal donation in a shard_map module needs the knob
+    haz = ("import jax\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "def build(f):\n"
+           "    return jax.jit(f, donate_argnums=(0,))\n"
+           "def fold(f):\n"
+           "    return build_folded_step(f, 8)\n")
+    vs = donation_safety.run(core.Codebase.from_sources(_mod("h.py", haz)))
+    assert any("literal donate_argnums in a shard_map module"
+               in v.message for v in vs)
+    assert any("implicit donate_carry=True default" in v.message
+               for v in vs)
+    # with the donate_carry knob threaded through, both are clean
+    okh = ("import jax\n"
+           "from jax.experimental.shard_map import shard_map\n"
+           "def build(f, donate_carry=True):\n"
+           "    d = (0,) if donate_carry else ()\n"
+           "    return jax.jit(f, donate_argnums=d)\n"
+           "def fold(f):\n"
+           "    return build_folded_step(f, 8, donate_carry=False)\n")
+    assert not donation_safety.run(
+        core.Codebase.from_sources(_mod("okh.py", okh)))
+
+
+def test_knob_consumption_negative_control():
+    strat = os.path.join("distributed", "fleet", "base",
+                         "distributed_strategy.py")
+    fleet = os.path.join("distributed", "fleet", "fleet.py")
+    sources = {
+        os.path.join(PKG, strat): (
+            "class DistributedStrategy:\n"
+            "    def __init__(self):\n"
+            "        self.amp = False\n"
+            "        self.ghost = False\n"
+            "        self.refused_ok = False\n"),
+        os.path.join(PKG, fleet): (
+            "_REFUSED_STRATEGY_KNOBS = {\n"
+            "    'refused_ok': 'no XLA analog',\n"
+            "    'phantom': 'not a knob at all',\n"
+            "}\n"
+            "def use(s):\n"
+            "    if s.amp:\n"
+            "        return getattr(s, some_var)\n"),
+    }
+    vs = knob_consumption.run(core.Codebase.from_sources(sources))
+    assert any("'ghost' is neither consumed nor refused" in v.message
+               for v in vs)
+    assert any("names 'phantom'" in v.message for v in vs)
+    assert any("computed strategy-knob name" in v.message for v in vs)
+    # consumed (amp) and refused (refused_ok) knobs are NOT flagged
+    assert not any("'amp'" in v.message or "'refused_ok'" in v.message
+                   for v in vs)
+
+
+def test_env_knobs_negative_control():
+    registry = ({"PADDLE_TPU_FOO": None, "PADDLE_TPU_DEAD": None},
+                "| Variable | Default | Description |\n")
+    src = ("import os\n"
+           "from paddle_tpu.framework import env_knobs\n"
+           "a = os.environ.get('PADDLE_TPU_FOO')\n"
+           "b = env_knobs.get_bool('PADDLE_TPU_UNREGISTERED')\n"
+           "c = env_knobs.get_raw(computed_name)\n")
+    syn = core.Codebase.from_sources(_mod("m.py", src),
+                                     texts={"README.md": "no markers"})
+    vs = env_knobs_pass.run(syn, registry=registry)
+    assert any("direct os.environ read of PADDLE_TPU_FOO" in v.message
+               for v in vs)
+    assert any("PADDLE_TPU_UNREGISTERED is not in the env_knobs "
+               "registry" in v.message for v in vs)
+    assert any("computed knob name" in v.message for v in vs)
+    assert any("PADDLE_TPU_DEAD has no production wiring" in v.message
+               for v in vs)
+    assert any("missing env-knob table markers" in v.message for v in vs)
+    # writes (child-process wiring) are exempt
+    ok = "import os\nos.environ['PADDLE_TPU_FOO'] = '1'\n"
+    vs = env_knobs_pass.run(
+        core.Codebase.from_sources(_mod("ok.py", ok)),
+        registry=({"PADDLE_TPU_FOO": None}, ""))
+    assert not any("direct os.environ" in v.message for v in vs)
+
+
+# ---------------------------------------------------------------------------
+# entry point + wrapper CLIs
+# ---------------------------------------------------------------------------
+
+def test_lint_entry_point_subset_and_errors():
+    """CLI contract on a cheap subset (the full-suite green run is the
+    in-process lint_results fixture — no second 7 s subprocess)."""
+    lint = os.path.join(SCRIPTS, "lint.py")
+    proc = subprocess.run(
+        [sys.executable, lint, "retrace-hazards", "metric-names"],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "2 pass(es) clean" in proc.stdout
+    proc = subprocess.run([sys.executable, lint, "no-such-pass"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 2
+    assert "unknown pass" in proc.stdout
+    proc = subprocess.run([sys.executable, lint, "--list"],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0
+    for name in PASSES:
+        assert name in proc.stdout
+
+
+def test_wrapper_cli_contract():
+    """The historic check_*.py CLIs stay: pkg-relative ``check()``
+    tuples and exit-0-clean (one subprocess smoke on the cheapest)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(SCRIPTS, "check_host_sync.py")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert host_sync.OK_MESSAGE in proc.stdout
+    # in-process API shape (what the historic call sites import)
+    import check_host_sync as chs
+    assert chs.HOT_MODULES is host_sync.HOT_MODULES
+    import check_metric_names as cmn
+    assert cmn.MIN_EXPECTED_SITES == metric_names.MIN_EXPECTED_SITES
+    assert cmn._check_name is metric_names._check_name
+
+
+# ---------------------------------------------------------------------------
+# runtime retrace sentinel (framework.dispatch.guarded_jit)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def _strict_restored():
+    from paddle_tpu.framework import dispatch
+    yield dispatch
+    dispatch.set_retrace_strict(None)
+
+
+def _retraces_total():
+    import paddle_tpu.observability as obs
+    return obs.scrape()["dispatch_retraces_total"]["value"]
+
+
+def test_retrace_sentinel_counts_and_scrapes(_strict_restored):
+    """A weak-type flip (python float vs jnp.float32 lr — the same
+    equivalent-but-unequal class as a trailing-None spec) re-traces;
+    the sentinel counts it on dispatch_retraces_total, scrape-visible
+    from entry construction."""
+    import jax.numpy as jnp
+    dispatch = _strict_restored
+    dispatch.set_retrace_strict(False)
+    prog = dispatch.guarded_jit(lambda x, lr: x * lr, "sentinel_test")
+    before = _retraces_total()   # counter exists at construction
+    x = jnp.ones((4,), jnp.float32)
+    prog(x, jnp.float32(0.1))
+    prog(x, jnp.float32(0.2))    # cache hit: same types
+    assert prog.entry.traces == 1 and prog.entry.dispatches == 2
+    assert _retraces_total() == before
+    prog(x, 0.3)                 # weak-type flip: silent retrace
+    assert prog.entry.traces == 2
+    assert _retraces_total() == before + 1
+    report = {e["label"]: e for e in dispatch.retrace_report()}
+    assert report["sentinel_test"]["traces"] == 2
+
+
+def test_retrace_sentinel_strict_raises(_strict_restored):
+    import jax.numpy as jnp
+    dispatch = _strict_restored
+    dispatch.set_retrace_strict(True)
+    prog = dispatch.guarded_jit(lambda x, lr: x * lr, "strict_test")
+    x = jnp.ones((4,), jnp.float32)
+    prog(x, jnp.float32(0.1))
+    with pytest.raises(dispatch.RetraceError, match="strict_test"):
+        prog(x, 0.2)
+    # multi-trace entries opt out of the contract (bucketed prefill)
+    multi = dispatch.guarded_jit(lambda x, lr: x + lr, "open_ended",
+                                 single_trace=False)
+    before = _retraces_total()
+    multi(x, jnp.float32(0.1))
+    multi(x, 0.2)                # re-trace is legitimate here
+    assert multi.entry.traces == 2
+    assert _retraces_total() == before

@@ -22,6 +22,12 @@ from paddle_tpu import nn, optimizer
 from paddle_tpu.distributed import collective
 from paddle_tpu.framework.dispatch import AutoFoldTuner
 
+# retrace sentinel armed module-wide (ISSUE 17): any trace of a
+# single-trace compiled entry after its first dispatch raises,
+# making every recompile pin in here an ambient property
+pytestmark = pytest.mark.usefixtures("retrace_strict")
+
+
 
 @pytest.fixture(autouse=True)
 def _clean_mesh():

@@ -27,7 +27,8 @@ from paddle_tpu import nn, optimizer
 from paddle_tpu.distributed import collective
 from paddle_tpu.distributed.runner import DistributedRunner
 
-pytestmark = pytest.mark.dist
+pytestmark = [pytest.mark.dist,
+              pytest.mark.usefixtures("retrace_strict")]
 
 
 @pytest.fixture(autouse=True)

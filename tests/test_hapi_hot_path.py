@@ -418,17 +418,6 @@ def test_early_stopping_consumes_lazy_logs():
     assert es.best is not None
 
 
-# -- static host-sync guard ---------------------------------------------
-
-
-def test_check_host_sync_static_guard():
-    scripts = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "scripts")
-    sys.path.insert(0, scripts)
-    try:
-        import check_host_sync
-        violations = check_host_sync.check()
-    finally:
-        sys.path.remove(scripts)
-    assert not violations, "\n".join(
-        f"paddle_tpu/{r}:{l}: {m}" for r, l, m in violations)
+# the static host-sync guard now lives in tests/test_analysis.py
+# (ISSUE 17: one parametrized module runs every pass on one shared
+# parse)

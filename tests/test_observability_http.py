@@ -432,43 +432,9 @@ def test_straggler_detector_needs_progress_and_peers():
     assert d3.step_time(0, now=t0 + 0.6) is None
 
 
-# ---------------------------------------------------------------------------
-# static checks: metric-name convention, host-sync coverage
-# ---------------------------------------------------------------------------
-def test_static_metric_name_convention():
-    """Every registry instrument in production code obeys the naming
-    convention (counters _total, histograms unit-suffixed, snake_case
-    everywhere) and is a string LITERAL — run exactly like the retry/
-    fault-site/host-sync checks."""
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import check_metric_names as cmn
-    finally:
-        sys.path.pop(0)
-    violations, sites = cmn.check()
-    assert not violations, "\n".join(
-        f"{r}:{l}: {m}" for r, l, m in violations)
-    assert sites >= cmn.MIN_EXPECTED_SITES
-    # and the rules themselves reject what they must
-    assert cmn._check_name("counter", "fit_steps")        # no _total
-    assert cmn._check_name("histogram", "dispatch_wall")  # no unit
-    assert cmn._check_name("gauge", "queue_total")        # fake total
-    assert cmn._check_name("counter", "Bad-Name_total")   # not snake
-    assert not cmn._check_name("counter", "fit_steps_total")
-    assert not cmn._check_name("histogram", "dispatch_wall_s")
-    assert not cmn._check_name("gauge", "serving_queue_depth")
-
-
-def test_check_host_sync_covers_http_and_aggregate():
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import check_host_sync as chs
-    finally:
-        sys.path.pop(0)
-    mods = set(chs.HOT_MODULES)
-    assert os.path.join("observability", "http.py") in mods
-    assert os.path.join("observability", "aggregate.py") in mods
-    assert chs.check() == []
+# the static metric-name and host-sync checks now live in
+# tests/test_analysis.py (ISSUE 17: one parametrized module runs
+# every pass on one shared parse)
 
 
 # ---------------------------------------------------------------------------

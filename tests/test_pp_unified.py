@@ -18,6 +18,12 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.distributed import collective
+
+# retrace sentinel armed module-wide (ISSUE 17): any trace of a
+# single-trace compiled entry after its first dispatch raises,
+# making every recompile pin in here an ambient property
+pytestmark = pytest.mark.usefixtures("retrace_strict")
+
 from paddle_tpu.distributed.fleet.meta_parallel import PipelineLayer
 from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
     import PipelineParallel

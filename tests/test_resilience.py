@@ -660,21 +660,9 @@ def test_crash_mid_commit_leaves_step_unverified(tmp_path):
     mgr.close()
 
 
-# ---------------------------------------------------------------------------
-# static retry coverage (CI-less enforcement: the checker runs as a
-# plain test, so tier-1 fails if a bare urlopen/checkpoint-IO call
-# sneaks in outside the retry layer)
-# ---------------------------------------------------------------------------
-def test_static_retry_coverage():
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import check_retry_coverage
-        violations = check_retry_coverage.check()
-    finally:
-        sys.path.pop(0)
-    assert not violations, "\n".join(
-        f"paddle_tpu/{rel}:{line}: {msg}"
-        for rel, line, msg in violations)
+# the static retry-coverage check now lives in tests/test_analysis.py
+# (ISSUE 17: one parametrized module runs every pass on one shared
+# parse)
 
 
 # ---------------------------------------------------------------------------
@@ -802,23 +790,8 @@ def test_chaos_e2e_kill_torn_checkpoint_resume_identical_loss(
     np.testing.assert_allclose(resumed, ref, rtol=0, atol=0)
 
 
-# ---------------------------------------------------------------------------
-# fault-site registry (static check, like retry coverage)
-# ---------------------------------------------------------------------------
-def test_static_fault_site_registry():
-    """Every fault_point/should_drop literal in production code must
-    be registered in faults.KNOWN_SITES, and every registered site
-    must be wired — a typo on either side is an injection point that
-    silently never fires."""
-    sys.path.insert(0, os.path.join(REPO, "scripts"))
-    try:
-        import check_fault_sites
-        violations = check_fault_sites.check()
-    finally:
-        sys.path.pop(0)
-    assert not violations, "\n".join(
-        f"paddle_tpu/{rel}:{line}: {msg}"
-        for rel, line, msg in violations)
+# the static fault-site registry check now lives in
+# tests/test_analysis.py (ISSUE 17)
 
 
 # ---------------------------------------------------------------------------

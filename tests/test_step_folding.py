@@ -17,6 +17,12 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+
+# retrace sentinel armed module-wide (ISSUE 17): any trace of a
+# single-trace compiled entry after its first dispatch raises,
+# making every recompile pin in here an ambient property
+pytestmark = pytest.mark.usefixtures("retrace_strict")
+
 from paddle_tpu import nn, optimizer
 from paddle_tpu.tensor import Tensor
 
