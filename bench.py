@@ -1751,6 +1751,169 @@ def bench_selfheal():
     print("RESULT " + json.dumps(out), flush=True)
 
 
+def bench_selfheal_hosts():
+    """Multi-host self-heal (`--selfheal --hosts 2`, ISSUE 18): a
+    REAL `launch --nnodes 2` run over two simulated host agents on
+    one KV server; SIGKILL of the WHOLE second node (agent + both its
+    ranks + its spares) mid-step.  The record is the node-level
+    action loop measured from outside over the controller plane:
+    ``selfheal_node_death_verdict_s`` (kill → node_death on
+    /fleet/events, i.e. the lease-expiry judgment) and
+    ``selfheal_node_death_to_recovered_s`` (kill → batch promotion
+    complete: no pending failures, every rank id alive again)."""
+    import signal
+    import socket
+    import tempfile
+    import urllib.request
+
+    from paddle_tpu.distributed.fleet.elastic import KVClient, KVServer
+    from paddle_tpu.distributed.resilience.elastic_rank import kv_key
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    work = tempfile.mkdtemp(prefix="bench_selfheal_hosts_")
+    script = os.path.join(work, "selfheal_worker.py")
+    with open(script, "w") as f:
+        f.write(_SELFHEAL_WORKER)
+    stop_file = os.path.join(work, "stop")
+    base = free_port()
+    job = "bench-selfheal-hosts"
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SELFHEAL_FAST_S": "0.08",
+        "SELFHEAL_SLOW_S": "0.08",     # nobody straggles: the fault
+        "SELFHEAL_SLOW_MEMBER": "-",   # here is a whole dead node
+        "SELFHEAL_STOP_FILE": stop_file,
+        "PYTHONPATH": here + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    server = KVServer().start()
+    client = KVClient(server.endpoint)
+    agents = [subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--agent", "--host_id", h, "--elastic_server",
+         server.endpoint, "--job_id", job,
+         "--log_dir", os.path.join(work, "log")],
+        env=env, cwd=work, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for h in ("h0", "h1")]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nnodes", "2", "--nproc_per_node", "2", "--spares", "2",
+         "--elastic_server", server.endpoint,
+         "--metrics_port", str(base),
+         "--beacon_timeout", "30",     # only the lease may judge
+         "--job_id", job,
+         "--log_dir", os.path.join(work, "log"), script],
+        env=env, cwd=work, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def get_json(path, timeout=1.0):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{base}{path}",
+                timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    out = {"selfheal_hosts": 2, "selfheal_world": 4}
+    t_kill = t_event = t_recovered = None
+    try:
+        # wait until every rank on the doomed host is actually
+        # stepping (beacon moving), so the kill lands mid-step
+        run_id = None
+        deadline = time.time() + 90
+        while time.time() < deadline and run_id is None:
+            try:
+                raw = client.get(kv_key(job, "run"))
+                if raw:
+                    run_id = json.loads(raw)["run_id"]
+            except (OSError, ValueError, KeyError):
+                pass
+            time.sleep(0.25)
+        victim_pids = []
+        while time.time() < deadline:
+            try:
+                raw = client.get(kv_key(job, "beacon", "2",
+                                        run_id=run_id))
+                if raw and json.loads(raw).get("step", 0) >= 2:
+                    lease = json.loads(client.get(
+                        kv_key(job, "node", "h1", run_id=run_id)))
+                    victim_pids = [
+                        p["pid"] for p in lease["procs"].values()
+                        if p.get("pid") and p.get("rc") is None]
+                    break
+            except (OSError, ValueError, TypeError, KeyError):
+                pass
+            time.sleep(0.25)
+        if not victim_pids:
+            out["selfheal_error"] = "node h1 never reached step 2"
+        else:
+            agents[1].kill()          # the agent itself…
+            for pid in victim_pids:   # …and every process it held
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except OSError:
+                    pass
+            t_kill = time.perf_counter()
+            deadline = time.time() + 120
+            while time.time() < deadline and proc.poll() is None:
+                time.sleep(0.25)
+                try:
+                    if t_event is None:
+                        ev = get_json("/fleet/events")
+                        if any(e.get("kind") == "node_death"
+                               for e in ev.get("events", [])):
+                            t_event = time.perf_counter()
+                        continue
+                    h = get_json("/fleet/healthz")
+                    if (h["epoch"] >= 1 and not h["pending_failures"]
+                            and all(m["alive"] or m["quarantined"]
+                                    for m in h["members"])
+                            and sum(1 for m in h["members"]
+                                    if m["alive"]) >= 4):
+                        t_recovered = time.perf_counter()
+                        break
+                except (OSError, ValueError, KeyError):
+                    continue
+            if t_event is not None:
+                out["selfheal_node_death_verdict_s"] = round(
+                    t_event - t_kill, 2)
+            else:
+                out["selfheal_error"] = "no node_death verdict in 120s"
+            if t_recovered is not None:
+                out["selfheal_node_death_to_recovered_s"] = round(
+                    t_recovered - t_kill, 2)
+                try:
+                    ctl = get_json("/metrics.json")["metrics"]
+                    out["selfheal_promotions_total"] = ctl.get(
+                        "resilience_promotions_total", {}).get("value")
+                except (OSError, ValueError):
+                    pass
+            elif t_event is not None:
+                out["selfheal_error"] = "verdict but never recovered"
+    finally:
+        with open(stop_file, "w") as f:
+            f.write("1")
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()          # reap, so returncode is real
+        for a in agents:
+            if a.poll() is None:
+                try:
+                    a.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    a.kill()
+                    a.wait()
+        server.stop()
+    out["selfheal_launch_rc"] = proc.returncode
+    print("RESULT " + json.dumps(out), flush=True)
+
+
 def bench_flash_micro():
     """Pallas flash kernel vs composed XLA attention, fwd+bwd wall time
     per call at seq 1k/4k/8k (VERDICT r2 item 5 microbench line)."""
@@ -1943,9 +2106,19 @@ def main():
     # `python bench.py --selfheal`: the observability ACTION loop e2e
     # (ISSUE 13; CPU, cheap) — a real 2-rank + spare launch with
     # --drain_stragglers armed and rank 1 stepping 5x slow; records
-    # time-from-latency-to-drain and drain-to-recovered-step-time
+    # time-from-latency-to-drain and drain-to-recovered-step-time.
+    # `--selfheal --hosts 2` (ISSUE 18) runs the multi-host variant:
+    # two host agents, whole-node SIGKILL, node-death-to-recovered
     if "--selfheal" in sys.argv:
-        sh, sherr = _run_child("selfheal", 240)
+        hosts = 1
+        if "--hosts" in sys.argv:
+            i = sys.argv.index("--hosts")
+            if i + 1 < len(sys.argv):
+                hosts = int(sys.argv[i + 1])
+        if hosts >= 2:
+            sh, sherr = _run_child("selfheal_hosts", 360)
+        else:
+            sh, sherr = _run_child("selfheal", 240)
         print(json.dumps(sh if sh is not None
                          else {"error": sherr[-1000:]}), flush=True)
         return
@@ -2014,6 +2187,8 @@ def main():
         return bench_fleet()
     if mode == "selfheal":
         return bench_selfheal()
+    if mode == "selfheal_hosts":
+        return bench_selfheal_hosts()
 
     t_start = time.time()
 

@@ -355,6 +355,13 @@ class PipelineParallel:
         # the memory trade that recovers 1F1B's advantage — module
         # header); expose the knob so the trade is measurable
         self.remat_stage = bool(cfg.get("remat_stage", True))
+        # carry-donation opt-out (DESIGN-DCN.md donation caveat): this
+        # container's CPU jaxlib intermittently reads a denormal from
+        # a donated (params, opt_state) buffer on the engine-roundtrip
+        # path; the switch lets that path (and any future platform
+        # with the same aliasing bug) run undonated — ROADMAP backlog
+        # holds the real-TPU re-measure before changing the default
+        self.donate_carry = bool(cfg.get("donate_carry", True))
         self.dispatch_mode = _resolve_dispatch_mode(
             cfg.get("dispatch_mode"))
         # tick-loop form: None = auto (see _unroll_ticks)
@@ -843,7 +850,7 @@ class PipelineParallel:
 
     # -- compiled entries ----------------------------------------------------
     def _build_step(self, capture: bool = False,
-                    donate_carry: bool = True):
+                    donate_carry: Optional[bool] = None):
         """The legacy per-batch entry — the parity reference: one jit
         per train batch, PRNG key drawn host-side, numerically the
         pre-unification program.  ``donate_carry`` is the one opt-out
@@ -851,7 +858,10 @@ class PipelineParallel:
         collectives are jit-level (psum through the partitioner, not
         shard_map manual collectives), so donation is safe here, but
         the decision stays on a knob like every shard_map-adjacent
-        engine (DESIGN-DCN.md donation caveat)."""
+        engine (DESIGN-DCN.md donation caveat) — default from
+        ``pipeline_configs['donate_carry']``."""
+        if donate_carry is None:
+            donate_carry = self.donate_carry
         per_step = self._step_math(capture=capture)
 
         def step(params, frozen, buffers, opt_state, lr, key, x, y):
@@ -887,9 +897,10 @@ class PipelineParallel:
         # explicit donate_carry: the fold scan's carry donation is
         # safe on pp meshes (jit-level collectives, no shard_map
         # manual aliases), but the opt-in is spelled out so the
-        # DESIGN-DCN.md caveat has one visible switch per engine
+        # DESIGN-DCN.md caveat has one visible switch per engine —
+        # pipeline_configs['donate_carry'] opts the whole engine out
         return build_folded_step(per_step, fold, donate_buffers=False,
-                                 donate_carry=True)
+                                 donate_carry=self.donate_carry)
 
     # -- commit / wrapper sync -----------------------------------------------
     def _commit_dicts(self, new_p, new_s, new_bufs, steps: int,

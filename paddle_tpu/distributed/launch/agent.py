@@ -1,0 +1,366 @@
+"""HostAgent: the per-node half of multi-host elastic supervision
+(DESIGN-RESILIENCE.md §Multi-host supervision).
+
+``python -m paddle_tpu.distributed.launch --agent --host_id H
+--elastic_server http://host:port`` runs one agent per node.  The
+rank controller (``controller.py``) never owns a remote PID — it
+*addresses* members as ``(host_id, rank)`` and talks to this daemon
+exclusively through the shared KV registry:
+
+* **Bootstrap** — the agent heartbeats as ``agent:<host_id>`` under
+  the job prefix (payload: its host IP, so the controller can lay
+  out endpoints), then polls the job-scoped ``run`` record until a
+  controller publishes one and adopts its ``run_id``.  Every mutable
+  key below is namespaced by that run id, exactly like the worker
+  protocol — a stale agent can never consume a previous run's
+  commands.
+* **Commands** — the controller appends idempotent records at
+  ``agent/<host_id>/cmd/<seq>`` (``spawn`` / ``kill``); the agent
+  consumes them strictly in sequence and writes an
+  ``agent/<host_id>/ack/<seq>`` result record *after* executing.
+  The ack is checked BEFORE executing, so a retried or re-read
+  command never double-spawns: a restarted agent re-walks the
+  sequence from 0, skipping everything already acked.  Execution
+  routes through the ``agent.command`` fault site (an injected
+  failure leaves the command unacked — retried next tick) and spawn
+  through ``agent.spawn`` (a real spawn failure acks ``ok=false``
+  and reports a synthetic nonzero rc in the lease, so the controller
+  judges it through the ordinary exit-rc path).
+* **Lease** — agent liveness is a heartbeat-refreshed record at
+  ``node/<host_id>``: a monotonically increasing beat plus the rc
+  table of every process it supervises.  The refresh is droppable
+  (``node.lease`` site) so chaos can freeze a lease without killing
+  anything; the controller judges lease *value change* on its own
+  clock (the BeaconMonitor machinery — no cross-host clock sync) and
+  declares **node death** when the lease freezes past the timeout.
+* **Degradation** — an agent that loses the controller (the ``ctl``
+  lease the controller refreshes stops changing, or the registry is
+  unreachable) PARKS: workers keep running (they are already stalled
+  at the data-plane barrier if the fleet lost quorum), commands stop
+  being consumed, nothing is orphaned.  When the controller's lease
+  moves again the agent re-reads the epoch and re-adopts — the
+  idempotent command sequence makes the replay safe.
+* **Shutdown** — the run-scoped ``shutdown`` key winds the agent
+  down: SIGTERM to every worker, a bounded reap, exit 0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ..resilience import faults as _faults
+from ..resilience.elastic_rank import kv_key
+
+
+@dataclass
+class _AgentProc:
+    """One supervised worker: the Popen (None when the spawn itself
+    failed) and its reaped return code (None while running)."""
+    proc: Optional[subprocess.Popen]
+    log_path: str
+    rc: Optional[int] = None
+
+
+class HostAgent:
+    """One node's process supervisor, driven entirely over the KV
+    registry (see module docstring for the protocol)."""
+
+    def __init__(self, args, client, host_id: str,
+                 tick: float = 0.25,
+                 ctl_timeout: Optional[float] = None):
+        self.args = args
+        self.client = client
+        self.host_id = str(host_id)
+        self.job_id = args.job_id
+        self.tick = float(tick)
+        # per-host log subtree: two agents simulated on one machine
+        # (the CI story) must never interleave into one workerlog
+        self.log_dir = os.path.join(args.log_dir, self.host_id)
+        self.run_id: Optional[str] = None
+        self._procs: Dict[str, _AgentProc] = {}
+        self._next_seq = 0
+        self._beat = 0
+        self._parked = False
+        # controller liveness: judged by ctl-lease VALUE change on
+        # our clock, the same skew-free rule the controller applies
+        # to our node lease
+        if ctl_timeout is None:
+            from ...framework import env_knobs
+            ctl_timeout = 2 * env_knobs.get_float(
+                "PADDLE_TPU_NODE_LEASE_TIMEOUT", 3.0) + 4.0
+        self.ctl_timeout = float(ctl_timeout)
+        self._ctl_val: Optional[str] = None
+        self._ctl_changed_t: Optional[float] = None
+
+    # -- keys ----------------------------------------------------------------
+    def _key(self, *parts: str) -> str:
+        return kv_key(self.job_id, *parts, run_id=self.run_id)
+
+    # -- bootstrap ------------------------------------------------------------
+    def _heartbeat(self):
+        from ..fleet.elastic.manager import host_ip
+        try:
+            self.client.heartbeat(f"{self.job_id}/agent:{self.host_id}",
+                                  payload=host_ip())
+        except Exception:  # noqa: BLE001 — registry blip: the TTL
+            # absorbs one missed beat; persistent loss parks us below
+            pass
+
+    def _try_adopt(self) -> bool:
+        """Poll the job-scoped run record the controller publishes;
+        adopt its run id (which namespaces every mutable key we
+        read/write from here on)."""
+        try:
+            raw = self.client.get(kv_key(self.job_id, "run"))
+        except Exception:  # noqa: BLE001
+            return False
+        if not raw:
+            return False
+        try:
+            run_id = str(json.loads(raw)["run_id"])
+        except (ValueError, KeyError, TypeError):
+            return False
+        self.run_id = run_id
+        print(f"launch: agent {self.host_id} adopted run {run_id} "
+              f"(job {self.job_id})", flush=True)
+        return True
+
+    # -- lease ----------------------------------------------------------------
+    def _refresh_lease(self):
+        """Publish the liveness lease: beat counter + the rc table of
+        every supervised process.  Droppable (``node.lease``) so a
+        chaos plan can simulate agent partition/death without
+        touching the workers."""
+        procs = {mid: {"pid": (None if ap.proc is None
+                               else ap.proc.pid),
+                       "rc": ap.rc}
+                 for mid, ap in self._procs.items()}
+        rec = {"beat": self._beat, "pid": os.getpid(),
+               "parked": self._parked, "procs": procs}
+        self._beat += 1
+        if _faults.should_drop("node.lease", host=self.host_id):
+            return  # injected partition: the lease silently freezes
+        try:
+            self.client.put(self._key("node", self.host_id),
+                            json.dumps(rec))
+        except Exception:  # noqa: BLE001 — registry outage: the
+            # controller's lease timeout is the judgment, not ours
+            pass
+
+    def _reap(self):
+        for ap in self._procs.values():
+            if ap.proc is not None and ap.rc is None:
+                ap.rc = ap.proc.poll()
+
+    # -- command consumption ---------------------------------------------------
+    def _consume_commands(self):
+        """Walk ``cmd/<seq>`` strictly in order.  A gap (no record at
+        the next seq) ends the walk; an execution failure (injected
+        ``agent.command``) leaves the command unacked and re-tried
+        next tick — never skipped, never double-run."""
+        while True:
+            try:
+                raw = self.client.get(self._key(
+                    "agent", self.host_id, "cmd", str(self._next_seq)))
+            except Exception:  # noqa: BLE001 — registry blip
+                return
+            if raw is None:
+                return
+            try:
+                rec = json.loads(raw)
+            except ValueError:
+                return  # torn write: the controller's retry rewrites it
+            try:
+                self._execute(self._next_seq, rec)
+            except Exception as e:  # noqa: BLE001 — injected
+                # agent.command failure: the command stays UNACKED;
+                # the next tick re-reads and retries it (idempotency
+                # holds either way — the ack gate below runs first)
+                print(f"launch: agent {self.host_id} command "
+                      f"{self._next_seq} failed "
+                      f"({type(e).__name__}: {e}); will retry",
+                      file=sys.stderr, flush=True)
+                return
+            self._next_seq += 1
+
+    def _execute(self, seq: int, rec: dict):
+        ack_key = self._key("agent", self.host_id, "ack", str(seq))
+        if self.client.get(ack_key) is not None:
+            # executed by a previous incarnation of this agent (or a
+            # re-read after a lost ack-side response): a retried
+            # command must never double-spawn
+            return
+        _faults.fault_point("agent.command", op=rec.get("op"),
+                            seq=seq, host=self.host_id)
+        op = rec.get("op")
+        ok, err = True, None
+        if op == "spawn":
+            ok, err = self._spawn(rec)
+        elif op == "kill":
+            self._kill(rec)
+        else:
+            ok, err = False, f"unknown op {op!r}"
+        self.client.put(ack_key, json.dumps(
+            {"seq": seq, "ok": ok, "error": err}))
+
+    def _spawn(self, rec: dict):
+        member = str(rec["member"])
+        log_path = os.path.join(self.log_dir,
+                                str(rec.get("log_name") or member))
+        try:
+            _faults.fault_point("agent.spawn", member=member,
+                                role=rec.get("role"),
+                                host=self.host_id)
+            env = dict(os.environ)
+            env.update({str(k): str(v)
+                        for k, v in (rec.get("env") or {}).items()})
+            cmd = [sys.executable, str(rec["script"])] + \
+                [str(a) for a in rec.get("args") or []]
+            proc = self._popen(cmd, env, log_path)
+        except Exception as e:  # noqa: BLE001 — injected or OS: the
+            # command DID execute (and must ack — retrying a spawn
+            # that half-ran is how double-spawns happen); a synthetic
+            # nonzero rc routes the failure through the controller's
+            # ordinary exit-rc judgment
+            self._procs[member] = _AgentProc(proc=None,
+                                             log_path=log_path, rc=127)
+            print(f"launch: agent {self.host_id} spawn of {member} "
+                  f"failed ({type(e).__name__}: {e})",
+                  file=sys.stderr, flush=True)
+            return False, f"{type(e).__name__}: {e}"
+        self._procs[member] = _AgentProc(proc=proc, log_path=log_path)
+        print(f"launch: agent {self.host_id} spawned {member} "
+              f"(pid {proc.pid})", flush=True)
+        return True, None
+
+    def _popen(self, cmd: List[str], env: dict,
+               log_path: str) -> subprocess.Popen:
+        log_f = open(log_path, "a")
+        return subprocess.Popen(cmd, env=env, stdout=log_f,
+                                stderr=subprocess.STDOUT)
+
+    def _kill(self, rec: dict):
+        ap = self._procs.get(str(rec.get("member")))
+        if ap is None or ap.proc is None or ap.proc.poll() is not None:
+            return  # already gone: kill is naturally idempotent
+        sig = str(rec.get("sig") or "KILL").upper()
+        try:
+            if sig == "TERM":
+                ap.proc.send_signal(signal.SIGTERM)
+            else:
+                ap.proc.kill()
+        except OSError:
+            pass
+
+    # -- controller liveness ---------------------------------------------------
+    def _poll_controller(self):
+        """Park when the controller's ``ctl`` lease freezes past the
+        timeout (controller death / partition): workers stay up,
+        commands stop.  Re-adopt when it moves again — the epoch is
+        re-read so the log shows what membership we woke up to, and
+        the idempotent command walk replays safely."""
+        try:
+            val = self.client.get(self._key("ctl"))
+        except Exception:  # noqa: BLE001 — registry unreachable
+            val = None
+        now = time.monotonic()
+        if val is not None and val != self._ctl_val:
+            self._ctl_val = val
+            self._ctl_changed_t = now
+            if self._parked:
+                self._parked = False
+                epoch = None
+                try:
+                    raw = self.client.get(self._key("epoch"))
+                    if raw:
+                        epoch = json.loads(raw).get("epoch")
+                except Exception:  # noqa: BLE001
+                    pass
+                print(f"launch: agent {self.host_id} controller is "
+                      f"back (epoch {epoch}) — re-adopting",
+                      flush=True)
+            return
+        if (not self._parked and self._ctl_changed_t is not None
+                and now - self._ctl_changed_t > self.ctl_timeout):
+            self._parked = True
+            print(f"launch: agent {self.host_id} lost the controller "
+                  f"(ctl lease frozen > {self.ctl_timeout:g}s) — "
+                  "parking workers, holding commands",
+                  file=sys.stderr, flush=True)
+
+    def _shutdown_requested(self) -> bool:
+        try:
+            return self.client.get(self._key("shutdown")) is not None
+        except Exception:  # noqa: BLE001
+            return False
+
+    # -- main loop -------------------------------------------------------------
+    def run(self) -> int:
+        os.makedirs(self.log_dir, exist_ok=True)
+        print(f"launch: host agent {self.host_id} up "
+              f"(job {self.job_id}, log {self.log_dir})", flush=True)
+        try:
+            while True:
+                self._heartbeat()
+                if self.run_id is None:
+                    self._try_adopt()
+                else:
+                    self._reap()
+                    self._refresh_lease()
+                    if self._shutdown_requested():
+                        print(f"launch: agent {self.host_id} run "
+                              "shutdown — winding down", flush=True)
+                        return 0
+                    self._poll_controller()
+                    if not self._parked:
+                        self._consume_commands()
+                time.sleep(self.tick)
+        except KeyboardInterrupt:
+            return 0
+        finally:
+            self._wind_down()
+
+    def _wind_down(self):
+        live = [ap for ap in self._procs.values()
+                if ap.proc is not None and ap.proc.poll() is None]
+        for ap in live:
+            try:
+                ap.proc.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.time() + 10
+        for ap in live:
+            while ap.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.1)
+            if ap.proc.poll() is None:
+                try:
+                    ap.proc.kill()
+                except OSError:
+                    pass
+        self._reap()
+        self._refresh_lease()  # final rc table for the post-mortem
+
+
+def run_agent(args) -> int:
+    """Entry point used by ``launch/main.py`` for ``--agent``."""
+    from ..fleet.elastic import KVClient
+    endpoint = args.elastic_server or \
+        os.environ.get("PADDLE_ELASTIC_SERVER")
+    if not endpoint or endpoint == "auto":
+        print("launch: --agent requires --elastic_server "
+              "http://host:port (the registry shared with the "
+              "controller; an agent cannot embed its own)",
+              file=sys.stderr)
+        return 1
+    if not args.host_id:
+        print("launch: --agent requires --host_id", file=sys.stderr)
+        return 1
+    agent = HostAgent(args, KVClient(endpoint), args.host_id)
+    return agent.run()

@@ -91,6 +91,23 @@ absent-this-round ``fleet_scrape_errors_total`` semantics.
 alive/finished/quarantined/straggler + lag, spare pool, epoch);
 ``/fleet/events`` merges the controller's decision ring with every
 live member's ``/events`` ring, each entry tagged with its source.
+
+Multi-host remote-member mode (DESIGN-RESILIENCE.md §Multi-host
+supervision): with ``--nnodes N`` (N > 1) the controller owns no
+remote PID — each node runs a :mod:`agent` (``launch --agent
+--host_id H``) and members are addressed ``(host_id, rank)``.
+Spawn/kill ride idempotent ``cmd/<seq>`` records (acked by the
+agent, so a retried command never double-spawns); each agent's
+liveness is a **lease** (``node/<host_id>``, judged by value change
+on the controller's clock — the BeaconMonitor machinery, no
+cross-host clock sync).  Lease expiry is a new failure class, *node
+death*: every rank that host held is quarantined in ONE pass and the
+whole batch is promoted under a single epoch bump
+(:meth:`_promote_batch` — publishing an intermediate epoch that
+still names a dead member would hang the survivors' reform barrier).
+With zero agents (``--nnodes 1``) none of this machinery is
+consulted: local supervision is byte-identical to the single-node
+path.
 """
 
 from __future__ import annotations
@@ -117,6 +134,38 @@ from ..resilience.elastic_rank import PromotionTicket, kv_key
 from ..resilience.failure_detector import BeaconMonitor, FailureDetector
 
 
+class _RemoteProc:
+    """Popen-shaped handle for a member supervised by a HostAgent on
+    another (possibly virtual) node.  ``poll()`` reads the rc the
+    agent's lease reported (node death synthesizes ``-9`` for every
+    process the dead host held, so every existing liveness predicate
+    — spare budget, healthz, promotion filter — works unchanged);
+    ``kill``/``send_signal`` enqueue best-effort kill commands."""
+
+    def __init__(self, ctl: "RankController", host: str,
+                 member_id: str):
+        self._ctl = ctl
+        self.host = host
+        self.member_id = member_id
+
+    def poll(self) -> Optional[int]:
+        return self._ctl._remote_rc.get(self.member_id)
+
+    def kill(self):
+        self._signal("KILL")
+
+    def send_signal(self, sig):
+        self._signal("TERM" if sig == signal.SIGTERM else "KILL")
+
+    def _signal(self, sig: str):
+        try:
+            self._ctl._agent_command(self.host, "kill",
+                                     member=self.member_id, sig=sig)
+        except Exception:  # noqa: BLE001 — best effort: a dead
+            # agent's processes die with it (or with the node)
+            pass
+
+
 @dataclass
 class _Member:
     member_id: str
@@ -125,6 +174,7 @@ class _Member:
     rank: Optional[int] = None     # None: parked spare
     finished: bool = False
     quarantined: bool = False
+    host: Optional[str] = None     # None: local (single-node mode)
 
 
 @dataclass
@@ -149,12 +199,19 @@ class RankController:
                  straggler_factor: Optional[float] = None,
                  scrape_interval: float = 1.0,
                  respawn_spares: bool = True,
-                 drain_stragglers: int = 0):
+                 drain_stragglers: int = 0,
+                 nnodes: int = 1):
         self.args = args
         self.client = client
         self.server_endpoint = server_endpoint
         self.nproc = int(nproc)
         self.n_spares = int(spares)
+        # remote-member mode (§Multi-host supervision): nnodes > 1
+        # addresses members (host_id, rank) through per-node agents;
+        # nnodes == 1 is the local path, byte-identical to before
+        self.nnodes = max(int(nnodes), 1)
+        self.remote = self.nnodes > 1
+        self.world = self.nproc * self.nnodes
         self.beacon_timeout = float(beacon_timeout)
         self.tick = float(tick)
         self.state = _JobState()
@@ -235,25 +292,57 @@ class RankController:
             "fleet_drains_skipped_total",
             "armed drains refused for lack of a live spare (a slow "
             "rank beats a missing rank)")
+        self._node_deaths = self._reg.counter(
+            "fleet_node_deaths_total",
+            "host agents whose liveness lease froze past the "
+            "timeout (every rank they held quarantined in one pass)")
+        # node-level failure domain (remote mode only; the local path
+        # touches none of this): agents discovered at bootstrap,
+        # leases judged by VALUE change on our clock — the same
+        # skew-free rule as the progress beacons
+        from ...framework import env_knobs as _env_knobs
+        self.node_lease_timeout = _env_knobs.get_float(
+            "PADDLE_TPU_NODE_LEASE_TIMEOUT", 3.0)
+        self._leases = BeaconMonitor(timeout=self.node_lease_timeout)
+        self.hosts: List[str] = []
+        self._host_ips: Dict[str, str] = {}
+        self._dead_hosts: set = set()
+        self._remote_rc: Dict[str, int] = {}   # member_id → exit rc
+        self._cmd_seq: Dict[str, int] = {}     # host → next cmd seq
+        self._ctl_beat = 0
+        self._ctl_beat_t = -float("inf")
 
     # -- spawn ---------------------------------------------------------------
     def _kv_key(self, *parts: str) -> str:
         return kv_key(self.job_id, *parts, run_id=self.run_id)
 
-    def _base_env(self, endpoints: List[str], master: str) -> dict:
-        env = dict(os.environ)
-        env.update({
-            "PADDLE_TRAINERS_NUM": str(self.nproc),
+    def _member_env(self, member_id: str, role: str,
+                    rank: Optional[int], endpoints: List[str],
+                    master: str,
+                    local_rank: Optional[int] = None) -> dict:
+        """The paddle env OVERLAY one member gets — shared
+        byte-identically by the local ``_spawn`` and the remote spawn
+        command, so a rank behaves the same whichever side forks
+        it."""
+        env = {
+            "PADDLE_TRAINERS_NUM": str(self.world),
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "PADDLE_MASTER": master,
             "PADDLE_JOB_ID": self.job_id,
             "PADDLE_ELASTIC_SERVER": self.server_endpoint,
             "PADDLE_ELASTIC_RUN_ID": self.run_id,
-        })
+            "PADDLE_RANK_ROLE": role,
+            "PADDLE_MEMBER_ID": member_id,
+            "PADDLE_TRAINER_ID": str(rank if rank is not None else -1),
+        }
         if self.metrics_base:
             # one env var, N endpoints: rank r offsets to BASE+1+r
             # inside observability.http; spares arm at promotion
             env["PADDLE_TPU_METRICS_PORT"] = str(self.metrics_base)
+        if rank is not None:
+            env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
+            env["FLAGS_selected_tpus"] = str(
+                rank if local_rank is None else local_rank)
         return env
 
     def _spawn(self, member_id: str, role: str, rank: Optional[int],
@@ -261,15 +350,9 @@ class RankController:
                log_name: str) -> _Member:
         _faults.fault_point("launch.spawn", member=member_id,
                             role=role, rank=rank)
-        env = self._base_env(endpoints, master)
-        env.update({
-            "PADDLE_RANK_ROLE": role,
-            "PADDLE_MEMBER_ID": member_id,
-            "PADDLE_TRAINER_ID": str(rank if rank is not None else -1),
-        })
-        if rank is not None:
-            env["PADDLE_CURRENT_ENDPOINT"] = endpoints[rank]
-            env["FLAGS_selected_tpus"] = str(rank)
+        env = dict(os.environ)
+        env.update(self._member_env(member_id, role, rank, endpoints,
+                                    master))
         log_path = os.path.join(self.args.log_dir, log_name)
         log_f = open(log_path, "a")
         cmd = [sys.executable, self.args.training_script] + \
@@ -279,10 +362,50 @@ class RankController:
         return _Member(member_id=member_id, proc=proc,
                        log_path=log_path, rank=rank)
 
+    # -- remote members (agent protocol) -------------------------------------
+    def _agent_command(self, host: str, op: str, **fields):
+        """Append one idempotent command record for ``host``'s agent.
+        Sequence numbers are per-host and never reused; the PUT rides
+        the KVClient retry layer, and a duplicate delivery simply
+        rewrites the same record — the agent's ack gate makes the
+        retry safe (never a double-spawn)."""
+        seq = self._cmd_seq.get(host, 0)
+        rec = dict(fields, op=op, seq=seq)
+        self.client.put(
+            self._kv_key("agent", host, "cmd", str(seq)),
+            json.dumps(rec))
+        self._cmd_seq[host] = seq + 1
+
+    def _spawn_remote(self, member_id: str, role: str,
+                      rank: Optional[int], host: str,
+                      log_name: str) -> _Member:
+        """Address a spawn to ``host``'s agent; the member's handle is
+        a :class:`_RemoteProc` fed by that agent's lease."""
+        overlay = self._member_env(
+            member_id, role, rank, self._endpoints, self._master,
+            local_rank=(None if rank is None else rank % self.nproc))
+        self._agent_command(
+            host, "spawn", member=member_id, role=role, rank=rank,
+            env=overlay, script=self.args.training_script,
+            args=list(self.args.training_script_args),
+            log_name=log_name)
+        return _Member(member_id=member_id,
+                       proc=_RemoteProc(self, host, member_id),
+                       log_path=os.path.join(self.args.log_dir, host,
+                                             log_name),
+                       rank=rank, host=host)
+
     def _publish_epoch(self):
+        # quarantined members whose replacement has not been promoted
+        # yet are EXCLUDED: an epoch record naming a dead member would
+        # park every survivor at a reform barrier the dead rank can
+        # never join (a full batch promotion replaces them before
+        # publish, so this only matters when the spare pool covers a
+        # node death partially)
         rec = {"epoch": self.state.epoch,
                "members": {str(r): m.member_id
-                           for r, m in self.state.members.items()}}
+                           for r, m in self.state.members.items()
+                           if not m.quarantined}}
         self.client.put(self._kv_key("epoch"), json.dumps(rec))
 
     # -- liveness feeds ------------------------------------------------------
@@ -307,7 +430,7 @@ class RankController:
                     "resilience_beacon_lag_s",
                     "seconds since this member's progress beacon "
                     "last changed",
-                    labels={"member": m.member_id}).set(lag)
+                    labels=self._member_labels(m)).set(lag)
             if val:
                 # the same beacon record feeds straggler attribution:
                 # its committed-step counter against the poll clock
@@ -316,6 +439,17 @@ class RankController:
                 except ValueError:
                     step = None
                 self.straggler.observe(rank, step, now=now)
+
+    @staticmethod
+    def _member_labels(m: _Member) -> dict:
+        """Member gauge labels; remote members carry their failure
+        domain (``host``) so a node-wide event reads as one label
+        value on the dashboard.  Local members keep the bare
+        ``member`` label — series identity unchanged from the
+        single-node path."""
+        if m.host is None:
+            return {"member": m.member_id}
+        return {"member": m.member_id, "host": m.host}
 
     def _clear_rank_observability(self, rank: Optional[int]):
         """Reset a departed rank's straggler state AND its exported
@@ -620,7 +754,7 @@ class RankController:
                           and not s.quarantined)
         if self.state.pending_failures:
             degraded = True
-        return {
+        out = {
             "status": "degraded" if degraded else "ok",
             "epoch": self.state.epoch,
             "members": members,
@@ -629,6 +763,31 @@ class RankController:
             "pending_failures": list(self.state.pending_failures),
             "drain_windows": self.drain_windows,
         }
+        if self.hosts:
+            # per-node failure domains (remote mode): lease age +
+            # what each host is holding right now
+            now_m = time.monotonic()
+            nodes = []
+            for host in self.hosts:
+                lag = self._leases.lag(host, now=now_m)
+                alive = host not in self._dead_hosts
+                nodes.append({
+                    "host": host,
+                    "alive": alive,
+                    "lease_age_s": (None if lag is None
+                                    else round(lag, 3)),
+                    "ranks": sorted(
+                        r for r, m in list(self.state.members.items())
+                        if m.host == host and not m.quarantined),
+                    "spares": sum(
+                        1 for s in self.state.spares
+                        if s.host == host and s.proc.poll() is None
+                        and not s.quarantined),
+                })
+                if not alive:
+                    out["status"] = "degraded"
+            out["nodes"] = nodes
+        return out
 
     def _fleet_healthz_route(self):
         return (200, _obs_http.JSON_CONTENT_TYPE,
@@ -739,8 +898,134 @@ class RankController:
                     "resilience_heartbeat_lag_s",
                     "seconds since this member's KV heartbeat was "
                     "last observed alive",
-                    labels={"member": m.member_id}).set(now - last)
+                    labels=self._member_labels(m)).set(now - last)
         return [e.member for e in events if e.kind == "lost"]
+
+    # -- node leases (remote mode) -------------------------------------------
+    def _bootstrap_agents(self, timeout: float = 60.0) -> Optional[int]:
+        """Publish the job-scoped run record (the agents' bootstrap
+        handle — they cannot know the run id before we mint it) and
+        wait for ``nnodes`` distinct host agents to heartbeat.
+        Returns an exit code on failure, None on success."""
+        self.client.put(kv_key(self.job_id, "run"),
+                        json.dumps({"run_id": self.run_id}))
+        pfx = f"{self.job_id}/agent:"
+        deadline = time.time() + timeout
+        while True:
+            try:
+                found = self.client.members(pfx)
+            except Exception:  # noqa: BLE001 — registry blip
+                found = {}
+            hosts = {k[len(pfx):]: v for k, v in found.items()}
+            if len(hosts) >= self.nnodes:
+                self.hosts = sorted(hosts)[:self.nnodes]
+                self._host_ips = {h: (hosts[h] or "127.0.0.1")
+                                  for h in self.hosts}
+                print(f"launch: {len(self.hosts)} host agents "
+                      f"registered ({', '.join(self.hosts)}); "
+                      f"world={self.world} across {self.nnodes} "
+                      "nodes", flush=True)
+                return None
+            if time.time() > deadline:
+                print(f"launch: only {len(hosts)}/{self.nnodes} host "
+                      f"agents registered within {timeout:g}s — "
+                      "start one `launch --agent --host_id H` per "
+                      "node against the same --elastic_server",
+                      file=sys.stderr, flush=True)
+                return 1
+            time.sleep(0.25)
+
+    def _refresh_ctl_lease(self):
+        """The controller's own liveness lease (``ctl`` key): agents
+        judge OUR value change the same way we judge theirs, and park
+        their workers instead of orphaning them when we vanish."""
+        nowm = time.monotonic()
+        if nowm - self._ctl_beat_t < 0.5:
+            return
+        self._ctl_beat_t = nowm
+        self._ctl_beat += 1
+        try:
+            self.client.put(self._kv_key("ctl"),
+                            json.dumps({"beat": self._ctl_beat}))
+        except Exception:  # noqa: BLE001 — registry blip: agents
+            # absorb it inside their own ctl timeout
+            pass
+
+    def _host_members(self, host: str) -> List[_Member]:
+        return [m for m in [*self.state.members.values(),
+                            *self.state.spares]
+                if m.host == host]
+
+    def _judge_nodes(self, now: Optional[float] = None):
+        """Observe every live host's lease: adopt the per-process rc
+        table it carries (the remote half of the exit-rc judgment),
+        export lease age, and declare **node death** when a lease
+        freezes past the timeout — quarantining every rank the host
+        held in ONE pass, so the whole batch promotes under a single
+        epoch bump."""
+        now = time.monotonic() if now is None else now
+        for host in self.hosts:
+            if host in self._dead_hosts:
+                continue
+            try:
+                raw = self.client.get(self._kv_key("node", host))
+            except Exception:  # noqa: BLE001 — registry blip: no
+                continue       # judgment this tick
+            if raw:
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    rec = None
+                if isinstance(rec, dict):
+                    for mid, p in (rec.get("procs") or {}).items():
+                        rc = (p.get("rc") if isinstance(p, dict)
+                              else None)
+                        if rc is not None:
+                            self._remote_rc[str(mid)] = int(rc)
+            self._leases.observe(host, raw, now=now)
+            lag = self._leases.lag(host, now=now)
+            if lag is not None:
+                self._reg.gauge(
+                    "fleet_node_lease_age_s",
+                    "seconds since this host agent's liveness lease "
+                    "last changed",
+                    labels={"host": host}).set(lag)
+        for host in self._leases.stalled(now=now):
+            if host in self._dead_hosts:
+                continue
+            self._dead_hosts.add(host)
+            self._leases.forget(host)
+            # absent-not-stale: a dead host's lease age is not a
+            # number that grows forever, it is a series that ends
+            self._reg.unregister("fleet_node_lease_age_s",
+                                 labels={"host": host})
+            self._node_deaths.inc()
+            doomed = self._host_members(host)
+            ranks = sorted(m.rank for m in doomed
+                           if m.rank is not None and not m.finished
+                           and not m.quarantined)
+            print(f"launch: NODE DEATH: host {host} lease frozen > "
+                  f"{self.node_lease_timeout:g}s — quarantining its "
+                  f"ranks {ranks} and parked spares in one pass",
+                  file=sys.stderr, flush=True)
+            _obs_events.record(
+                "node_death", host=host, ranks=ranks,
+                members=[m.member_id for m in doomed])
+            # every process the host held is dead with it — the
+            # synthesized rc makes every existing liveness predicate
+            # (spare budget, healthz, promotion filter) agree
+            for m in doomed:
+                self._remote_rc.setdefault(m.member_id, -9)
+            for rank in ranks:
+                self._queue_failure(rank, "node death")
+        if self.hosts:
+            alive = len(self.hosts) - len(self._dead_hosts)
+            self._reg.gauge(
+                "fleet_nodes", "host agents by liveness state",
+                labels={"state": "alive"}).set(alive)
+            self._reg.gauge(
+                "fleet_nodes", "host agents by liveness state",
+                labels={"state": "dead"}).set(len(self._dead_hosts))
 
     # -- failure handling ----------------------------------------------------
     def _queue_failure(self, rank: int, reason: str):
@@ -778,45 +1063,67 @@ class RankController:
         """Promote the first live spare into ``rank``.  Returns True
         when a ticket was published; the failed rank stays queued
         otherwise (no spare live, or the promotion path itself was
-        chaos-injected) and is retried next tick."""
-        spare = next((s for s in self.state.spares
-                      if s.proc.poll() is None and not s.quarantined),
-                     None)
-        if spare is None:
-            return False
+        chaos-injected) and is retried next tick.  A batch of one —
+        the single-failure decision path is unchanged."""
+        return bool(self._promote_batch([rank]))
+
+    def _promote_batch(self, ranks: List[int]) -> List[int]:
+        """Promote parked spares into every rank in ``ranks`` under
+        ONE epoch bump (the PR-13 spare *budget* generalized to the
+        batch).  Node death hands this a whole host's worth of ranks
+        at once; publishing an intermediate epoch per promotion would
+        name still-dead members and park the survivors at a reform
+        barrier those members can never join.  Greedy and partial:
+        ranks the pool (or a chaos-injected ``member.promote``) can't
+        cover stay queued and retry next tick.  Returns the ranks
+        actually promoted."""
+        pool = [s for s in self.state.spares
+                if s.proc.poll() is None and not s.quarantined]
+        pairs = list(zip(ranks, pool))
+        if not pairs:
+            return []
         new_epoch = self.state.epoch + 1
-        try:
-            with _obs_trace.span("resilience.promote",
-                                 args=({"rank": rank,
-                                        "spare": spare.member_id}
-                                       if _obs_trace.enabled()
-                                       else None)):
-                _faults.fault_point("member.promote", rank=rank,
-                                    spare=spare.member_id,
-                                    epoch=new_epoch)
-                self.client.put(
-                    self._kv_key("promote", spare.member_id),
-                    PromotionTicket(rank=rank,
-                                    epoch=new_epoch).to_json())
-        except Exception as e:  # noqa: BLE001 — injected or registry
-            print(f"launch: promoting {spare.member_id} into rank "
-                  f"{rank} failed ({type(e).__name__}: {e}); will "
-                  "retry", file=sys.stderr, flush=True)
-            return False
-        self.state.spares.remove(spare)
-        spare.rank = rank
-        self.state.members[rank] = spare
+        promoted: List[tuple] = []
+        for rank, spare in pairs:
+            try:
+                with _obs_trace.span("resilience.promote",
+                                     args=({"rank": rank,
+                                            "spare": spare.member_id}
+                                           if _obs_trace.enabled()
+                                           else None)):
+                    _faults.fault_point("member.promote", rank=rank,
+                                        spare=spare.member_id,
+                                        epoch=new_epoch)
+                    self.client.put(
+                        self._kv_key("promote", spare.member_id),
+                        PromotionTicket(rank=rank,
+                                        epoch=new_epoch).to_json())
+            except Exception as e:  # noqa: BLE001 — injected or
+                # registry: this pair stays queued, the rest of the
+                # batch proceeds
+                print(f"launch: promoting {spare.member_id} into "
+                      f"rank {rank} failed ({type(e).__name__}: {e});"
+                      " will retry", file=sys.stderr, flush=True)
+                continue
+            promoted.append((rank, spare))
+        if not promoted:
+            return []
+        for rank, spare in promoted:
+            self.state.spares.remove(spare)
+            spare.rank = rank
+            self.state.members[rank] = spare
+            self._promotions.inc()
+            _obs_events.record("promote", rank=rank,
+                               spare=spare.member_id, epoch=new_epoch)
+            print(f"launch: promoted spare {spare.member_id} into "
+                  f"rank {rank} (epoch {new_epoch}); healthy ranks "
+                  "re-form at the barrier and resume — no process "
+                  "restart", flush=True)
         self.state.epoch = new_epoch
         self._publish_epoch()
-        self._promotions.inc()
-        _obs_events.record("promote", rank=rank,
-                           spare=spare.member_id, epoch=new_epoch)
-        print(f"launch: promoted spare {spare.member_id} into rank "
-              f"{rank} (epoch {new_epoch}); healthy ranks re-form at "
-              "the barrier and resume — no process restart",
-              flush=True)
-        self._respawn_spare()
-        return True
+        for _ in promoted:
+            self._respawn_spare()
+        return [rank for rank, _ in promoted]
 
     def _respawn_spare(self):
         """Replenish the pool after a promotion (ROADMAP PR-9
@@ -830,8 +1137,27 @@ class RankController:
             return
         member_id = f"spare-{self._spare_seq}"
         try:
-            m = self._spawn(member_id, "spare", None, self._endpoints,
-                            self._master, f"sparelog.{self._spare_seq}")
+            if self.remote:
+                # least-loaded SURVIVING host: a replacement spare on
+                # an already-dead node is a promotion that can never
+                # happen
+                alive = [h for h in self.hosts
+                         if h not in self._dead_hosts]
+                if not alive:
+                    print("launch: no surviving host to respawn "
+                          f"spare {member_id} on; pool stays short",
+                          file=sys.stderr, flush=True)
+                    return
+                host = min(alive, key=lambda h: (sum(
+                    1 for s in self.state.spares
+                    if s.host == h and s.proc.poll() is None
+                    and not s.quarantined), h))
+                m = self._spawn_remote(member_id, "spare", None, host,
+                                       f"sparelog.{self._spare_seq}")
+            else:
+                m = self._spawn(member_id, "spare", None,
+                                self._endpoints, self._master,
+                                f"sparelog.{self._spare_seq}")
         except Exception as e:  # noqa: BLE001 — injected or OS
             print(f"launch: could not respawn replacement spare "
                   f"{member_id} ({type(e).__name__}: {e}); pool "
@@ -847,6 +1173,8 @@ class RankController:
     # -- main loop -----------------------------------------------------------
     def run(self) -> int:
         os.makedirs(self.args.log_dir, exist_ok=True)
+        if self.remote:
+            return self._run_remote()
         # one endpoint per rank off a private base port (loopback
         # contract identical to the classic controller)
         from .main import _free_port
@@ -865,6 +1193,43 @@ class RankController:
                 f"spare-{s}", "spare", None, endpoints, master,
                 f"sparelog.{s}"))
         self._publish_epoch()
+        self.detector.poll()  # seed baseline
+        try:
+            return self._watch_loop()
+        finally:
+            self._shutdown()
+
+    def _run_remote(self) -> int:
+        """Remote-member mode: the controller owns no PID — ranks and
+        spares are spawn commands addressed to the registered host
+        agents, ``--spares`` is PER NODE (the pool survives any one
+        node), and ranks pack onto hosts in blocks of ``nproc``
+        (rank r → hosts[r // nproc], local accelerator r % nproc)."""
+        rc = self._bootstrap_agents()
+        if rc is not None:
+            return rc
+        from .main import _free_port
+        base_port = _free_port()
+        endpoints = [
+            f"{self._host_ips[self.hosts[r // self.nproc]]}"
+            f":{base_port + r}" for r in range(self.world)]
+        self._endpoints, self._master = endpoints, \
+            self.server_endpoint
+        self._arm_metrics_server()
+        for r in range(self.world):
+            self.state.members[r] = self._spawn_remote(
+                f"rank-{r}", "rank", r, self.hosts[r // self.nproc],
+                f"workerlog.{r}")
+        # spares round-robin across nodes so a whole-node death
+        # leaves replacements on the survivors
+        for j in range(self.n_spares * self.nnodes):
+            self.state.spares.append(self._spawn_remote(
+                f"spare-{j}", "spare", None,
+                self.hosts[j % self.nnodes], f"sparelog.{j}"))
+        self._spare_seq = self.n_spares * self.nnodes
+        self._spares_gauge.set(len(self.state.spares))
+        self._publish_epoch()
+        self._refresh_ctl_lease()
         self.detector.poll()  # seed baseline
         try:
             return self._watch_loop()
@@ -892,8 +1257,28 @@ class RankController:
             # 2. control-plane heartbeat loss (host gone / partition)
             for member in self._poll_heartbeats():
                 for rank, m in self.state.members.items():
-                    if m.member_id == member and m.proc.poll() is None:
-                        self._queue_failure(rank, "heartbeat lost")
+                    if m.member_id != member or m.proc.poll() is not None:
+                        continue
+                    if m.host is not None:
+                        # remote member: its host agent is the process
+                        # authority — a vanished per-member heartbeat
+                        # is a graceful exit whose rc is still in
+                        # flight through the lease (the exit deletes
+                        # the heartbeat before process teardown
+                        # finishes, and the rc travels worker → agent
+                        # reap → lease → here, losing that race).
+                        # Real process death lands as an rc, node
+                        # death as a frozen lease, and a wedge via the
+                        # beacon cross-check — heartbeat loss is a
+                        # single-node verdict only.
+                        continue
+                    self._queue_failure(rank, "heartbeat lost")
+            # 2b. node-level failure domain (remote mode only): lease
+            # judgment + our own lease so agents can tell a dead
+            # controller from a slow one
+            if self.remote:
+                self._judge_nodes()
+                self._refresh_ctl_lease()
             # 3. data-plane cross-check: heartbeat alive, beacon frozen
             self._poll_beacons()
             # 3b. observability plane: straggler attribution from the
@@ -914,14 +1299,19 @@ class RankController:
                           "alive — wedged chip, replacing",
                           file=sys.stderr, flush=True)
                     self._queue_failure(rank, "beacon")
-            # 4. promotions for everything queued
-            for rank in list(self.state.pending_failures):
-                if self._try_promote(rank):
+            # 4. promotions for everything queued — as ONE batch
+            # under a single epoch bump (a node death queues a whole
+            # host's ranks in the same tick; see _promote_batch)
+            if self.state.pending_failures:
+                for rank in self._promote_batch(
+                        list(self.state.pending_failures)):
                     self.state.pending_failures.remove(rank)
-                elif not any(s.proc.poll() is None
-                             for s in self.state.spares):
-                    print(f"launch: rank {rank} lost with no live "
-                          "spare left — job cannot re-form",
+                if self.state.pending_failures and not any(
+                        s.proc.poll() is None
+                        for s in self.state.spares):
+                    print("launch: rank(s) "
+                          f"{self.state.pending_failures} lost with "
+                          "no live spare left — job cannot re-form",
                           file=sys.stderr, flush=True)
                     return 1
             # 5. completion: every rank finished cleanly
@@ -954,14 +1344,22 @@ class RankController:
             self.client.put(self._kv_key("shutdown"), "1")
         except Exception:
             pass
-        for m in [*self.state.spares, *self.state.members.values()]:
+        # remote members wind down with their agents: the shutdown
+        # key just published tells every agent to TERM its own
+        # children, and polling a _RemoteProc here would spin the
+        # whole 10 s deadline waiting for rc records that stop
+        # arriving once the leases go quiet
+        local = [m for m in [*self.state.spares,
+                             *self.state.members.values()]
+                 if not isinstance(m.proc, _RemoteProc)]
+        for m in local:
             if m.proc.poll() is None:
                 try:
                     m.proc.send_signal(signal.SIGTERM)
                 except OSError:
                     pass
         deadline = time.time() + 10
-        for m in [*self.state.spares, *self.state.members.values()]:
+        for m in local:
             while m.proc.poll() is None and time.time() < deadline:
                 time.sleep(0.1)
             if m.proc.poll() is None:
@@ -975,10 +1373,21 @@ def run_rank_elastic(args) -> int:
     """Entry point used by ``launch/main.py`` when ``--spares`` > 0."""
     from ..fleet.elastic import KVClient, KVServer
     nproc = args.nproc_per_node or 1
+    nnodes = max(int(str(args.nnodes).split(":")[0]), 1)
     server = None
     endpoint = args.elastic_server or \
         os.environ.get("PADDLE_ELASTIC_SERVER")
     if not endpoint or endpoint == "auto":
+        if nnodes > 1:
+            # an embedded registry's endpoint is minted after the
+            # agents must already be pointing somewhere — multi-host
+            # needs one shared, pre-agreed server
+            print("launch: --nnodes > 1 needs an explicit "
+                  "--elastic_server every host agent was started "
+                  "against (an embedded 'auto' registry cannot be "
+                  "discovered by the agents)",
+                  file=sys.stderr, flush=True)
+            return 2
         server = KVServer().start()
         endpoint = server.endpoint
     client = KVClient(endpoint)
@@ -987,7 +1396,8 @@ def run_rank_elastic(args) -> int:
         beacon_timeout=args.beacon_timeout,
         metrics_port=getattr(args, "metrics_port", 0),
         straggler_factor=getattr(args, "straggler_factor", None),
-        drain_stragglers=getattr(args, "drain_stragglers", 0))
+        drain_stragglers=getattr(args, "drain_stragglers", 0),
+        nnodes=nnodes)
     try:
         return ctl.run()
     finally:

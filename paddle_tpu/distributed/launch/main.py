@@ -61,9 +61,19 @@ def parse_args(argv=None):
     # judgment windows is auto-drained onto a spare.  0 (default)
     # = attribution only, never a drain.
     p.add_argument("--drain_stragglers", type=int, default=0)
-    p.add_argument("training_script", type=str)
+    # host-agent mode (DESIGN-RESILIENCE.md §Multi-host supervision):
+    # `launch --agent --host_id H --elastic_server EP` runs the
+    # per-node HostAgent daemon instead of a controller — it spawns
+    # nothing until a controller publishes spawn commands for H.
+    p.add_argument("--agent", action="store_true")
+    p.add_argument("--host_id", type=str, default=None)
+    p.add_argument("training_script", type=str, nargs="?",
+                   default=None)
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if not args.agent and args.training_script is None:
+        p.error("training_script is required (unless --agent)")
+    return args
 
 
 def _spawn_pod(args, nproc: int, world: int, endpoints: List[str],
@@ -107,7 +117,11 @@ def _kill_pod(procs: List[subprocess.Popen]):
 
 def main(argv=None):
     args = parse_args(argv)
-    single_node = str(args.nnodes).split(":")[0] == "1"
+    if args.agent:
+        # per-node supervisor daemon: all spawn/kill decisions come
+        # from a controller over the KV registry (agent.py)
+        from .agent import run_agent
+        return run_agent(args)
     # NOTE: a PADDLE_TPU_METRICS_PORT env var does NOT route here —
     # it arms the per-rank endpoints through plain env inheritance
     # (workers offset BASE+1+rank themselves) but must never change
@@ -121,15 +135,10 @@ def main(argv=None):
         # kill-the-pod watchdog below (controller.py).  --metrics_port
         # routes here too: the fleet observability plane (per-rank
         # /metrics, /fleet/* aggregation, straggler attribution) lives
-        # in the rank controller.  Single-node only today — silently
-        # shrinking a multi-node request to one node would run at
-        # half the asked-for world size
-        if not single_node:
-            print("launch: --spares/--metrics_port/--drain_stragglers "
-                  f"support single-node jobs only (got --nnodes "
-                  f"{args.nnodes}); multi-node spare pools are a "
-                  "documented follow-up", file=sys.stderr)
-            return 1
+        # in the rank controller.  Multi-node (--nnodes N > 1) routes
+        # here as well: the controller addresses (host_id, rank)
+        # members through one `launch --agent` per node
+        # (DESIGN-RESILIENCE.md §Multi-host supervision).
         if args.spares <= 0:
             # recovery semantics change and the user should know:
             # rank-elastic supervision recovers by PROMOTION, so with

@@ -59,6 +59,9 @@ KNOWN_SITES = frozenset({
     "member.drain",        # controller auto-drains a persistent straggler
     "router.shed",         # droppable: serving router sheds an admission
     "replica.spawn",       # serving router spawns a new replica
+    "agent.command",       # host agent executes a controller command
+    "agent.spawn",         # host agent spawns a worker process
+    "node.lease",          # droppable: host agent's liveness lease refresh
 })
 
 

@@ -170,6 +170,9 @@ _k("PADDLE_TPU_STRAGGLER_FACTOR", "2.0", "float",
 _k("PADDLE_TPU_DRAIN_STRAGGLERS", "0 (attribution only)", "int",
    "Consecutive straggler windows before the controller drains a "
    "rank (0 = never drain).")
+_k("PADDLE_TPU_NODE_LEASE_TIMEOUT", "3.0", "float",
+   "Multi-host mode: seconds a host agent's lease may freeze before "
+   "the controller declares node death.")
 
 
 _TRUTHY = ("1", "true", "yes", "on")

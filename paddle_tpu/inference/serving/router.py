@@ -182,7 +182,7 @@ class ServingRouter:
                     "slo_p99_s", "scale_up_queue_depth",
                     "scale_down_queue_depth", "windows_up",
                     "windows_down", "cooldown_s",
-                    "decision_interval_s")
+                    "decision_interval_s", "drain_relief_rate")
 
     def __init__(self, replica_factory: Callable[[], Any], *,
                  phase: Optional[str] = None,
@@ -193,6 +193,7 @@ class ServingRouter:
                  windows_up: int = 2, windows_down: int = 8,
                  cooldown_s: float = 5.0,
                  decision_interval_s: float = 0.25,
+                 drain_relief_rate: float = 0.0,
                  metrics_port: Optional[int] = None):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
@@ -218,6 +219,13 @@ class ServingRouter:
         self.windows_down = max(int(windows_down), 1)
         self.cooldown_s = float(cooldown_s)
         self.decision_interval_s = float(decision_interval_s)
+        # drain-relief (ROADMAP fleet remainder): when the per-replica
+        # queue is FALLING at >= this rate (requests per round), depth
+        # and shed evidence are discounted — a burst already draining
+        # should not latch shed state.  0 = off (level-only policy,
+        # bit-identical to before); SLO violation always counts.
+        self.drain_relief_rate = float(drain_relief_rate)
+        self._prev_queue: Optional[int] = None
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = []
         self._shedding = False
@@ -452,9 +460,14 @@ class ServingRouter:
         p99 = (_quantile_from_cum(edges, merged_cum, 0.99)
                if merged_cum and edges else None)
         self._last_p99 = p99
+        # queue-depth derivative: requests gained (+) or drained (-)
+        # since the previous sample — the drain-relief policy's
+        # evidence; first sample has no baseline, so delta 0
+        prev, self._prev_queue = self._prev_queue, queue
         return {"replicas": len(reps), "queue_depth": queue,
                 "active": active, "p99_s": p99,
-                "shed_delta": shed_delta}
+                "shed_delta": shed_delta,
+                "queue_delta": (0 if prev is None else queue - prev)}
 
     def control_round(self) -> Dict[str, Any]:
         """ONE policy decision over one signal sample (the background
@@ -472,9 +485,19 @@ class ServingRouter:
         # sheds since the last round are overload evidence too: a
         # burst that fills AND drains every queue between two rounds
         # never shows up in the sampled depth, but the rejections it
-        # forced did happen
-        overloaded = (per_rep > self.scale_up_queue_depth
-                      or slo_violated or sig["shed_delta"] > 0)
+        # forced did happen.  Drain relief scales that evidence with
+        # the depth DERIVATIVE: a queue already falling faster than
+        # drain_relief_rate per replica per round is a burst on its
+        # way out, and holding shed latched against it rejects
+        # traffic the pool is about to absorb anyway — only a live
+        # SLO violation overrides the relief
+        draining = (self.drain_relief_rate > 0
+                    and sig["queue_delta"] < 0
+                    and (-sig["queue_delta"]) / max(n, 1)
+                    >= self.drain_relief_rate)
+        overloaded = (((per_rep > self.scale_up_queue_depth
+                        or sig["shed_delta"] > 0) and not draining)
+                      or slo_violated)
         idle = (per_rep <= self.scale_down_queue_depth
                 and not slo_violated and sig["shed_delta"] == 0)
         self._up_streak = self._up_streak + 1 if overloaded else 0

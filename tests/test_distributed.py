@@ -1041,7 +1041,13 @@ def test_optimizer_state_roundtrip_through_engines():
         {"pp": 2}, devices=jax.devices()[:2]))
 
     class _Strat:
-        pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2}
+        # donate_carry off: this container's CPU jaxlib intermittently
+        # hands back a denormal read from the donated (params,
+        # opt_state) buffer on exactly this restore-then-step path —
+        # the one engine-level opt-out the DESIGN-DCN.md donation
+        # caveat reserves (real-TPU re-measure in the ROADMAP backlog)
+        pipeline_configs = {"accumulate_steps": 2, "micro_batch_size": 2,
+                            "donate_carry": False}
 
     paddle.seed(0)
     net = GPTForCausalLMPipe(cfg, num_stages=2)

@@ -1180,6 +1180,241 @@ def test_controller_respawn_can_be_disabled_and_survives_failure(
     assert ctl2.state.spares == []
 
 
+# ---------------------------------------------------------------------------
+# multi-host supervision (ISSUE 18): the HostAgent command protocol
+# (idempotent cmd/<seq> records) and the controller's node-level
+# failure domain (lease judgment, batch promotion under ONE epoch)
+# ---------------------------------------------------------------------------
+def _stub_agent(server, tmp_path, host_id="h0", job_id="aj",
+                run_id="r1"):
+    import types
+    from paddle_tpu.distributed.launch.agent import HostAgent
+    args = types.SimpleNamespace(job_id=job_id,
+                                 log_dir=str(tmp_path))
+    agent = HostAgent(args, KVClient(server.endpoint), host_id)
+    agent.run_id = run_id     # adopted (normally from the run record)
+    spawned = []
+
+    def fake_popen(cmd, env, log_path):
+        spawned.append((list(cmd), dict(env), log_path))
+        proc = _StubProc()
+        proc.pid = 4242 + len(spawned)
+        return proc
+
+    agent._popen = fake_popen
+    return agent, spawned
+
+
+def test_agent_commands_are_idempotent_and_retry_on_injection(
+        server, tmp_path):
+    """THE idempotency pin: a command record consumed twice — by a
+    retry after an injected ``agent.command`` failure, or by a fresh
+    agent incarnation re-walking the sequence — never double-spawns,
+    because the ack record is checked before executing."""
+    import json as _json
+    from paddle_tpu.distributed.resilience.elastic_rank import kv_key
+    agent, spawned = _stub_agent(server, tmp_path)
+    key = lambda *p: kv_key("aj", *p, run_id="r1")  # noqa: E731
+    agent.client.put(key("agent", "h0", "cmd", "0"), _json.dumps(
+        {"op": "spawn", "seq": 0, "member": "rank-0", "role": "rank",
+         "rank": 0, "env": {"PADDLE_TRAINER_ID": "0"},
+         "script": "train.py", "args": ["--x"],
+         "log_name": "workerlog.0"}))
+    agent._consume_commands()
+    assert len(spawned) == 1
+    cmd, env, log_path = spawned[0]
+    assert cmd[1:] == ["train.py", "--x"]
+    assert env["PADDLE_TRAINER_ID"] == "0"
+    # per-host log subtree: two simulated agents must never share one
+    assert os.path.join(str(tmp_path), "h0") in log_path
+    ack = _json.loads(agent.client.get(key("agent", "h0", "ack", "0")))
+    assert ack == {"seq": 0, "ok": True, "error": None}
+    # a restarted agent re-walks from seq 0: the ack gate skips the
+    # executed command — no second spawn
+    agent2, spawned2 = _stub_agent(server, tmp_path)
+    agent2._consume_commands()
+    assert spawned2 == [] and agent2._next_seq == 1
+    # injected agent.command failure: the command stays UNACKED and
+    # the next tick retries it — executed exactly once overall
+    agent2.client.put(key("agent", "h0", "cmd", "1"), _json.dumps(
+        {"op": "spawn", "seq": 1, "member": "spare-0",
+         "role": "spare", "rank": None, "env": {},
+         "script": "train.py", "args": [],
+         "log_name": "sparelog.0"}))
+    install(FaultPlan.from_json(
+        '[{"site":"agent.command","action":"error","at":1,'
+        '"count":1}]'))
+    agent2._consume_commands()
+    assert spawned2 == []
+    assert agent2.client.get(key("agent", "h0", "ack", "1")) is None
+    agent2._consume_commands()      # retry lands
+    clear()
+    assert len(spawned2) == 1 and spawned2[0][2].endswith("sparelog.0")
+    assert _json.loads(agent2.client.get(
+        key("agent", "h0", "ack", "1")))["ok"] is True
+
+
+def test_agent_spawn_failure_acks_false_with_synthetic_rc(
+        server, tmp_path):
+    """A spawn whose fork fails DID execute: it must ack (retrying a
+    half-run spawn is how double-spawns happen) and report a
+    synthetic nonzero rc in the lease, so the controller judges it
+    through the ordinary exit-rc path — a worker that never spawned
+    also never heartbeats, which no liveness detector can see."""
+    import json as _json
+    from paddle_tpu.distributed.resilience.elastic_rank import kv_key
+    agent, _ = _stub_agent(server, tmp_path, job_id="aj2")
+
+    def bad_popen(cmd, env, log_path):
+        raise OSError("fork failed")
+
+    agent._popen = bad_popen
+    key = lambda *p: kv_key("aj2", *p, run_id="r1")  # noqa: E731
+    agent.client.put(key("agent", "h0", "cmd", "0"), _json.dumps(
+        {"op": "spawn", "seq": 0, "member": "rank-1", "role": "rank",
+         "rank": 1, "env": {}, "script": "t.py", "args": [],
+         "log_name": "workerlog.1"}))
+    agent._consume_commands()
+    ack = _json.loads(agent.client.get(key("agent", "h0", "ack", "0")))
+    assert ack["ok"] is False and "fork failed" in ack["error"]
+    agent._refresh_lease()
+    lease = _json.loads(agent.client.get(key("node", "h0")))
+    assert lease["procs"]["rank-1"]["rc"] == 127
+
+
+def _remote_controller(server, tmp_path, job_id="mh"):
+    import types
+    from paddle_tpu.distributed.launch.controller import (
+        RankController, _Member, _RemoteProc)
+    args = types.SimpleNamespace(job_id=job_id,
+                                 log_dir=str(tmp_path),
+                                 training_script="x.py",
+                                 training_script_args=[])
+    ctl = RankController(args, KVClient(server.endpoint),
+                         server.endpoint, nproc=2, spares=2,
+                         beacon_timeout=30.0, nnodes=2)
+    ctl.hosts = ["h0", "h1"]
+    ctl._host_ips = {"h0": "127.0.0.1", "h1": "127.0.0.1"}
+    ctl._endpoints = [f"127.0.0.1:{9000 + r}" for r in range(4)]
+    ctl._master = server.endpoint
+
+    def member(mid, rank, host):
+        return _Member(mid, _RemoteProc(ctl, host, mid), "",
+                       rank=rank, host=host)
+
+    ctl.state.members = {r: member(f"rank-{r}", r,
+                                   "h0" if r < 2 else "h1")
+                         for r in range(4)}
+    # spares round-robin across nodes, like _run_remote lays them out
+    ctl.state.spares = [member(f"spare-{j}", None,
+                               "h0" if j % 2 == 0 else "h1")
+                        for j in range(4)]
+    ctl._spare_seq = 4
+    ctl._publish_epoch()
+    return ctl
+
+
+def test_controller_node_death_batch_promotes_under_one_epoch(
+        server, tmp_path):
+    """Node-level failure domain: a frozen lease is judged NODE DEATH
+    — every rank the host held quarantined in one pass, and the whole
+    batch promoted under a SINGLE epoch bump (an intermediate epoch
+    naming a still-dead member would hang the survivors' reform
+    barrier).  Replacement spares respawn on the surviving host."""
+    import json as _json
+    from paddle_tpu.distributed.resilience.elastic_rank import kv_key
+    ctl = _remote_controller(server, tmp_path)
+    deaths0 = ctl._node_deaths.collect()
+    t0 = time.monotonic()
+    lease = lambda beat: _json.dumps(  # noqa: E731
+        {"beat": beat, "pid": 1, "parked": False, "procs": {}})
+    ctl.client.put(ctl._kv_key("node", "h0"), lease(0))
+    ctl.client.put(ctl._kv_key("node", "h1"), lease(0))
+    ctl._judge_nodes(now=t0)
+    assert ctl._dead_hosts == set()
+    # h0 keeps beating, h1's lease freezes past the timeout
+    ctl.client.put(ctl._kv_key("node", "h0"), lease(1))
+    ctl._judge_nodes(now=t0 + ctl.node_lease_timeout)
+    ctl._judge_nodes(now=t0 + ctl.node_lease_timeout + 0.5)
+    assert ctl._dead_hosts == {"h1"}
+    assert ctl._node_deaths.collect() == deaths0 + 1
+    # ALL of h1's processes are dead with it (ranks AND spares): the
+    # synthesized rc makes every liveness predicate agree
+    for mid in ("rank-2", "rank-3", "spare-1", "spare-3"):
+        assert ctl._remote_rc[mid] == -9
+    assert ctl.state.pending_failures == [2, 3]
+    assert ctl.state.members[2].quarantined
+    assert ctl.state.members[3].quarantined
+    # node gauges: 1 alive / 1 dead; the dead host's lease-age series
+    # ended with it (absent, not stale)
+    assert ctl._reg.gauge("fleet_nodes",
+                          labels={"state": "alive"}).collect() == 1.0
+    assert ctl._reg.gauge("fleet_nodes",
+                          labels={"state": "dead"}).collect() == 1.0
+    # the epoch record published while the batch is pending EXCLUDES
+    # the quarantined members — survivors must never be parked at a
+    # barrier a dead member can't join
+    ctl._publish_epoch()
+    rec = _json.loads(ctl.client.get(
+        kv_key("mh", "epoch", run_id=ctl.run_id)))
+    assert set(rec["members"]) == {"0", "1"}
+    # batch promotion: both ranks land under ONE epoch bump, tickets
+    # both name epoch 1, and the pool refills on the SURVIVING host
+    assert ctl._promote_batch(list(ctl.state.pending_failures)) == \
+        [2, 3]
+    assert ctl.state.epoch == 1
+    assert ctl.state.members[2].member_id == "spare-0"
+    assert ctl.state.members[3].member_id == "spare-2"
+    for spare, rank in (("spare-0", 2), ("spare-2", 3)):
+        ticket = _json.loads(ctl.client.get(
+            kv_key("mh", "promote", spare, run_id=ctl.run_id)))
+        assert ticket == {"rank": rank, "epoch": 1}
+    rec = _json.loads(ctl.client.get(
+        kv_key("mh", "epoch", run_id=ctl.run_id)))
+    assert rec["epoch"] == 1
+    assert rec["members"] == {"0": "rank-0", "1": "rank-1",
+                              "2": "spare-0", "3": "spare-2"}
+    respawned = [s for s in ctl.state.spares
+                 if s.member_id in ("spare-4", "spare-5")]
+    assert [s.host for s in respawned] == ["h0", "h0"]
+    # the healthz node section shows the degraded fleet at one glance
+    h = ctl._fleet_health_summary()
+    nodes = {n["host"]: n for n in h["nodes"]}
+    assert nodes["h1"]["alive"] is False
+    assert nodes["h0"]["ranks"] == [0, 1, 2, 3]
+    assert h["status"] == "degraded"
+
+
+def test_controller_partial_batch_keeps_uncovered_rank_queued(
+        server, tmp_path):
+    """A spare pool that covers a node death only partially promotes
+    what it can: the covered ranks land under one epoch bump, the
+    uncovered rank stays queued (retried when the pool refills), and
+    the published epoch names no dead member."""
+    import json as _json
+    from paddle_tpu.distributed.resilience.elastic_rank import kv_key
+    ctl = _remote_controller(server, tmp_path, job_id="mh2")
+    ctl.respawn_spares = False
+    # only ONE live spare survives: spare-0 on h0
+    ctl.state.spares = ctl.state.spares[:1]
+    t0 = time.monotonic()
+    lease = lambda beat: _json.dumps(  # noqa: E731
+        {"beat": beat, "pid": 1, "parked": False, "procs": {}})
+    ctl.client.put(ctl._kv_key("node", "h0"), lease(0))
+    ctl.client.put(ctl._kv_key("node", "h1"), lease(0))
+    ctl._judge_nodes(now=t0)
+    ctl.client.put(ctl._kv_key("node", "h0"), lease(1))
+    ctl._judge_nodes(now=t0 + ctl.node_lease_timeout + 0.5)
+    assert ctl.state.pending_failures == [2, 3]
+    assert ctl._promote_batch(list(ctl.state.pending_failures)) == [2]
+    assert ctl.state.epoch == 1
+    rec = _json.loads(ctl.client.get(
+        kv_key("mh2", "epoch", run_id=ctl.run_id)))
+    # rank 3 is still down: the epoch record must NOT name it
+    assert rec["members"] == {"0": "rank-0", "1": "rank-1",
+                              "2": "spare-0"}
+
+
 def test_controller_straggler_gauge_fires_on_injected_latency(
         server, capsys):
     """ISSUE 10: the controller turns the beacon records it already
@@ -1740,7 +1975,7 @@ _ELASTIC_WORKER = textwrap.dedent("""
         # losses to the controller-spawned workers, at a quarter of
         # the process-spawn cost
         from paddle_tpu import optimizer as _optim
-        for rank in (0, 1):
+        for rank in range(int(os.environ.get("E2E_WORLD", "2"))):
             paddle.seed(7 + rank)
             net = Net()
             opt = _optim.Adam(learning_rate=1e-2,
@@ -1916,9 +2151,9 @@ def _run_elastic_pod(tmp_path, name, extra_env=None, spares=1,
     return proc, _read_pod_logs(work), work
 
 
-def _losses(work):
+def _losses(work, world=2):
     out = {}
-    for r in (0, 1):
+    for r in range(world):
         p = work / "loss" / f"rank{r}.loss"
         if p.exists():
             out[r] = float(p.read_text())
@@ -2253,6 +2488,211 @@ def test_chaos_e2e_straggler_auto_drained_and_recovers(tmp_path):
     chaos = _losses(work)
     assert sorted(chaos) == [0, 1], chaos
     for r in (0, 1):
+        np.testing.assert_allclose(chaos[r], ref[r], rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18 acceptance: multi-host elastic fleet — two host agents
+# (virtual host ids, one shared KV registry), SIGKILL of an ENTIRE
+# node, node-death verdict from the frozen lease, batch promotion of
+# both lost ranks under ONE epoch, bit-identical end state
+# ---------------------------------------------------------------------------
+_MULTIHOST_ENV = {
+    # pace the steps so the external SIGKILL lands mid-run (the
+    # single-node e2es crash deterministically from INSIDE the
+    # victim; a whole-node kill is necessarily an outside event)
+    "E2E_TOTAL_STEPS": "12",
+    "E2E_STEP_SLEEP": "0.4",
+    # retention reaches back across the ~3 s death-verdict window
+    "E2E_CKPT_KEEP": "40",
+}
+
+
+@pytest.mark.dist
+@pytest.mark.slow
+def test_chaos_e2e_node_death_batch_promotion(tmp_path):
+    """THE multi-host acceptance (ISSUE 18): a 2-node pod — two
+    ``launch --agent`` daemons with virtual host ids against one KV
+    server — runs 4 ranks + 2 spares per node.  Host h1 (agent AND
+    both its worker pids) is SIGKILLed mid-run.  The controller must
+    judge NODE DEATH from the frozen lease (no process exit is
+    observable across hosts), quarantine BOTH of h1's ranks in one
+    pass, batch-promote the two surviving spares under a SINGLE
+    epoch bump, and the re-formed 4-rank run must finish with final
+    losses bit-identical to an uninterrupted reference — with the
+    node_death decision visible on /fleet/events while the job
+    runs."""
+    import signal as _signal
+    import socket as _socket
+    from paddle_tpu.distributed.resilience.elastic_rank import kv_key
+
+    # uninterrupted 4-rank reference (one process, sequential ranks)
+    ref_work = tmp_path / "ref"
+    ref_work.mkdir()
+    (ref_work / "loss").mkdir()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_backend_optimization_level=0"
+    env["CKPT_ROOT"] = str(ref_work / "ckpt")
+    env["LOSS_DIR"] = str(ref_work / "loss")
+    env["E2E_REFERENCE_MODE"] = "1"
+    env["E2E_WORLD"] = "4"
+    env["E2E_TOTAL_STEPS"] = _MULTIHOST_ENV["E2E_TOTAL_STEPS"]
+    env.pop("PADDLE_FAULT_PLAN", None)
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(_ELASTIC_WORKER)
+    proc = subprocess.run([sys.executable, str(script)], env=env,
+                          cwd=str(ref_work), capture_output=True,
+                          text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    ref = _losses(ref_work, world=4)
+    assert sorted(ref) == [0, 1, 2, 3], ref
+
+    # the shared registry is test-owned (NOT controller-embedded):
+    # agents must outlive any one controller, that is the point
+    kv = KVServer().start()
+    with _socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        base = s.getsockname()[1]
+    name = "nodedeath"
+    cmd, env, work = _elastic_pod_cmd_env(
+        tmp_path, name, extra_env=_MULTIHOST_ENV, spares=2,
+        beacon_timeout=30.0,   # the ONLY path allowed to replace
+        # h1's ranks is the node-lease judgment (worker heartbeats
+        # outlive it: server ttl 6 s + grace > lease timeout 3 s)
+        extra_args=["--nnodes", "2",
+                    "--elastic_server", kv.endpoint,
+                    "--metrics_port", str(base)])
+    agent_cmd = [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--agent", "--elastic_server", kv.endpoint,
+                 "--job_id", name, "--log_dir", str(work / "log")]
+    agents, agent_logs, pod, death_ev = {}, {}, None, None
+    client = KVClient(kv.endpoint)
+    try:
+        for host in ("h0", "h1"):
+            agent_logs[host] = open(work / f"agent_{host}.log", "w")
+            agents[host] = subprocess.Popen(
+                agent_cmd + ["--host_id", host], env=env,
+                cwd=str(work), stdout=agent_logs[host],
+                stderr=subprocess.STDOUT, text=True)
+        pod = subprocess.Popen(cmd, env=env, cwd=str(work),
+                               stdout=subprocess.PIPE,
+                               stderr=subprocess.PIPE, text=True)
+
+        def wait_for(fn, what, budget=90.0):
+            deadline = time.time() + budget
+            while time.time() < deadline:
+                assert pod.poll() is None, \
+                    f"controller died while waiting for {what}"
+                got = fn()
+                if got is not None:
+                    return got
+                time.sleep(0.2)
+            raise AssertionError(f"no {what} within {budget}s")
+
+        run_id = wait_for(
+            lambda: (json.loads(client.get(kv_key(name, "run")))
+                     ["run_id"]
+                     if client.get(kv_key(name, "run")) else None),
+            "run record")
+
+        def h1_rank_beacon():
+            raw = client.get(kv_key(name, "beacon", "2",
+                                    run_id=run_id))
+            if raw and json.loads(raw).get("step", -1) >= 2:
+                return raw
+            return None
+
+        wait_for(h1_rank_beacon, "rank-2 progress past step 2", 120.0)
+        lease = json.loads(client.get(kv_key(name, "node", "h1",
+                                             run_id=run_id)))
+        victims = sorted(p["pid"] for p in lease["procs"].values()
+                         if p["pid"] is not None and p["rc"] is None)
+        assert len(victims) == 4, lease    # 2 ranks + 2 spares on h1
+        # kill the WHOLE node: agent first (a surviving agent would
+        # report its workers' exit codes and turn this into four
+        # ordinary exit-rc failures — the node verdict must come
+        # from the frozen lease alone), then every process it held
+        agents["h1"].kill()
+        agents["h1"].wait(timeout=30)
+        for pid in victims:
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        # observability-first acceptance: the node_death decision is
+        # readable on /fleet/events from outside while the job runs
+        def node_death_event():
+            payload = _get_json_quiet(
+                f"http://127.0.0.1:{base}/fleet/events")
+            for e in (payload or {}).get("events", []):
+                if e.get("kind") == "node_death":
+                    return e
+            return None
+
+        death_ev = wait_for(node_death_event, "node_death event")
+        assert death_ev["host"] == "h1"
+        assert death_ev["ranks"] == [2, 3]
+        h = _get_json_quiet(f"http://127.0.0.1:{base}/fleet/healthz")
+        if h is not None and "nodes" in h:
+            nodes = {n["host"]: n for n in h["nodes"]}
+            assert nodes["h1"]["alive"] is False
+        out, err = pod.communicate(timeout=240)
+        # the surviving agent winds down with the job
+        agents["h0"].wait(timeout=60)
+    except BaseException:
+        if pod is not None:
+            pod.kill()
+            pod.communicate()
+        raise
+    finally:
+        for host, a in agents.items():
+            if a.poll() is None:
+                a.kill()
+                a.wait()
+        for f in agent_logs.values():
+            f.close()
+        kv.stop()
+    logs = {}
+    for host in ("h0", "h1"):
+        for fname in ("workerlog.0", "workerlog.1", "workerlog.2",
+                      "workerlog.3", "sparelog.0", "sparelog.1",
+                      "sparelog.2", "sparelog.3"):
+            p = work / "log" / host / fname
+            if p.exists():
+                logs[f"{host}/{fname}"] = p.read_text()
+    assert pod.returncode == 0, (
+        f"rc={pod.returncode}\nstderr:\n{err[-4000:]}\n"
+        f"logs: {sorted(logs)}\n"
+        f"log h0/0:\n{logs.get('h0/workerlog.0', '')[-2000:]}")
+    # the verdict was NODE death — one pass, both ranks — not two
+    # independent member failures
+    assert "NODE DEATH: host h1" in err
+    assert "quarantining its ranks [2, 3]" in err
+    # batch promotion landed under ONE epoch: both spares on the
+    # surviving host promoted into the lost ranks at epoch 1
+    assert "promoted spare spare-0 into rank 2 (epoch 1)" in out
+    assert "promoted spare spare-2 into rank 3 (epoch 1)" in out
+    assert "(epoch 2)" not in out
+    assert "PROMOTED-TO-RANK 2 epoch=1" in logs["h0/sparelog.0"]
+    assert "PROMOTED-TO-RANK 3 epoch=1" in logs["h0/sparelog.2"]
+    assert "TRAIN-COMPLETE rank=2" in logs["h0/sparelog.0"]
+    assert "TRAIN-COMPLETE rank=3" in logs["h0/sparelog.2"]
+    # the survivors on h0 were NOT restarted: one incarnation each,
+    # re-formed in place at epoch 1
+    for r in (0, 1):
+        log = logs[f"h0/workerlog.{r}"]
+        starts = [l for l in log.splitlines()
+                  if l.startswith("WORKER-START")]
+        assert len(starts) == 1, starts
+        pid = starts[0].split("pid=")[1].strip()
+        assert f"TRAIN-COMPLETE rank={r} pid={pid}" in log
+        assert "REFORMED epoch=1" in log
+    # bit-identical final losses vs the uninterrupted 4-rank run
+    chaos = _losses(work, world=4)
+    assert sorted(chaos) == [0, 1, 2, 3], chaos
+    for r in range(4):
         np.testing.assert_allclose(chaos[r], ref[r], rtol=0, atol=0)
 
 

@@ -354,6 +354,75 @@ def test_slo_violation_counts_as_overload():
         router.close()
 
 
+def test_drain_rate_relief_discounts_depth_and_shed_evidence():
+    """ISSUE 18 policy-matrix pin: with ``drain_relief_rate`` armed, a
+    deep queue whose depth is FALLING faster than the rate (per
+    replica, per round) is a burst already draining — its depth and
+    shed-count evidence must not advance the scale-up streak or latch
+    shedding.  A stalled or growing queue counts again immediately,
+    and an SLO violation is never discounted (latency debt is real
+    even while the queue shortens)."""
+    router, made = _stub_router(n=1, max_replicas=3, windows_up=2,
+                                scale_up_queue_depth=4.0,
+                                drain_relief_rate=2.0)
+    try:
+        made[0].set_load(queue=20)     # static deep queue: overload
+        sig = router.control_round()
+        assert sig["queue_delta"] == 0 and sig["decision"] == "hold"
+        for q in (14, 10, 7):          # draining ≥ 2 req/round
+            made[0].set_load(queue=q)
+            sig = router.control_round()
+            assert sig["queue_delta"] < 0
+            assert sig["decision"] == "hold"
+        assert router.num_replicas == 1   # streak never reached 2
+        # the drain stalls: depth evidence counts again, streak
+        # rebuilds from zero and the second window scales up
+        router.control_round()
+        assert router.control_round()["decision"] == "scale_up"
+        assert router.num_replicas == 2
+    finally:
+        router.close()
+
+
+def test_drain_rate_relief_never_discounts_slo_and_defaults_off():
+    router, made = _stub_router(n=1, max_replicas=1, windows_up=1,
+                                slo_p99_s=0.5,
+                                scale_up_queue_depth=4.0,
+                                drain_relief_rate=2.0)
+    try:
+        # draining hard, but p99 is blown: shedding must still latch
+        # (capacity is maxed, so shed is the only lever left)
+        made[0].set_load(queue=20)
+        router.control_round()
+        made[0].set_load(queue=10)
+        made[0].observe_latency(*([2.0] * 10))
+        router.control_round()
+        assert router.shedding
+    finally:
+        router.close()
+    # a draining-shaped load with the knob at its 0.0 default is
+    # plain overload — the relief is strictly opt-in
+    router, made = _stub_router(n=1, max_replicas=1, windows_up=1,
+                                scale_up_queue_depth=4.0)
+    try:
+        made[0].set_load(queue=20)
+        router.control_round()
+        made[0].set_load(queue=10)     # delta -10: no relief knob
+        router.control_round()
+        assert router.shedding
+    finally:
+        router.close()
+    # the knob rides the config surface like every other policy knob
+    router, _ = _stub_router(n=1, drain_relief_rate=3.5)
+    cfg = router.to_config()
+    router.close()
+    assert cfg["drain_relief_rate"] == 3.5
+    r2 = ServingRouter.from_config(cfg, lambda: _StubServer(),
+                                   decision_interval_s=0)
+    assert r2.drain_relief_rate == 3.5
+    r2.close()
+
+
 # ---------------------------------------------------------------------------
 # windowed p99: cumulative-histogram diff math
 # ---------------------------------------------------------------------------
