@@ -1135,6 +1135,130 @@ def bench_serving():
     })
 
 
+def bench_spec():
+    """Speculative decoding (ISSUE 19) — CPU host-loop proxy.
+
+    On a TPU deployment the decode loop is HOST-bound: the per-token
+    device forward is microseconds while Python dispatch, streaming,
+    and the done-poll sync cost milliseconds — speculation's whole win
+    is doing that host round-trip once per k+1 tokens.  This bench
+    reproduces that regime on CPU with a deliberately tiny model
+    (device forward ~1 ms) and a LIVE streaming consumer that reads
+    each token as it arrives (the SSE-server pattern: one lazy-stack
+    materialization per dispatch) — so tokens/s tracks host
+    round-trips per token, exactly what speculation collapses.
+
+    Matrix: k in {2, 4, 8} x {self-draft (accept ~1, the headline),
+    adversarial draft (sign-flipped weights, accept ~0, the floor)}
+    against the non-speculative engine on the same closed-loop load.
+    Every leg is steady-state (a full warm round first, so compile
+    time never pollutes the ratio) and token-identical to the
+    baseline by the exactness contract (tests/test_serving_spec.py).
+    Reports tokens/s per request, speedup, lane-normalized
+    dispatches/token (from serving_spec_dispatches_total), and the
+    measured accept rate."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+    from paddle_tpu.inference.serving import (DecodeEngine,
+                                              extract_decode_params,
+                                              filter_spec_stream)
+
+    print("devices-ok", jax.devices(), flush=True)
+    tiny = bool(os.environ.get("GRAFT_BENCH_TINY"))
+    B = 4
+    max_tokens = 16 if tiny else 96
+    ks = (2,) if tiny else (2, 4, 8)
+
+    paddle.seed(0)
+    # host-loop proxy config: 1 layer / hidden 32 keeps the device
+    # forward ~1 ms so the host round-trip dominates, as on TPU
+    cfg = gpt_tiny(use_flash_attention=False, num_hidden_layers=1,
+                   hidden_size=32, num_attention_heads=2,
+                   intermediate_size=64)
+    net = GPTForCausalLM(cfg)
+    net.eval()
+    params = extract_decode_params(net)
+    # adversarial draft: sign-flipped weights share the geometry but
+    # never agree with the target's argmax — the accept ~0 floor
+    neg = jax.tree_util.tree_map(lambda a: -a, params)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 256, (12,)).tolist() for _ in range(B)]
+
+    def mkcb(spec):
+        raw = lambda rid, idx, tok: int(tok)       # live consumer
+        return (filter_spec_stream(raw, max_tokens=max_tokens)
+                if spec else raw)
+
+    def warm(eng, spec):
+        for p in prompts:                  # warm round: every program
+            eng.submit(p, max_tokens=max_tokens, stream_cb=mkcb(spec))
+        eng.run_until_idle()
+
+    def timed(eng, spec):
+        d0 = eng._dispatch_count
+        futs = [eng.submit(p, max_tokens=max_tokens,
+                           stream_cb=mkcb(spec)).future
+                for p in prompts]
+        t0 = time.perf_counter()
+        eng.run_until_idle()
+        wall = time.perf_counter() - t0
+        toks = sum(len(f.result(timeout=0).tokens) for f in futs)
+        return wall, toks, eng._dispatch_count - d0
+
+    # the baseline engine stays alive the whole matrix and every leg
+    # re-times it back-to-back with its spec rounds (best of 3 each):
+    # single-core wall noise drifts over the minutes this bench runs,
+    # and pairing the rounds in time cancels it in the RATIO — an
+    # up-front baseline against a late leg does not
+    base_eng = DecodeEngine(net, max_batch=B, block_size=8,
+                            num_blocks=256)
+    warm(base_eng, False)
+    out = {"spec_max_tokens": max_tokens, "spec_batch": B}
+    base_best = None
+    best = 0.0
+    for k in ks:
+        for name, dp in (("self", params), ("adv", neg)):
+            eng = DecodeEngine(net, max_batch=B, block_size=8,
+                               num_blocks=256, draft_params=dp,
+                               spec_k=k)
+            warm(eng, True)
+            wb = ws = None
+            for _ in range(3):
+                b = timed(base_eng, False)
+                s = timed(eng, True)
+                if wb is None or b[0] < wb[0]:
+                    wb = b
+                if ws is None or s[0] < ws[0]:
+                    ws = s
+            if base_best is None or wb[0] < base_best[0]:
+                base_best = wb
+            w, t, d = ws
+            sp = eng.stats()["spec"]
+            speedup = (t / w) / (wb[1] / wb[0])
+            # lane-normalized dispatches per committed token over the
+            # timed round (the delta of serving_spec_dispatches_total
+            # across it): all B lanes run the whole closed-loop round,
+            # so lanes = dispatches * B
+            dpt = d * B / t
+            key = f"spec_k{k}_{name}"
+            out[f"{key}_tokens_per_sec_per_request"] = round(
+                t / w / B, 1)
+            out[f"{key}_speedup"] = round(speedup, 2)
+            out[f"{key}_dispatches_per_token"] = round(dpt, 3)
+            out[f"{key}_accept_rate"] = round(sp["accept_rate"], 3)
+            if name == "self":
+                best = max(best, speedup)
+    out["spec_baseline_tokens_per_sec_per_request"] = round(
+        base_best[1] / base_best[0] / B, 1)
+    out["spec_baseline_dispatches_per_token"] = round(
+        base_best[2] * B / base_best[1], 3)
+    out["spec_best_self_speedup"] = round(best, 2)
+    _emit_result("spec", out)
+
+
 def bench_longcontext():
     """Long-context serving tier (ISSUE 14) — CPU by design like the
     serving bench.  Three sub-rounds:
@@ -2071,6 +2195,16 @@ def main():
                          else {"error": serr[-1000:]}), flush=True)
         return
 
+    # `python bench.py --spec`: the speculative-decoding matrix only
+    # (ISSUE 19; CPU host-loop proxy, cheap) — tok/s per request and
+    # dispatches/token vs the non-speculative engine across
+    # k x {self-draft, adversarial-draft}
+    if "--spec" in sys.argv:
+        spec, sperr = _run_child("spec", 420)
+        print(json.dumps(spec if spec is not None
+                         else {"error": sperr[-1000:]}), flush=True)
+        return
+
     # `python bench.py --longcontext`: the long-context serving tier
     # (ISSUE 14; CPU, self-contained) — a ~32k-token round through
     # chunked prefill + the fused paged-attention kernel (interpret),
@@ -2179,6 +2313,8 @@ def main():
         return bench_dp_compressed()
     if mode == "serving":
         return bench_serving()
+    if mode == "spec":
+        return bench_spec()
     if mode == "longcontext":
         return bench_longcontext()
     if mode == "disagg":
@@ -2293,6 +2429,18 @@ def main():
             out["serving_error"] = serr[-500:]
     elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
         out["serving_error"] = "skipped: out of budget"
+
+    # speculative decoding tier (CPU, self-contained): tok/s per
+    # request and dispatches/token vs the non-speculative engine for
+    # k x {self, adversarial} drafts record every round (ISSUE 19)
+    if remaining() > 120 and not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        sp, sperr = _run_child("spec", min(300, remaining()))
+        if sp is not None:
+            out.update(sp)
+        else:
+            out["spec_error"] = sperr[-500:]
+    elif not os.environ.get("GRAFT_BENCH_GPT_ONLY"):
+        out["spec_error"] = "skipped: out of budget"
 
     # long-context serving tier (CPU, self-contained): the 32k-round
     # memory story (kernel vs gather working set), prefix-cache hit

@@ -121,6 +121,9 @@ _k("PADDLE_TPU_PREFIX_CACHE", "off", "bool",
 _k("PADDLE_TPU_PAGED_ATTENTION", "auto", "str",
    "Decode-attention implementation: gather | pallas | auto (pallas "
    "on TPU backends, gather elsewhere).")
+_k("PADDLE_TPU_SPEC_K", "4", "int",
+   "Speculative decoding: draft tokens proposed per decode dispatch "
+   "(active only when the engine is given draft weights).")
 
 # -- hapi fit loop ----------------------------------------------------------
 _k("PADDLE_TPU_FIT_WATCHDOG", "on", "bool",

@@ -20,17 +20,18 @@ from .ragged_attention import (causal_prefill_attention,
                                paged_decode_attention,
                                ragged_decode_attention,
                                resolve_paged_attention_mode)
-from .sampling import sample_tokens
+from .sampling import sample_tokens, sample_tokens_grid
 from .prefix_cache import PrefixCache, PrefixEntry
 from .decode_model import (ServingModelConfig, chunk_prefill_forward,
                            decode_forward, extract_decode_params,
                            prefill_forward, prefill_group_forward,
-                           reference_decode)
+                           reference_decode, spec_score_forward)
 from .scheduler import QueueFull, Request, RequestStats, Scheduler
 from .migration import (MigrationError, PageMigration,
                         gather_request_pages, scatter_request_pages)
+from .spec_decode import SPEC_SENTINEL, spec_decode_step
 from .engine import DecodeEngine, ENGINE_ROLES, GenerationResult
-from .api import LLMServer
+from .api import LLMServer, filter_spec_stream
 from .router import Overloaded, ROUTER_PHASES, ServingRouter
 from .disagg import DisaggRouter
 
@@ -48,6 +49,8 @@ __all__ = [
     "QueueFull", "Request", "RequestStats", "Scheduler",
     "MigrationError", "PageMigration", "gather_request_pages",
     "scatter_request_pages",
+    "SPEC_SENTINEL", "spec_decode_step", "sample_tokens_grid",
+    "spec_score_forward", "filter_spec_stream",
     "DecodeEngine", "ENGINE_ROLES", "GenerationResult", "LLMServer",
     "Overloaded", "ROUTER_PHASES", "ServingRouter", "DisaggRouter",
 ]

@@ -15,6 +15,17 @@ blocks on device work.  Streaming callbacks receive ``LazyScalar``
 token views — reading/formatting one is the CONSUMER's device sync;
 an unread stream costs the server nothing (framework/lazy.py).
 
+Speculative multi-token stream-out (DESIGN-SERVING.md §Speculative
+tier): an engine built with a draft artifact pushes a fixed ``k+1``
+lazy views per decode dispatch — the host cannot know the accepted
+count without a sync, so rejected window positions materialize as the
+negative :data:`SPEC_SENTINEL` and up to ``k`` bonus tokens past
+``max_tokens`` may stream before the device-side stop is polled (the
+resolved ``GenerationResult`` is always sentinel-free and clipped).
+Consumers that want plain in-order tokens wrap their callback in
+:func:`filter_spec_stream`; consumers that already read lazily just
+skip negative values.
+
 Backpressure: the admission queue is bounded; ``submit`` raises
 :class:`~.scheduler.QueueFull` at capacity.  Stats: ``stats()``
 reports queue depth, batch occupancy, KV-pool fragmentation, compile
@@ -32,6 +43,32 @@ from typing import Dict, Optional, Sequence
 from ...framework import compile_cache
 from .engine import DecodeEngine
 from .scheduler import QueueFull  # noqa: F401  (re-export: caller API)
+from .spec_decode import SPEC_SENTINEL  # noqa: F401  (re-export)
+
+
+def filter_spec_stream(cb, max_tokens: Optional[int] = None):
+    """Adapt a plain ``cb(request_id, index, int_token)`` callback to
+    a speculative engine's stream: drops :data:`SPEC_SENTINEL`
+    placeholders, re-numbers the surviving tokens densely, and (when
+    ``max_tokens`` is given) suppresses the final window's overshoot
+    past the cap.  Reading the lazy view to decide IS a device sync —
+    the consumer's sanctioned one (an adapted callback is a consumer
+    that reads every token).  Callers who need the zero-sync stream
+    keep the raw callback and skip negatives at their own read point.
+    """
+    counts: Dict[object, int] = {}
+
+    def wrapped(request_id, index, lazy_tok):
+        tok = int(lazy_tok)
+        if tok == SPEC_SENTINEL:
+            return
+        n = counts.get(request_id, 0)
+        if max_tokens is not None and n >= max_tokens:
+            return
+        counts[request_id] = n + 1
+        cb(request_id, n, tok)
+
+    return wrapped
 
 
 class LLMServer:
@@ -203,6 +240,12 @@ class LLMServer:
                 req.prefix_entries = []
             eng._lengths[s] = 0
             eng._slots[s] = None
+            if eng.spec_k:
+                # speculative lengths live ON DEVICE: a stale positive
+                # value would run the dead lane as active on restart
+                eng._maxt[s] = 0
+                with eng._on_device():
+                    eng._spec_clear(s)
             if not req.future.done():
                 req.future.set_exception(exc)
         for req in eng.scheduler.drain_waiting():
@@ -256,14 +299,23 @@ class LLMServer:
         self._warmup_record = self.engine.warmup(prompt_lengths)
         return self._warmup_record
 
-    def refresh_weights(self, network):
+    def refresh_weights(self, network, draft=None):
         """Re-snapshot weights from a (re)trained network.  Pump must
-        be stopped (same exclusivity contract as warmup)."""
+        be stopped (same exclusivity contract as warmup).  A
+        speculative server passes the refreshed ``draft`` network too;
+        refreshing the target alone is allowed (the draft is an
+        approximation — a stale one only lowers the accept rate, never
+        correctness)."""
         if self.running:
             raise RuntimeError("stop the server before refreshing "
                                "weights")
         from .decode_model import extract_decode_params
         self.engine._params = extract_decode_params(network)
+        if draft is not None:
+            if not self.engine.spec_k:
+                raise ValueError("draft weights on a non-speculative "
+                                 "server — construct with draft= first")
+            self.engine._draft_params = extract_decode_params(draft)
 
     # -- observability -------------------------------------------------------
     def stats(self) -> Dict[str, object]:

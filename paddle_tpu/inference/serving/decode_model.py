@@ -292,6 +292,42 @@ def decode_forward(params, cfg: ServingModelConfig, pool, page_table,
     return pool, logits
 
 
+def spec_score_forward(params, cfg: ServingModelConfig, pool,
+                       page_table, lengths, tokens, write_ok,
+                       attention="gather"):
+    """Score all ``S = k+1`` positions of a speculative window in ONE
+    batched forward (DESIGN-SERVING.md §Speculative tier).
+
+    ``tokens`` ``[B, S]`` int32 — the incoming token plus the draft's
+    k proposals per request; ``lengths`` ``[B]`` — cache tokens before
+    the window.  The window is flattened into the batch axis and fed
+    through :func:`decode_forward` unchanged: window slot ``(b, i)``
+    becomes a row with the same page table and length ``n_b + i``.
+    Because ``decode_forward`` appends every row's K/V *before* the
+    layer's attention read, row ``(b, i)`` attends over positions
+    ``0..n_b+i`` — which includes the K/V rows ``(b, 0..i)`` just
+    wrote — so the semantics are exactly causal over the proposed
+    suffix, with no new attention math and the same grouped page-write
+    scatter committing the window.  Rows whose window position would
+    land past the page table's reach (look-ahead at the max-context
+    edge) are routed to the scratch block instead of clamp-colliding
+    with real cache.  Returns ``(pool, logits [B, S, V])``.
+    """
+    _, _, _, BS, _, _ = pool.shape
+    B, MAXNB = page_table.shape
+    S = tokens.shape[1]
+    offs = jnp.arange(S, dtype=jnp.int32)
+    flat_len = (lengths.astype(jnp.int32)[:, None]
+                + offs[None]).reshape(-1)              # [B*S]
+    flat_ok = (jnp.repeat(write_ok, S)
+               & (flat_len < MAXNB * BS))
+    pool, logits = decode_forward(
+        params, cfg, pool,
+        jnp.repeat(page_table, S, axis=0),
+        flat_len, tokens.reshape(-1), flat_ok, attention=attention)
+    return pool, logits.reshape(B, S, -1)
+
+
 # ---------------------------------------------------------------------------
 # sequential oracle (tests only)
 # ---------------------------------------------------------------------------

@@ -15,7 +15,11 @@ program is compiled ONCE for the engine's geometry —
 
 Requests joining and leaving the running batch mutate page-table
 *data* between dispatches, never a traced shape, so membership churn
-costs no recompiles (test-pinned).  The KV pool is donated and rides
+costs no recompiles (test-pinned).  With a draft artifact
+(``draft=``/``PADDLE_TPU_SPEC_K``) the ONE decode program becomes its
+speculative variant — the same contract, but each dispatch commits up
+to ``k+1`` tokens per slot and lengths/token-counts ride the loop
+device-resident (DESIGN-SERVING.md §Speculative tier).  The KV pool is donated and rides
 the dispatch chain; emitted tokens feed back as the next dispatch's
 input entirely on device; per-token streaming hands consumers
 ``LazyScalar`` views of a shared per-dispatch ``LazyStack`` — one D2H
@@ -72,6 +76,7 @@ from .prefix_cache import PrefixCache
 from .ragged_attention import resolve_paged_attention_mode
 from .sampling import sample_tokens
 from .scheduler import QueueFull, Request, Scheduler
+from .spec_decode import SPEC_SENTINEL, spec_decode_step
 
 #: phase roles an engine can run as (DESIGN-SERVING.md §Disaggregated
 #: tier): "both" is the classic single-engine pipeline; "prefill"
@@ -170,7 +175,9 @@ class DecodeEngine:
                  attention: Optional[str] = None,
                  role: str = "both",
                  prefix_reserve_discount: bool = False,
-                 device=None):
+                 device=None,
+                 draft=None, draft_params=None,
+                 spec_k: Optional[int] = None):
         if role not in ENGINE_ROLES:
             raise ValueError(
                 f"role {role!r} is not one of {ENGINE_ROLES}")
@@ -194,6 +201,68 @@ class DecodeEngine:
         self._params = (params if device is None
                         else jax.device_put(params, device))
         cfg = self._cfg
+        # -- speculative tier (DESIGN-SERVING.md §Speculative tier):
+        # a draft artifact turns the decode program into a k+1-token
+        # speculative window.  The draft is a second prepare_serving
+        # style artifact — a network to extract or an already-extracted
+        # params pytree — sharing the target's pool geometry (same
+        # L/H/Dh/vocab: its K/V land in the SAME pool and are
+        # overwritten by the verify pass).  Heterogeneous draft
+        # geometries are the multi-tenant weight-pool follow-up
+        # (ROADMAP).
+        if draft is not None and draft_params is None:
+            draft_params = extract_decode_params(draft)
+            dcfg = ServingModelConfig.from_gpt_config(draft.config)
+            if dcfg != cfg:
+                raise ValueError(
+                    f"draft model geometry {dcfg} != target {cfg}: "
+                    "speculative decoding shares the target's paged "
+                    "pool, so the draft must match its serving "
+                    "geometry (heterogeneous drafts need the "
+                    "multi-tenant weight pool — ROADMAP)")
+        if draft_params is not None:
+            if self.role == "prefill":
+                # a knob that cannot act must refuse: a prefill-role
+                # engine's decode program never runs
+                raise ValueError(
+                    "draft= on a prefill-role engine: its program "
+                    "never decodes, so speculation cannot act — "
+                    "attach the draft to the decode replica")
+            t_shapes = jax.tree_util.tree_map(lambda a: a.shape,
+                                              self._params)
+            d_shapes = jax.tree_util.tree_map(lambda a: a.shape,
+                                              draft_params)
+            if t_shapes != d_shapes:
+                raise ValueError(
+                    "draft_params shapes do not match the target's "
+                    "serving params: speculative decoding requires "
+                    "the same pool/model geometry")
+            if spec_k is None:
+                spec_k = env_knobs.get_int("PADDLE_TPU_SPEC_K", 4)
+            if int(spec_k) < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            self.spec_k = int(spec_k)
+            self._draft_params = (draft_params if device is None
+                                  else jax.device_put(draft_params,
+                                                      device))
+        else:
+            if spec_k is not None:
+                raise ValueError(
+                    "spec_k= without draft=/draft_params=: "
+                    "speculation needs a proposal model")
+            self.spec_k = 0
+            self._draft_params = None
+        self._spec_accept: Optional[float] = None
+        # active-lane dispatch count (host view): the tokens/dispatch
+        # and accept-rate aggregates normalize per LANE, not per batch
+        # dispatch, so a full batch and a lone request read the same.
+        # The accept GAUGE is cumulative (total committed over total
+        # lane-dispatches) — a per-window value whipsaws on the tiny
+        # drain windows where one lane survives; the HISTOGRAM keeps
+        # the per-window distribution
+        self._spec_lanes = 0
+        self._spec_last_poll_lanes = 0
+        self._spec_emitted = 0
         self.max_batch = int(max_batch)
         self.block_size = int(block_size)
         self.eos_id = eos_id
@@ -236,7 +305,8 @@ class DecodeEngine:
         self.scheduler = Scheduler(self._kv.allocator, block_size,
                                    max_queue=max_queue,
                                    max_context=self.max_context,
-                                   door_need_fn=door_need)
+                                   door_need_fn=door_need,
+                                   lookahead=self.spec_k)
         if prefill_buckets is None:
             prefill_buckets = _default_buckets(block_size,
                                                self.max_context)
@@ -290,6 +360,10 @@ class DecodeEngine:
         self._topks = np.zeros(self.max_batch, dtype=np.int32)
         self._topps = np.ones(self.max_batch, dtype=np.float32)
         self._seeds = np.zeros(self.max_batch, dtype=np.uint32)
+        # speculative mode stages max_tokens as a [B] data vector too:
+        # mid-window truncation is detected ON DEVICE (gen >= maxt),
+        # because the host cannot count committed tokens without a sync
+        self._maxt = np.zeros(self.max_batch, dtype=np.int32)
         self._samp_dev = None          # invalidated by _mark_sampling
         # reservation-discount knob (opt-in): admission reserves
         # worst-case MINUS live prefix-cache hits; the pinned-block
@@ -320,6 +394,24 @@ class DecodeEngine:
         with self._on_device():
             self._tokens = jnp.zeros(self.max_batch, dtype=jnp.int32)
             self._done = jnp.zeros(self.max_batch, dtype=bool)
+            if self.spec_k:
+                # speculative windows commit a data-dependent token
+                # count, so lengths and per-request generated counts
+                # ride the loop ON DEVICE; the host `_lengths` becomes
+                # an UPPER BOUND (for page growth) reconciled at the
+                # whitelisted poll
+                self._lengths_dev = jnp.zeros(self.max_batch,
+                                              dtype=jnp.int32)
+                self._gen = jnp.zeros(self.max_batch, dtype=jnp.int32)
+        # committed-token counts already credited to the spec metrics
+        # at the last poll (host mirror of `_gen`, poll-delayed)
+        self._gen_seen = np.zeros(self.max_batch, dtype=np.int64)
+        # committed-token UPPER bound per slot (a window commits at
+        # most k+1): while every active lane is provably below its
+        # max_tokens the timed poll is skipped outright — a poll is a
+        # pipeline-stalling sync, and with no eos id max_tokens is the
+        # only way a lane can finish (see step())
+        self._gen_ub = np.zeros(self.max_batch, dtype=np.int64)
         # compiled steps (ONE jit each; trace cache keyed by shape —
         # decode must stay at exactly one trace, tests pin it)
         self._decode = self._build_decode_step()
@@ -334,6 +426,14 @@ class DecodeEngine:
         self._join = jax.jit(
             lambda tok, done, i, v: (tok.at[i].set(v),
                                      done.at[i].set(False)))
+        # speculative join/clear: one op sets every device-resident
+        # per-slot scalar (token, done, length, generated count) so a
+        # seat or finalize updates the loop state in ONE dispatch.
+        # Same non-donation rationale as _join.
+        self._spec_join = jax.jit(
+            lambda tok, done, lens, gen, i, v, L, g, d: (
+                tok.at[i].set(v), done.at[i].set(d),
+                lens.at[i].set(L), gen.at[i].set(g)))
         # page-migration D2D copy ops (DESIGN-SERVING.md
         # §Disaggregated tier): the exporter's pool is NOT donated
         # (other slots still live in it); the importer's is — the
@@ -438,6 +538,21 @@ class DecodeEngine:
             "serving_intertoken_s",
             "gap between consecutive decode dispatches of a non-empty "
             "batch", labels=labels)
+        # speculative tier (DESIGN-SERVING.md §Speculative tier): the
+        # dispatch counter ticks on the hot path; tokens/dispatch and
+        # the implied acceptance rate are poll-window aggregates
+        # computed at the one sanctioned sync (_reconcile_spec) — a
+        # per-dispatch accept readout would itself be a sync
+        self._c_spec_dispatches = reg.counter(
+            "serving_spec_dispatches_total",
+            "speculative decode dispatches (k+1-token windows)",
+            labels=labels)
+        self._h_spec_tpd = reg.histogram(
+            "serving_spec_tokens_per_dispatch",
+            "committed tokens per active lane per speculative "
+            "dispatch (poll-window mean)", labels=labels,
+            edges=(0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0,
+                   16.0))
         wr = weakref.ref(self)
 
         def _gauge_fn(getter):
@@ -473,6 +588,13 @@ class DecodeEngine:
                   labels=labels).set_function(
             _gauge_fn(lambda e: None if e._prefix is None
                       else e._prefix.live_refs))
+        # absent (None) while speculation is off or unmeasured — a
+        # dead series would read as "speculating, rejecting all"
+        reg.gauge("serving_spec_accept_rate",
+                  "draft-token acceptance rate [0,1] implied by the "
+                  "cumulative committed tokens per lane-dispatch",
+                  labels=labels).set_function(
+            _gauge_fn(lambda e: e._spec_accept if e.spec_k else None))
         self._obs_metric_names = (
             "serving_dispatches_total", "serving_tokens_total",
             "serving_requests_completed_total", "serving_latency_s",
@@ -484,9 +606,12 @@ class DecodeEngine:
             "serving_page_migrations_total",
             "serving_migrated_blocks_total",
             "serving_migration_s", "serving_intertoken_s",
+            "serving_spec_dispatches_total",
+            "serving_spec_tokens_per_dispatch",
             "serving_queue_depth", "serving_active",
             "serving_kv_fragmentation", "serving_done_poll_interval",
-            "serving_prefix_blocks", "serving_prefix_refs")
+            "serving_prefix_blocks", "serving_prefix_refs",
+            "serving_spec_accept_rate")
 
     def unregister_metrics(self):
         """Reclaim this engine's labeled children from the process-wide
@@ -523,6 +648,8 @@ class DecodeEngine:
     def _build_decode_step(self):
         cfg, eos, pad = self._cfg, self.eos_id, self.pad_id
         attn_mode = self.attention_mode
+        if self.spec_k:
+            return self._build_spec_step(cfg, eos, attn_mode)
 
         def step(params, pool, table, lengths, tokens, done, temps,
                  topks, topps, seeds):
@@ -547,6 +674,38 @@ class DecodeEngine:
         from ...framework.dispatch import guarded_jit
         return guarded_jit(step, label="serving.decode",
                            single_trace=True, donate_argnums=(1,))
+
+    def _build_spec_step(self, cfg, eos, attn_mode):
+        """THE decode program, speculative variant: one compiled
+        dispatch proposes, verifies, and commits up to ``k+1`` tokens
+        per slot (``spec_decode.py``).  Same single-trace contract and
+        label as the plain step — speculation changes what one
+        dispatch emits, not how many programs exist.  Completion is
+        fully device-detected here (EOS *and* ``gen >= maxt``): the
+        host cannot know how many tokens committed without a sync, so
+        both ride the ``done`` mask to the poll."""
+        k = self.spec_k
+
+        def step(params, dparams, pool, table, lengths, tokens, done,
+                 gen, maxt, temps, topks, topps, seeds):
+            active = (lengths > 0) & jnp.logical_not(done)
+            pool, emit, last, n_emit = spec_decode_step(
+                params, dparams, cfg, k, pool, table, lengths,
+                tokens, active, temps, topks, topps, seeds,
+                attention=attn_mode)
+            lengths = jnp.where(active, lengths + n_emit, lengths)
+            gen = gen + n_emit
+            if eos is not None:
+                offs = jnp.arange(k + 1, dtype=jnp.int32)
+                valid = offs[None] < n_emit[:, None]
+                done = done | (active & jnp.any(
+                    valid & (emit == jnp.int32(eos)), axis=1))
+            done = done | (active & (gen >= maxt))
+            return pool, emit, last, lengths, done, gen
+
+        from ...framework.dispatch import guarded_jit
+        return guarded_jit(step, label="serving.decode",
+                           single_trace=True, donate_argnums=(2,))
 
     # -- front door ----------------------------------------------------------
     def submit(self, prompt_ids, max_tokens: int, stream_cb=None,
@@ -599,7 +758,7 @@ class DecodeEngine:
                 "imported — tickets are single-use")
         mig.check_geometry(self)
         req = mig.request
-        need = req.worst_case_blocks(self.block_size)
+        need = req.worst_case_blocks(self.block_size, self.spec_k)
         if need > self._kv.allocator.capacity:
             raise ValueError(
                 f"migrated request needs {need} blocks worst-case but "
@@ -690,13 +849,21 @@ class DecodeEngine:
             # async H2D staging of the (tiny) host-authoritative batch
             # layout; the decode dispatch itself never syncs
             table = jax.device_put(self._tables)
-            lengths = jax.device_put(self._lengths)
-            temps, topks, topps, seeds = self._staged_sampling()
-            pool, emit, done = self._decode(
-                self._params, self._kv.pool, table, lengths,
-                self._tokens, self._done, temps, topks, topps, seeds)
+            if self.spec_k:
+                staged = self._staged_sampling()
+                pool, emit, last, lens, done, gen = self._decode(
+                    self._params, self._draft_params, self._kv.pool,
+                    table, self._lengths_dev, self._tokens,
+                    self._done, self._gen, staged[4], staged[0],
+                    staged[1], staged[2], staged[3])
+            else:
+                lengths = jax.device_put(self._lengths)
+                temps, topks, topps, seeds = self._staged_sampling()
+                pool, emit, done = self._decode(
+                    self._params, self._kv.pool, table, lengths,
+                    self._tokens, self._done, temps, topks, topps,
+                    seeds)
         self._kv.swap_pool(pool)
-        self._tokens = emit            # feeds back next dispatch (D2D)
         self._done = done
         self._c_dispatches.inc()
         stack = LazyStack(emit)        # ONE shared fetch, if read
@@ -704,18 +871,58 @@ class DecodeEngine:
         if self._last_dispatch_t is not None:
             self._h_intertoken.observe(now - self._last_dispatch_t)
         self._last_dispatch_t = now
-        to_finish = []
-        for s in active:
-            req = self._slots[s]
-            req.push_token(
-                LazyScalar(stack, post=(lambda a, i=s: a[i])), now)
-            if not req.capped:
-                self._lengths[s] += 1
-            if len(req.lazy_tokens) >= req.max_tokens:
-                to_finish.append(s)
-        for s in to_finish:
-            self._finalize(s)
-        if self.eos_id is not None and \
+        if self.spec_k:
+            # the window's LAST emitted token feeds back (D2D); the
+            # accepted count stays on device — the host pushes a fixed
+            # k+1 lazy views per slot (SPEC_SENTINEL beyond the
+            # accepted prefix, stripped at finalize/stream read) and
+            # advances its page-growth length by the window UPPER
+            # BOUND, reconciled to truth at the next poll.  max_tokens
+            # completion is device-detected (gen >= maxt), so no host
+            # count check here.
+            self._tokens = last
+            self._lengths_dev, self._gen = lens, gen
+            self._c_spec_dispatches.inc()
+            self._spec_lanes += len(active)
+            for s in active:
+                req = self._slots[s]
+                for j in range(self.spec_k + 1):
+                    req.push_token(
+                        LazyScalar(stack,
+                                   post=(lambda a, i=s, jj=j:
+                                         a[i, jj])), now)
+                self._gen_ub[s] += self.spec_k + 1
+                if not req.capped:
+                    self._lengths[s] += self.spec_k + 1
+        else:
+            self._tokens = emit        # feeds back next dispatch (D2D)
+            to_finish = []
+            for s in active:
+                req = self._slots[s]
+                req.push_token(
+                    LazyScalar(stack, post=(lambda a, i=s: a[i])), now)
+                if not req.capped:
+                    self._lengths[s] += 1
+                if len(req.lazy_tokens) >= req.max_tokens:
+                    to_finish.append(s)
+            for s in to_finish:
+                self._finalize(s)
+        # speculative mode polls even without an eos id: max_tokens
+        # completion only exists on device there.  But with NO eos id,
+        # max_tokens is also the ONLY way a lane finishes — and the
+        # host holds a committed-count upper bound (`_gen_ub`, +k+1
+        # per window, reconciled to truth at each poll), so the
+        # reachability gate IS the poll cadence: the sync — a
+        # pipeline-stalling host round-trip — fires exactly when some
+        # lane may have crossed its cap and is provably a no-op any
+        # earlier.  The bound grows every dispatch, so the gate always
+        # opens eventually.  With an eos id EOS can end any lane on
+        # any dispatch, and the interval cadence stays in charge.
+        if self.spec_k and self.eos_id is None:
+            if any(self._gen_ub[s] >= self._slots[s].max_tokens
+                   for s in active):
+                self._timed_poll()
+        elif (self.eos_id is not None or self.spec_k) and \
                 self._dispatch_count % self.done_poll_interval == 0:
             self._timed_poll()
         return True
@@ -749,16 +956,18 @@ class DecodeEngine:
         self._topks[slot] = req.top_k
         self._topps[slot] = req.top_p
         self._seeds[slot] = np.uint32(req.seed & 0xFFFFFFFF)
+        self._maxt[slot] = req.max_tokens
         self._samp_dev = None
 
     def _staged_sampling(self):
-        """Device copies of the per-slot sampling vectors, re-staged
-        only when a seat/finalize mutated them."""
+        """Device copies of the per-slot sampling vectors (plus the
+        max_tokens vector in speculative mode), re-staged only when a
+        seat/finalize mutated them."""
         if self._samp_dev is None:
-            self._samp_dev = (jax.device_put(self._temps),
-                              jax.device_put(self._topks),
-                              jax.device_put(self._topps),
-                              jax.device_put(self._seeds))
+            vecs = [self._temps, self._topks, self._topps, self._seeds]
+            if self.spec_k:
+                vecs.append(self._maxt)
+            self._samp_dev = tuple(jax.device_put(v) for v in vecs)
         return self._samp_dev
 
     def _cache_insert(self, req: Request, start: int, chain: bytes,
@@ -792,7 +1001,8 @@ class DecodeEngine:
         that can no longer be evicted, never a stale peek."""
         base = (-(-len(req.prompt) // self.block_size)
                 if self.role == "prefill"
-                else req.worst_case_blocks(self.block_size))
+                else req.worst_case_blocks(self.block_size,
+                                           self.spec_k))
         if not self._reserve_discount or self._prefix is None:
             return base
         entries, chain = self._prefix.match(req.prompt, count=False)
@@ -904,6 +1114,37 @@ class DecodeEngine:
                            LazyScalar(stack, post=(lambda a, i=g: a[i])),
                            now)
 
+    def _join_loop(self, slot: int, tok_dev, length: int, gen: int):
+        """Join a seated request into the device loop state: token and
+        done flag in classic mode, plus device length and generated
+        count in speculative mode (where both ride the loop)."""
+        if self.spec_k:
+            (self._tokens, self._done, self._lengths_dev,
+             self._gen) = self._spec_join(
+                self._tokens, self._done, self._lengths_dev,
+                self._gen, np.int32(slot), tok_dev, np.int32(length),
+                np.int32(gen), np.bool_(False))
+            self._gen_seen[slot] = gen
+            self._gen_ub[slot] = gen
+        else:
+            self._tokens, self._done = self._join(
+                self._tokens, self._done, np.int32(slot), tok_dev)
+
+    def _spec_clear(self, slot: int):
+        """Kill a slot in the speculative device loop (finalize and
+        re-enter-prefill paths): done=True, length 0.  Classic mode
+        needs no analogue — it stages host lengths every dispatch, so
+        zeroing ``_lengths[slot]`` deactivates the lane; speculative
+        lengths live on device and a stale positive value would run
+        the dead lane as active."""
+        (self._tokens, self._done, self._lengths_dev,
+         self._gen) = self._spec_join(
+            self._tokens, self._done, self._lengths_dev, self._gen,
+            np.int32(slot), jnp.int32(0), np.int32(0), np.int32(0),
+            np.bool_(True))
+        self._gen_seen[slot] = 0
+        self._gen_ub[slot] = 0
+
     def _seat(self, slot: int, req: Request, blocks: List[int],
               tok_dev, first_tok, now: float):
         """Seat a fully prefilled request in the decode batch: page
@@ -932,8 +1173,7 @@ class DecodeEngine:
                 self._stage_handoff(slot, req, tok_dev, now)
             return
         self._set_sampling(slot, req)
-        self._tokens, self._done = self._join(self._tokens, self._done,
-                                              np.int32(slot), tok_dev)
+        self._join_loop(slot, tok_dev, Lp, 1)
         req.push_token(first_tok, now)
         if req.max_tokens == 1:
             self._finalize(slot)
@@ -950,6 +1190,8 @@ class DecodeEngine:
         self._tables[slot, :] = SCRATCH_BLOCK
         self._tables[slot, :len(entries)] = [e.block for e in entries]
         self._lengths[slot] = 0            # joins decode at completion
+        if self.spec_k:
+            self._spec_clear(slot)         # predecessor's device state
         self._prefill_jobs.append(
             _PrefillJob(req, slot, chain, ctx_len, len(entries)))
 
@@ -1018,33 +1260,44 @@ class DecodeEngine:
                 self._stage_handoff(slot, req, tok, now)
             return
         self._set_sampling(slot, req)
-        self._tokens, self._done = self._join(self._tokens, self._done,
-                                              np.int32(slot), tok)
+        self._join_loop(slot, tok, Lp, 1)
         req.push_token(LazyScalar(tok), now)
         if req.max_tokens == 1:
             self._finalize(slot)
 
     def _grow_pages(self, active: List[int]):
-        """Append-allocate the next block for requests whose upcoming
-        write crosses a block boundary.  Reservation-gated admission
+        """Append-allocate blocks for requests whose upcoming writes
+        cross a block boundary.  Reservation-gated admission
         guarantees success within ``req.reserved_blocks``; a slot at
         its budget is a device-done request the host has not polled
         yet — growth (and length advance) stop, its masked writes land
-        in scratch."""
+        in scratch.
+
+        Speculative mode covers the whole look-ahead window: the next
+        dispatch writes positions up to ``length + k`` where the host
+        length is an UPPER BOUND on the device truth, so coverage of
+        the bound covers every real write; the window's uncommitted
+        tail is inside the ``lookahead``-widened budget the scheduler
+        reserved, so rejection churn can never OOM the pool.  May
+        allocate several blocks per dispatch (the window can cross
+        more than one boundary)."""
+        look = self.spec_k
         for s in active:
             req = self._slots[s]
             if req.capped:
                 continue
             have = req.n_prefix_blocks + len(req.blocks)
-            if int(self._lengths[s]) < have * self.block_size:
-                continue
-            if have >= req.block_budget or \
-                    have >= self.max_blocks_per_seq:
-                req.capped = True
-                continue
-            blk = self._alloc_blocks(1)[0]
-            req.blocks.append(blk)
-            self._tables[s, have] = blk
+            need = self._kv.blocks_for_tokens(
+                int(self._lengths[s]) + 1, lookahead=look)
+            while have < need:
+                if have >= req.block_budget or \
+                        have >= self.max_blocks_per_seq:
+                    req.capped = True
+                    break
+                blk = self._alloc_blocks(1)[0]
+                req.blocks.append(blk)
+                self._tables[s, have] = blk
+                have += 1
 
     # -- page migration (disaggregated tier) ---------------------------------
     def _stage_handoff(self, slot: int, req: Request, tok_dev,
@@ -1105,7 +1358,8 @@ class DecodeEngine:
                          if r is None), None)
             if slot is None:
                 return
-            need = mig.request.worst_case_blocks(self.block_size)
+            need = mig.request.worst_case_blocks(self.block_size,
+                                                 self.spec_k)
             if not self._kv.allocator.reserve(need):
                 return
             with self._mig_lock:
@@ -1160,7 +1414,8 @@ class DecodeEngine:
         req.blocks = list(blocks)
         req.prefix_entries = []
         req.reserved_blocks = need
-        req.block_budget = req.worst_case_blocks(self.block_size)
+        req.block_budget = req.worst_case_blocks(self.block_size,
+                                                 self.spec_k)
         Lp = len(req.prompt)
         self._tables[slot, :] = SCRATCH_BLOCK
         self._tables[slot, :nb] = blocks
@@ -1172,8 +1427,10 @@ class DecodeEngine:
             # prompts (and the discount envelope) can share them
             self._cache_insert(req, 0, b"", list(req.blocks))
         req.prefilling = False
-        self._tokens, self._done = self._join(self._tokens, self._done,
-                                              np.int32(slot), mig_tok)
+        # gen carries the tokens already streamed on the prefill side
+        # (token 0), so max_tokens truncation stays exact across the
+        # phase boundary
+        self._join_loop(slot, mig_tok, Lp, len(req.lazy_tokens))
         self._c_migrations.inc()
         self._c_migrated_blocks.inc(nb)
         self._h_migration.observe(time.monotonic() - mig.t_start)
@@ -1196,6 +1453,13 @@ class DecodeEngine:
         cadence instead of saturating at the bound, keeping the
         EOS→reclaim occupancy loss small (DESIGN-SERVING.md §EOS)."""
         tuner = self._poll_tuner
+        if self.spec_k and self.eos_id is None:
+            # gated mode (see step()): the reachability gate is the
+            # cadence and the tuned interval is never consulted — the
+            # calibration's second, empty-chain poll would be a pure
+            # wasted sync
+            self._poll_done()
+            return
         if tuner is None or tuner.decided:
             self._poll_done()
             return
@@ -1225,10 +1489,23 @@ class DecodeEngine:
 
     def _poll_done(self):
         """THE group-boundary sync: fetch the [B] device done-mask so
-        EOS'd requests free their slot/pages.  Runs every
-        ``done_poll_interval`` dispatches, never inside one."""
+        EOS'd (and, speculatively, max_tokens'd) requests free their
+        slot/pages.  Speculative mode widens the SAME fetch to one
+        ``device_get`` of (done, lengths, gen) — still one sync at the
+        same cadence — because committed lengths and token counts only
+        exist on device there: the host reconciles its upper-bound
+        lengths to truth and credits the spec metrics from the gen
+        deltas.  Runs every ``done_poll_interval`` dispatches, never
+        inside one."""
         with _obs_trace.span("serving.poll"):
-            done = np.asarray(jax.device_get(self._done))
+            if self.spec_k:
+                done, lens, gen = jax.device_get(
+                    (self._done, self._lengths_dev, self._gen))
+                done = np.asarray(done)
+                self._reconcile_spec(np.asarray(lens),
+                                     np.asarray(gen))
+            else:
+                done = np.asarray(jax.device_get(self._done))
         for s, req in enumerate(self._slots):
             # a chunk-prefilling slot has not joined the device loop
             # yet: its done flag is its dead predecessor's leftover
@@ -1237,12 +1514,54 @@ class DecodeEngine:
                     not getattr(req, "prefilling", False):
                 self._finalize(s)
 
+    def _reconcile_spec(self, lens: np.ndarray, gen: np.ndarray):
+        """Fold one poll's device truth back into host bookkeeping:
+        page-growth lengths drop from upper bound to actual (freeing
+        over-advance before it costs an unneeded block), and the spec
+        instruments observe the poll window — committed tokens per
+        active lane per dispatch (histogram, per window) and the
+        implied draft acceptance rate (gauge, CUMULATIVE over the
+        engine's life): a live lane commits ``1 + accept*k`` tokens
+        per window, so the rate is ``(tokens/lane-dispatch - 1) / k``.
+        Lanes the device finished mid-window commit fewer — the
+        done-lag drag every occupancy number in this engine shares."""
+        emitted = 0
+        for s, req in enumerate(self._slots):
+            if req is None or getattr(req, "prefilling", False):
+                continue
+            self._lengths[s] = lens[s]
+            # the poll sits at a dispatch boundary, so the fetched gen
+            # IS the current truth: the upper bound snaps down to it
+            # and the poll gate in step() re-arms
+            self._gen_ub[s] = int(gen[s])
+            d = int(gen[s]) - int(self._gen_seen[s])
+            if d > 0:
+                emitted += d
+                self._gen_seen[s] = int(gen[s])
+        nd = self._spec_lanes - self._spec_last_poll_lanes
+        self._spec_emitted += emitted
+        if nd > 0:
+            self._h_spec_tpd.observe(emitted / nd)
+        if self._spec_lanes > 0:
+            tpd_cum = self._spec_emitted / self._spec_lanes
+            self._spec_accept = max(
+                0.0, min(1.0, (tpd_cum - 1.0) / self.spec_k))
+        self._spec_last_poll_lanes = self._spec_lanes
+
     def _finalize(self, slot: int):
         """Consumer-boundary materialization: the request is leaving —
         resolving its future IS the read, so the (single, shared per
         dispatch-stack) D2H transfers are sanctioned here."""
         req = self._slots[slot]
         toks = [int(t) for t in req.lazy_tokens]
+        if self.spec_k:
+            # strip rejected-position sentinels, then clip the final
+            # window's overshoot: the device stops AFTER the window
+            # that crosses max_tokens, so up to k bonus tokens beyond
+            # the cap were committed (and streamed — api.py documents
+            # the stream-side contract) and drop here
+            toks = [t for t in toks if t != SPEC_SENTINEL]
+            toks = toks[:req.max_tokens]
         if self.eos_id is not None and self.eos_id in toks:
             toks = toks[:toks.index(self.eos_id) + 1]
         req.stats.finished = time.monotonic()
@@ -1263,7 +1582,10 @@ class DecodeEngine:
         self._topks[slot] = 0
         self._topps[slot] = 1.0
         self._seeds[slot] = 0
+        self._maxt[slot] = 0
         self._samp_dev = None
+        if self.spec_k:
+            self._spec_clear(slot)
         self._observe_finalize(slot, req, len(toks))
         req.future.set_result(
             GenerationResult(req.id, toks, req.stats))
@@ -1339,17 +1661,34 @@ class DecodeEngine:
                                            jax.device_put(blocks_arr)))
             jax.block_until_ready(tok)
             per_bucket[b] = round(time.monotonic() - tb, 4)
-        self._tokens, self._done = self._join(
-            self._tokens, self._done, np.int32(0), jnp.int32(0))
         td = time.monotonic()
-        w_temps, w_topks, w_topps, w_seeds = self._staged_sampling()
-        pool, emit, done = self._decode(
-            self._params, self._kv.pool, jax.device_put(self._tables),
-            jax.device_put(self._lengths), self._tokens, self._done,
-            w_temps, w_topks, w_topps, w_seeds)
-        self._kv.swap_pool(pool)
-        self._tokens, self._done = emit, done
-        jax.block_until_ready(emit)
+        if self.spec_k:
+            # all-inactive warm dispatch (device lengths are zero):
+            # compiles the full draft+verify window without touching
+            # loop semantics; warms the spec join op too
+            self._join_loop(0, jnp.int32(0), 0, 0)
+            staged = self._staged_sampling()
+            pool, emit, last, lens, done, gen = self._decode(
+                self._params, self._draft_params, self._kv.pool,
+                jax.device_put(self._tables), self._lengths_dev,
+                self._tokens, self._done, self._gen, staged[4],
+                staged[0], staged[1], staged[2], staged[3])
+            self._kv.swap_pool(pool)
+            self._tokens, self._done = last, done
+            self._lengths_dev, self._gen = lens, gen
+            jax.block_until_ready(last)
+        else:
+            self._tokens, self._done = self._join(
+                self._tokens, self._done, np.int32(0), jnp.int32(0))
+            w_temps, w_topks, w_topps, w_seeds = self._staged_sampling()
+            pool, emit, done = self._decode(
+                self._params, self._kv.pool,
+                jax.device_put(self._tables),
+                jax.device_put(self._lengths), self._tokens,
+                self._done, w_temps, w_topks, w_topps, w_seeds)
+            self._kv.swap_pool(pool)
+            self._tokens, self._done = emit, done
+            jax.block_until_ready(emit)
         decode_s = time.monotonic() - td
         return {"warmup_s": round(time.monotonic() - t0, 4),
                 "decode_compile_s": round(decode_s, 4),
@@ -1396,6 +1735,13 @@ class DecodeEngine:
               "attention": self.attention_mode,
               "prefill_chunk": self.prefill_chunk,
               "kv": self._kv.allocator.stats()}
+        if self.spec_k:
+            st["spec"] = {
+                "k": self.spec_k,
+                "dispatches": int(self._c_spec_dispatches.collect(
+                    materialize=False)),
+                "accept_rate": self._spec_accept,
+            }
         if self._prefix is not None:
             st["prefix_cache"] = self._prefix.stats()
         if self._poll_decision is not None:

@@ -255,8 +255,12 @@ class PagedKVCache:
     def swap_pool(self, new_pool):
         self.pool = new_pool
 
-    def blocks_for_tokens(self, n_tokens: int) -> int:
-        return -(-int(n_tokens) // self.block_size)
+    def blocks_for_tokens(self, n_tokens: int,
+                          lookahead: int = 0) -> int:
+        """Pages covering ``n_tokens`` committed positions plus
+        ``lookahead`` uncommitted write positions past them (the
+        speculative window's in-flight draft/verify appends)."""
+        return -(-(int(n_tokens) + int(lookahead)) // self.block_size)
 
 
 # ---------------------------------------------------------------------------

@@ -182,7 +182,8 @@ class ServingRouter:
                     "slo_p99_s", "scale_up_queue_depth",
                     "scale_down_queue_depth", "windows_up",
                     "windows_down", "cooldown_s",
-                    "decision_interval_s", "drain_relief_rate")
+                    "decision_interval_s", "drain_relief_rate",
+                    "predictive_scale_rate")
 
     def __init__(self, replica_factory: Callable[[], Any], *,
                  phase: Optional[str] = None,
@@ -194,6 +195,7 @@ class ServingRouter:
                  cooldown_s: float = 5.0,
                  decision_interval_s: float = 0.25,
                  drain_relief_rate: float = 0.0,
+                 predictive_scale_rate: float = 0.0,
                  metrics_port: Optional[int] = None):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
@@ -225,6 +227,14 @@ class ServingRouter:
         # should not latch shed state.  0 = off (level-only policy,
         # bit-identical to before); SLO violation always counts.
         self.drain_relief_rate = float(drain_relief_rate)
+        # predictive scale-UP: the same queue-depth derivative read
+        # the other way — a queue RISING at >= this rate (requests per
+        # replica per round) is overload evidence before the level
+        # crosses scale_up_queue_depth, so capacity starts spinning up
+        # while the ramp is still shallow.  0 = off (level-only
+        # policy, bit-identical to before); windows_up/cooldown still
+        # gate the actual spawn, so one noisy sample never scales.
+        self.predictive_scale_rate = float(predictive_scale_rate)
         self._prev_queue: Optional[int] = None
         self._lock = threading.Lock()
         self._replicas: List[_Replica] = []
@@ -495,11 +505,21 @@ class ServingRouter:
                     and sig["queue_delta"] < 0
                     and (-sig["queue_delta"]) / max(n, 1)
                     >= self.drain_relief_rate)
+        # predictive scale-up: the same derivative read the other way
+        # — a steep enough RISE is overload evidence before the level
+        # is (rising and draining are mutually exclusive by sign, so
+        # the relief conjunct below never cancels it)
+        rising = (self.predictive_scale_rate > 0
+                  and sig["queue_delta"] > 0
+                  and sig["queue_delta"] / max(n, 1)
+                  >= self.predictive_scale_rate)
         overloaded = (((per_rep > self.scale_up_queue_depth
-                        or sig["shed_delta"] > 0) and not draining)
+                        or sig["shed_delta"] > 0 or rising)
+                       and not draining)
                       or slo_violated)
         idle = (per_rep <= self.scale_down_queue_depth
-                and not slo_violated and sig["shed_delta"] == 0)
+                and not slo_violated and sig["shed_delta"] == 0
+                and not rising)
         self._up_streak = self._up_streak + 1 if overloaded else 0
         self._down_streak = self._down_streak + 1 if idle else 0
         now = time.monotonic()

@@ -96,3 +96,27 @@ def sample_tokens(logits, temperature, top_k, top_p, seed, position):
     # so the one compiled program still serves any greedy/sampled mix
     return jax.lax.cond(jnp.any(temperature > 0.0), _sampled,
                         lambda _: greedy, None)
+
+
+def sample_tokens_grid(logits, temperature, top_k, top_p, seed,
+                       positions):
+    """``[B, S, V]`` logits → ``[B, S]`` int32 tokens: the window
+    variant for speculative verify (DESIGN-SERVING.md §Speculative
+    tier).
+
+    Per-request sampling vectors stay ``[B]``; ``positions`` is
+    ``[B, S]`` — each window slot's sequence index.  Flattens the
+    window into the batch axis and reuses :func:`sample_tokens`
+    verbatim, so slot ``(b, i)`` draws with the exact key
+    ``fold_in(PRNGKey(seed_b), positions_{b,i})`` the sequential
+    single-token path would use at that index — the property the
+    speculative accept rule's exactness rests on.
+    """
+    B, S, V = logits.shape
+    flat = sample_tokens(logits.reshape(B * S, V),
+                         jnp.repeat(temperature, S),
+                         jnp.repeat(top_k, S),
+                         jnp.repeat(top_p, S),
+                         jnp.repeat(seed, S),
+                         positions.reshape(B * S))
+    return flat.reshape(B, S)

@@ -135,10 +135,15 @@ class Request:
         cache-owned; the request holds one reference each)."""
         return len(self.prefix_entries)
 
-    def worst_case_blocks(self, block_size: int) -> int:
+    def worst_case_blocks(self, block_size: int,
+                          lookahead: int = 0) -> int:
         # prompt positions + one cache write per decode dispatch
-        # (the last generated token is emitted, never written)
-        need = len(self.prompt) + self.max_tokens - 1
+        # (the last generated token is emitted, never written);
+        # `lookahead` extends the envelope for engines that write past
+        # the committed length each dispatch (speculative decoding
+        # writes up to k look-ahead positions before knowing how many
+        # commit — DESIGN-SERVING.md §Speculative tier)
+        need = len(self.prompt) + self.max_tokens - 1 + int(lookahead)
         return -(-need // block_size)
 
     def push_token(self, lazy_tok, t_now: float):
@@ -157,11 +162,17 @@ class Scheduler:
 
     def __init__(self, allocator, block_size: int, max_queue: int = 64,
                  max_context: Optional[int] = None,
-                 door_need_fn: Optional[Callable] = None):
+                 door_need_fn: Optional[Callable] = None,
+                 lookahead: int = 0):
         self.allocator = allocator
         self.block_size = int(block_size)
         self.max_queue = int(max_queue)
         self.max_context = max_context
+        # per-dispatch write look-ahead folded into every worst-case
+        # envelope (admission reservation AND growth budget) so a
+        # speculative engine's k uncommitted writes can never outrun a
+        # request's allocation, whatever the rejection churn
+        self.lookahead = int(lookahead)
         # the submit-door capacity sanity check: how many blocks this
         # ENGINE will ever hold for the request.  Default worst case;
         # a prefill-role engine overrides with prompt-blocks-only —
@@ -176,7 +187,8 @@ class Scheduler:
     def submit(self, req: Request) -> Request:
         need = (self._door_need_fn(req)
                 if self._door_need_fn is not None
-                else req.worst_case_blocks(self.block_size))
+                else req.worst_case_blocks(self.block_size,
+                                           self.lookahead))
         if need > self.allocator.capacity:
             raise ValueError(
                 f"request needs {need} blocks worst-case but the pool "
@@ -224,14 +236,16 @@ class Scheduler:
             while free_slots > 0 and self._waiting:
                 req = self._waiting[0]
                 need = (need_fn(req) if need_fn is not None
-                        else req.worst_case_blocks(self.block_size))
+                        else req.worst_case_blocks(self.block_size,
+                                                   self.lookahead))
                 if not self.allocator.reserve(need):
                     if cancel_fn is not None:
                         cancel_fn(req)
                     break           # strict FCFS: no head-of-line skip
                 self._waiting.popleft()
                 req.reserved_blocks = need
-                req.block_budget = req.worst_case_blocks(self.block_size)
+                req.block_budget = req.worst_case_blocks(
+                    self.block_size, self.lookahead)
                 req.stats.admitted = now
                 admitted.append(req)
                 free_slots -= 1

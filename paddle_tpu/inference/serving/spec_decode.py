@@ -1,0 +1,113 @@
+"""Speculative multi-token decoding INSIDE the one decode program
+(DESIGN-SERVING.md §Speculative tier).
+
+The decode loop's biggest remaining cost on this repo's CPU host-loop
+proxy is the same one fold-K attacked in training (DESIGN-PERF.md):
+one host dispatch per emitted token.  This module folds up to ``k+1``
+token emissions into ONE compiled dispatch while preserving the
+serving stack's exactness contract bit for bit:
+
+1. **Draft.**  A small draft model (same pool geometry as the target;
+   self-draft = the target's own weights) proposes ``k`` tokens by
+   running ``k`` sequential single-token decode forwards against the
+   SHARED paged pool.  Its interim K/V writes land in the look-ahead
+   positions and are overwritten by the verify pass below — the draft
+   never owns cache state.
+2. **Verify.**  The target model scores all ``k+1`` positions (the
+   incoming token plus the k proposals) in ONE batched forward:
+   :func:`~.decode_model.spec_score_forward` flattens the window into
+   the batch axis, so each window row appends its own K/V page write
+   and attends causally over the pool through the existing ragged
+   paged-attention seam — no new attention math, no new scatter.
+3. **Accept/reject.**  Sampling is deterministic Gumbel-max on
+   ``fold_in(seed, position)`` keys (``sampling.py``), so the target's
+   "own" token at every position is a pure function of (prefix
+   logits, seed, position).  A proposal is accepted iff it EQUALS the
+   target's choice at that position; the first mismatch emits the
+   target's verified token instead and the window ends.  The emitted
+   sequence is therefore token-IDENTICAL to what sequential
+   non-speculative decoding would produce — greedy and seeded
+   sampling alike — whatever the draft proposed.  Rejection sampling
+   composes with the PR-14 machinery trivially because the
+   Gumbel-max draw IS the target distribution sample; determinism and
+   join/leave invariance carry over unchanged.
+
+Accepted prefixes need no commit step: the verify forward already
+wrote the target K/V for every window position through the same
+page-write scatter the plain decode step uses, and positions beyond
+the accepted prefix are masked by length in every later read (the
+page-padding argument, DESIGN-SERVING.md §Exactness).
+
+Rejected-position emissions are :data:`SPEC_SENTINEL` so the host can
+push a fixed ``k+1`` lazy views per dispatch without syncing on the
+accept count; real lengths ride the loop device-resident and
+reconcile at the engine's one whitelisted poll.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .decode_model import decode_forward, spec_score_forward
+from .sampling import sample_tokens, sample_tokens_grid
+
+#: emitted-token placeholder for positions past the accepted prefix —
+#: never a valid vocab id, stripped host-side at finalize/stream
+SPEC_SENTINEL = -1
+
+
+def spec_decode_step(params, draft_params, cfg, k, pool, page_table,
+                     lengths, tokens, active, temps, topks, topps,
+                     seeds, attention="gather"):
+    """One speculative window for the whole batch, fully in-program.
+
+    ``lengths``/``tokens``/``active`` as in
+    :func:`~.decode_model.decode_forward`; ``k`` is a static trace
+    constant (the draft loop unrolls).  Returns ``(pool, emit
+    [B, k+1], last [B], n_emit [B])`` where ``emit`` holds the
+    accepted prefix plus the verified correction/bonus token
+    (:data:`SPEC_SENTINEL` beyond it), ``last`` is the final emitted
+    token per row (the next dispatch's input), and ``n_emit`` is the
+    number of real tokens emitted (0 for inactive rows).
+    """
+    B = tokens.shape[0]
+    S = k + 1
+    # -- 1) draft proposal loop: k sequential forwards on the shared
+    # pool.  Proposals use the SAME (seed, position) keys as the
+    # target, so a self-draft agrees with the verify pass exactly and
+    # the accept rate is 1 by construction.
+    props = []
+    d_tok, d_len = tokens, lengths
+    for _ in range(k):
+        pool, d_logits = decode_forward(draft_params, cfg, pool,
+                                        page_table, d_len, d_tok,
+                                        active, attention=attention)
+        d_tok = sample_tokens(d_logits, temps, topks, topps, seeds,
+                              d_len + 1)
+        props.append(d_tok)
+        d_len = d_len + 1
+    props = jnp.stack(props, axis=1)                       # [B, k]
+    window = jnp.concatenate([tokens[:, None], props], axis=1)
+    # -- 2) verify: target scores all k+1 positions in ONE forward,
+    # overwriting the draft's interim K/V with the target's own
+    pool, logits = spec_score_forward(params, cfg, pool, page_table,
+                                      lengths, window, active,
+                                      attention=attention)
+    # -- 3) the target's deterministic choice at every window
+    # position: fold_in(seed, position) keys, position = the sampled
+    # token's sequence index, exactly as the plain decode step
+    offs = jnp.arange(S, dtype=jnp.int32)
+    positions = lengths[:, None] + 1 + offs[None]          # [B, S]
+    choices = sample_tokens_grid(logits, temps, topks, topps, seeds,
+                                 positions)                # [B, S]
+    # -- 4) accept the longest proposal prefix that matches the
+    # target's own choices; slot a = first mismatch emits the
+    # verified token (a == k emits the bonus token)
+    match = (props == choices[:, :k]).astype(jnp.int32)    # [B, k]
+    acc = jnp.cumprod(match, axis=1).sum(axis=1)           # [B]
+    valid = (offs[None] <= acc[:, None]) & active[:, None]
+    emit = jnp.where(valid, choices, jnp.int32(SPEC_SENTINEL))
+    last = jnp.take_along_axis(choices, acc[:, None], axis=1)[:, 0]
+    last = jnp.where(active, last, tokens)
+    n_emit = jnp.where(active, acc + 1, 0).astype(jnp.int32)
+    return pool, emit, last, n_emit

@@ -71,6 +71,11 @@ HOT_MODULES = [
     # disagg router runs its transition hook on prefill pump threads
     os.path.join("inference", "serving", "migration.py"),
     os.path.join("inference", "serving", "disagg.py"),
+    # speculative tier (DESIGN-SERVING.md §Speculative tier): the
+    # draft/verify/accept-reject window traces INSIDE the compiled
+    # decode step — acceptance counting on the host would sync every
+    # dispatch and erase the whole multi-token win
+    os.path.join("inference", "serving", "spec_decode.py"),
     # observability subsystem (DESIGN-OBSERVABILITY.md): it lives
     # INSIDE every hot loop above, so it is held to the same contract
     # — instruments hold lazy device values and defer the sync to
@@ -156,9 +161,11 @@ ALLOWED_SYNC = {
     ("io", "dataloader.py", "default_collate_fn"):
         "collates host sample arrays produced by the dataset",
     ("inference", "serving", "engine.py", "_poll_done"):
-        "THE group-boundary sync of the decode loop: one [B] bool "
-        "done-mask fetch every done_poll_interval dispatches, never "
-        "inside one (DESIGN-SERVING.md §EOS)",
+        "THE group-boundary sync of the decode loop: one fetch every "
+        "done_poll_interval dispatches, never inside one — [B] bool "
+        "done mask classically; widened to the (done, lengths, gen) "
+        "triple under speculative decoding, still one device_get at "
+        "the same cadence (DESIGN-SERVING.md §EOS, §Speculative tier)",
     ("inference", "serving", "engine.py", "_warmup"):
         "AOT compile timing before traffic cuts over — blocking on "
         "device completion is the point (cold-start metric; `warmup` "

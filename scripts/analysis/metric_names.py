@@ -10,8 +10,8 @@ Enforced at the AST level over every production module:
   dimension instead).
 - **Shape:** snake_case, ``^[a-z][a-z0-9_]*[a-z0-9]$``, no ``__``.
 - **Counters end in ``_total``**; **histograms end in a unit suffix**
-  (``_s``, ``_ms``, ``_bytes``, ``_pct``, ``_ratio``); **gauges never
-  end in ``_total``**.
+  (``_s``, ``_ms``, ``_bytes``, ``_pct``, ``_ratio``,
+  ``_per_dispatch``); **gauges never end in ``_total``**.
 
 Receiver heuristic (syntactic): ``registry().counter(...)``,
 ``reg.counter(...)`` or ``self._reg.counter(...)``.  The check fails
@@ -33,7 +33,8 @@ REPORT_HEADER = "metric-name violations:"
 
 KINDS = ("counter", "gauge", "histogram")
 NAME_RE = re.compile(r"^[a-z][a-z0-9_]*[a-z0-9]$")
-UNIT_SUFFIXES = ("_s", "_ms", "_bytes", "_pct", "_ratio")
+UNIT_SUFFIXES = ("_s", "_ms", "_bytes", "_pct", "_ratio",
+                 "_per_dispatch")
 
 # fewer literal call sites than this means the receiver heuristic
 # stopped matching the codebase idiom — fail loudly, not silently

@@ -423,6 +423,59 @@ def test_drain_rate_relief_never_discounts_slo_and_defaults_off():
     r2.close()
 
 
+def test_predictive_scale_up_arms_on_queue_rise_before_the_level():
+    """ISSUE 19 policy pin: with ``predictive_scale_rate`` armed, a
+    queue RISING faster than the rate (per replica, per round) is
+    overload evidence while the sampled depth is still far below
+    ``scale_up_queue_depth`` — capacity spins up on the ramp, not the
+    cliff.  Hysteresis still applies: one steep sample never scales."""
+    router, made = _stub_router(n=1, max_replicas=3, windows_up=2,
+                                scale_up_queue_depth=1e9,
+                                predictive_scale_rate=2.0)
+    try:
+        made[0].set_load(queue=3)      # first sample: no baseline
+        sig = router.control_round()
+        assert sig["queue_delta"] == 0 and sig["decision"] == "hold"
+        made[0].set_load(queue=6)      # +3/round >= 2.0: streak 1
+        sig = router.control_round()
+        assert sig["queue_delta"] == 3 and sig["decision"] == "hold"
+        made[0].set_load(queue=9)      # streak 2: spawn
+        assert router.control_round()["decision"] == "scale_up"
+        assert router.num_replicas == 2
+        # a rising queue also blocks the idle half of the policy: the
+        # shallow absolute depth must not retire the fresh replica
+        made[0].set_load(queue=14)
+        for _ in range(12):
+            assert router.control_round()["decision"] != "scale_down"
+            made[0].set_load(queue=made[0].engine.scheduler
+                             .queue_depth + 5)
+    finally:
+        router.close()
+
+
+def test_predictive_scale_up_defaults_off_and_rides_config():
+    # the same ramp with the knob at its 0.0 default is invisible:
+    # the level-only policy is bit-identical to before
+    router, made = _stub_router(n=1, max_replicas=3, windows_up=2,
+                                scale_up_queue_depth=1e9)
+    try:
+        for q in (3, 6, 9, 12):
+            made[0].set_load(queue=q)
+            assert router.control_round()["decision"] == "hold"
+        assert router.num_replicas == 1
+    finally:
+        router.close()
+    # the knob rides the config surface like every other policy knob
+    router, _ = _stub_router(n=1, predictive_scale_rate=1.5)
+    cfg = router.to_config()
+    router.close()
+    assert cfg["predictive_scale_rate"] == 1.5
+    r2 = ServingRouter.from_config(cfg, lambda: _StubServer(),
+                                   decision_interval_s=0)
+    assert r2.predictive_scale_rate == 1.5
+    r2.close()
+
+
 # ---------------------------------------------------------------------------
 # windowed p99: cumulative-histogram diff math
 # ---------------------------------------------------------------------------

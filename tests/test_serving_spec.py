@@ -1,0 +1,434 @@
+"""Speculative decoding tests (ISSUE 19): draft/verify/accept-reject
+inside the ONE compiled decode program.
+
+Exactness contract under test (DESIGN-SERVING.md §Speculative tier):
+a proposal is accepted only when it EQUALS the target's own
+deterministic sampling choice at that position, so the emitted
+sequence is token-identical to the sequential oracle — under greedy
+AND under seeded sampling, for ANY draft (a bad draft only lowers the
+accept rate, never changes a token).  The single-trace pin, the
+k-page admission envelope, and composition with the prefix cache /
+chunked prefill / disaggregation all ride along.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+# retrace sentinel armed module-wide: any trace of a single-trace
+# compiled entry after its first dispatch raises, making every
+# recompile pin in here an ambient property
+pytestmark = pytest.mark.usefixtures("retrace_strict")
+
+import jax  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_persistent_compile_cache():
+    """This jaxlib's CPU client segfaults inside
+    ``backend.deserialize_executable`` when, late in a full-suite run,
+    a compile in this module hits a persistent-cache entry written
+    earlier in the same process (observed deterministically at
+    sample_tokens' lax.cond with a cold cache dir, so it is not a
+    corrupt entry — it is the deserialize path itself).  Compile these
+    tests fresh; the module's programs are tiny and the rest of the
+    suite keeps the conftest cache."""
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    yield
+    jax.config.update("jax_enable_compilation_cache", old)
+
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.inference.serving import (
+    DecodeEngine, LLMServer, SPEC_SENTINEL, ServingModelConfig,
+    extract_decode_params, filter_spec_stream, reference_decode)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def nets():
+    """(target, adversarial draft, gpt config): same geometry,
+    different weights — the draft proposes near-garbage, which is
+    exactly what the exactness contract must shrug off."""
+    paddle.seed(0)
+    cfg = gpt_tiny(use_flash_attention=False)
+    target = GPTForCausalLM(cfg)
+    target.eval()
+    paddle.seed(7)
+    adversary = GPTForCausalLM(cfg)
+    adversary.eval()
+    return target, adversary, cfg
+
+
+def _oracle(net, cfg):
+    params = extract_decode_params(net)
+    scfg = ServingModelConfig.from_gpt_config(cfg)
+
+    def ref(prompt, n, **kw):
+        toks, _ = reference_decode(params, scfg, prompt, n, **kw)
+        return [int(t) for t in toks]
+    return ref
+
+
+# ---------------------------------------------------------------------------
+# exactness: greedy and seeded, self-draft and adversarial
+# ---------------------------------------------------------------------------
+def test_spec_greedy_token_identity_vs_oracle(nets):
+    """THE acceptance pin: mixed-length speculative decode (self-draft,
+    accept ≈ 1) = per-request sequential dense decode, token for
+    token — including a request whose max_tokens truncates inside a
+    speculative window."""
+    net, _, cfg = nets
+    ref = _oracle(net, cfg)
+    eng = DecodeEngine(net, max_batch=4, block_size=8, num_blocks=64,
+                       draft=net, spec_k=4)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+               for n in (5, 11, 3, 17)]
+    lens = (12, 3, 5, 9)               # 3 lands mid-window at k=4
+    futs = [eng.submit(p, max_tokens=n).future
+            for p, n in zip(prompts, lens)]
+    eng.run_until_idle()
+    for p, n, f in zip(prompts, lens, futs):
+        got = f.result(timeout=0).tokens
+        assert got == ref(p, n)
+    assert eng.compile_stats()["decode_traces"] == 1
+    st = eng._kv.allocator.stats()
+    assert st["allocated"] == 0 and st["reserved"] == 0
+    spec = eng.stats()["spec"]
+    assert spec["k"] == 4 and spec["dispatches"] > 0
+    assert 0.0 <= spec["accept_rate"] <= 1.0
+
+
+def test_spec_adversarial_draft_still_token_exact(nets):
+    """A draft with unrelated weights proposes mostly-rejected tokens:
+    throughput degrades toward one token per dispatch, correctness
+    does not budge."""
+    net, adversary, cfg = nets
+    ref = _oracle(net, cfg)
+    eng = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       draft=adversary, spec_k=4)
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+               for n in (6, 13)]
+    futs = [eng.submit(p, max_tokens=10).future for p in prompts]
+    eng.run_until_idle()
+    for p, f in zip(prompts, futs):
+        assert f.result(timeout=0).tokens == ref(p, 10)
+    assert eng.compile_stats()["decode_traces"] == 1
+    # rejections never commit look-ahead writes: pool fully reclaimed
+    st = eng._kv.allocator.stats()
+    assert st["allocated"] == 0 and st["reserved"] == 0
+
+
+def test_spec_seeded_sampling_matches_oracle(nets):
+    """Distribution-exactness pin: seeded sampled requests reproduce
+    the sequential oracle token for token THROUGH the speculative
+    window (same ``fold_in(seed, position)`` keys, and the accept rule
+    compares against the target's own sampled choice) — with a
+    self-draft and with an adversarial draft."""
+    net, adversary, cfg = nets
+    ref = _oracle(net, cfg)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(0, cfg.vocab_size, (9,)).tolist()
+    kw = dict(temperature=0.9, top_k=12, top_p=0.85, seed=42)
+    want = ref(prompt, 11, **kw)
+    for draft in (net, adversary):
+        eng = DecodeEngine(net, max_batch=2, block_size=8,
+                           num_blocks=64, draft=draft, spec_k=4)
+        fut = eng.submit(prompt, max_tokens=11, **kw).future
+        eng.run_until_idle()
+        assert fut.result(timeout=0).tokens == want
+
+
+def test_spec_mixed_greedy_and_sampled_batch(nets):
+    """Greedy and sampled requests share one speculative batch (the
+    sampling vectors are [B] data): each matches its own oracle."""
+    net, _, cfg = nets
+    ref = _oracle(net, cfg)
+    eng = DecodeEngine(net, max_batch=3, block_size=8, num_blocks=64,
+                       draft=net, spec_k=3)
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+               for n in (4, 8, 6)]
+    kws = [dict(), dict(temperature=1.1, seed=5),
+           dict(temperature=0.7, top_k=8, seed=9)]
+    futs = [eng.submit(p, max_tokens=7, **kw).future
+            for p, kw in zip(prompts, kws)]
+    eng.run_until_idle()
+    for p, kw, f in zip(prompts, kws, futs):
+        assert f.result(timeout=0).tokens == ref(p, 7, **kw)
+    assert eng.compile_stats()["decode_traces"] == 1
+
+
+def test_spec_eos_truncates_mid_window(nets):
+    """EOS emitted inside a speculative window: the result truncates
+    at (and includes) eos, and the device-side done mask frees the
+    slot before max_tokens."""
+    net, _, cfg = nets
+    prompt = list(range(3, 9))
+    ref = _oracle(net, cfg)
+    toks = ref(prompt, 10)
+    eos = toks[4]
+    cut = toks.index(eos)
+    eng = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=64,
+                       draft=net, spec_k=4, eos_id=eos,
+                       done_poll_interval=2)
+    fut = eng.submit(prompt, 10).future
+    eng.run_until_idle()
+    got = fut.result(timeout=0).tokens
+    assert got == toks[:cut + 1] and got[-1] == eos
+    assert eng.active_count == 0
+
+
+# ---------------------------------------------------------------------------
+# continuous batching: join/leave, single trace
+# ---------------------------------------------------------------------------
+def test_spec_join_leave_across_groups_zero_recompiles(nets):
+    net, _, cfg = nets
+    ref = _oracle(net, cfg)
+    eng = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       draft=net, spec_k=4)
+    rng = np.random.RandomState(5)
+
+    def run_some(n):
+        for _ in range(n):
+            if not eng.step():
+                break
+
+    p1 = rng.randint(0, 256, (5,)).tolist()
+    p2 = rng.randint(0, 256, (9,)).tolist()
+    f1 = eng.submit(p1, 4).future
+    f2 = eng.submit(p2, 10).future
+    run_some(4)
+    assert eng.compile_stats()["decode_traces"] == 1
+    p3 = rng.randint(0, 256, (12,)).tolist()
+    f3 = eng.submit(p3, 6).future
+    p4 = rng.randint(0, 256, (3,)).tolist()
+    f4 = eng.submit(p4, 8).future
+    eng.run_until_idle()
+    for p, n, f in ((p1, 4, f1), (p2, 10, f2), (p3, 6, f3),
+                    (p4, 8, f4)):
+        assert f.result(timeout=0).tokens == ref(p, n)
+    assert eng.compile_stats()["decode_traces"] == 1
+    st = eng._kv.allocator.stats()
+    assert st["allocated"] == 0 and st["reserved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# k-page admission envelope
+# ---------------------------------------------------------------------------
+def test_spec_admission_reserves_k_lookahead(nets):
+    """The worst-case envelope grows by k look-ahead positions: a
+    request the classic engine admits at the pool boundary is refused
+    by the speculative door (its uncommitted window writes could
+    outrun the allocation)."""
+    net, _, cfg = nets
+    prompt = list(range(1, 9))                    # 8 tokens
+    # need = 8 + 9 - 1 = 16 positions = 2 blocks: exactly capacity
+    plain = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=3)
+    plain.submit(prompt, 9)
+    spec = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=3,
+                        draft=net, spec_k=4)
+    assert spec.scheduler.lookahead == 4
+    with pytest.raises(ValueError):
+        spec.submit(prompt, 9)                    # 16 + 4 > 2 blocks
+
+
+def test_spec_no_oom_under_rejection_churn(nets):
+    """Adversarial draft on a tight pool: maximum rejection churn
+    (every window re-writes look-ahead positions that never commit)
+    crosses block boundaries for many requests without ever taking a
+    hot-loop allocation failure, and the pool drains clean."""
+    net, adversary, cfg = nets
+    ref = _oracle(net, cfg)
+    eng = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=12,
+                       draft=adversary, spec_k=4, max_queue=8)
+    rng = np.random.RandomState(6)
+    jobs = []
+    for n in (5, 11, 7, 3):
+        p = rng.randint(0, cfg.vocab_size, (n,)).tolist()
+        jobs.append((p, eng.submit(p, max_tokens=9).future))
+    eng.run_until_idle()
+    for p, f in jobs:
+        assert f.result(timeout=0).tokens == ref(p, 9)
+    st = eng._kv.allocator.stats()
+    assert st["allocated"] == 0 and st["reserved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# composition: prefix cache, chunked prefill, disaggregation
+# ---------------------------------------------------------------------------
+def test_spec_composes_with_prefix_cache_and_chunked_prefill(nets):
+    net, _, cfg = nets
+    ref = _oracle(net, cfg)
+    eng = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       draft=net, spec_k=4, prefix_cache=True,
+                       prefill_chunk=8)
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, cfg.vocab_size, (16,)).tolist()
+    a = shared + rng.randint(0, cfg.vocab_size, (5,)).tolist()
+    b = shared + rng.randint(0, cfg.vocab_size, (3,)).tolist()
+    fa = eng.submit(a, max_tokens=8).future
+    eng.run_until_idle()
+    fb = eng.submit(b, max_tokens=8).future       # prefix now cached
+    eng.run_until_idle()
+    assert fa.result(timeout=0).tokens == ref(a, 8)
+    assert fb.result(timeout=0).tokens == ref(b, 8)
+    assert eng._prefix.stats()["hits"] > 0
+    assert eng.compile_stats()["decode_traces"] == 1
+
+
+def test_spec_composes_with_disagg_handoff(nets):
+    """Prefill-role replica (no draft — speculation lives with the
+    decode program) hands a migrated request to a speculative
+    decode-role replica: token-exact end to end."""
+    net, _, cfg = nets
+    ref = _oracle(net, cfg)
+    pre = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="prefill")
+    dec = DecodeEngine(net, max_batch=2, block_size=8, num_blocks=64,
+                       role="decode", draft=net, spec_k=4)
+    rng = np.random.RandomState(8)
+    prompt = rng.randint(0, cfg.vocab_size, (11,)).tolist()
+    fut = pre.submit(prompt, max_tokens=9).future
+    for _ in range(200):
+        busy = pre.step()
+        for mig in pre.pop_ready_migrations():
+            dec.submit_migration(mig)
+        if not busy:
+            break
+    dec.run_until_idle()
+    assert fut.result(timeout=0).tokens == ref(prompt, 9)
+    for e in (pre, dec):
+        st = e._kv.allocator.stats()
+        assert st["allocated"] == 0 and st["reserved"] == 0
+
+
+# ---------------------------------------------------------------------------
+# stream-out: sentinel contract
+# ---------------------------------------------------------------------------
+def test_filter_spec_stream_drops_sentinels_and_renumbers():
+    seen = []
+    wrapped = filter_spec_stream(
+        lambda rid, idx, tok: seen.append((rid, idx, tok)),
+        max_tokens=4)
+    feed = [3, SPEC_SENTINEL, 5, SPEC_SENTINEL, SPEC_SENTINEL,
+            7, 9, 11]                      # 11 overshoots max_tokens
+    for i, t in enumerate(feed):
+        wrapped(1, i, t)
+    assert seen == [(1, 0, 3), (1, 1, 5), (1, 2, 7), (1, 3, 9)]
+
+
+def test_spec_stream_matches_result(nets):
+    """End-to-end lazy stream through the filter: dense in-order
+    indices, no sentinels, token values equal to the final result."""
+    net, _, cfg = nets
+    eng = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=64,
+                       draft=net, spec_k=4)
+    got = []
+    cb = filter_spec_stream(
+        lambda rid, idx, tok: got.append((idx, tok)), max_tokens=9)
+    fut = eng.submit(list(range(2, 9)), max_tokens=9,
+                     stream_cb=cb).future
+    eng.run_until_idle()
+    toks = fut.result(timeout=0).tokens
+    assert [i for i, _ in got] == list(range(len(toks)))
+    assert [t for _, t in got] == toks
+    assert SPEC_SENTINEL not in toks
+
+
+# ---------------------------------------------------------------------------
+# configuration surface and refusals
+# ---------------------------------------------------------------------------
+def test_spec_refusals(nets):
+    net, _, cfg = nets
+    with pytest.raises(ValueError, match="prefill-role"):
+        DecodeEngine(net, role="prefill", draft=net)
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(net, spec_k=4)           # no proposal model
+    with pytest.raises(ValueError, match="spec_k"):
+        DecodeEngine(net, draft=net, spec_k=0)
+    paddle.seed(11)
+    other = GPTForCausalLM(gpt_tiny(use_flash_attention=False,
+                                    num_hidden_layers=1))
+    other.eval()
+    with pytest.raises(ValueError, match="geometry"):
+        DecodeEngine(net, draft=other)
+
+
+def test_spec_k_env_knob(nets, monkeypatch):
+    net, _, cfg = nets
+    monkeypatch.setenv("PADDLE_TPU_SPEC_K", "2")
+    eng = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=32,
+                       draft=net)
+    assert eng.spec_k == 2
+    plain = DecodeEngine(net, max_batch=1, block_size=8,
+                         num_blocks=32)
+    assert plain.spec_k == 0                  # knob alone never arms
+
+
+def test_spec_metrics_registered(nets):
+    net, _, cfg = nets
+    eng = DecodeEngine(net, max_batch=1, block_size=8, num_blocks=64,
+                       draft=net, spec_k=4)
+    fut = eng.submit(list(range(1, 7)), max_tokens=8).future
+    eng.run_until_idle()
+    assert fut.result(timeout=0)
+    assert eng._c_spec_dispatches.collect() > 0
+    assert eng._h_spec_tpd.collect()["count"] > 0
+    from paddle_tpu import observability as obs
+    text = obs.scrape_prometheus()
+    for name in ("serving_spec_dispatches_total",
+                 "serving_spec_tokens_per_dispatch",
+                 "serving_spec_accept_rate"):
+        assert name in text
+
+
+# ---------------------------------------------------------------------------
+# multi-process end to end
+# ---------------------------------------------------------------------------
+_E2E = """
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+from paddle_tpu.inference.serving import (LLMServer,
+                                          extract_decode_params,
+                                          reference_decode,
+                                          ServingModelConfig)
+paddle.seed(0)
+cfg = gpt_tiny(use_flash_attention=False)
+net = GPTForCausalLM(cfg); net.eval()
+paddle.seed(7)
+draft = GPTForCausalLM(cfg); draft.eval()
+srv = LLMServer(net, max_batch=2, block_size=8, num_blocks=64,
+                draft=draft, spec_k=4, auto_start=False)
+srv.warmup([8]); srv.start()
+rng = np.random.RandomState(1)
+prompts = [rng.randint(0, cfg.vocab_size, (n,)).tolist()
+           for n in (6, 14)]
+res = [srv.submit(p, 10).result(timeout=240) for p in prompts]
+srv.close()
+params = extract_decode_params(net)
+scfg = ServingModelConfig.from_gpt_config(cfg)
+for p, r in zip(prompts, res):
+    ref, _ = reference_decode(params, scfg, p, 10)
+    assert r.tokens == [int(t) for t in ref], (p, r.tokens)
+print("SPEC-E2E-OK")
+"""
+
+
+@pytest.mark.slow
+def test_spec_server_multiprocess_e2e():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", _E2E], env=env,
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "SPEC-E2E-OK" in r.stdout
